@@ -1,0 +1,15 @@
+"""dynamo_tpu — a TPU-native distributed LLM inference-serving framework.
+
+Provides the serving fabric (discovery, routing, disaggregation, KV-cache
+management, autoscaling) of a Dynamo-class system plus a native JAX/XLA/pallas
+engine with first-class TP/PP/EP sharding over TPU meshes.
+
+Reference capability map: see SURVEY.md at the repo root. The reference system
+(NVIDIA Dynamo, mounted read-only) is Rust/CUDA; this package is a ground-up
+TPU-first redesign, not a port.
+"""
+
+__version__ = "0.1.0"
+
+from dynamo_tpu.runtime.cancellation import CancellationToken  # noqa: F401
+from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: F401

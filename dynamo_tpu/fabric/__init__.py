@@ -1,0 +1,17 @@
+"""The fabric: discovery + messaging control plane for dynamo_tpu.
+
+One subsystem plays the role both of etcd (kv store with leases, CAS, prefix
+watches — reference lib/runtime/src/transports/etcd.rs) and of NATS (subject
+pub/sub with queue groups, JetStream-style work queues, object store —
+reference lib/runtime/src/transports/nats.rs).
+
+Three deployment shapes, one client API (`FabricClient`):
+  * in-process  — a process-local `FabricState` (reference "static mode",
+    DistributedRuntime::from_settings_without_discovery)
+  * remote      — TCP connection to a `FabricServer` (msgpack-framed)
+  * the server  — `python -m dynamo_tpu.fabric.server --port 6650`
+"""
+
+from dynamo_tpu.fabric.state import FabricState, WatchEvent, KVEntry  # noqa: F401
+from dynamo_tpu.fabric.client import FabricClient  # noqa: F401
+from dynamo_tpu.fabric.server import FabricServer  # noqa: F401

@@ -1,0 +1,410 @@
+"""Unified fabric client: identical async API in-process or over TCP.
+
+In-process mode wraps a process-local FabricState (the reference's "static
+mode" / in-memory KeyValueStore, lib/runtime/src/storage/key_value_store/mem.rs
++ distributed.rs:113); remote mode speaks the wire protocol to a FabricServer.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.fabric import wire
+from dynamo_tpu.fabric.state import FabricState, WatchEvent
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.fabric.client")
+
+# Process-local fabric shared by all in-process clients, so that several
+# DistributedRuntimes in one process (e.g. tests, single-process serving)
+# discover each other without a server.
+_SHARED_STATE: Optional[FabricState] = None
+
+
+def shared_state() -> FabricState:
+    global _SHARED_STATE
+    if _SHARED_STATE is None:
+        _SHARED_STATE = FabricState()
+    return _SHARED_STATE
+
+
+def reset_shared_state() -> None:
+    global _SHARED_STATE
+    _SHARED_STATE = None
+
+
+class Watch:
+    """Async iterator of WatchEvents for a key prefix, with initial snapshot."""
+
+    def __init__(self, initial: list[WatchEvent], cancel_fn) -> None:
+        self.initial = initial
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._cancel_fn = cancel_fn
+        self._done = False
+
+    def _feed(self, ev: Optional[WatchEvent]) -> None:
+        self._queue.put_nowait(ev)
+
+    def __aiter__(self) -> "Watch":
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        if self._done:
+            raise StopAsyncIteration
+        ev = await self._queue.get()
+        if ev is None:
+            self._done = True
+            raise StopAsyncIteration
+        return ev
+
+    async def cancel(self) -> None:
+        if not self._done:
+            await self._cancel_fn()
+            self._feed(None)
+
+
+class Subscription:
+    """Async iterator of (subject, payload) messages."""
+
+    def __init__(self, cancel_fn) -> None:
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._cancel_fn = cancel_fn
+        self._done = False
+
+    def _feed(self, item: Optional[tuple[str, bytes]]) -> None:
+        self._queue.put_nowait(item)
+
+    def __aiter__(self) -> "Subscription":
+        return self
+
+    async def __anext__(self) -> tuple[str, bytes]:
+        if self._done:
+            raise StopAsyncIteration
+        item = await self._queue.get()
+        if item is None:
+            self._done = True
+            raise StopAsyncIteration
+        return item
+
+    async def next(self, timeout: Optional[float] = None) -> Optional[tuple[str, bytes]]:
+        try:
+            return await asyncio.wait_for(self.__anext__(), timeout)
+        except (asyncio.TimeoutError, StopAsyncIteration):
+            return None
+
+    async def unsubscribe(self) -> None:
+        if not self._done:
+            await self._cancel_fn()
+            self._feed(None)
+
+
+class FabricClient:
+    """Async fabric API. Construct via `in_process()` or `connect(addr)`."""
+
+    def __init__(self) -> None:
+        self._state: Optional[FabricState] = None  # in-process mode
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._streams: dict[int, Any] = {}  # stream_id -> Watch|Subscription
+        self._stream_kind: dict[int, str] = {}
+        self._req_ids = itertools.count(1)
+        self._read_task: Optional[asyncio.Task] = None
+        self._pump_tasks: set[asyncio.Task] = set()
+        self._inproc_watches: set[int] = set()
+        self._inproc_subs: set[int] = set()
+        self._write_lock = asyncio.Lock()
+        self.addr: str = ""
+
+    # ------------------------------------------------------- construction
+
+    @classmethod
+    def in_process(cls, state: Optional[FabricState] = None) -> "FabricClient":
+        c = cls()
+        c._state = state if state is not None else shared_state()
+        return c
+
+    @classmethod
+    async def connect(cls, addr: str) -> "FabricClient":
+        c = cls()
+        host, _, port = addr.rpartition(":")
+        c._reader, c._writer = await asyncio.open_connection(host, int(port))
+        c.addr = addr
+        c._read_task = asyncio.get_running_loop().create_task(c._read_loop())
+        return c
+
+    @property
+    def is_remote(self) -> bool:
+        return self._state is None
+
+    def _track_pump(self, coro) -> None:
+        task = asyncio.get_running_loop().create_task(coro)
+        self._pump_tasks.add(task)
+        task.add_done_callback(self._pump_tasks.discard)
+
+    def _ensure_started(self) -> None:
+        if self._state is not None:
+            self._state.start()
+
+    async def close(self) -> None:
+        if self._read_task:
+            self._read_task.cancel()
+        for t in list(self._pump_tasks):
+            t.cancel()
+        if self._state is not None:
+            # unregister in-process watches/subs from the (possibly shared)
+            # FabricState so its event queues don't accumulate forever
+            for wid in list(self._inproc_watches):
+                self._state.watch_cancel(wid)
+            for sid in list(self._inproc_subs):
+                self._state.unsubscribe(sid)
+            self._inproc_watches.clear()
+            self._inproc_subs.clear()
+        if self._writer:
+            with contextlib.suppress(Exception):
+                self._writer.close()
+                await self._writer.wait_closed()
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionError("fabric client closed"))
+        self._pending.clear()
+
+    # ------------------------------------------------------------- remote
+
+    async def _read_loop(self) -> None:
+        assert self._reader is not None
+        try:
+            while True:
+                msg = await wire.read_frame(self._reader)
+                req_id = msg[0]
+                if req_id == 0:  # push
+                    _, _, stream_id, payload = msg
+                    target = self._streams.get(stream_id)
+                    if target is None:
+                        continue
+                    kind = self._stream_kind[stream_id]
+                    if payload is None:
+                        target._feed(None)
+                        self._streams.pop(stream_id, None)
+                        self._stream_kind.pop(stream_id, None)
+                    elif kind == "watch":
+                        target._feed(WatchEvent.from_wire(payload))
+                    else:
+                        target._feed((payload[0], payload[1]))
+                else:
+                    fut = self._pending.pop(req_id, None)
+                    if fut is None or fut.done():
+                        continue
+                    if msg[1] == "ok":
+                        fut.set_result(msg[2])
+                    else:
+                        fut.set_exception(RuntimeError(msg[2]))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("fabric connection lost"))
+            self._pending.clear()
+            for sid, target in list(self._streams.items()):
+                target._feed(None)
+            self._streams.clear()
+            self._stream_kind.clear()
+
+    async def _call(self, op: str, **kwargs: Any) -> Any:
+        assert self._writer is not None, "client not connected"
+        req_id = next(self._req_ids)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[req_id] = fut
+        async with self._write_lock:
+            self._writer.write(wire.pack([req_id, op, kwargs]))
+            await self._writer.drain()
+        return await fut
+
+    # ------------------------------------------------------------- leases
+
+    async def lease_grant(self, ttl: float) -> int:
+        if self._state is not None:
+            self._ensure_started()
+            return self._state.lease_grant(ttl)
+        return await self._call("lease_grant", ttl=ttl)
+
+    async def lease_keepalive(self, lease_id: int) -> bool:
+        if self._state is not None:
+            return self._state.lease_keepalive(lease_id)
+        return await self._call("lease_keepalive", lease_id=lease_id)
+
+    async def lease_revoke(self, lease_id: int) -> None:
+        if self._state is not None:
+            self._state.lease_revoke(lease_id)
+            return
+        await self._call("lease_revoke", lease_id=lease_id)
+
+    # ----------------------------------------------------------------- kv
+
+    async def kv_put(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        if self._state is not None:
+            return self._state.kv_put(key, value, lease_id)
+        return await self._call("kv_put", key=key, value=value, lease_id=lease_id)
+
+    async def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        if self._state is not None:
+            return self._state.kv_create(key, value, lease_id)
+        return await self._call("kv_create", key=key, value=value, lease_id=lease_id)
+
+    async def kv_get(self, key: str) -> Optional[bytes]:
+        if self._state is not None:
+            e = self._state.kv_get(key)
+            return None if e is None else e.value
+        return await self._call("kv_get", key=key)
+
+    async def kv_get_prefix(self, prefix: str) -> dict[str, bytes]:
+        if self._state is not None:
+            return {
+                k: e.value for k, e in self._state.kv_get_prefix(prefix).items()
+            }
+        return await self._call("kv_get_prefix", prefix=prefix)
+
+    async def kv_delete(self, key: str) -> bool:
+        if self._state is not None:
+            return self._state.kv_delete(key)
+        return await self._call("kv_delete", key=key)
+
+    async def kv_delete_prefix(self, prefix: str) -> int:
+        if self._state is not None:
+            return self._state.kv_delete_prefix(prefix)
+        return await self._call("kv_delete_prefix", prefix=prefix)
+
+    # -------------------------------------------------------------- watch
+
+    async def watch_prefix(self, prefix: str) -> Watch:
+        if self._state is not None:
+            self._ensure_started()
+            wid, snapshot, q = self._state.watch_create(prefix)
+            self._inproc_watches.add(wid)
+
+            async def cancel() -> None:
+                self._inproc_watches.discard(wid)
+                self._state.watch_cancel(wid)
+
+            watch = Watch(snapshot, cancel)
+
+            async def pump() -> None:
+                with contextlib.suppress(asyncio.CancelledError):
+                    while True:
+                        ev = await q.get()
+                        watch._feed(ev)
+                        if ev is None:
+                            self._inproc_watches.discard(wid)
+                            return
+
+            self._track_pump(pump())
+            return watch
+
+        wid, snapshot_wire = await self._call("watch_create", prefix=prefix)
+
+        async def cancel_remote() -> None:
+            self._streams.pop(wid, None)
+            self._stream_kind.pop(wid, None)
+            with contextlib.suppress(Exception):
+                await self._call("watch_cancel", watch_id=wid)
+
+        watch = Watch([WatchEvent.from_wire(d) for d in snapshot_wire], cancel_remote)
+        self._streams[wid] = watch
+        self._stream_kind[wid] = "watch"
+        return watch
+
+    # ------------------------------------------------------------ pub/sub
+
+    async def subscribe(self, subject: str, group: str = "") -> Subscription:
+        if self._state is not None:
+            self._ensure_started()
+            sid, q = self._state.subscribe(subject, group)
+            self._inproc_subs.add(sid)
+
+            async def cancel() -> None:
+                self._inproc_subs.discard(sid)
+                self._state.unsubscribe(sid)
+
+            sub = Subscription(cancel)
+
+            async def pump() -> None:
+                with contextlib.suppress(asyncio.CancelledError):
+                    while True:
+                        item = await q.get()
+                        sub._feed(item)
+                        if item is None:
+                            self._inproc_subs.discard(sid)
+                            return
+
+            self._track_pump(pump())
+            return sub
+
+        sid = await self._call("subscribe", subject=subject, group=group)
+
+        async def cancel_remote() -> None:
+            self._streams.pop(sid, None)
+            self._stream_kind.pop(sid, None)
+            with contextlib.suppress(Exception):
+                await self._call("unsubscribe", sub_id=sid)
+
+        sub = Subscription(cancel_remote)
+        self._streams[sid] = sub
+        self._stream_kind[sid] = "sub"
+        return sub
+
+    async def publish(self, subject: str, payload: bytes) -> int:
+        if self._state is not None:
+            return self._state.publish(subject, payload)
+        return await self._call("publish", subject=subject, payload=payload)
+
+    # ------------------------------------------------------------- queues
+
+    async def queue_put(self, name: str, payload: bytes) -> int:
+        if self._state is not None:
+            self._ensure_started()
+            return self._state.queue_put(name, payload)
+        return await self._call("queue_put", name=name, payload=payload)
+
+    async def queue_pop(
+        self, name: str, timeout: Optional[float] = None
+    ) -> Optional[tuple[int, bytes]]:
+        if self._state is not None:
+            msg = await self._state.queue_pop(name, timeout)
+            return None if msg is None else (msg.id, msg.payload)
+        res = await self._call("queue_pop", name=name, timeout=timeout)
+        return None if res is None else (res[0], res[1])
+
+    async def queue_ack(self, name: str, msg_id: int) -> bool:
+        if self._state is not None:
+            return self._state.queue_ack(name, msg_id)
+        return await self._call("queue_ack", name=name, msg_id=msg_id)
+
+    async def queue_depth(self, name: str) -> int:
+        if self._state is not None:
+            return self._state.queue_depth(name)
+        return await self._call("queue_depth", name=name)
+
+    # ------------------------------------------------------------ objects
+
+    async def obj_put(self, bucket: str, name: str, data: bytes) -> None:
+        if self._state is not None:
+            self._state.obj_put(bucket, name, data)
+            return
+        await self._call("obj_put", bucket=bucket, name=name, data=data)
+
+    async def obj_get(self, bucket: str, name: str) -> Optional[bytes]:
+        if self._state is not None:
+            return self._state.obj_get(bucket, name)
+        return await self._call("obj_get", bucket=bucket, name=name)
+
+    async def obj_delete(self, bucket: str, name: str) -> bool:
+        if self._state is not None:
+            return self._state.obj_delete(bucket, name)
+        return await self._call("obj_delete", bucket=bucket, name=name)
+
+    async def obj_list(self, bucket: str) -> list[str]:
+        if self._state is not None:
+            return self._state.obj_list(bucket)
+        return await self._call("obj_list", bucket=bucket)

@@ -1,0 +1,232 @@
+"""Fabric TCP server: exposes a FabricState over the msgpack wire protocol.
+
+The single external infrastructure process of a dynamo_tpu cluster, playing
+the role that the etcd + NATS server pair plays for the reference
+(deploy/metrics/docker-compose.yml runs both; we run one).
+
+    python -m dynamo_tpu.fabric.server --host 0.0.0.0 --port 6650
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import contextlib
+from typing import Any, Optional
+
+from dynamo_tpu.fabric import wire
+from dynamo_tpu.fabric.state import FabricState
+from dynamo_tpu.runtime.logging import get_logger, init as init_logging
+
+logger = get_logger("dynamo_tpu.fabric.server")
+
+
+class _Conn:
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.watch_tasks: dict[int, asyncio.Task] = {}
+        self.sub_tasks: dict[int, asyncio.Task] = {}
+        self.leases: set[int] = set()
+        self.write_lock = asyncio.Lock()
+
+    async def send(self, msg: Any) -> None:
+        async with self.write_lock:
+            self.writer.write(wire.pack(msg))
+            await self.writer.drain()
+
+
+class FabricServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 6650) -> None:
+        self.host = host
+        self.port = port
+        self.state = FabricState()
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    async def start(self) -> None:
+        self.state.start()
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("fabric server listening on %s:%d", self.host, self.port)
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        await self.state.close()
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn = _Conn(reader, writer)
+        # Each request runs as its own task so a blocking op (queue_pop with
+        # no timeout) cannot stall other multiplexed requests — in particular
+        # lease keepalives — on the same connection.
+        req_tasks: set[asyncio.Task] = set()
+
+        async def run_one(req_id: int, op: str, kwargs: dict) -> None:
+            try:
+                result = await self._dispatch(conn, op, kwargs or {})
+                await conn.send([req_id, "ok", result])
+            except ConnectionError:
+                pass
+            except Exception as e:  # noqa: BLE001 — report to client
+                with contextlib.suppress(ConnectionError):
+                    await conn.send([req_id, "err", f"{type(e).__name__}: {e}"])
+
+        try:
+            while True:
+                try:
+                    msg = await wire.read_frame(reader)
+                    req_id, op, kwargs = msg
+                except (
+                    asyncio.IncompleteReadError,
+                    ConnectionResetError,
+                    ValueError,
+                ):
+                    break
+                except Exception:  # malformed frame: drop connection quietly
+                    logger.warning("malformed frame; closing connection")
+                    break
+                task = asyncio.get_running_loop().create_task(
+                    run_one(req_id, op, kwargs)
+                )
+                req_tasks.add(task)
+                task.add_done_callback(req_tasks.discard)
+        finally:
+            for t in list(req_tasks):
+                t.cancel()
+            for t in list(conn.watch_tasks.values()):
+                t.cancel()
+            for t in list(conn.sub_tasks.values()):
+                t.cancel()
+            for wid in list(conn.watch_tasks):
+                self.state.watch_cancel(wid)
+            for sid in list(conn.sub_tasks):
+                self.state.unsubscribe(sid)
+            # Leases are NOT revoked on disconnect: they expire by TTL, which
+            # gives a reconnecting process its grace period (etcd semantics).
+            with contextlib.suppress(Exception):
+                writer.close()
+                await writer.wait_closed()
+
+    async def _dispatch(self, conn: _Conn, op: str, a: dict) -> Any:
+        st = self.state
+        if op == "ping":
+            return "pong"
+        if op == "lease_grant":
+            lease_id = st.lease_grant(a["ttl"])
+            conn.leases.add(lease_id)
+            return lease_id
+        if op == "lease_keepalive":
+            return st.lease_keepalive(a["lease_id"])
+        if op == "lease_revoke":
+            st.lease_revoke(a["lease_id"])
+            return True
+        if op == "kv_put":
+            return st.kv_put(a["key"], a["value"], a.get("lease_id", 0))
+        if op == "kv_create":
+            return st.kv_create(a["key"], a["value"], a.get("lease_id", 0))
+        if op == "kv_get":
+            e = st.kv_get(a["key"])
+            return None if e is None else e.value
+        if op == "kv_get_prefix":
+            return {k: e.value for k, e in st.kv_get_prefix(a["prefix"]).items()}
+        if op == "kv_delete":
+            return st.kv_delete(a["key"])
+        if op == "kv_delete_prefix":
+            return st.kv_delete_prefix(a["prefix"])
+        if op == "watch_create":
+            wid, snapshot, q = st.watch_create(a["prefix"])
+            conn.watch_tasks[wid] = asyncio.get_running_loop().create_task(
+                self._pump_watch(conn, wid, q)
+            )
+            return [wid, [ev.to_wire() for ev in snapshot]]
+        if op == "watch_cancel":
+            st.watch_cancel(a["watch_id"])
+            t = conn.watch_tasks.pop(a["watch_id"], None)
+            if t:
+                t.cancel()
+            return True
+        if op == "subscribe":
+            sid, q = st.subscribe(a["subject"], a.get("group", ""))
+            conn.sub_tasks[sid] = asyncio.get_running_loop().create_task(
+                self._pump_sub(conn, sid, q)
+            )
+            return sid
+        if op == "unsubscribe":
+            st.unsubscribe(a["sub_id"])
+            t = conn.sub_tasks.pop(a["sub_id"], None)
+            if t:
+                t.cancel()
+            return True
+        if op == "publish":
+            return st.publish(a["subject"], a["payload"])
+        if op == "queue_put":
+            return st.queue_put(a["name"], a["payload"])
+        if op == "queue_pop":
+            msg = await st.queue_pop(a["name"], a.get("timeout"))
+            return None if msg is None else [msg.id, msg.payload]
+        if op == "queue_ack":
+            return st.queue_ack(a["name"], a["msg_id"])
+        if op == "queue_depth":
+            return st.queue_depth(a["name"])
+        if op == "obj_put":
+            st.obj_put(a["bucket"], a["name"], a["data"])
+            return True
+        if op == "obj_get":
+            return st.obj_get(a["bucket"], a["name"])
+        if op == "obj_delete":
+            return st.obj_delete(a["bucket"], a["name"])
+        if op == "obj_list":
+            return st.obj_list(a["bucket"])
+        raise ValueError(f"unknown op {op!r}")
+
+    async def _pump_watch(self, conn: _Conn, wid: int, q: asyncio.Queue) -> None:
+        with contextlib.suppress(asyncio.CancelledError, ConnectionError):
+            while True:
+                ev = await q.get()
+                payload = None if ev is None else ev.to_wire()
+                await conn.send([0, "push", wid, payload])
+                if ev is None:
+                    return
+
+    async def _pump_sub(self, conn: _Conn, sid: int, q: asyncio.Queue) -> None:
+        with contextlib.suppress(asyncio.CancelledError, ConnectionError):
+            while True:
+                item = await q.get()
+                payload = None if item is None else [item[0], item[1]]
+                await conn.send([0, "push", sid, payload])
+                if item is None:
+                    return
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="dynamo_tpu fabric server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=6650)
+    args = parser.parse_args()
+    init_logging()
+
+    async def run() -> None:
+        server = FabricServer(args.host, args.port)
+        await server.start()
+        await server.serve_forever()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

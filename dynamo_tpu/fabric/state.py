@@ -1,0 +1,387 @@
+"""In-memory fabric state machine: kv+lease+watch, pub/sub, queues, objects.
+
+Single-writer semantics: all mutations happen on one asyncio event loop (either
+the fabric server's loop, or the process's own loop in in-process mode), so no
+locks are needed — mirroring the reference's actor-ish single-threaded-behind-
+a-channel designs (e.g. lib/llm/src/kv_router/indexer.rs:518-690).
+
+Capability map to the reference:
+  kv_put/kv_get/kv_get_prefix/kv_delete/kv_create (CAS)/watch_prefix/leases
+      -> transports/etcd.rs:103-404 (kv_create_or_validate :203, watch :312)
+  publish/subscribe(+queue groups)
+      -> transports/nats.rs service groups / core pub-sub
+  queue_put/queue_pop (ack/redeliver)
+      -> transports/nats.rs:345-480 NatsQueue (JetStream work queue)
+  obj_put/obj_get
+      -> transports/nats.rs:123-196 object store (model-card upload)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import fnmatch
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.fabric")
+
+
+@dataclass
+class KVEntry:
+    value: bytes
+    lease_id: int = 0
+    create_rev: int = 0
+    mod_rev: int = 0
+
+
+@dataclass
+class WatchEvent:
+    type: str  # "put" | "delete"
+    key: str
+    value: bytes = b""
+    lease_id: int = 0
+    rev: int = 0
+
+    def to_wire(self) -> dict:
+        return {
+            "type": self.type,
+            "key": self.key,
+            "value": self.value,
+            "lease_id": self.lease_id,
+            "rev": self.rev,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "WatchEvent":
+        return cls(
+            type=d["type"],
+            key=d["key"],
+            value=d.get("value", b""),
+            lease_id=d.get("lease_id", 0),
+            rev=d.get("rev", 0),
+        )
+
+
+@dataclass
+class _Lease:
+    id: int
+    ttl: float
+    deadline: float
+    keys: set[str] = field(default_factory=set)
+
+
+@dataclass
+class _Watcher:
+    id: int
+    prefix: str
+    queue: "asyncio.Queue[Optional[WatchEvent]]"
+
+
+@dataclass
+class _Subscription:
+    id: int
+    subject: str  # may end with ".>" wildcard
+    group: str  # "" = broadcast subscriber
+    queue: "asyncio.Queue[Optional[tuple[str, bytes]]]"  # (subject, payload)
+
+
+@dataclass
+class _QueueMsg:
+    id: int
+    payload: bytes
+
+
+class _WorkQueue:
+    """Pull-based at-least-once work queue with ack + timed redelivery."""
+
+    def __init__(self, name: str, redeliver_after: float = 30.0) -> None:
+        self.name = name
+        self.ready: deque[_QueueMsg] = deque()
+        self.inflight: dict[int, tuple[_QueueMsg, float]] = {}
+        self.redeliver_after = redeliver_after
+        self.waiters: deque[asyncio.Future] = deque()
+
+    def depth(self) -> int:
+        return len(self.ready) + len(self.inflight)
+
+
+def subject_matches(pattern: str, subject: str) -> bool:
+    """NATS-style: tokens split on '.', '*' matches one token, '>' the rest."""
+    if pattern == subject:
+        return True
+    pt = pattern.split(".")
+    st = subject.split(".")
+    for i, tok in enumerate(pt):
+        if tok == ">":
+            return True
+        if i >= len(st):
+            return False
+        if tok != "*" and tok != st[i]:
+            return False
+    return len(pt) == len(st)
+
+
+class FabricState:
+    """The complete control-plane state. All methods are loop-affine."""
+
+    def __init__(self) -> None:
+        self.kv: dict[str, KVEntry] = {}
+        self.revision = 0
+        self.leases: dict[int, _Lease] = {}
+        self.watchers: dict[int, _Watcher] = {}
+        self.subs: dict[int, _Subscription] = {}
+        self.queues: dict[str, _WorkQueue] = {}
+        self.objects: dict[str, dict[str, bytes]] = {}
+        self._ids = itertools.count(1)
+        self._group_rr: dict[tuple[str, str], int] = {}
+        self._janitor: Optional[asyncio.Task] = None
+
+    def next_id(self) -> int:
+        return next(self._ids)
+
+    def start(self) -> None:
+        if self._janitor is None or self._janitor.done():
+            self._janitor = asyncio.get_running_loop().create_task(
+                self._janitor_loop()
+            )
+
+    async def close(self) -> None:
+        if self._janitor is not None:
+            self._janitor.cancel()
+            self._janitor = None
+
+    async def _janitor_loop(self) -> None:
+        """Expire dead leases and redeliver unacked queue messages."""
+        try:
+            while True:
+                await asyncio.sleep(0.5)
+                now = time.monotonic()
+                for lease in [
+                    l for l in self.leases.values() if l.deadline < now
+                ]:
+                    logger.info("lease %d expired; revoking", lease.id)
+                    self.lease_revoke(lease.id)
+                for q in self.queues.values():
+                    expired = [
+                        mid
+                        for mid, (_, dl) in q.inflight.items()
+                        if dl < now
+                    ]
+                    for mid in expired:
+                        msg, _ = q.inflight.pop(mid)
+                        q.ready.appendleft(msg)
+                        self._wake_queue(q)
+        except asyncio.CancelledError:
+            pass
+
+    # ------------------------------------------------------------- leases
+
+    def lease_grant(self, ttl: float) -> int:
+        lease_id = self.next_id()
+        self.leases[lease_id] = _Lease(
+            id=lease_id, ttl=ttl, deadline=time.monotonic() + ttl
+        )
+        return lease_id
+
+    def lease_keepalive(self, lease_id: int) -> bool:
+        lease = self.leases.get(lease_id)
+        if lease is None:
+            return False
+        lease.deadline = time.monotonic() + lease.ttl
+        return True
+
+    def lease_revoke(self, lease_id: int) -> None:
+        lease = self.leases.pop(lease_id, None)
+        if lease is None:
+            return
+        for key in list(lease.keys):
+            self._delete_key(key)
+
+    # ----------------------------------------------------------------- kv
+
+    def _notify(self, ev: WatchEvent) -> None:
+        for w in self.watchers.values():
+            if ev.key.startswith(w.prefix):
+                w.queue.put_nowait(ev)
+
+    def kv_put(self, key: str, value: bytes, lease_id: int = 0) -> int:
+        if lease_id and lease_id not in self.leases:
+            raise KeyError(f"unknown lease {lease_id}")
+        self.revision += 1
+        prev = self.kv.get(key)
+        entry = KVEntry(
+            value=value,
+            lease_id=lease_id,
+            create_rev=prev.create_rev if prev else self.revision,
+            mod_rev=self.revision,
+        )
+        if prev and prev.lease_id and prev.lease_id != lease_id:
+            old = self.leases.get(prev.lease_id)
+            if old:
+                old.keys.discard(key)
+        self.kv[key] = entry
+        if lease_id:
+            self.leases[lease_id].keys.add(key)
+        self._notify(
+            WatchEvent("put", key, value, lease_id=lease_id, rev=self.revision)
+        )
+        return self.revision
+
+    def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        """CAS create: fails if the key exists with a different value
+        (reference etcd.rs:203 kv_create_or_validate)."""
+        existing = self.kv.get(key)
+        if existing is not None:
+            return existing.value == value
+        self.kv_put(key, value, lease_id)
+        return True
+
+    def kv_get(self, key: str) -> Optional[KVEntry]:
+        return self.kv.get(key)
+
+    def kv_get_prefix(self, prefix: str) -> dict[str, KVEntry]:
+        return {k: v for k, v in self.kv.items() if k.startswith(prefix)}
+
+    def _delete_key(self, key: str) -> bool:
+        entry = self.kv.pop(key, None)
+        if entry is None:
+            return False
+        if entry.lease_id:
+            lease = self.leases.get(entry.lease_id)
+            if lease:
+                lease.keys.discard(key)
+        self.revision += 1
+        self._notify(WatchEvent("delete", key, rev=self.revision))
+        return True
+
+    def kv_delete(self, key: str) -> bool:
+        return self._delete_key(key)
+
+    def kv_delete_prefix(self, prefix: str) -> int:
+        keys = [k for k in self.kv if k.startswith(prefix)]
+        for k in keys:
+            self._delete_key(k)
+        return len(keys)
+
+    # -------------------------------------------------------------- watch
+
+    def watch_create(self, prefix: str) -> tuple[int, list[WatchEvent], asyncio.Queue]:
+        """Returns (watch_id, initial snapshot as synthetic puts, event queue)."""
+        wid = self.next_id()
+        q: asyncio.Queue = asyncio.Queue()
+        self.watchers[wid] = _Watcher(id=wid, prefix=prefix, queue=q)
+        snapshot = [
+            WatchEvent("put", k, e.value, lease_id=e.lease_id, rev=e.mod_rev)
+            for k, e in sorted(self.kv_get_prefix(prefix).items())
+        ]
+        return wid, snapshot, q
+
+    def watch_cancel(self, watch_id: int) -> None:
+        w = self.watchers.pop(watch_id, None)
+        if w is not None:
+            w.queue.put_nowait(None)
+
+    # ------------------------------------------------------------ pub/sub
+
+    def subscribe(self, subject: str, group: str = "") -> tuple[int, asyncio.Queue]:
+        sid = self.next_id()
+        q: asyncio.Queue = asyncio.Queue()
+        self.subs[sid] = _Subscription(id=sid, subject=subject, group=group, queue=q)
+        return sid, q
+
+    def unsubscribe(self, sub_id: int) -> None:
+        sub = self.subs.pop(sub_id, None)
+        if sub is not None:
+            sub.queue.put_nowait(None)
+
+    def publish(self, subject: str, payload: bytes) -> int:
+        """Deliver to all broadcast subscribers + one member per queue group.
+        Returns the number of deliveries."""
+        delivered = 0
+        groups: dict[tuple[str, str], list[_Subscription]] = {}
+        for sub in self.subs.values():
+            if not subject_matches(sub.subject, subject):
+                continue
+            if sub.group:
+                groups.setdefault((sub.subject, sub.group), []).append(sub)
+            else:
+                sub.queue.put_nowait((subject, payload))
+                delivered += 1
+        for key, members in groups.items():
+            members.sort(key=lambda s: s.id)
+            idx = self._group_rr.get(key, 0) % len(members)
+            self._group_rr[key] = idx + 1
+            members[idx].queue.put_nowait((subject, payload))
+            delivered += 1
+        return delivered
+
+    # ------------------------------------------------------------- queues
+
+    def _queue(self, name: str) -> _WorkQueue:
+        q = self.queues.get(name)
+        if q is None:
+            q = self.queues[name] = _WorkQueue(name)
+        return q
+
+    def _wake_queue(self, q: _WorkQueue) -> None:
+        while q.waiters and q.ready:
+            fut = q.waiters.popleft()
+            if fut.done():
+                continue
+            msg = q.ready.popleft()
+            q.inflight[msg.id] = (msg, time.monotonic() + q.redeliver_after)
+            fut.set_result(msg)
+
+    def queue_put(self, name: str, payload: bytes) -> int:
+        q = self._queue(name)
+        msg = _QueueMsg(id=self.next_id(), payload=payload)
+        q.ready.append(msg)
+        self._wake_queue(q)
+        return msg.id
+
+    async def queue_pop(
+        self, name: str, timeout: Optional[float] = None
+    ) -> Optional[_QueueMsg]:
+        """Pop one message; it stays in-flight until acked or redelivery."""
+        q = self._queue(name)
+        if q.ready:
+            msg = q.ready.popleft()
+            q.inflight[msg.id] = (msg, time.monotonic() + q.redeliver_after)
+            return msg
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        q.waiters.append(fut)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            if not fut.done():
+                fut.cancel()
+            return None
+
+    def queue_ack(self, name: str, msg_id: int) -> bool:
+        q = self._queue(name)
+        return q.inflight.pop(msg_id, None) is not None
+
+    def queue_depth(self, name: str) -> int:
+        return self._queue(name).depth()
+
+    # ------------------------------------------------------------ objects
+
+    def obj_put(self, bucket: str, name: str, data: bytes) -> None:
+        self.objects.setdefault(bucket, {})[name] = data
+
+    def obj_get(self, bucket: str, name: str) -> Optional[bytes]:
+        return self.objects.get(bucket, {}).get(name)
+
+    def obj_delete(self, bucket: str, name: str) -> bool:
+        b = self.objects.get(bucket)
+        if b is None:
+            return False
+        return b.pop(name, None) is not None
+
+    def obj_list(self, bucket: str) -> list[str]:
+        return sorted(self.objects.get(bucket, {}).keys())

@@ -1,0 +1,32 @@
+"""Framed msgpack wire protocol shared by the fabric server and client.
+
+Frame = 4-byte big-endian length || msgpack body.
+Request  body: [req_id, op, kwargs]
+Response body: [req_id, "ok", result] | [req_id, "err", message]
+Push     body: [0, "push", stream_id, payload]   (watch events / sub messages)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import msgpack
+
+MAX_FRAME = 512 * 1024 * 1024  # object store payloads (model cards) can be big
+_LEN = struct.Struct(">I")
+
+
+def pack(msg: Any) -> bytes:
+    body = msgpack.packb(msg, use_bin_type=True)
+    return _LEN.pack(len(body)) + body
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(4)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise ValueError(f"frame too large: {length}")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False)

@@ -1,0 +1,9 @@
+"""Pipeline: request context, streaming envelopes, routing, ingress/egress.
+
+Role-equivalent of the reference's lib/runtime/src/pipeline (nodes, context,
+network egress PushRouter, ingress PushEndpoint, TwoPartCodec, TCP response
+plane)."""
+
+from dynamo_tpu.pipeline.context import Context  # noqa: F401
+from dynamo_tpu.pipeline.annotated import Annotated  # noqa: F401
+from dynamo_tpu.pipeline.router import PushRouter, RouterMode  # noqa: F401

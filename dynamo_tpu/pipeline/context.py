@@ -1,0 +1,74 @@
+"""Request-scoped context: id, metadata, cancellation — propagated across
+process boundaries in the request header.
+
+Role-equivalent of the reference's Context<T>/Controller
+(lib/runtime/src/pipeline/context.rs:33,324) and AsyncEngineContext
+(lib/runtime/src/engine.rs:124-160: id / stop_generating / kill / stopped).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Optional
+
+from dynamo_tpu.runtime.cancellation import CancellationToken
+
+
+class Context:
+    """Carries a request id, arbitrary metadata, and a stop/kill controller."""
+
+    __slots__ = ("id", "metadata", "_stop", "_kill")
+
+    def __init__(
+        self,
+        id: Optional[str] = None,
+        metadata: Optional[dict[str, Any]] = None,
+        parent: Optional["Context"] = None,
+    ) -> None:
+        self.id: str = id or uuid.uuid4().hex
+        self.metadata: dict[str, Any] = dict(metadata or {})
+        if parent is not None:
+            self._stop = parent._stop.child_token()
+            self._kill = parent._kill.child_token()
+        else:
+            self._stop = CancellationToken()
+            self._kill = CancellationToken()
+
+    # --- controller surface (engine.rs AsyncEngineContext semantics) ---
+
+    def stop_generating(self) -> None:
+        """Graceful: stop producing new tokens, let in-flight output drain."""
+        self._stop.cancel()
+
+    def kill(self) -> None:
+        """Hard: abandon the request entirely (client disconnected)."""
+        self._stop.cancel()
+        self._kill.cancel()
+
+    def is_stopped(self) -> bool:
+        return self._stop.is_cancelled()
+
+    def is_killed(self) -> bool:
+        return self._kill.is_cancelled()
+
+    async def stopped(self) -> None:
+        await self._stop.cancelled()
+
+    async def killed(self) -> None:
+        await self._kill.cancelled()
+
+    @property
+    def stop_token(self) -> CancellationToken:
+        return self._stop
+
+    # --- wire form ---
+
+    def to_header(self) -> dict[str, Any]:
+        return {"id": self.id, "metadata": self.metadata}
+
+    @classmethod
+    def from_header(cls, header: dict[str, Any]) -> "Context":
+        return cls(id=header.get("id"), metadata=header.get("metadata") or {})
+
+    def child(self) -> "Context":
+        return Context(id=self.id, metadata=self.metadata, parent=self)

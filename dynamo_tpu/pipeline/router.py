@@ -1,0 +1,72 @@
+"""PushRouter: client-side instance selection policy over a Client.
+
+Role-equivalent of lib/runtime/src/pipeline/network/egress/push_router.rs
+(RouterMode {Random, RoundRobin, Direct, KV} :74, constructors :113-177).
+KV mode delegates to a pluggable selector (the KV-aware router, M5) which
+picks the worker with the best cached-prefix overlap.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING, Any, Optional, Protocol
+
+from dynamo_tpu.pipeline.context import Context
+
+if TYPE_CHECKING:
+    from dynamo_tpu.runtime.component import Client, ResponseStream
+
+
+class RouterMode(enum.Enum):
+    RANDOM = "random"
+    ROUND_ROBIN = "round_robin"
+    DIRECT = "direct"
+    KV = "kv"
+
+
+class WorkerSelector(Protocol):
+    """KV-aware selection hook (reference kv_router.rs:54 WorkerSelector)."""
+
+    async def select_worker(
+        self, token_ids: list[int], context: Context
+    ) -> tuple[int, float]:
+        """Returns (instance_id, overlap_blocks_estimate)."""
+        ...
+
+
+class PushRouter:
+    def __init__(
+        self,
+        client: Client,
+        mode: RouterMode = RouterMode.ROUND_ROBIN,
+        selector: Optional[WorkerSelector] = None,
+    ) -> None:
+        self.client = client
+        self.mode = mode
+        self.selector = selector
+        if mode is RouterMode.KV and selector is None:
+            raise ValueError("KV router mode requires a WorkerSelector")
+
+    async def generate(
+        self,
+        request: Any,
+        context: Optional[Context] = None,
+        instance_id: Optional[int] = None,
+    ) -> ResponseStream:
+        ctx = context or Context()
+        if instance_id is not None or self.mode is RouterMode.DIRECT:
+            if instance_id is None:
+                raise ValueError("direct mode requires instance_id")
+            return await self.client.direct(request, instance_id, ctx)
+        if self.mode is RouterMode.RANDOM:
+            return await self.client.random(request, ctx)
+        if self.mode is RouterMode.ROUND_ROBIN:
+            return await self.client.round_robin(request, ctx)
+        # KV mode: requests must expose token_ids for prefix matching
+        token_ids = (
+            request.get("token_ids", []) if isinstance(request, dict) else []
+        )
+        assert self.selector is not None
+        worker_id, overlap = await self.selector.select_worker(token_ids, ctx)
+        ctx.metadata["kv_overlap_blocks"] = overlap
+        return await self.client.direct(request, worker_id, ctx)

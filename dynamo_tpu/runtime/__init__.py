@@ -1,0 +1,14 @@
+"""Core runtime: process lifecycle, distributed fabric handle, component model.
+
+Role-equivalent of the reference's lib/runtime crate (dynamo-runtime)."""
+
+from dynamo_tpu.runtime.cancellation import CancellationToken  # noqa: F401
+from dynamo_tpu.runtime.config import RuntimeConfig  # noqa: F401
+from dynamo_tpu.runtime.distributed import DistributedRuntime  # noqa: F401
+from dynamo_tpu.runtime.component import (  # noqa: F401
+    Namespace,
+    Component,
+    Endpoint,
+    Client,
+    Instance,
+)

@@ -1,0 +1,72 @@
+"""Layered runtime configuration: defaults <- TOML file <- DYN_* env vars.
+
+Role-equivalent of the reference's Figment-based RuntimeConfig/WorkerConfig
+(lib/runtime/src/config.rs:30-130).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields
+from typing import Any, Optional
+
+
+def _env(name: str, default: Optional[str] = None) -> Optional[str]:
+    return os.environ.get(name, default)
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v is not None else default
+
+
+@dataclass
+class RuntimeConfig:
+    """Per-process runtime settings.
+
+    Environment overrides (highest precedence):
+      DYN_FABRIC_ADDR       host:port of the fabric server ("" => in-process)
+      DYN_TCP_HOST          advertised host for the TCP response plane
+      DYN_TCP_PORT          fixed port for the TCP response plane (0 = ephemeral)
+      DYN_RUNTIME_HTTP_ENABLED / DYN_RUNTIME_HTTP_PORT  system health/metrics server
+      DYN_LEASE_TTL_S       discovery lease TTL seconds
+      DYN_NAMESPACE         default namespace
+    """
+
+    fabric_addr: str = ""
+    tcp_host: str = "127.0.0.1"
+    tcp_port: int = 0
+    http_enabled: bool = False
+    http_port: int = 9090
+    lease_ttl_s: float = 10.0
+    namespace: str = "dynamo"
+
+    @classmethod
+    def from_settings(cls, config_path: Optional[str] = None) -> "RuntimeConfig":
+        values: dict[str, Any] = {}
+        path = config_path or _env("DYN_RUNTIME_CONFIG")
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                doc = tomllib.load(f)
+            section = doc.get("runtime", doc)
+            known = {f.name for f in fields(cls)}
+            values.update({k: v for k, v in section.items() if k in known})
+        cfg = cls(**values)
+        cfg.fabric_addr = _env("DYN_FABRIC_ADDR", cfg.fabric_addr) or ""
+        cfg.tcp_host = _env("DYN_TCP_HOST", cfg.tcp_host) or cfg.tcp_host
+        cfg.tcp_port = _env_int("DYN_TCP_PORT", cfg.tcp_port)
+        cfg.http_enabled = _env_bool("DYN_RUNTIME_HTTP_ENABLED", cfg.http_enabled)
+        cfg.http_port = _env_int("DYN_RUNTIME_HTTP_PORT", cfg.http_port)
+        ttl = _env("DYN_LEASE_TTL_S")
+        if ttl is not None:
+            cfg.lease_ttl_s = float(ttl)
+        cfg.namespace = _env("DYN_NAMESPACE", cfg.namespace) or cfg.namespace
+        return cfg

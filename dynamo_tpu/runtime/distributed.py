@@ -1,0 +1,142 @@
+"""DistributedRuntime: the per-process handle to the cluster.
+
+Role-equivalent of the reference's DistributedRuntime
+(lib/runtime/src/distributed.rs:34-197): owns the fabric client (etcd+NATS
+analogue), the primary lease with its keep-alive task, the lazy TCP response
+server, the local endpoint registry (for in-process short-circuit calls), and
+the root cancellation token whose cascade tears everything down.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from typing import TYPE_CHECKING, Any, Callable, Optional
+
+from dynamo_tpu.fabric.client import FabricClient
+from dynamo_tpu.fabric.state import FabricState
+from dynamo_tpu.pipeline.tcp import TcpResponseServer
+from dynamo_tpu.runtime import logging as dlog
+from dynamo_tpu.runtime.cancellation import CancellationToken
+from dynamo_tpu.runtime.config import RuntimeConfig
+
+if TYPE_CHECKING:
+    from dynamo_tpu.runtime.component import Namespace
+
+logger = dlog.get_logger("dynamo_tpu.runtime")
+
+
+class DistributedRuntime:
+    def __init__(
+        self,
+        fabric: FabricClient,
+        config: Optional[RuntimeConfig] = None,
+    ) -> None:
+        self.fabric = fabric
+        self.config = config or RuntimeConfig()
+        self.token = CancellationToken()
+        self.tcp_server = TcpResponseServer(
+            self.config.tcp_host, self.config.tcp_port
+        )
+        # (subject) -> handler for same-process short-circuit dispatch
+        self.local_endpoints: dict[str, Callable] = {}
+        self.primary_lease: int = 0
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._extra_leases: list[int] = []
+        self._closed = False
+
+    # ----------------------------------------------------- constructors
+
+    @classmethod
+    async def from_settings(
+        cls, config: Optional[RuntimeConfig] = None
+    ) -> "DistributedRuntime":
+        """Connect per config: remote fabric if DYN_FABRIC_ADDR set, else the
+        process-shared in-memory fabric."""
+        cfg = config or RuntimeConfig.from_settings()
+        if cfg.fabric_addr:
+            fabric = await FabricClient.connect(cfg.fabric_addr)
+        else:
+            fabric = FabricClient.in_process()
+        drt = cls(fabric, cfg)
+        await drt._start_primary_lease()
+        return drt
+
+    @classmethod
+    async def detached(
+        cls,
+        config: Optional[RuntimeConfig] = None,
+        state: Optional[FabricState] = None,
+    ) -> "DistributedRuntime":
+        """Static mode: process-local fabric, no external dependencies
+        (reference distributed.rs:113 from_settings_without_discovery)."""
+        drt = cls(FabricClient.in_process(state), config)
+        await drt._start_primary_lease()
+        return drt
+
+    # ----------------------------------------------------------- leases
+
+    async def _start_primary_lease(self) -> None:
+        ttl = self.config.lease_ttl_s
+        self.primary_lease = await self.fabric.lease_grant(ttl)
+        self._keepalive_task = asyncio.get_running_loop().create_task(
+            self._keepalive_loop(self.primary_lease, ttl)
+        )
+
+    async def _keepalive_loop(self, lease_id: int, ttl: float) -> None:
+        """Refresh the lease at ttl/3 cadence; if the fabric reports the lease
+        gone (e.g. expired during a partition), shut the process's work down —
+        a dead lease means the cluster already considers us gone
+        (reference transports/etcd.rs:51-166)."""
+        try:
+            while not self.token.is_cancelled():
+                await asyncio.sleep(ttl / 3.0)
+                try:
+                    alive = await self.fabric.lease_keepalive(lease_id)
+                except ConnectionError:
+                    alive = False
+                if not alive:
+                    logger.error(
+                        "primary lease %d lost; cancelling runtime", lease_id
+                    )
+                    self.token.cancel()
+                    return
+        except asyncio.CancelledError:
+            pass
+
+    async def create_lease(self, ttl: Optional[float] = None) -> int:
+        lease_id = await self.fabric.lease_grant(ttl or self.config.lease_ttl_s)
+        self._extra_leases.append(lease_id)
+        return lease_id
+
+    # -------------------------------------------------------- hierarchy
+
+    def namespace(self, name: Optional[str] = None) -> "Namespace":
+        from dynamo_tpu.runtime.component import Namespace
+
+        return Namespace(self, name or self.config.namespace)
+
+    def child_token(self) -> CancellationToken:
+        return self.token.child_token()
+
+    # --------------------------------------------------------- shutdown
+
+    def shutdown(self) -> None:
+        self.token.cancel()
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.token.cancel()
+        if self._keepalive_task:
+            self._keepalive_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._keepalive_task
+        with contextlib.suppress(Exception):
+            if self.primary_lease:
+                await self.fabric.lease_revoke(self.primary_lease)
+            for lease in self._extra_leases:
+                await self.fabric.lease_revoke(lease)
+        await self.tcp_server.close()
+        await self.fabric.close()

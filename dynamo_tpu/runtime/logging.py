@@ -1,0 +1,111 @@
+"""Structured logging: pretty console or JSONL, env-controlled.
+
+Role-equivalent of the reference runtime's tracing-subscriber setup
+(lib/runtime/src/logging.rs): `DYN_LOG` filter syntax ("info",
+"debug,dynamo_tpu.router=trace"), `DYN_LOGGING_JSONL=1` for machine-readable
+JSON lines with span-style extra fields.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+from typing import Any, Optional
+
+_INITIALIZED = False
+
+_LEVELS = {
+    "trace": 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+logging.addLevelName(5, "TRACE")
+
+
+class JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out: dict[str, Any] = {
+            "time": time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.gmtime(record.created)
+            )
+            + f".{int(record.msecs):03d}Z",
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        fields = getattr(record, "fields", None)
+        if fields:
+            out.update(fields)
+        if record.exc_info and record.exc_info[0] is not None:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+class PrettyFormatter(logging.Formatter):
+    def __init__(self) -> None:
+        super().__init__(
+            fmt="%(asctime)s %(levelname)-5s %(name)s: %(message)s",
+            datefmt="%H:%M:%S",
+        )
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = super().format(record)
+        fields = getattr(record, "fields", None)
+        if fields:
+            kv = " ".join(f"{k}={v}" for k, v in fields.items())
+            base = f"{base} [{kv}]"
+        return base
+
+
+def _parse_filter(spec: str) -> tuple[int, dict[str, int]]:
+    """Parse "info,dynamo_tpu.router=trace" into (default, per-target)."""
+    default = logging.INFO
+    targets: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            name, lvl = part.split("=", 1)
+            targets[name.strip()] = _LEVELS.get(lvl.strip().lower(), logging.INFO)
+        else:
+            default = _LEVELS.get(part.lower(), logging.INFO)
+    return default, targets
+
+
+def init(level: Optional[str] = None, jsonl: Optional[bool] = None) -> None:
+    """Idempotent global logging init honoring DYN_LOG / DYN_LOGGING_JSONL."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    _INITIALIZED = True
+    spec = level if level is not None else os.environ.get("DYN_LOG", "info")
+    use_jsonl = (
+        jsonl
+        if jsonl is not None
+        else os.environ.get("DYN_LOGGING_JSONL", "0") in ("1", "true")
+    )
+    default, targets = _parse_filter(spec)
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(JsonlFormatter() if use_jsonl else PrettyFormatter())
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(default)
+    for name, lvl in targets.items():
+        logging.getLogger(name).setLevel(lvl)
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(name)
+
+
+def with_fields(logger: logging.Logger, level: int, msg: str, **fields: Any) -> None:
+    """Log with structured span-style fields (rendered in both formats)."""
+    logger.log(level, msg, extra={"fields": fields})

@@ -1,0 +1,47 @@
+"""Test harness config.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip sharding validated
+without TPU hardware, mirroring how the reference tests distributed logic
+against local etcd instead of clusters — SURVEY.md §4).
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+import asyncio  # noqa: E402
+import inspect  # noqa: E402
+
+import pytest  # noqa: E402
+
+from dynamo_tpu.fabric import client as fabric_client  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: run test in an event loop")
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal async test support (pytest-asyncio is not in the image)."""
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(asyncio.wait_for(fn(**kwargs), timeout=120))
+        return True
+    return None
+
+
+@pytest.fixture(autouse=True)
+def _fresh_fabric():
+    """Each test gets a clean process-shared in-memory fabric."""
+    fabric_client.reset_shared_state()
+    yield
+    fabric_client.reset_shared_state()

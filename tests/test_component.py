@@ -1,0 +1,222 @@
+"""Component model + ingress/egress round-trip tests (in-process and remote)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu import DistributedRuntime
+from dynamo_tpu.fabric import FabricServer
+from dynamo_tpu.fabric.client import FabricClient
+from dynamo_tpu.pipeline import Annotated, Context, PushRouter, RouterMode
+from dynamo_tpu.runtime.barrier import LeaderBarrier, WorkerBarrier
+from dynamo_tpu.runtime.component import NoInstancesError
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.protocols import EndpointId
+
+
+def test_endpoint_id_parsing():
+    eid = EndpointId.parse("dyn://ns.comp.ep")
+    assert (eid.namespace, eid.component, eid.name) == ("ns", "comp", "ep")
+    assert EndpointId.parse("comp.ep").namespace == "dynamo"
+    assert str(eid) == "dyn://ns.comp.ep"
+    with pytest.raises(ValueError):
+        EndpointId.parse("only_one")
+
+
+async def echo_handler(request, context):
+    for tok in request["text"].split():
+        yield {"token": tok}
+
+
+async def failing_handler(request, context):
+    yield {"token": "ok"}
+    raise RuntimeError("boom")
+
+
+@pytest.mark.asyncio
+async def test_serve_and_call_local_short_circuit():
+    drt = await DistributedRuntime.detached()
+    try:
+        ep = drt.namespace("test").component("echo").endpoint("generate")
+        service = await ep.serve_endpoint(echo_handler)
+        client = await ep.client()
+        assert await client.wait_for_instances(2.0) == [service.instance_id]
+        stream = await client.round_robin({"text": "a b c"})
+        toks = [a.data["token"] async for a in stream if a.data]
+        assert toks == ["a", "b", "c"]
+        await service.stop()
+        await asyncio.sleep(0.05)  # watch delete event propagates async
+        assert client.instances == {}
+        with pytest.raises(NoInstancesError):
+            await client.random({"text": "x"})
+        await client.close()
+    finally:
+        await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_handler_error_surfaces_as_error_annotation():
+    drt = await DistributedRuntime.detached()
+    try:
+        ep = drt.namespace("test").component("bad").endpoint("generate")
+        await ep.serve_endpoint(failing_handler)
+        client = await ep.client()
+        stream = await client.random({})
+        items = [a async for a in stream]
+        assert items[0].data == {"token": "ok"}
+        assert items[-1].is_error()
+        assert "boom" in items[-1].error_message()
+        await client.close()
+    finally:
+        await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_remote_round_trip_over_fabric_server():
+    """Two DistributedRuntimes connected via a real fabric server + TCP
+    response plane (full cross-process wire path, in one process)."""
+    server = FabricServer("127.0.0.1", 0)
+    await server.start()
+    try:
+        cfg = RuntimeConfig(fabric_addr=server.addr)
+        worker_drt = DistributedRuntime(
+            await FabricClient.connect(server.addr), cfg
+        )
+        await worker_drt._start_primary_lease()
+        caller_drt = DistributedRuntime(
+            await FabricClient.connect(server.addr), cfg
+        )
+        await caller_drt._start_primary_lease()
+        try:
+            ep_w = worker_drt.namespace("ns").component("c").endpoint("e")
+            service = await ep_w.serve_endpoint(echo_handler)
+            ep_c = caller_drt.namespace("ns").component("c").endpoint("e")
+            client = await ep_c.client()
+            await client.wait_for_instances(5.0)
+            stream = await client.direct(
+                {"text": "hello distributed world"}, service.instance_id
+            )
+            toks = [a.data["token"] async for a in stream if a.data]
+            assert toks == ["hello", "distributed", "world"]
+            await client.close()
+        finally:
+            await caller_drt.close()
+            await worker_drt.close()
+    finally:
+        await server.close()
+
+
+@pytest.mark.asyncio
+async def test_push_router_modes():
+    drt = await DistributedRuntime.detached()
+    try:
+        ns = drt.namespace("rt")
+        ep = ns.component("w").endpoint("gen")
+        seen: list[int] = []
+
+        def make_handler(tag):
+            async def handler(request, context):
+                seen.append(tag)
+                yield {"tag": tag}
+
+            return handler
+
+        lease_a = await drt.create_lease()
+        lease_b = await drt.create_lease()
+        await ep.serve_endpoint(make_handler(1), lease_id=lease_a)
+        await ep.serve_endpoint(make_handler(2), lease_id=lease_b)
+        client = await ep.client()
+        await client.wait_for_instances(2.0)
+        router = PushRouter(client, RouterMode.ROUND_ROBIN)
+        for _ in range(4):
+            stream = await router.generate({})
+            async for _item in stream:
+                pass
+        assert sorted(seen) == [1, 1, 2, 2]
+        # direct mode hits the requested instance only
+        seen.clear()
+        router_d = PushRouter(client, RouterMode.DIRECT)
+        stream = await router_d.generate({}, instance_id=lease_b)
+        async for _item in stream:
+            pass
+        assert seen == [2]
+        await client.close()
+    finally:
+        await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_instance_removed_on_lease_expiry():
+    """A worker whose lease dies disappears from every client's view
+    (liveness semantics, SURVEY §5 failure detection)."""
+    drt = await DistributedRuntime.detached()
+    try:
+        ep = drt.namespace("ft").component("w").endpoint("gen")
+        lease = await drt.fabric.lease_grant(0.6)  # short, un-refreshed
+        await ep.serve_endpoint(echo_handler, lease_id=lease)
+        client = await ep.client()
+        await client.wait_for_instances(2.0)
+        assert len(client.instances) == 1
+        await asyncio.sleep(1.5)  # janitor expires the lease
+        assert client.instances == {}
+        await client.close()
+    finally:
+        await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_leader_worker_barrier():
+    drt = await DistributedRuntime.detached()
+    try:
+        fabric = drt.fabric
+        lease = drt.primary_lease
+        results = {}
+
+        async def leader():
+            await LeaderBarrier("b1", num_workers=2, timeout=5).sync(
+                fabric, lease, {"addr": "10.0.0.1:1234"}
+            )
+            results["leader"] = True
+
+        async def worker(wid):
+            data = await WorkerBarrier("b1", wid, timeout=5).sync(fabric, lease)
+            results[wid] = data
+
+        await asyncio.wait_for(
+            asyncio.gather(leader(), worker("w0"), worker("w1")), 10
+        )
+        assert results["leader"]
+        assert results["w0"]["addr"] == "10.0.0.1:1234"
+        assert results["w1"]["addr"] == "10.0.0.1:1234"
+    finally:
+        await drt.close()
+
+
+@pytest.mark.asyncio
+async def test_stream_cancellation_kills_context():
+    drt = await DistributedRuntime.detached()
+    cancelled = asyncio.Event()
+    try:
+        async def slow_handler(request, context):
+            try:
+                for i in range(1000):
+                    yield {"i": i}
+                    await asyncio.sleep(0.01)
+            finally:
+                if context.is_killed():
+                    cancelled.set()
+
+        ep = drt.namespace("cx").component("slow").endpoint("gen")
+        await ep.serve_endpoint(slow_handler)
+        client = await ep.client()
+        stream = await client.random({})
+        count = 0
+        async for _item in stream:
+            count += 1
+            if count >= 3:
+                break
+        await stream.close()
+        await asyncio.wait_for(cancelled.wait(), 2.0)
+        await client.close()
+    finally:
+        await drt.close()
