@@ -1,0 +1,125 @@
+"""Backend operator: incremental detokenization + stop-condition handling on
+the engine's token-delta stream.
+
+Role-equivalent of lib/llm/src/backend.rs (Backend :67, Decoder :278,
+SeqResult::step :400): engines emit token ids; this operator turns them into
+text deltas, detects visible stop strings across chunk boundaries (holding
+back — "jailing" — text that might be the prefix of a stop sequence until it
+is disambiguated), recognizes hidden eos tokens, and enforces max_tokens.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    StopConditions,
+)
+from dynamo_tpu.tokenizer import TokenizerWrapper
+
+
+@dataclass
+class StepResult:
+    text: str = ""
+    finish_reason: Optional[FinishReason] = None
+    tokens_emitted: int = 0
+
+
+class SequenceDecoder:
+    """Per-request decoder state (one choice index)."""
+
+    def __init__(
+        self,
+        tokenizer: TokenizerWrapper,
+        stop: StopConditions,
+        eos_token_ids: list[int],
+    ) -> None:
+        self._stream = tokenizer.decode_stream()
+        self._stop = stop
+        self._eos = set(eos_token_ids) | set(stop.stop_token_ids_hidden)
+        self._stop_seqs = list(stop.stop)
+        self._max_hold = max((len(s) for s in self._stop_seqs), default=0)
+        self._jail = ""  # held-back text possibly prefixing a stop sequence
+        self._emitted_tokens = 0
+        self.finished: Optional[FinishReason] = None
+
+    def _scan_stop(self, text: str) -> tuple[str, bool]:
+        """Returns (releasable_text, hit). Keeps a possible stop-seq prefix
+        jailed in self._jail."""
+        if not self._stop_seqs:
+            return text, False
+        buf = self._jail + text
+        for seq in self._stop_seqs:
+            idx = buf.find(seq)
+            if idx != -1:
+                self._jail = ""
+                return buf[:idx], True  # visible text before the stop string
+        # keep the longest tail that could still grow into a stop sequence
+        hold = 0
+        for seq in self._stop_seqs:
+            for k in range(min(len(seq) - 1, len(buf)), 0, -1):
+                if buf.endswith(seq[:k]):
+                    hold = max(hold, k)
+                    break
+        if hold:
+            self._jail = buf[-hold:]
+            return buf[:-hold], False
+        self._jail = ""
+        return buf, False
+
+    def step(self, output: LLMEngineOutput) -> StepResult:
+        """Fold one engine delta; returns text to emit + finish state."""
+        if self.finished is not None:
+            return StepResult(finish_reason=self.finished)
+        result = StepResult()
+        if output.text is not None:
+            # engine already detokenized (e.g. echo_full)
+            pieces = output.text
+            released, hit = self._scan_stop(pieces)
+            result.text += released
+            self._emitted_tokens += max(len(output.token_ids), 1)
+            if hit:
+                self.finished = FinishReason.STOP_SEQUENCE
+        else:
+            for tok in output.token_ids:
+                if not self._stop.ignore_eos and tok in self._eos:
+                    self.finished = FinishReason.EOS
+                    break
+                piece = self._stream.step(tok)
+                self._emitted_tokens += 1
+                result.tokens_emitted += 1
+                if piece:
+                    released, hit = self._scan_stop(piece)
+                    result.text += released
+                    if hit:
+                        self.finished = FinishReason.STOP_SEQUENCE
+                        break
+                if (
+                    self._stop.max_tokens is not None
+                    and self._emitted_tokens >= self._stop.max_tokens
+                ):
+                    self.finished = FinishReason.LENGTH
+                    break
+        if self.finished is None and output.finish_reason is not None:
+            self.finished = output.finish_reason
+        result.finish_reason = self.finished
+        return result
+
+    @property
+    def emitted_tokens(self) -> int:
+        return self._emitted_tokens
+
+
+class Backend:
+    """Factory wiring SequenceDecoders per request/choice."""
+
+    def __init__(self, tokenizer: TokenizerWrapper) -> None:
+        self.tokenizer = tokenizer
+
+    def decoder(
+        self, stop: StopConditions, eos_token_ids: list[int]
+    ) -> SequenceDecoder:
+        return SequenceDecoder(self.tokenizer, stop, eos_token_ids)

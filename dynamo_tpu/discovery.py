@@ -1,0 +1,166 @@
+"""Model discovery: workers register models; frontends watch and wire chains.
+
+Role-equivalent of lib/llm/src/discovery/{watcher,model_manager,model_entry}.rs
+and the bindings' `register_llm`: a worker publishes its ModelDeploymentCard
+to the fabric object store and writes a lease-bound kv entry under `models/`;
+every frontend's ModelWatcher sees the entry, downloads the card, builds the
+preprocessor -> router -> backend chain, and registers it with its
+ModelManager. Lease death removes the entry and (on last ref) the model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.http.service import ModelExecution, ModelManager
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.pipeline.annotated import Annotated
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.pipeline.router import PushRouter, RouterMode
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.runtime.component import Endpoint
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.protocols import MODEL_ROOT, EndpointId
+
+logger = get_logger("dynamo_tpu.discovery")
+
+
+@dataclass
+class ModelEntry:
+    """The kv record under models/ (reference discovery/model_entry.rs)."""
+
+    name: str
+    slug: str
+    endpoint: str  # dyn://ns.comp.ep
+    model_type: str = "both"
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "ModelEntry":
+        d = json.loads(raw)
+        return cls(**{k: v for k, v in d.items() if k in cls.__dataclass_fields__})
+
+
+async def register_llm(
+    drt: DistributedRuntime,
+    endpoint: Endpoint,
+    mdc: ModelDeploymentCard,
+    lease_id: Optional[int] = None,
+) -> str:
+    """Publish the model card + discovery entry for a serving worker.
+
+    Returns the kv key (which dies with the lease)."""
+    await mdc.publish(drt.fabric)
+    lid = lease_id if lease_id is not None else drt.primary_lease
+    entry = ModelEntry(
+        name=mdc.name,
+        slug=mdc.slug,
+        endpoint=str(endpoint.id),
+        model_type=mdc.model_type,
+    )
+    key = f"{MODEL_ROOT}{mdc.slug}:{lid:x}"
+    await drt.fabric.kv_put(key, entry.to_bytes(), lease_id=lid)
+    logger.info("registered model %s -> %s", mdc.name, entry.endpoint)
+    return key
+
+
+class RemoteEngine:
+    """EngineFn adapter: forwards PreprocessedRequests over a PushRouter and
+    yields LLMEngineOutput deltas from the response stream."""
+
+    def __init__(self, router: PushRouter) -> None:
+        self.router = router
+
+    async def __call__(
+        self, request: PreprocessedRequest, ctx: Context
+    ) -> AsyncIterator[LLMEngineOutput]:
+        stream = await self.router.generate(request.to_dict(), ctx)
+        try:
+            async for item in stream:
+                if item.is_error():
+                    raise RuntimeError(item.error_message() or "worker error")
+                if item.data is not None:
+                    yield LLMEngineOutput.from_dict(item.data)
+        finally:
+            await stream.close()
+
+
+class ModelWatcher:
+    """Watches `models/` and keeps a ModelManager in sync.
+
+    (reference discovery/watcher.rs:69-346)"""
+
+    def __init__(
+        self,
+        drt: DistributedRuntime,
+        manager: ModelManager,
+        router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+    ) -> None:
+        self.drt = drt
+        self.manager = manager
+        self.router_mode = router_mode
+        self._task: Optional[asyncio.Task] = None
+        self._watch = None
+        self._clients: dict[str, Any] = {}  # endpoint str -> Client
+        self._key_to_model: dict[str, str] = {}
+
+    async def start(self) -> None:
+        self._watch = await self.drt.fabric.watch_prefix(MODEL_ROOT)
+        for ev in self._watch.initial:
+            await self._on_put(ev.key, ev.value)
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._watch is not None:
+            await self._watch.cancel()
+        if self._task is not None:
+            self._task.cancel()
+        for client in self._clients.values():
+            await client.close()
+        self._clients.clear()
+
+    async def _loop(self) -> None:
+        assert self._watch is not None
+        with contextlib.suppress(asyncio.CancelledError):
+            async for ev in self._watch:
+                try:
+                    if ev.type == "put":
+                        await self._on_put(ev.key, ev.value)
+                    else:
+                        await self._on_delete(ev.key)
+                except Exception:  # noqa: BLE001 — keep watching
+                    logger.exception("model watcher failed applying %s", ev.key)
+
+    async def _on_put(self, key: str, value: bytes) -> None:
+        entry = ModelEntry.from_bytes(value)
+        if self.manager.get(entry.name) is not None:
+            self._key_to_model[key] = entry.name
+            self.manager.add_model(entry.name, self.manager.get(entry.name), ref=key)  # type: ignore[arg-type]
+            return
+        mdc = await ModelDeploymentCard.download(self.drt.fabric, entry.slug)
+        eid = EndpointId.parse(entry.endpoint)
+        endpoint = (
+            self.drt.namespace(eid.namespace).component(eid.component).endpoint(eid.name)
+        )
+        client = self._clients.get(entry.endpoint)
+        if client is None:
+            client = await endpoint.client()
+            self._clients[entry.endpoint] = client
+        router = PushRouter(client, self.router_mode)
+        execution = ModelExecution(mdc, RemoteEngine(router))
+        self.manager.add_model(entry.name, execution, ref=key)
+        self._key_to_model[key] = entry.name
+        logger.info("watcher wired model %s via %s", entry.name, entry.endpoint)
+
+    async def _on_delete(self, key: str) -> None:
+        model = self._key_to_model.pop(key, None)
+        if model is None:
+            return
+        self.manager.remove_ref(model, key)

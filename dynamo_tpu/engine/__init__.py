@@ -1,0 +1,33 @@
+"""Engine layer: the AsyncEngine protocol and engine registry.
+
+Role-equivalent of lib/runtime/src/engine.rs (AsyncEngine trait) +
+lib/llm/src/engines.rs (engine dispatch). An engine consumes a
+PreprocessedRequest and streams LLMEngineOutput deltas; everything above it
+(preprocessing, detokenization, routing, HTTP) is engine-agnostic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional, Protocol, runtime_checkable
+
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+
+
+@runtime_checkable
+class AsyncEngine(Protocol):
+    def generate(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[LLMEngineOutput]:
+        """Stream token deltas for one request."""
+        ...
+
+
+@dataclass
+class MultiNodeConfig:
+    """Multi-host engine bring-up settings (reference engines.rs:43)."""
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    leader_addr: str = ""

@@ -1,0 +1,75 @@
+"""Echo engines: deterministic fake engines for tests and pipeline bring-up.
+
+Role-equivalent of lib/llm/src/engines.rs:66-128 (EchoEngineCore /
+EchoEngineFull, ~100 tok/s paced by DYN_TOKEN_ECHO_DELAY_MS): echo_core
+replays the prompt's token ids one by one; echo_full emits pre-detokenized
+text (exercising the engines-that-detokenize path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import AsyncIterator
+
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+
+
+def _delay_s() -> float:
+    return float(os.environ.get("DYN_TOKEN_ECHO_DELAY_MS", "10")) / 1000.0
+
+
+class EchoEngineCore:
+    """Echoes prompt token ids back as generation output."""
+
+    async def generate(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[LLMEngineOutput]:
+        delay = _delay_s()
+        limit = request.stop.max_tokens or len(request.token_ids)
+        count = 0
+        for tok in request.token_ids:
+            if context.is_stopped() or count >= limit:
+                break
+            await asyncio.sleep(delay)
+            yield LLMEngineOutput(token_ids=[tok])
+            count += 1
+        reason = (
+            FinishReason.CANCELLED
+            if context.is_killed()
+            else (FinishReason.LENGTH if count >= limit else FinishReason.STOP)
+        )
+        yield LLMEngineOutput.final(reason)
+
+
+class EchoEngineFull:
+    """Echoes the prompt text back word by word (pre-detokenized path)."""
+
+    def __init__(self, text_source_key: str = "echo_text") -> None:
+        self.text_source_key = text_source_key
+
+    async def generate(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[LLMEngineOutput]:
+        delay = _delay_s()
+        text = request.extra.get(self.text_source_key, "")
+        words = text.split(" ") if text else [str(t) for t in request.token_ids]
+        limit = request.stop.max_tokens or len(words)
+        count = 0
+        for i, w in enumerate(words):
+            if context.is_stopped() or count >= limit:
+                break
+            await asyncio.sleep(delay)
+            yield LLMEngineOutput(text=(w if i == 0 else " " + w))
+            count += 1
+        reason = (
+            FinishReason.CANCELLED
+            if context.is_killed()
+            else (FinishReason.LENGTH if count >= limit else FinishReason.STOP)
+        )
+        yield LLMEngineOutput.final(reason)

@@ -1,0 +1,6 @@
+"""Entrypoints: wire an input (http/text/batch/endpoint) to an engine config.
+
+Role-equivalent of lib/llm/src/entrypoint (EngineConfig at entrypoint.rs:35,
+run_input dispatch at input.rs:101-134, per-input modules)."""
+
+from dynamo_tpu.entrypoint.inputs import EngineConfig, run_input  # noqa: F401

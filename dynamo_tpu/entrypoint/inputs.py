@@ -1,0 +1,269 @@
+"""Input modes: http server, interactive text, jsonl batch, dyn:// worker.
+
+Role-equivalent of lib/llm/src/entrypoint/input/{http,text,batch,endpoint,
+common}.rs. `EngineConfig.dynamic()` serves whatever workers register via
+discovery; `EngineConfig.static_(engine, mdc)` wires a local engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.discovery import ModelWatcher, register_llm
+from dynamo_tpu.engine import AsyncEngine
+from dynamo_tpu.http.service import EngineFn, HttpService, ModelExecution, ModelManager
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.pipeline.router import RouterMode
+from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.protocols.openai import ChatCompletionRequest, ChatMessage
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.protocols import EndpointId
+
+logger = get_logger("dynamo_tpu.entrypoint")
+
+
+@dataclass
+class EngineConfig:
+    """Either dynamic (discovered workers) or a static local engine."""
+
+    engine: Optional[AsyncEngine] = None
+    mdc: Optional[ModelDeploymentCard] = None
+    router_mode: RouterMode = RouterMode.ROUND_ROBIN
+
+    @classmethod
+    def dynamic(cls, router_mode: RouterMode = RouterMode.ROUND_ROBIN) -> "EngineConfig":
+        return cls(router_mode=router_mode)
+
+    @classmethod
+    def static_(cls, engine: AsyncEngine, mdc: ModelDeploymentCard) -> "EngineConfig":
+        return cls(engine=engine, mdc=mdc)
+
+    @property
+    def is_static(self) -> bool:
+        return self.engine is not None
+
+    def local_engine_fn(self) -> EngineFn:
+        assert self.engine is not None
+        return self.engine.generate
+
+
+async def run_input(
+    drt: DistributedRuntime,
+    in_opt: str,
+    config: EngineConfig,
+    http_port: int = 8080,
+    http_host: str = "0.0.0.0",
+) -> None:
+    """Dispatch on the input flavor (reference input.rs:101-134)."""
+    if in_opt == "http":
+        await run_http(drt, config, host=http_host, port=http_port)
+    elif in_opt in ("text", "stdin"):
+        await run_text(drt, config)
+    elif in_opt.startswith("batch:"):
+        await run_batch(drt, config, in_opt[len("batch:") :])
+    elif in_opt.startswith("dyn://") or "." in in_opt:
+        await run_endpoint(drt, config, in_opt)
+    else:
+        raise ValueError(f"unknown input {in_opt!r}")
+
+
+# ------------------------------------------------------------------ http
+
+
+async def run_http(
+    drt: DistributedRuntime,
+    config: EngineConfig,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+) -> HttpService:
+    manager = ModelManager()
+    service = HttpService(manager, host=host, port=port)
+    if config.is_static:
+        assert config.mdc is not None
+        manager.add_model(
+            config.mdc.name, ModelExecution(config.mdc, config.local_engine_fn())
+        )
+    else:
+        watcher = ModelWatcher(drt, manager, config.router_mode)
+        await watcher.start()
+    await service.start()
+    return service
+
+
+async def serve_http_forever(
+    drt: DistributedRuntime, config: EngineConfig, host: str, port: int
+) -> None:
+    await run_http(drt, config, host, port)
+    await drt.token.cancelled()
+
+
+# ------------------------------------------------------------------ text
+
+
+async def run_text(
+    drt: DistributedRuntime, config: EngineConfig, prompt_once: Optional[str] = None
+) -> None:
+    """Interactive chat REPL on stdin/stdout (reference input/text.rs)."""
+    execution, model_name = await _resolve_execution(drt, config)
+    messages: list[ChatMessage] = []
+    loop = asyncio.get_running_loop()
+
+    async def one_turn(user_text: str) -> None:
+        messages.append(ChatMessage(role="user", content=user_text))
+        req = ChatCompletionRequest(
+            model=model_name, messages=messages, stream=True
+        )
+        ctx = Context()
+        reply_parts: list[str] = []
+        async for item in execution.chat_stream(req, ctx):
+            if item.is_error():
+                print(f"\n[error] {item.error_message()}", flush=True)
+                return
+            if item.data:
+                for choice in item.data.get("choices", []):
+                    delta = choice.get("delta", {}).get("content")
+                    if delta:
+                        reply_parts.append(delta)
+                        print(delta, end="", flush=True)
+        print()
+        messages.append(ChatMessage(role="assistant", content="".join(reply_parts)))
+
+    if prompt_once is not None:
+        await one_turn(prompt_once)
+        return
+    print(f"chatting with {model_name} — ctrl-d to exit", flush=True)
+    while True:
+        line = await loop.run_in_executor(None, sys.stdin.readline)
+        if not line:
+            return
+        line = line.strip()
+        if line:
+            await one_turn(line)
+
+
+# ----------------------------------------------------------------- batch
+
+
+async def run_batch(
+    drt: DistributedRuntime,
+    config: EngineConfig,
+    path: str,
+    output_path: Optional[str] = None,
+    concurrency: int = 8,
+) -> dict[str, Any]:
+    """JSONL batch eval with TTFT/ITL stats (reference input/batch.rs)."""
+    execution, model_name = await _resolve_execution(drt, config)
+    with open(path) as f:
+        requests = [json.loads(line) for line in f if line.strip()]
+    sem = asyncio.Semaphore(concurrency)
+    results: list[dict[str, Any]] = [None] * len(requests)  # type: ignore[list-item]
+
+    async def run_one(i: int, spec: dict[str, Any]) -> None:
+        async with sem:
+            prompt = spec.get("text") or spec.get("prompt") or ""
+            req = ChatCompletionRequest(
+                model=model_name,
+                messages=[ChatMessage(role="user", content=prompt)],
+                stream=True,
+                max_tokens=spec.get("max_tokens"),
+            )
+            start = time.monotonic()
+            first: Optional[float] = None
+            last = start
+            parts: list[str] = []
+            itls: list[float] = []
+            async for item in execution.chat_stream(req, Context()):
+                if item.data:
+                    for choice in item.data.get("choices", []):
+                        delta = choice.get("delta", {}).get("content")
+                        if delta:
+                            now = time.monotonic()
+                            if first is None:
+                                first = now
+                            else:
+                                itls.append(now - last)
+                            last = now
+                            parts.append(delta)
+            results[i] = {
+                "text": "".join(parts),
+                "ttft_ms": (first - start) * 1e3 if first else None,
+                "itl_ms_mean": (sum(itls) / len(itls) * 1e3) if itls else None,
+                "elapsed_ms": (time.monotonic() - start) * 1e3,
+            }
+
+    await asyncio.gather(*(run_one(i, s) for i, s in enumerate(requests)))
+    ttfts = [r["ttft_ms"] for r in results if r and r["ttft_ms"] is not None]
+    summary = {
+        "num_requests": len(requests),
+        "ttft_ms_mean": sum(ttfts) / len(ttfts) if ttfts else None,
+        "results": results,
+    }
+    out_path = output_path or (path + ".out.jsonl")
+    with open(out_path, "w") as f:
+        for r in results:
+            f.write(json.dumps(r) + "\n")
+    logger.info(
+        "batch done: %d requests, mean TTFT %.1f ms",
+        len(requests),
+        summary["ttft_ms_mean"] or -1,
+    )
+    return summary
+
+
+# -------------------------------------------------------------- endpoint
+
+
+async def run_endpoint(
+    drt: DistributedRuntime, config: EngineConfig, endpoint_str: str
+) -> None:
+    """Host a static engine as a dyn:// worker and register its model
+    (reference input/endpoint.rs:26-96 + bindings register_llm)."""
+    if not config.is_static:
+        raise ValueError("in=dyn:// requires a static engine (the worker owns it)")
+    assert config.mdc is not None and config.engine is not None
+    eid = EndpointId.parse(endpoint_str, drt.config.namespace)
+    endpoint = (
+        drt.namespace(eid.namespace).component(eid.component).endpoint(eid.name)
+    )
+    engine = config.engine
+
+    async def handler(request: dict, ctx: Context) -> AsyncIterator[dict]:
+        pre = PreprocessedRequest.from_dict(request)
+        async for out in engine.generate(pre, ctx):
+            yield out.to_dict()
+
+    service = await endpoint.serve_endpoint(handler)
+    await register_llm(drt, endpoint, config.mdc)
+    logger.info("worker serving %s (model %s)", eid, config.mdc.name)
+    await service.wait()
+
+
+# ----------------------------------------------------------------- util
+
+
+async def _resolve_execution(
+    drt: DistributedRuntime, config: EngineConfig
+) -> tuple[ModelExecution, str]:
+    if config.is_static:
+        assert config.mdc is not None
+        return ModelExecution(config.mdc, config.local_engine_fn()), config.mdc.name
+    # dynamic: wait for a discovered model
+    manager = ModelManager()
+    watcher = ModelWatcher(drt, manager, config.router_mode)
+    await watcher.start()
+    for _ in range(300):
+        models = manager.list_models()
+        if models:
+            execution = manager.get(models[0])
+            assert execution is not None
+            return execution, models[0]
+        await asyncio.sleep(0.1)
+    raise TimeoutError("no models discovered within 30s")

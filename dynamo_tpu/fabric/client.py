@@ -111,6 +111,10 @@ class FabricClient:
         self._pending: dict[int, asyncio.Future] = {}
         self._streams: dict[int, Any] = {}  # stream_id -> Watch|Subscription
         self._stream_kind: dict[int, str] = {}
+        # pushes that raced ahead of the watch/subscribe response: the server
+        # may emit an event for a stream before our coroutine has registered
+        # it in _streams; buffer instead of dropping
+        self._early_pushes: dict[int, list[Any]] = {}
         self._req_ids = itertools.count(1)
         self._read_task: Optional[asyncio.Task] = None
         self._pump_tasks: set[asyncio.Task] = set()
@@ -145,6 +149,23 @@ class FabricClient:
         self._pump_tasks.add(task)
         task.add_done_callback(self._pump_tasks.discard)
 
+    def _deliver_push(self, stream_id: int, target: Any, payload: Any) -> None:
+        kind = self._stream_kind.get(stream_id, "watch")
+        if payload is None:
+            target._feed(None)
+            self._streams.pop(stream_id, None)
+            self._stream_kind.pop(stream_id, None)
+        elif kind == "watch":
+            target._feed(WatchEvent.from_wire(payload))
+        else:
+            target._feed((payload[0], payload[1]))
+
+    def _register_stream(self, stream_id: int, target: Any, kind: str) -> None:
+        self._streams[stream_id] = target
+        self._stream_kind[stream_id] = kind
+        for payload in self._early_pushes.pop(stream_id, []):
+            self._deliver_push(stream_id, target, payload)
+
     def _ensure_started(self) -> None:
         if self._state is not None:
             self._state.start()
@@ -152,17 +173,22 @@ class FabricClient:
     async def close(self) -> None:
         if self._read_task:
             self._read_task.cancel()
-        for t in list(self._pump_tasks):
-            t.cancel()
         if self._state is not None:
-            # unregister in-process watches/subs from the (possibly shared)
-            # FabricState so its event queues don't accumulate forever
+            # Unregister in-process watches/subs from the (possibly shared)
+            # FabricState so its event queues don't accumulate forever. Do it
+            # BEFORE touching pump tasks: cancellation feeds a terminating
+            # None through the state queue, and the pumps must still be alive
+            # to deliver it to iterating consumers (or they'd hang).
             for wid in list(self._inproc_watches):
                 self._state.watch_cancel(wid)
             for sid in list(self._inproc_subs):
                 self._state.unsubscribe(sid)
             self._inproc_watches.clear()
             self._inproc_subs.clear()
+            if self._pump_tasks:
+                await asyncio.wait(list(self._pump_tasks), timeout=1.0)
+        for t in list(self._pump_tasks):
+            t.cancel()
         if self._writer:
             with contextlib.suppress(Exception):
                 self._writer.close()
@@ -184,16 +210,11 @@ class FabricClient:
                     _, _, stream_id, payload = msg
                     target = self._streams.get(stream_id)
                     if target is None:
+                        self._early_pushes.setdefault(stream_id, []).append(
+                            payload
+                        )
                         continue
-                    kind = self._stream_kind[stream_id]
-                    if payload is None:
-                        target._feed(None)
-                        self._streams.pop(stream_id, None)
-                        self._stream_kind.pop(stream_id, None)
-                    elif kind == "watch":
-                        target._feed(WatchEvent.from_wire(payload))
-                    else:
-                        target._feed((payload[0], payload[1]))
+                    self._deliver_push(stream_id, target, payload)
                 else:
                     fut = self._pending.pop(req_id, None)
                     if fut is None or fut.done():
@@ -311,8 +332,7 @@ class FabricClient:
                 await self._call("watch_cancel", watch_id=wid)
 
         watch = Watch([WatchEvent.from_wire(d) for d in snapshot_wire], cancel_remote)
-        self._streams[wid] = watch
-        self._stream_kind[wid] = "watch"
+        self._register_stream(wid, watch, "watch")
         return watch
 
     # ------------------------------------------------------------ pub/sub
@@ -350,8 +370,7 @@ class FabricClient:
                 await self._call("unsubscribe", sub_id=sid)
 
         sub = Subscription(cancel_remote)
-        self._streams[sid] = sub
-        self._stream_kind[sid] = "sub"
+        self._register_stream(sid, sub, "sub")
         return sub
 
     async def publish(self, subject: str, payload: bytes) -> int:

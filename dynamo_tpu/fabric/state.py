@@ -19,12 +19,11 @@ Capability map to the reference:
 from __future__ import annotations
 
 import asyncio
-import fnmatch
 import itertools
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, AsyncIterator, Optional
+from typing import Optional
 
 from dynamo_tpu.runtime.logging import get_logger
 
@@ -118,7 +117,7 @@ def subject_matches(pattern: str, subject: str) -> bool:
     st = subject.split(".")
     for i, tok in enumerate(pt):
         if tok == ">":
-            return True
+            return i < len(st)  # '>' matches one or more remaining tokens
         if i >= len(st):
             return False
         if tok != "*" and tok != st[i]:
@@ -234,10 +233,16 @@ class FabricState:
 
     def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
         """CAS create: fails if the key exists with a different value
-        (reference etcd.rs:203 kv_create_or_validate)."""
+        (reference etcd.rs:203 kv_create_or_validate). On a matching value
+        the key is re-bound to the caller's lease, so a process restarting
+        within its old lease's grace period owns the key again."""
         existing = self.kv.get(key)
         if existing is not None:
-            return existing.value == value
+            if existing.value != value:
+                return False
+            if existing.lease_id != lease_id:
+                self.kv_put(key, value, lease_id)  # re-bind lease
+            return True
         self.kv_put(key, value, lease_id)
         return True
 
@@ -357,10 +362,21 @@ class FabricState:
         q.waiters.append(fut)
         try:
             return await asyncio.wait_for(fut, timeout)
-        except (asyncio.TimeoutError, asyncio.CancelledError):
+        except asyncio.TimeoutError:
             if not fut.done():
                 fut.cancel()
             return None
+        except asyncio.CancelledError:
+            # A message may have been assigned to us concurrently; requeue it
+            # so it isn't lost, then propagate the cancellation.
+            if fut.done() and not fut.cancelled():
+                msg = fut.result()
+                q.inflight.pop(msg.id, None)
+                q.ready.appendleft(msg)
+                self._wake_queue(q)
+            else:
+                fut.cancel()
+            raise
 
     def queue_ack(self, name: str, msg_id: int) -> bool:
         q = self._queue(name)

@@ -1,0 +1,6 @@
+"""OpenAI-compatible HTTP frontend (aiohttp) with Prometheus metrics.
+
+Role-equivalent of lib/llm/src/http/service (axum HttpService, openai.rs
+handlers, metrics.rs)."""
+
+from dynamo_tpu.http.service import HttpService, ModelManager  # noqa: F401

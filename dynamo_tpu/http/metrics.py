@@ -1,0 +1,123 @@
+"""Frontend Prometheus metrics.
+
+Role-equivalent of lib/llm/src/http/service/metrics.rs (nv_llm_http_service_*
+counters/gauges/histograms: per-model request counts, inflight, duration,
+TTFT, token throughput). Exposed at GET /metrics.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+PREFIX = "dyn_llm_http_service"
+
+_DURATION_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0,
+)
+_TTFT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0,
+)
+
+
+class ServiceMetrics:
+    def __init__(self, registry: CollectorRegistry | None = None) -> None:
+        self.registry = registry or CollectorRegistry()
+        self.requests_total = Counter(
+            f"{PREFIX}_requests_total",
+            "Total requests",
+            ["model", "endpoint", "status"],
+            registry=self.registry,
+        )
+        self.inflight = Gauge(
+            f"{PREFIX}_inflight_requests",
+            "Currently executing requests",
+            ["model", "endpoint"],
+            registry=self.registry,
+        )
+        self.request_duration = Histogram(
+            f"{PREFIX}_request_duration_seconds",
+            "End-to-end request duration",
+            ["model", "endpoint"],
+            buckets=_DURATION_BUCKETS,
+            registry=self.registry,
+        )
+        self.time_to_first_token = Histogram(
+            f"{PREFIX}_time_to_first_token_seconds",
+            "Time to first streamed token",
+            ["model"],
+            buckets=_TTFT_BUCKETS,
+            registry=self.registry,
+        )
+        self.inter_token_latency = Histogram(
+            f"{PREFIX}_inter_token_latency_seconds",
+            "Latency between streamed tokens",
+            ["model"],
+            buckets=_TTFT_BUCKETS,
+            registry=self.registry,
+        )
+        self.prompt_tokens = Counter(
+            f"{PREFIX}_prompt_tokens_total",
+            "Prompt tokens processed",
+            ["model"],
+            registry=self.registry,
+        )
+        self.output_tokens = Counter(
+            f"{PREFIX}_output_tokens_total",
+            "Output tokens generated",
+            ["model"],
+            registry=self.registry,
+        )
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+    @contextmanager
+    def track(self, model: str, endpoint: str):
+        """Track one request: inflight gauge + duration + status count."""
+        start = time.monotonic()
+        self.inflight.labels(model, endpoint).inc()
+        status = "success"
+        try:
+            yield
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            self.inflight.labels(model, endpoint).dec()
+            self.requests_total.labels(model, endpoint, status).inc()
+            self.request_duration.labels(model, endpoint).observe(
+                time.monotonic() - start
+            )
+
+
+class TokenTimer:
+    """Per-request TTFT / inter-token latency observer."""
+
+    def __init__(self, metrics: ServiceMetrics, model: str) -> None:
+        self.metrics = metrics
+        self.model = model
+        self.start = time.monotonic()
+        self.last: float | None = None
+
+    def on_token(self, count: int = 1) -> None:
+        now = time.monotonic()
+        if self.last is None:
+            self.metrics.time_to_first_token.labels(self.model).observe(
+                now - self.start
+            )
+        else:
+            self.metrics.inter_token_latency.labels(self.model).observe(
+                now - self.last
+            )
+        self.last = now
+        self.metrics.output_tokens.labels(self.model).inc(count)

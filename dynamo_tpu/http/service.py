@@ -1,0 +1,315 @@
+"""OpenAI-compatible HTTP service over aiohttp.
+
+Role-equivalent of lib/llm/src/http/service/service_v2.rs (HttpService,
+State{ModelManager, Metrics}) + openai.rs handlers (:133 completions, :287
+chat, :677 models) with SSE streaming, client-disconnect kill (:725-811),
+per-model execution chains, /health and Prometheus /metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+from typing import Any, AsyncIterator, Callable, Optional
+
+from aiohttp import web
+
+from dynamo_tpu.backend import Backend
+from dynamo_tpu.http.metrics import ServiceMetrics, TokenTimer
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.pipeline.annotated import Annotated
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.preprocessor import (
+    ChatDeltaGenerator,
+    CompletionDeltaGenerator,
+    OpenAIPreprocessor,
+)
+from dynamo_tpu.protocols.aggregator import ChatDeltaAggregator, CompletionAggregator
+from dynamo_tpu.protocols.common import FinishReason, LLMEngineOutput, PreprocessedRequest
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    CompletionRequest,
+    CompletionResponse,
+    ModelInfo,
+    ModelList,
+    usage_dict,
+)
+from dynamo_tpu.protocols.sse import encode_done, encode_json_event
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.http")
+
+# engine_fn(PreprocessedRequest, Context) -> AsyncIterator[LLMEngineOutput]
+EngineFn = Callable[[PreprocessedRequest, Context], AsyncIterator[LLMEngineOutput]]
+
+
+class ModelExecution:
+    """Per-model chain: preprocess -> engine -> detokenize -> OpenAI chunks."""
+
+    def __init__(self, mdc: ModelDeploymentCard, engine_fn: EngineFn) -> None:
+        self.mdc = mdc
+        self.engine_fn = engine_fn
+        self.preprocessor = OpenAIPreprocessor(mdc)
+        self.backend = Backend(self.preprocessor.tokenizer)
+
+    async def chat_stream(
+        self, request: ChatCompletionRequest, ctx: Context, timer: Optional[TokenTimer] = None
+    ) -> AsyncIterator[Annotated]:
+        pre, prompt = self.preprocessor.preprocess_chat(request)
+        pre.extra["echo_text"] = prompt  # feeds echo_full test engines
+        for ann in self.preprocessor.requested_annotations(pre, prompt):
+            yield ann
+        gen = ChatDeltaGenerator(request.model)
+        yield Annotated.from_data(gen.role_chunk().model_dump(exclude_none=True))
+        decoder = self.backend.decoder(pre.stop, pre.eos_token_ids)
+        completion_tokens = 0
+        finish: Optional[FinishReason] = None
+        async for out in self.engine_fn(pre, ctx):
+            step = decoder.step(out)
+            completion_tokens += step.tokens_emitted or (
+                1 if out.text is not None else 0
+            )
+            if step.text:
+                if timer:
+                    timer.on_token(max(step.tokens_emitted, 1))
+                yield Annotated.from_data(
+                    gen.text_chunk(step.text).model_dump(exclude_none=True)
+                )
+            if step.finish_reason is not None:
+                finish = step.finish_reason
+                break
+        if ctx.is_killed():
+            return
+        yield Annotated.from_data(
+            gen.finish_chunk(finish or FinishReason.STOP).model_dump(exclude_none=True)
+        )
+        if request.stream_options and request.stream_options.get("include_usage"):
+            yield Annotated.from_data(
+                gen.usage_chunk(len(pre.token_ids), completion_tokens).model_dump(
+                    exclude_none=True
+                )
+            )
+
+    async def completion_stream(
+        self, request: CompletionRequest, ctx: Context, timer: Optional[TokenTimer] = None
+    ) -> AsyncIterator[Annotated]:
+        pre, prompt = self.preprocessor.preprocess_completion(request)
+        pre.extra["echo_text"] = prompt
+        gen = CompletionDeltaGenerator(request.model)
+        decoder = self.backend.decoder(pre.stop, pre.eos_token_ids)
+        finish: Optional[FinishReason] = None
+        if request.echo and prompt:
+            yield Annotated.from_data(
+                gen.text_chunk(prompt).model_dump(exclude_none=True)
+            )
+        async for out in self.engine_fn(pre, ctx):
+            step = decoder.step(out)
+            if step.text:
+                if timer:
+                    timer.on_token(max(step.tokens_emitted, 1))
+                yield Annotated.from_data(
+                    gen.text_chunk(step.text).model_dump(exclude_none=True)
+                )
+            if step.finish_reason is not None:
+                finish = step.finish_reason
+                break
+        if ctx.is_killed():
+            return
+        yield Annotated.from_data(
+            gen.finish_chunk(finish or FinishReason.STOP).model_dump(exclude_none=True)
+        )
+
+
+class ModelManager:
+    """Registry of live models (reference discovery/model_manager.rs)."""
+
+    def __init__(self) -> None:
+        self._models: dict[str, dict[str, Any]] = {}
+
+    def add_model(
+        self, name: str, execution: ModelExecution, ref: str = "local"
+    ) -> None:
+        entry = self._models.get(name)
+        if entry is None:
+            self._models[name] = {"execution": execution, "refs": {ref}}
+            logger.info("model added: %s", name)
+        else:
+            entry["refs"].add(ref)
+
+    def remove_ref(self, name: str, ref: str) -> bool:
+        """Drop one worker ref; removes the model when the last ref dies.
+        Returns True if the model was fully removed."""
+        entry = self._models.get(name)
+        if entry is None:
+            return False
+        entry["refs"].discard(ref)
+        if not entry["refs"]:
+            del self._models[name]
+            logger.info("model removed: %s", name)
+            return True
+        return False
+
+    def get(self, name: str) -> Optional[ModelExecution]:
+        entry = self._models.get(name)
+        return entry["execution"] if entry else None
+
+    def list_models(self) -> list[str]:
+        return sorted(self._models.keys())
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: Optional[ModelManager] = None,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        metrics: Optional[ServiceMetrics] = None,
+    ) -> None:
+        self.manager = manager or ModelManager()
+        self.host = host
+        self.port = port
+        self.metrics = metrics or ServiceMetrics()
+        self.app = web.Application(client_max_size=64 * 1024 * 1024)
+        self.app.add_routes(
+            [
+                web.post("/v1/chat/completions", self._chat),
+                web.post("/v1/completions", self._completions),
+                web.get("/v1/models", self._models),
+                web.get("/health", self._health),
+                web.get("/live", self._health),
+                web.get("/metrics", self._metrics),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+
+    # ---------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        logger.info("openai http service on %s:%d", self.host, self.port)
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ----------------------------------------------------------- helpers
+
+    @staticmethod
+    def _error(status: int, message: str, typ: str = "invalid_request_error"):
+        return web.json_response(
+            {"error": {"message": message, "type": typ}}, status=status
+        )
+
+    async def _stream_sse(
+        self,
+        request: web.Request,
+        ctx: Context,
+        annotated_stream: AsyncIterator[Annotated],
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(request)
+        try:
+            async for item in annotated_stream:
+                if item.is_error():
+                    payload = {
+                        "error": {
+                            "message": item.error_message(),
+                            "type": "internal_error",
+                        }
+                    }
+                    await resp.write(encode_json_event(payload).encode())
+                    break
+                if item.event is not None:
+                    await resp.write(
+                        encode_json_event(
+                            item.annotation_value(), event=item.event
+                        ).encode()
+                    )
+                elif item.data is not None:
+                    await resp.write(encode_json_event(item.data).encode())
+            await resp.write(encode_done().encode())
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: kill generation (reference openai.rs:725-811)
+            ctx.kill()
+            raise
+        return resp
+
+    # ---------------------------------------------------------- handlers
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            chat_req = ChatCompletionRequest.model_validate(body)
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, f"invalid request: {e}")
+        execution = self.manager.get(chat_req.model)
+        if execution is None:
+            return self._error(404, f"model {chat_req.model!r} not found", "not_found_error")
+        ctx = Context()
+        timer = TokenTimer(self.metrics, chat_req.model)
+        with self.metrics.track(chat_req.model, "chat_completions"):
+            self.metrics.prompt_tokens.labels(chat_req.model)  # touch label
+            stream = execution.chat_stream(chat_req, ctx, timer)
+            if chat_req.stream:
+                return await self._stream_sse(request, ctx, stream)
+            agg = ChatDeltaAggregator()
+            async for item in stream:
+                if item.is_error():
+                    return self._error(500, item.error_message() or "engine error", "internal_error")
+                if item.data is not None:
+                    agg.add(ChatCompletionChunk.model_validate(item.data))
+            return web.json_response(agg.finish().model_dump(exclude_none=True))
+
+    async def _completions(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body = await request.json()
+            comp_req = CompletionRequest.model_validate(body)
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, f"invalid request: {e}")
+        execution = self.manager.get(comp_req.model)
+        if execution is None:
+            return self._error(404, f"model {comp_req.model!r} not found", "not_found_error")
+        ctx = Context()
+        timer = TokenTimer(self.metrics, comp_req.model)
+        with self.metrics.track(comp_req.model, "completions"):
+            stream = execution.completion_stream(comp_req, ctx, timer)
+            if comp_req.stream:
+                return await self._stream_sse(request, ctx, stream)
+            agg = CompletionAggregator()
+            async for item in stream:
+                if item.is_error():
+                    return self._error(500, item.error_message() or "engine error", "internal_error")
+                if item.data is not None:
+                    agg.add(CompletionResponse.model_validate(item.data))
+            return web.json_response(agg.finish().model_dump(exclude_none=True))
+
+    async def _models(self, request: web.Request) -> web.Response:
+        listing = ModelList(
+            data=[ModelInfo(id=name) for name in self.manager.list_models()]
+        )
+        return web.json_response(listing.model_dump())
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "healthy", "models": self.manager.list_models()}
+        )
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            body=self.metrics.render(), content_type="text/plain"
+        )

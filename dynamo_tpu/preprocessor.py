@@ -1,0 +1,200 @@
+"""OpenAI -> token-space preprocessor and token-space -> OpenAI delta
+generation.
+
+Role-equivalent of lib/llm/src/preprocessor.rs:93 (OpenAIPreprocessor: chat
+template + tokenize -> PreprocessedRequest; reverse edge folds engine deltas
+into the OpenAI stream) and protocols/openai/chat_completions/delta.rs
+(DeltaGenerator). Emits the same annotation events the reference does
+("formatted_prompt", "token_ids", "llm_metrics" — preprocessor.rs:57-90).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, AsyncIterator, Optional, Union
+
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.pipeline.annotated import Annotated
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChoiceDelta,
+    CompletionChoice,
+    CompletionRequest,
+    CompletionResponse,
+    StreamChoice,
+    gen_request_id,
+    usage_dict,
+)
+
+ANNOTATION_FORMATTED_PROMPT = "formatted_prompt"
+ANNOTATION_TOKEN_IDS = "token_ids"
+ANNOTATION_LLM_METRICS = "llm_metrics"
+
+
+class OpenAIPreprocessor:
+    def __init__(self, mdc: ModelDeploymentCard) -> None:
+        self.mdc = mdc
+        self.tokenizer = mdc.load_tokenizer()
+        self.template = mdc.load_chat_template()
+
+    # -------------------------------------------------------- forward
+
+    def preprocess_chat(
+        self, request: ChatCompletionRequest
+    ) -> tuple[PreprocessedRequest, str]:
+        prompt = self.template.render(
+            [m.model_dump(exclude_none=True) for m in request.messages],
+            add_generation_prompt=True,
+            tools=request.tools,
+        )
+        enc = self.tokenizer.encode(prompt)
+        return self._build(request, enc.ids, request.output_limit()), prompt
+
+    def preprocess_completion(
+        self, request: CompletionRequest
+    ) -> tuple[PreprocessedRequest, str]:
+        prompt = request.prompt
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            token_ids = list(prompt)
+            text = ""
+        else:
+            if isinstance(prompt, list):
+                prompt = prompt[0] if prompt else ""
+            text = str(prompt)
+            token_ids = self.tokenizer.encode(text).ids
+        return self._build(request, token_ids, request.output_limit()), text
+
+    def _build(
+        self,
+        request: Union[ChatCompletionRequest, CompletionRequest],
+        token_ids: list[int],
+        max_tokens: Optional[int],
+    ) -> PreprocessedRequest:
+        ext = request.ext
+        sampling = SamplingOptions(
+            temperature=request.temperature,
+            top_p=request.top_p,
+            top_k=request.top_k,
+            frequency_penalty=request.frequency_penalty,
+            presence_penalty=request.presence_penalty,
+            seed=request.seed,
+            n=request.n,
+            greedy=bool(ext and ext.greedy),
+        )
+        budget = self.mdc.context_length - len(token_ids)
+        if max_tokens is None:
+            max_tokens = max(1, budget)
+        stop = StopConditions(
+            max_tokens=max_tokens,
+            stop=request.stop_list(),
+            ignore_eos=bool(ext and ext.ignore_eos),
+        )
+        return PreprocessedRequest(
+            token_ids=token_ids,
+            model=request.model,
+            sampling=sampling,
+            stop=stop,
+            eos_token_ids=self.tokenizer.eos_token_ids,
+            annotations=list(ext.annotations) if ext else [],
+        )
+
+    def requested_annotations(
+        self, preprocessed: PreprocessedRequest, prompt: str
+    ) -> list[Annotated]:
+        out: list[Annotated] = []
+        if ANNOTATION_FORMATTED_PROMPT in preprocessed.annotations:
+            out.append(Annotated.from_annotation(ANNOTATION_FORMATTED_PROMPT, prompt))
+        if ANNOTATION_TOKEN_IDS in preprocessed.annotations:
+            out.append(
+                Annotated.from_annotation(ANNOTATION_TOKEN_IDS, preprocessed.token_ids)
+            )
+        return out
+
+
+class ChatDeltaGenerator:
+    """Folds detokenized engine deltas into OpenAI chat.completion.chunk's."""
+
+    def __init__(self, model: str, request_id: Optional[str] = None) -> None:
+        self.id = request_id or gen_request_id("chatcmpl")
+        self.model = model
+        self.created = int(time.time())
+        self._first: set[int] = set()
+
+    def role_chunk(self, index: int = 0) -> ChatCompletionChunk:
+        self._first.add(index)
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[
+                StreamChoice(index=index, delta=ChoiceDelta(role="assistant"))
+            ],
+        )
+
+    def text_chunk(self, text: str, index: int = 0) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[StreamChoice(index=index, delta=ChoiceDelta(content=text))],
+        )
+
+    def finish_chunk(
+        self, reason: FinishReason, index: int = 0
+    ) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[
+                StreamChoice(index=index, delta=ChoiceDelta(), finish_reason=reason.as_openai())
+            ],
+        )
+
+    def usage_chunk(
+        self, prompt_tokens: int, completion_tokens: int
+    ) -> ChatCompletionChunk:
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[],
+            usage=usage_dict(prompt_tokens, completion_tokens),
+        )
+
+
+class CompletionDeltaGenerator:
+    """Streamed `text_completion` chunks (OpenAI completions API)."""
+
+    def __init__(self, model: str, request_id: Optional[str] = None) -> None:
+        self.id = request_id or gen_request_id("cmpl")
+        self.model = model
+        self.created = int(time.time())
+
+    def text_chunk(self, text: str, index: int = 0) -> CompletionResponse:
+        return CompletionResponse(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[CompletionChoice(index=index, text=text)],
+        )
+
+    def finish_chunk(
+        self, reason: FinishReason, index: int = 0
+    ) -> CompletionResponse:
+        return CompletionResponse(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[
+                CompletionChoice(index=index, text="", finish_reason=reason.as_openai())
+            ],
+        )

@@ -1,0 +1,12 @@
+"""LLM wire protocols: OpenAI-compatible API types, internal engine types,
+SSE codec, and stream aggregators.
+
+Role-equivalent of the reference's lib/llm/src/protocols tree."""
+
+from dynamo_tpu.protocols.common import (  # noqa: F401
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)

@@ -1,0 +1,112 @@
+"""Stream -> unary aggregation: folds a stream of chunks into one response.
+
+Role-equivalent of lib/llm/src/protocols/openai/chat_completions/aggregator.rs
+(DeltaAggregator :32) and completions/aggregator.rs — used when the client
+asked for a non-streaming response but the engine always streams.
+"""
+
+from __future__ import annotations
+
+from typing import AsyncIterator, Optional
+
+from dynamo_tpu.protocols.openai import (
+    ChatChoice,
+    ChatCompletionChunk,
+    ChatCompletionResponse,
+    ChatMessage,
+    CompletionChoice,
+    CompletionResponse,
+)
+
+
+class ChatDeltaAggregator:
+    def __init__(self) -> None:
+        self.id: str = ""
+        self.model: str = ""
+        self.created: int = 0
+        self.usage: Optional[dict] = None
+        self._choices: dict[int, dict] = {}
+
+    def add(self, chunk: ChatCompletionChunk) -> None:
+        self.id = chunk.id or self.id
+        self.model = chunk.model or self.model
+        self.created = chunk.created or self.created
+        if chunk.usage:
+            self.usage = chunk.usage
+        for c in chunk.choices:
+            slot = self._choices.setdefault(
+                c.index,
+                {"role": None, "content": [], "finish_reason": None, "tool_calls": []},
+            )
+            if c.delta.role:
+                slot["role"] = c.delta.role
+            if c.delta.content:
+                slot["content"].append(c.delta.content)
+            if c.delta.tool_calls:
+                slot["tool_calls"].extend(c.delta.tool_calls)
+            if c.finish_reason:
+                slot["finish_reason"] = c.finish_reason
+
+    def finish(self) -> ChatCompletionResponse:
+        choices = [
+            ChatChoice(
+                index=i,
+                message=ChatMessage(
+                    role=slot["role"] or "assistant",
+                    content="".join(slot["content"]),
+                    tool_calls=slot["tool_calls"] or None,
+                ),
+                finish_reason=slot["finish_reason"],
+            )
+            for i, slot in sorted(self._choices.items())
+        ]
+        kwargs = dict(id=self.id, model=self.model, choices=choices, usage=self.usage)
+        if self.created:
+            kwargs["created"] = self.created
+        return ChatCompletionResponse(**kwargs)
+
+    @classmethod
+    async def fold(
+        cls, chunks: AsyncIterator[ChatCompletionChunk]
+    ) -> ChatCompletionResponse:
+        agg = cls()
+        async for chunk in chunks:
+            agg.add(chunk)
+        return agg.finish()
+
+
+class CompletionAggregator:
+    def __init__(self) -> None:
+        self.id = ""
+        self.model = ""
+        self.usage: Optional[dict] = None
+        self._choices: dict[int, dict] = {}
+
+    def add(self, chunk: CompletionResponse) -> None:
+        self.id = chunk.id or self.id
+        self.model = chunk.model or self.model
+        if chunk.usage:
+            self.usage = chunk.usage
+        for c in chunk.choices:
+            slot = self._choices.setdefault(
+                c.index, {"text": [], "finish_reason": None}
+            )
+            if c.text:
+                slot["text"].append(c.text)
+            if c.finish_reason:
+                slot["finish_reason"] = c.finish_reason
+
+    def finish(self) -> CompletionResponse:
+        return CompletionResponse(
+            id=self.id,
+            model=self.model,
+            choices=[
+                CompletionChoice(
+                    index=i,
+                    text="".join(slot["text"]),
+                    finish_reason=slot["finish_reason"],
+                )
+                for i, slot in sorted(self._choices.items())
+            ],
+            usage=self.usage,
+        )

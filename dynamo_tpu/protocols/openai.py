@@ -1,0 +1,190 @@
+"""OpenAI-compatible API types (chat completions, completions, embeddings)
+plus the `ext` extension block.
+
+Role-equivalent of lib/llm/src/protocols/openai/* — request/response models
+with validation, delta (streaming chunk) types, and the nvext-style extension
+(openai/nvext.rs:28: annotations, ignore_eos, greedy). We accept the
+extension under either key "ext" or "nvext" for client compatibility.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Literal, Optional, Union
+
+from pydantic import BaseModel, ConfigDict, Field, model_validator
+
+
+class Ext(BaseModel):
+    """Extension block: out-of-band annotations + sampling overrides."""
+
+    model_config = ConfigDict(extra="allow")
+    annotations: list[str] = Field(default_factory=list)
+    ignore_eos: bool = False
+    greedy: bool = False
+
+
+class ChatMessage(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    role: str
+    content: Optional[Union[str, list[dict[str, Any]]]] = None
+    name: Optional[str] = None
+    tool_calls: Optional[list[dict[str, Any]]] = None
+    tool_call_id: Optional[str] = None
+
+    def text_content(self) -> str:
+        if isinstance(self.content, str):
+            return self.content
+        if isinstance(self.content, list):
+            return "".join(
+                part.get("text", "")
+                for part in self.content
+                if part.get("type") == "text"
+            )
+        return ""
+
+
+class _CommonSampling(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    model: str
+    temperature: Optional[float] = Field(default=None, ge=0.0, le=2.0)
+    top_p: Optional[float] = Field(default=None, gt=0.0, le=1.0)
+    top_k: Optional[int] = Field(default=None, ge=0)
+    frequency_penalty: Optional[float] = Field(default=None, ge=-2.0, le=2.0)
+    presence_penalty: Optional[float] = Field(default=None, ge=-2.0, le=2.0)
+    seed: Optional[int] = None
+    n: int = Field(default=1, ge=1, le=16)
+    stream: bool = False
+    stream_options: Optional[dict[str, Any]] = None
+    stop: Optional[Union[str, list[str]]] = None
+    logprobs: Optional[Union[bool, int]] = None
+    user: Optional[str] = None
+    ext: Optional[Ext] = None
+
+    @model_validator(mode="before")
+    @classmethod
+    def _accept_nvext(cls, data: Any) -> Any:
+        if isinstance(data, dict) and "nvext" in data and "ext" not in data:
+            data = dict(data)
+            data["ext"] = data.pop("nvext")
+        return data
+
+    def stop_list(self) -> list[str]:
+        if self.stop is None:
+            return []
+        return [self.stop] if isinstance(self.stop, str) else list(self.stop)
+
+
+class ChatCompletionRequest(_CommonSampling):
+    messages: list[ChatMessage]
+    max_tokens: Optional[int] = Field(default=None, ge=1)
+    max_completion_tokens: Optional[int] = Field(default=None, ge=1)
+    tools: Optional[list[dict[str, Any]]] = None
+    tool_choice: Optional[Union[str, dict[str, Any]]] = None
+    response_format: Optional[dict[str, Any]] = None
+
+    def output_limit(self) -> Optional[int]:
+        return self.max_completion_tokens or self.max_tokens
+
+
+class CompletionRequest(_CommonSampling):
+    prompt: Union[str, list[str], list[int], list[list[int]]]
+    max_tokens: Optional[int] = Field(default=16, ge=1)
+    echo: bool = False
+
+    def output_limit(self) -> Optional[int]:
+        return self.max_tokens
+
+
+class EmbeddingRequest(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    model: str
+    input: Union[str, list[str], list[int], list[list[int]]]
+    encoding_format: str = "float"
+
+
+# --------------------------------------------------------------- responses
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def gen_request_id(prefix: str = "chatcmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+class ChoiceDelta(BaseModel):
+    model_config = ConfigDict(extra="allow")
+    role: Optional[str] = None
+    content: Optional[str] = None
+    tool_calls: Optional[list[dict[str, Any]]] = None
+
+
+class StreamChoice(BaseModel):
+    index: int = 0
+    delta: ChoiceDelta = Field(default_factory=ChoiceDelta)
+    finish_reason: Optional[str] = None
+    logprobs: Optional[dict[str, Any]] = None
+
+
+class ChatCompletionChunk(BaseModel):
+    id: str
+    object: Literal["chat.completion.chunk"] = "chat.completion.chunk"
+    created: int = Field(default_factory=_now)
+    model: str = ""
+    choices: list[StreamChoice] = Field(default_factory=list)
+    usage: Optional[dict[str, Any]] = None
+
+
+class ChatChoice(BaseModel):
+    index: int = 0
+    message: ChatMessage = Field(default_factory=lambda: ChatMessage(role="assistant"))
+    finish_reason: Optional[str] = None
+    logprobs: Optional[dict[str, Any]] = None
+
+
+class ChatCompletionResponse(BaseModel):
+    id: str
+    object: Literal["chat.completion"] = "chat.completion"
+    created: int = Field(default_factory=_now)
+    model: str = ""
+    choices: list[ChatChoice] = Field(default_factory=list)
+    usage: Optional[dict[str, Any]] = None
+
+
+class CompletionChoice(BaseModel):
+    index: int = 0
+    text: str = ""
+    finish_reason: Optional[str] = None
+    logprobs: Optional[dict[str, Any]] = None
+
+
+class CompletionResponse(BaseModel):
+    id: str
+    object: Literal["text_completion"] = "text_completion"
+    created: int = Field(default_factory=_now)
+    model: str = ""
+    choices: list[CompletionChoice] = Field(default_factory=list)
+    usage: Optional[dict[str, Any]] = None
+
+
+class ModelInfo(BaseModel):
+    id: str
+    object: Literal["model"] = "model"
+    created: int = Field(default_factory=_now)
+    owned_by: str = "dynamo_tpu"
+
+
+class ModelList(BaseModel):
+    object: Literal["list"] = "list"
+    data: list[ModelInfo] = Field(default_factory=list)
+
+
+def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict[str, int]:
+    return {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }

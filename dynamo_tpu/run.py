@@ -1,0 +1,124 @@
+"""`python -m dynamo_tpu.run` — the dynamo-run equivalent CLI.
+
+Role-equivalent of launch/dynamo-run (src/main.rs:39, opt.rs):
+
+    python -m dynamo_tpu.run in=http out=echo_full --model-name test \\
+        --model-path /path/to/hf/dir --http-port 8080
+
+in  = http | text | batch:FILE.jsonl | dyn://ns.comp.ep
+out = echo_core | echo_full | jax | dyn   (dyn = route to discovered workers)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+from typing import Optional
+
+from dynamo_tpu.engine.echo import EchoEngineCore, EchoEngineFull
+from dynamo_tpu.entrypoint.inputs import EngineConfig, run_batch, run_input, run_text
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.pipeline.router import RouterMode
+from dynamo_tpu.runtime import logging as dlog
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.tokenizer import TokenizerWrapper
+
+
+def build_test_mdc(name: str) -> ModelDeploymentCard:
+    """A self-contained word-level model card for echo engines (no files)."""
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {"<unk>": 0, "<s>": 1, "</s>": 2}
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    wrapper = TokenizerWrapper(tok, eos_token_ids=[2])
+    return ModelDeploymentCard.from_tokenizer(name, wrapper)
+
+
+def parse_args(argv: Optional[list[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(prog="dynamo_tpu.run", description=__doc__)
+    parser.add_argument("inout", nargs="*", help="in=... out=...")
+    parser.add_argument("--model-path", default=None)
+    parser.add_argument("--model-name", default=None)
+    parser.add_argument("--http-port", type=int, default=8080)
+    parser.add_argument("--http-host", default="0.0.0.0")
+    parser.add_argument("--kv-block-size", type=int, default=16)
+    parser.add_argument("--context-length", type=int, default=None)
+    parser.add_argument(
+        "--router-mode",
+        choices=[m.value for m in RouterMode],
+        default="round_robin",
+    )
+    parser.add_argument("--endpoint", default="dynamo.backend.generate")
+    parser.add_argument(
+        "--tensor-parallel-size", type=int, default=1,
+        help="TP degree for out=jax engines",
+    )
+    args = parser.parse_args(argv)
+    args.in_opt = "http"
+    args.out_opt = "echo_full"
+    for tok in args.inout:
+        if tok.startswith("in="):
+            args.in_opt = tok[3:]
+        elif tok.startswith("out="):
+            args.out_opt = tok[4:]
+        elif args.model_path is None:
+            args.model_path = tok
+    return args
+
+
+async def amain(args: argparse.Namespace) -> None:
+    dlog.init()
+    drt = await DistributedRuntime.from_settings()
+    try:
+        name = args.model_name or (args.model_path or "echo-model")
+        if args.out_opt == "dyn":
+            config = EngineConfig.dynamic(RouterMode(args.router_mode))
+        elif args.out_opt in ("echo_core", "echo_full"):
+            if args.model_path:
+                mdc = ModelDeploymentCard.from_model_dir(
+                    args.model_path,
+                    name,
+                    kv_block_size=args.kv_block_size,
+                    context_length=args.context_length,
+                )
+            else:
+                mdc = build_test_mdc(name)
+            engine = EchoEngineCore() if args.out_opt == "echo_core" else EchoEngineFull()
+            config = EngineConfig.static_(engine, mdc)
+        elif args.out_opt == "jax":
+            from dynamo_tpu.engine.jax.factory import build_jax_engine
+
+            if not args.model_path:
+                raise SystemExit("out=jax requires a --model-path (HF dir)")
+            engine, mdc = await build_jax_engine(
+                args.model_path,
+                name,
+                kv_block_size=args.kv_block_size,
+                context_length=args.context_length,
+                tensor_parallel_size=args.tensor_parallel_size,
+            )
+            config = EngineConfig.static_(engine, mdc)
+        else:
+            raise SystemExit(f"unknown out={args.out_opt}")
+        if args.in_opt == "http":
+            from dynamo_tpu.entrypoint.inputs import serve_http_forever
+
+            await serve_http_forever(drt, config, args.http_host, args.http_port)
+        else:
+            await run_input(drt, args.in_opt, config, args.http_port, args.http_host)
+    finally:
+        await drt.close()
+
+
+def main() -> None:
+    args = parse_args()
+    try:
+        asyncio.run(amain(args))
+    except KeyboardInterrupt:
+        print("\ninterrupted", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,207 @@
+"""Tokenizer wrapper: HF `tokenizers` backend, incremental streaming decode,
+and jinja2 chat templating.
+
+Role-equivalent of lib/llm/src/tokenizers.rs (HuggingFaceTokenizer, Encoding,
+lifetime-safe DecodeStream) + preprocessor/prompt/template (minijinja chat
+templates). The Python `tokenizers` package has no DecodeStream binding, so
+streaming decode uses the windowed decode-diff technique: decode a small
+trailing window with and without the new token and emit the text difference,
+holding output while it ends in an incomplete UTF-8 replacement char.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jinja2
+
+from tokenizers import Tokenizer as HfTokenizer
+
+# Default template: ChatML-ish, used when a model ships no chat template.
+DEFAULT_CHAT_TEMPLATE = (
+    "{% for message in messages %}"
+    "<|im_start|>{{ message['role'] }}\n{{ message['content'] }}<|im_end|>\n"
+    "{% endfor %}"
+    "{% if add_generation_prompt %}<|im_start|>assistant\n{% endif %}"
+)
+
+_REPLACEMENT_CHAR = "�"
+
+
+@dataclass
+class Encoding:
+    ids: list[int]
+    tokens: list[str]
+
+
+class DecodeStream:
+    """Incremental detokenizer for one sequence."""
+
+    def __init__(self, tokenizer: "TokenizerWrapper", window: int = 10) -> None:
+        self._tok = tokenizer
+        self._window = window
+        self._ids: list[int] = []
+        self._prefix_text = ""
+        self._prefix_index = 0  # index into self._ids where the window starts
+
+    def step(self, token_id: int) -> str:
+        """Feed one token id, return newly-decodable text (possibly "")."""
+        self._ids.append(token_id)
+        window_ids = self._ids[self._prefix_index :]
+        text = self._tok.decode(window_ids)
+        if text.endswith(_REPLACEMENT_CHAR):
+            # mid multi-byte sequence; wait for more tokens
+            return ""
+        new_text = text[len(self._prefix_text) :]
+        # slide the window forward to bound decode cost
+        if len(window_ids) >= self._window:
+            keep = max(1, self._window // 2)
+            self._prefix_index = len(self._ids) - keep
+            self._prefix_text = self._tok.decode(self._ids[self._prefix_index :])
+        else:
+            self._prefix_text = text
+        return new_text
+
+
+class TokenizerWrapper:
+    def __init__(self, hf: HfTokenizer, eos_token_ids: Sequence[int] = ()) -> None:
+        self._hf = hf
+        self.eos_token_ids = list(eos_token_ids)
+
+    # ----------------------------------------------------------- factory
+
+    @classmethod
+    def from_file(cls, path: str, eos_token_ids: Sequence[int] = ()) -> "TokenizerWrapper":
+        return cls(HfTokenizer.from_file(path), eos_token_ids)
+
+    @classmethod
+    def from_json_str(
+        cls, data: str, eos_token_ids: Sequence[int] = ()
+    ) -> "TokenizerWrapper":
+        return cls(HfTokenizer.from_str(data), eos_token_ids)
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str) -> "TokenizerWrapper":
+        tok_path = os.path.join(model_dir, "tokenizer.json")
+        if not os.path.exists(tok_path):
+            raise FileNotFoundError(f"no tokenizer.json in {model_dir}")
+        hf = HfTokenizer.from_file(tok_path)
+        eos_ids: list[int] = []
+        cfg_path = os.path.join(model_dir, "config.json")
+        if os.path.exists(cfg_path):
+            with open(cfg_path) as f:
+                cfg = json.load(f)
+            raw = cfg.get("eos_token_id")
+            if isinstance(raw, int):
+                eos_ids = [raw]
+            elif isinstance(raw, list):
+                eos_ids = [int(x) for x in raw]
+        if not eos_ids:
+            # fall back to tokenizer_config.json's eos_token string
+            tc_path = os.path.join(model_dir, "tokenizer_config.json")
+            if os.path.exists(tc_path):
+                with open(tc_path) as f:
+                    tc = json.load(f)
+                eos_tok = tc.get("eos_token")
+                if isinstance(eos_tok, dict):
+                    eos_tok = eos_tok.get("content")
+                if eos_tok:
+                    tid = hf.token_to_id(eos_tok)
+                    if tid is not None:
+                        eos_ids = [tid]
+        return cls(hf, eos_ids)
+
+    # --------------------------------------------------------------- api
+
+    def encode(self, text: str, add_special_tokens: bool = True) -> Encoding:
+        enc = self._hf.encode(text, add_special_tokens=add_special_tokens)
+        return Encoding(ids=list(enc.ids), tokens=list(enc.tokens))
+
+    def decode(self, ids: Sequence[int], skip_special_tokens: bool = True) -> str:
+        return self._hf.decode(list(ids), skip_special_tokens=skip_special_tokens)
+
+    def decode_stream(self) -> DecodeStream:
+        return DecodeStream(self)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._hf.token_to_id(token)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._hf.get_vocab_size()
+
+    def to_json_str(self) -> str:
+        return self._hf.to_str()
+
+
+class ChatTemplate:
+    """Jinja2 chat template (HF tokenizer_config.json `chat_template`)."""
+
+    def __init__(
+        self,
+        template: Optional[str] = None,
+        bos_token: str = "",
+        eos_token: str = "",
+    ) -> None:
+        self.source = template or DEFAULT_CHAT_TEMPLATE
+        env = jinja2.Environment(
+            loader=jinja2.BaseLoader(),
+            trim_blocks=True,
+            lstrip_blocks=True,
+        )
+        env.filters.setdefault("tojson", lambda v, **kw: json.dumps(v, **kw))
+        env.globals["raise_exception"] = _raise_exception
+        self._template = env.from_string(self.source)
+        self.bos_token = bos_token
+        self.eos_token = eos_token
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str) -> "ChatTemplate":
+        tc_path = os.path.join(model_dir, "tokenizer_config.json")
+        template = None
+        bos = eos = ""
+        if os.path.exists(tc_path):
+            with open(tc_path) as f:
+                tc = json.load(f)
+            template = tc.get("chat_template")
+            if isinstance(template, list):  # multiple named templates
+                template = next(
+                    (
+                        t.get("template")
+                        for t in template
+                        if t.get("name") == "default"
+                    ),
+                    template[0].get("template") if template else None,
+                )
+            for name, attr in (("bos_token", "bos"), ("eos_token", "eos")):
+                val = tc.get(name)
+                if isinstance(val, dict):
+                    val = val.get("content")
+                if name == "bos_token":
+                    bos = val or ""
+                else:
+                    eos = val or ""
+        return cls(template, bos, eos)
+
+    def render(
+        self,
+        messages: list[dict],
+        add_generation_prompt: bool = True,
+        tools: Optional[list[dict]] = None,
+        **extra,
+    ) -> str:
+        return self._template.render(
+            messages=messages,
+            add_generation_prompt=add_generation_prompt,
+            bos_token=self.bos_token,
+            eos_token=self.eos_token,
+            tools=tools,
+            **extra,
+        )
+
+
+def _raise_exception(message: str):  # chat templates call this on bad input
+    raise ValueError(message)

@@ -14,6 +14,7 @@ def test_subject_matching():
     assert subject_matches("a.*.c", "a.b.c")
     assert subject_matches("a.>", "a.b.c")
     assert subject_matches(">", "anything.at.all")
+    assert not subject_matches("a.>", "a")  # '>' needs >=1 token (NATS)
     assert not subject_matches("a.b", "a.b.c")
     assert not subject_matches("a.b.c", "a.b")
     assert not subject_matches("a.*.x", "a.b.c")

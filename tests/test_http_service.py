@@ -1,0 +1,174 @@
+"""HTTP frontend e2e: OpenAI chat/completions over a real aiohttp server,
+streaming + aggregated, metrics, model discovery wiring.
+
+(reference lib/llm/tests/http-service.rs)"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.engine.echo import EchoEngineCore
+from dynamo_tpu.entrypoint.inputs import EngineConfig, run_http
+from dynamo_tpu.discovery import register_llm
+from dynamo_tpu.pipeline.router import RouterMode
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.protocols.sse import SseParser
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+from tests.util import make_test_mdc
+
+
+async def _collect_sse(resp) -> list:
+    parser = SseParser()
+    events = []
+    async for chunk, _ in resp.content.iter_chunks():
+        events.extend(parser.feed(chunk.decode()))
+    return events
+
+
+async def test_http_static_echo_chat_stream_and_aggregate():
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        mdc = make_test_mdc("echo-8b")
+        config = EngineConfig.static_(EchoEngineCore(), mdc)
+        service = await run_http(drt, config, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as session:
+            # model list
+            async with session.get(f"{base}/v1/models") as resp:
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["data"][0]["id"] == "echo-8b"
+            # streaming chat
+            payload = {
+                "model": "echo-8b",
+                "messages": [{"role": "user", "content": "hello world quick"}],
+                "stream": True,
+                "max_tokens": 16,
+            }
+            async with session.post(
+                f"{base}/v1/chat/completions", json=payload
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/event-stream")
+                events = await _collect_sse(resp)
+            assert events[-1].is_done()
+            chunks = [ev.json() for ev in events[:-1]]
+            text = "".join(
+                c["choices"][0].get("delta", {}).get("content") or ""
+                for c in chunks
+                if c.get("choices")
+            )
+            # echo_core echoes back prompt tokens; prompt contains the words
+            for word in ("hello", "world", "quick"):
+                assert word in text
+            finishes = [
+                c["choices"][0].get("finish_reason")
+                for c in chunks
+                if c.get("choices")
+            ]
+            assert finishes[-1] in ("stop", "length")
+            # aggregated (non-streaming)
+            payload["stream"] = False
+            async with session.post(
+                f"{base}/v1/chat/completions", json=payload
+            ) as resp:
+                assert resp.status == 200
+                agg = await resp.json()
+            assert agg["object"] == "chat.completion"
+            assert "hello" in agg["choices"][0]["message"]["content"]
+            # unknown model -> 404
+            async with session.post(
+                f"{base}/v1/chat/completions",
+                json={**payload, "model": "nope"},
+            ) as resp:
+                assert resp.status == 404
+            # malformed -> 400
+            async with session.post(
+                f"{base}/v1/chat/completions", json={"model": "echo-8b"}
+            ) as resp:
+                assert resp.status == 400
+            # completions API
+            async with session.post(
+                f"{base}/v1/completions",
+                json={
+                    "model": "echo-8b",
+                    "prompt": "one two three",
+                    "stream": False,
+                    "max_tokens": 8,
+                },
+            ) as resp:
+                assert resp.status == 200
+                comp = await resp.json()
+            assert comp["object"] == "text_completion"
+            assert "one" in comp["choices"][0]["text"]
+            # metrics plane
+            async with session.get(f"{base}/metrics") as resp:
+                metrics_text = await resp.text()
+            assert "dyn_llm_http_service_requests_total" in metrics_text
+            assert 'model="echo-8b"' in metrics_text
+            async with session.get(f"{base}/health") as resp:
+                assert (await resp.json())["status"] == "healthy"
+    finally:
+        if service:
+            await service.close()
+        await drt.close()
+
+
+async def test_http_dynamic_discovery_e2e():
+    """Worker registers a model via register_llm; the frontend's ModelWatcher
+    discovers it and serves OpenAI requests routed over the fabric."""
+    worker_drt = await DistributedRuntime.detached()
+    front_drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        # --- worker side
+        mdc = make_test_mdc("distributed-echo")
+        endpoint = worker_drt.namespace("demo").component("worker").endpoint("generate")
+        engine = EchoEngineCore()
+
+        async def handler(request, ctx):
+            pre = PreprocessedRequest.from_dict(request)
+            async for out in engine.generate(pre, ctx):
+                yield out.to_dict()
+
+        await endpoint.serve_endpoint(handler)
+        await register_llm(worker_drt, endpoint, mdc)
+        # --- frontend side
+        config = EngineConfig.dynamic(RouterMode.ROUND_ROBIN)
+        service = await run_http(front_drt, config, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as session:
+            for _ in range(50):
+                async with session.get(f"{base}/v1/models") as resp:
+                    if (await resp.json())["data"]:
+                        break
+                await asyncio.sleep(0.1)
+            payload = {
+                "model": "distributed-echo",
+                "messages": [{"role": "user", "content": "fox jumps over"}],
+                "stream": True,
+            }
+            async with session.post(
+                f"{base}/v1/chat/completions", json=payload
+            ) as resp:
+                assert resp.status == 200
+                events = await _collect_sse(resp)
+            text = "".join(
+                (ev.json() or {}).get("choices", [{}])[0]
+                .get("delta", {})
+                .get("content")
+                or ""
+                for ev in events[:-1]
+                if ev.json()
+            )
+            for word in ("fox", "jumps", "over"):
+                assert word in text
+    finally:
+        if service:
+            await service.close()
+        await front_drt.close()
+        await worker_drt.close()

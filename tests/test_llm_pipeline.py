@@ -1,0 +1,140 @@
+"""Tokenizer, preprocessor, backend (stop/jail), model card, echo engines."""
+
+import pytest
+
+from dynamo_tpu.backend import Backend
+from dynamo_tpu.engine.echo import EchoEngineCore, EchoEngineFull
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.preprocessor import OpenAIPreprocessor
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    StopConditions,
+)
+from dynamo_tpu.protocols.openai import ChatCompletionRequest, ChatMessage
+from dynamo_tpu.fabric.client import FabricClient
+from dynamo_tpu.fabric.state import FabricState
+from dynamo_tpu.tokenizer import ChatTemplate
+
+from tests.util import make_test_mdc, make_test_tokenizer
+
+
+def test_tokenizer_encode_decode_stream():
+    tok = make_test_tokenizer()
+    enc = tok.encode("hello world quick brown fox")
+    assert len(enc.ids) == 5
+    stream = tok.decode_stream()
+    text = "".join(stream.step(t) for t in enc.ids)
+    assert text == "hello world quick brown fox"
+
+
+def test_decode_stream_long_sequence_windowing():
+    tok = make_test_tokenizer()
+    words = ("hello world quick brown fox dog lazy " * 10).split()
+    ids = tok.encode(" ".join(words)).ids
+    stream = tok.decode_stream()
+    text = "".join(stream.step(t) for t in ids)
+    assert text == " ".join(words)
+
+
+def test_chat_template_default_and_custom():
+    tpl = ChatTemplate()
+    out = tpl.render(
+        [{"role": "user", "content": "hello"}], add_generation_prompt=True
+    )
+    assert "<|im_start|>user" in out and out.endswith("<|im_start|>assistant\n")
+    custom = ChatTemplate("{% for m in messages %}{{ m['content'] }} {% endfor %}")
+    assert custom.render([{"role": "user", "content": "x"}]).strip() == "x"
+
+
+def test_preprocessor_builds_request():
+    mdc = make_test_mdc(context_length=100)
+    pre_op = OpenAIPreprocessor(mdc)
+    req = ChatCompletionRequest(
+        model="test-model",
+        messages=[ChatMessage(role="user", content="hello world")],
+        max_tokens=7,
+        temperature=0.3,
+        stop=["STOP"],
+    )
+    pre, prompt = pre_op.preprocess_chat(req)
+    assert "hello world" in prompt
+    assert len(pre.token_ids) > 0
+    assert pre.stop.max_tokens == 7
+    assert pre.stop.stop == ["STOP"]
+    assert pre.sampling.temperature == 0.3
+    assert pre.eos_token_ids == [2]
+
+
+def test_backend_stop_sequence_jail():
+    """Stop string split across chunks must be caught and withheld."""
+    tok = make_test_tokenizer()
+    backend = Backend(tok)
+    stop = StopConditions(stop=["lazy dog"])
+    dec = backend.decoder(stop, eos_token_ids=[2])
+    ids = tok.encode("hello world lazy dog quick").ids
+    emitted = []
+    finish = None
+    for t in ids:
+        step = dec.step(LLMEngineOutput(token_ids=[t]))
+        if step.text:
+            emitted.append(step.text)
+        if step.finish_reason:
+            finish = step.finish_reason
+            break
+    text = "".join(emitted)
+    assert finish is FinishReason.STOP_SEQUENCE
+    assert "lazy dog" not in text
+    assert text.strip() == "hello world"
+
+
+def test_backend_eos_and_max_tokens():
+    tok = make_test_tokenizer()
+    backend = Backend(tok)
+    dec = backend.decoder(StopConditions(max_tokens=100), eos_token_ids=[2])
+    step = dec.step(LLMEngineOutput(token_ids=[3, 4, 2, 5]))
+    assert step.finish_reason is FinishReason.EOS
+    dec2 = backend.decoder(StopConditions(max_tokens=2), eos_token_ids=[2])
+    step2 = dec2.step(LLMEngineOutput(token_ids=[3, 4, 5]))
+    assert step2.finish_reason is FinishReason.LENGTH
+    # ignore_eos generates through the eos token
+    dec3 = backend.decoder(
+        StopConditions(max_tokens=10, ignore_eos=True), eos_token_ids=[2]
+    )
+    step3 = dec3.step(LLMEngineOutput(token_ids=[3, 2, 4]))
+    assert step3.finish_reason is None
+
+
+async def test_model_card_publish_download_roundtrip():
+    fabric = FabricClient.in_process(FabricState())
+    mdc = make_test_mdc("pub-model", context_length=123)
+    await mdc.publish(fabric)
+    got = await ModelDeploymentCard.download(fabric, mdc.slug)
+    assert got.name == "pub-model"
+    assert got.context_length == 123
+    tok = got.load_tokenizer()
+    assert tok.encode("hello").ids == make_test_tokenizer().encode("hello").ids
+
+
+async def test_echo_engine_core():
+    engine = EchoEngineCore()
+    pre = PreprocessedRequest(
+        token_ids=[3, 4, 5], stop=StopConditions(max_tokens=2)
+    )
+    outs = [o async for o in engine.generate(pre, Context())]
+    assert [o.token_ids for o in outs[:-1]] == [[3], [4]]
+    assert outs[-1].finish_reason is FinishReason.LENGTH
+
+
+async def test_echo_engine_respects_cancellation():
+    engine = EchoEngineCore()
+    pre = PreprocessedRequest(token_ids=list(range(100)))
+    ctx = Context()
+    outs = []
+    async for o in engine.generate(pre, ctx):
+        outs.append(o)
+        if len(outs) == 3:
+            ctx.stop_generating()
+    assert len(outs) <= 5  # 3 data + final

@@ -1,0 +1,150 @@
+"""Protocol layer tests: OpenAI types, SSE codec, aggregators, token hashes."""
+
+import json
+
+import pytest
+
+from dynamo_tpu.protocols.aggregator import ChatDeltaAggregator
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChoiceDelta,
+    StreamChoice,
+)
+from dynamo_tpu.protocols.sse import (
+    SseParser,
+    encode_done,
+    encode_json_event,
+)
+from dynamo_tpu.tokens import (
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_seq_hash_chain,
+)
+
+
+def test_chat_request_validation_and_nvext_alias():
+    req = ChatCompletionRequest.model_validate(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "hi"}],
+            "nvext": {"ignore_eos": True, "annotations": ["token_ids"]},
+            "max_tokens": 5,
+        }
+    )
+    assert req.ext is not None and req.ext.ignore_eos
+    assert req.output_limit() == 5
+    with pytest.raises(Exception):
+        ChatCompletionRequest.model_validate(
+            {"model": "m", "messages": [{"role": "user"}], "temperature": 99}
+        )
+
+
+def test_preprocessed_request_roundtrip():
+    pre = PreprocessedRequest(
+        token_ids=[1, 2, 3],
+        model="m",
+        sampling=SamplingOptions(temperature=0.5, n=2),
+        stop=StopConditions(max_tokens=10, stop=["x"]),
+        eos_token_ids=[2],
+    )
+    back = PreprocessedRequest.from_dict(pre.to_dict())
+    assert back.token_ids == [1, 2, 3]
+    assert back.sampling.temperature == 0.5
+    assert back.stop.max_tokens == 10
+    assert back.stop.stop == ["x"]
+
+
+def test_llm_engine_output_roundtrip():
+    out = LLMEngineOutput(token_ids=[5], finish_reason=FinishReason.EOS)
+    back = LLMEngineOutput.from_dict(out.to_dict())
+    assert back.finish_reason is FinishReason.EOS
+    assert back.finish_reason.as_openai() == "stop"
+
+
+def test_sse_roundtrip():
+    text = encode_json_event({"a": 1}) + encode_json_event(
+        ["x"], event="token_ids"
+    ) + encode_done()
+    parser = SseParser()
+    events = parser.feed(text)
+    assert len(events) == 3
+    assert events[0].json() == {"a": 1}
+    assert events[1].event == "token_ids"
+    assert events[2].is_done()
+
+
+def test_sse_incremental_feed():
+    parser = SseParser()
+    full = encode_json_event({"k": "v"})
+    events = parser.feed(full[:7])
+    assert events == []
+    events = parser.feed(full[7:])
+    assert len(events) == 1 and events[0].json() == {"k": "v"}
+
+
+def test_chat_delta_aggregator():
+    agg = ChatDeltaAggregator()
+    agg.add(
+        ChatCompletionChunk(
+            id="x",
+            model="m",
+            choices=[StreamChoice(index=0, delta=ChoiceDelta(role="assistant"))],
+        )
+    )
+    for piece in ("Hello", ", ", "world"):
+        agg.add(
+            ChatCompletionChunk(
+                id="x",
+                model="m",
+                choices=[StreamChoice(index=0, delta=ChoiceDelta(content=piece))],
+            )
+        )
+    agg.add(
+        ChatCompletionChunk(
+            id="x",
+            model="m",
+            choices=[StreamChoice(index=0, delta=ChoiceDelta(), finish_reason="stop")],
+        )
+    )
+    resp = agg.finish()
+    assert resp.choices[0].message.content == "Hello, world"
+    assert resp.choices[0].finish_reason == "stop"
+
+
+def test_block_hash_chain_properties():
+    toks = list(range(40))
+    chain = compute_seq_hash_chain(toks, block_size=16)
+    assert len(chain) == 2  # 40 tokens -> 2 complete 16-blocks
+    # chained: hash depends on parent
+    h1 = compute_block_hash(0, toks[:16])
+    assert chain[0] == h1
+    assert chain[1] == compute_block_hash(h1, toks[16:32])
+    # salt changes everything
+    assert compute_seq_hash_chain(toks, 16, salt=7) != chain
+    # shared prefix -> shared chain prefix
+    other = toks[:32] + [999] * 16
+    assert compute_seq_hash_chain(other, 16)[:2] == chain
+
+
+def test_token_block_sequence_incremental():
+    seq = TokenBlockSequence(block_size=4)
+    new = seq.extend([1, 2, 3])
+    assert new == [] and len(seq.blocks) == 0
+    blk = seq.append(4)
+    assert blk is not None and blk.position == 0
+    assert seq.block_hashes() == compute_seq_hash_chain([1, 2, 3, 4], 4)
+    seq.extend([5, 6, 7, 8, 9])
+    assert len(seq.blocks) == 2 and len(seq.partial.tokens) == 1
+    assert seq.tokens == [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    # incremental chain matches batch chain
+    assert seq.block_hashes() == compute_seq_hash_chain(seq.tokens, 4)
+    seq.truncate(5)
+    assert len(seq) == 5 and len(seq.blocks) == 1
