@@ -1,0 +1,172 @@
+"""Benchmark of record: output tokens/sec/chip + p50 TTFT.
+
+Serves a ShareGPT-like synthetic workload (lognormal ISL/OSL, fixed seed)
+through the continuous-batching JaxEngine at Llama-3-8B shapes (int8 weights
+— the v5e fit; values are zero-filled, which is FLOP/bandwidth-identical to
+trained weights) and prints ONE JSON line:
+
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+vs_baseline normalizes against a public-ballpark vLLM Llama-3-8B on 1xH100
+ShareGPT serving throughput of ~4000 output tok/s (BASELINE.md documents
+that the reference publishes no absolute table, only relative gains).
+
+Usage: python bench.py [--tiny] [--requests N] [--concurrency C]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import sys
+import time
+
+import numpy as np
+
+H100_REFERENCE_TOK_S = 4000.0
+
+
+def build_engine(tiny: bool, max_batch: int):
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+    from dynamo_tpu.models import llama as L
+    import __graft_entry__ as graft
+
+    if tiny:
+        cfg = L.LlamaConfig.tiny(vocab_size=256)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        block_size, num_blocks, max_len = 16, 256, 512
+    else:
+        cfg, params = graft._flagship_setup(tiny=False)
+        block_size = 16
+        max_len = 2048
+        num_blocks = max_batch * (max_len // block_size) + 128
+    runner = ModelRunner(
+        cfg,
+        params,
+        num_blocks=num_blocks,
+        block_size=block_size,
+        max_batch=max_batch,
+        max_model_len=max_len,
+    )
+    engine = JaxEngine(
+        runner,
+        JaxEngineConfig(
+            max_batch=max_batch,
+            block_size=block_size,
+            num_blocks=num_blocks,
+            max_model_len=max_len,
+        ),
+    )
+    return engine, cfg, max_len
+
+
+def sharegpt_workload(n: int, vocab: int, max_len: int, seed: int = 0):
+    """Synthetic ShareGPT-shaped requests: lognormal ISL/OSL."""
+    rng = np.random.default_rng(seed)
+    isl = np.clip(rng.lognormal(5.4, 0.9, n), 16, max_len * 0.6).astype(int)
+    osl = np.clip(rng.lognormal(5.0, 0.6, n), 32, 512).astype(int)
+    prompts = [
+        rng.integers(0, vocab, size=int(l)).tolist() for l in isl
+    ]
+    return prompts, osl.tolist()
+
+
+async def run_bench(engine, prompts, osls, concurrency: int):
+    from dynamo_tpu.pipeline.context import Context
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    sem = asyncio.Semaphore(concurrency)
+    ttfts: list[float] = []
+    token_counts: list[int] = []
+
+    async def one(prompt, osl):
+        async with sem:
+            req = PreprocessedRequest(
+                token_ids=prompt,
+                sampling=SamplingOptions(greedy=True),
+                stop=StopConditions(max_tokens=int(osl), ignore_eos=True),
+            )
+            start = time.monotonic()
+            first = None
+            count = 0
+            async for out in engine.generate(req, Context()):
+                if out.token_ids:
+                    if first is None:
+                        first = time.monotonic() - start
+                    count += len(out.token_ids)
+            if first is not None:
+                ttfts.append(first)
+            token_counts.append(count)
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(one(p, o) for p, o in zip(prompts, osls)))
+    wall = time.monotonic() - t0
+    return wall, sum(token_counts), ttfts
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tiny", action="store_true", help="CPU smoke mode")
+    parser.add_argument("--requests", type=int, default=48)
+    parser.add_argument("--concurrency", type=int, default=32)
+    parser.add_argument("--max-batch", type=int, default=16)
+    parser.add_argument("--warmup", type=int, default=2)
+    args = parser.parse_args()
+
+    import jax
+
+    if args.tiny:
+        jax.config.update("jax_platforms", "cpu")
+    elif (want := __import__("os").environ.get("JAX_PLATFORMS")) and (
+        jax.config.jax_platforms != want
+    ):
+        # env var is authoritative (the axon sitecustomize overrides it)
+        jax.config.update("jax_platforms", want)
+    devices = jax.devices()
+    print(f"bench devices: {devices}", file=sys.stderr)
+
+    engine, cfg, max_len = build_engine(args.tiny, args.max_batch)
+    prompts, osls = sharegpt_workload(
+        args.requests, cfg.vocab_size, max_len
+    )
+
+    async def go():
+        # warmup: compile prefill buckets + decode
+        if args.warmup:
+            await run_bench(
+                engine, prompts[: args.warmup], [8] * args.warmup, 2
+            )
+        return await run_bench(engine, prompts, osls, args.concurrency)
+
+    wall, total_tokens, ttfts = asyncio.run(go())
+    n_chips = max(1, len(devices))
+    tok_s_chip = total_tokens / wall / n_chips
+    p50_ttft_ms = statistics.median(ttfts) * 1e3 if ttfts else None
+    result = {
+        "metric": "output_tok_s_per_chip",
+        "value": round(tok_s_chip, 2),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s_chip / H100_REFERENCE_TOK_S, 4),
+        "p50_ttft_ms": round(p50_ttft_ms, 1) if p50_ttft_ms else None,
+        "total_output_tokens": total_tokens,
+        "wall_s": round(wall, 2),
+        "requests": args.requests,
+        "model": "llama3-8b-int8" if not args.tiny else "tiny",
+        "chips": n_chips,
+        "device": str(devices[0].platform),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""The native JAX/TPU engine: paged KV cache, continuous batching, jitted
+prefill/decode with buffer donation, on-device sampling.
+
+This engine is the TPU-native replacement for the vLLM/SGLang workers the
+reference schedules (SURVEY.md §2.3): same contract (AsyncEngine streaming
+LLMEngineOutput), but the model math runs here, in JAX over a device mesh.
+"""
+
+from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig  # noqa: F401

@@ -1,0 +1,391 @@
+"""JaxEngine: continuous-batching AsyncEngine over the ModelRunner.
+
+The scheduler mirrors what the reference's workers get from vLLM (and what
+its mocker simulates — lib/llm/src/mocker/scheduler.rs): FIFO admission with
+a block watermark, iteration-level batching (admit prefills between decode
+steps), LIFO preemption under block pressure, per-token streaming. The
+asyncio loop overlaps host scheduling with device execution by syncing
+sampled tokens in a worker thread.
+
+KV events (block stored/removed) are emitted through hooks with the same
+hash-chain identity the router indexes — the engine IS the KV event source
+(no ZMQ shim needed; we own the engine).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Callable, Optional
+
+import numpy as np
+
+from dynamo_tpu.engine.jax_engine.kv_cache import (
+    BlockAllocator,
+    OutOfBlocks,
+    SequenceState,
+)
+from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.tokens import TokenBlockSequence
+
+logger = get_logger("dynamo_tpu.engine")
+
+
+@dataclass
+class JaxEngineConfig:
+    max_batch: int = 8
+    block_size: int = 16
+    num_blocks: int = 512
+    max_model_len: int = 2048
+    watermark_blocks: int = 8  # admission reserve
+    rng_seed: int = 0
+
+
+@dataclass
+class EngineStats:
+    """Live load/cache stats (feeds WorkerMetricsPublisher, M5)."""
+
+    active_slots: int = 0
+    waiting: int = 0
+    used_blocks: int = 0
+    total_blocks: int = 0
+    generated_tokens: int = 0
+
+    @property
+    def kv_usage(self) -> float:
+        return self.used_blocks / max(1, self.total_blocks)
+
+
+class _Sequence(SequenceState):
+    def __init__(self, seq_id: int, request: PreprocessedRequest, ctx: Context):
+        super().__init__(
+            seq_id=seq_id,
+            token_ids=list(request.token_ids),
+            num_prompt=len(request.token_ids),
+        )
+        self.request = request
+        self.ctx = ctx
+        self.out: asyncio.Queue = asyncio.Queue()
+        self.eos: set[int] = set()
+        if not request.stop.ignore_eos:
+            self.eos = set(request.eos_token_ids) | set(
+                request.stop.stop_token_ids_hidden
+            )
+        s = request.sampling
+        self.temperature = 0.0 if s.greedy else (
+            s.temperature if s.temperature is not None else 1.0
+        )
+        self.top_p = s.top_p if s.top_p is not None else 1.0
+        self.top_k = s.top_k if s.top_k is not None else 0
+        self.max_new = request.stop.max_tokens or 16
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.token_ids) - self.num_prompt
+
+
+class JaxEngine:
+    """AsyncEngine implementation backed by a ModelRunner."""
+
+    def __init__(
+        self,
+        runner: ModelRunner,
+        config: Optional[JaxEngineConfig] = None,
+        on_blocks_stored: Optional[Callable[[list[dict]], None]] = None,
+        on_blocks_removed: Optional[Callable[[list[int]], None]] = None,
+    ) -> None:
+        self.runner = runner
+        self.config = config or JaxEngineConfig(
+            max_batch=runner.max_batch,
+            block_size=runner.block_size,
+            num_blocks=runner.num_blocks,
+            max_model_len=runner.max_model_len,
+        )
+        self.allocator = BlockAllocator(self.config.num_blocks)
+        self.slots: list[Optional[_Sequence]] = [None] * self.config.max_batch
+        self.waiting: list[_Sequence] = []
+        self._seq_ids = itertools.count(1)
+        self._admit_order: list[_Sequence] = []  # for LIFO preemption
+        self._loop_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self._closed = False
+        self.stats = EngineStats(total_blocks=self.config.num_blocks - 1)
+        self.on_blocks_stored = on_blocks_stored
+        self.on_blocks_removed = on_blocks_removed
+        # persistent host-side decode arrays
+        B = self.config.max_batch
+        self._tokens = np.zeros(B, np.int32)
+        self._positions = np.zeros(B, np.int32)
+        self._block_tables = np.zeros(
+            (B, self.runner.max_blocks_per_seq), np.int32
+        )
+        self._slot_indices = np.zeros(B, np.int32)
+        self._temps = np.ones(B, np.float32)
+        self._top_ps = np.ones(B, np.float32)
+        self._top_ks = np.zeros(B, np.int32)
+
+    # --------------------------------------------------------------- api
+
+    async def generate(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[LLMEngineOutput]:
+        if self._closed:
+            yield LLMEngineOutput.final(FinishReason.ERROR)
+            return
+        if len(request.token_ids) > self.config.max_model_len:
+            yield LLMEngineOutput.final(FinishReason.ERROR)
+            return
+        seq = _Sequence(next(self._seq_ids), request, context)
+        self.waiting.append(seq)
+        self._ensure_loop()
+        self._wake.set()
+        try:
+            while True:
+                item = await seq.out.get()
+                yield item
+                if item.finish_reason is not None:
+                    return
+        finally:
+            # consumer went away (kill/disconnect): let the loop reap it
+            context.kill()
+            self._wake.set()
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._engine_loop()
+            )
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._loop_task is not None:
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._loop_task
+        # finish every parked consumer so no generate() call hangs
+        for seq in list(self.waiting):
+            self.waiting.remove(seq)
+            seq.out.put_nowait(LLMEngineOutput.final(FinishReason.CANCELLED))
+        for seq in list(self._admit_order):
+            self._finish(seq, FinishReason.CANCELLED)
+
+    # ------------------------------------------------------------- events
+
+    def _emit_stored(self, seq: _Sequence) -> None:
+        """Publish hash-chain events for newly completed blocks."""
+        if seq.hash_seq is None:
+            return
+        new = seq.hash_seq.blocks[seq.emitted_hashes :]
+        if not new or self.on_blocks_stored is None:
+            seq.emitted_hashes = len(seq.hash_seq.blocks)
+            return
+        events = [
+            {
+                "block_hash": b.block_hash,
+                "parent_hash": b.parent_hash,
+                "tokens": b.tokens,
+                "block_id": seq.block_ids[b.position]
+                if b.position < len(seq.block_ids)
+                else -1,
+            }
+            for b in new
+        ]
+        seq.emitted_hashes = len(seq.hash_seq.blocks)
+        self.on_blocks_stored(events)
+
+    def _emit_removed(self, seq: _Sequence) -> None:
+        if self.on_blocks_removed is not None and seq.hash_seq is not None:
+            self.on_blocks_removed(
+                [b.block_hash for b in seq.hash_seq.blocks]
+            )
+
+    # ----------------------------------------------------------- schedule
+
+    def _free_seq(self, seq: _Sequence, emit_remove: bool = True) -> None:
+        if seq.slot is not None:
+            self.slots[seq.slot] = None
+            seq.slot = None
+        if seq.block_ids:
+            self.allocator.free(seq.block_ids)
+            seq.block_ids = []
+        if seq in self._admit_order:
+            self._admit_order.remove(seq)
+        if emit_remove:
+            self._emit_removed(seq)
+
+    def _finish(self, seq: _Sequence, reason: FinishReason) -> None:
+        self._free_seq(seq)
+        seq.out.put_nowait(LLMEngineOutput.final(reason))
+
+    def _preempt_youngest(self, exclude: _Sequence) -> bool:
+        for victim in reversed(self._admit_order):
+            if victim is exclude or victim.slot is None:
+                continue
+            logger.debug("preempting seq %d", victim.seq_id)
+            # drop generated KV; it will re-prefill from its full token_ids
+            self._free_seq(victim)
+            victim.hash_seq = None
+            victim.emitted_hashes = 0
+            self.waiting.insert(0, victim)
+            return True
+        return False
+
+    def _try_admit(self, seq: _Sequence) -> bool:
+        """Allocate blocks + a slot and run prefill. False if no capacity."""
+        free_slots = [i for i, s in enumerate(self.slots) if s is None]
+        if not free_slots:
+            return False
+        need = seq.blocks_needed(self.config.block_size)
+        if self.allocator.free_count < need + self.config.watermark_blocks:
+            return False
+        seq.block_ids = self.allocator.alloc(need)
+        seq.slot = free_slots[0]
+        self.slots[seq.slot] = seq
+        self._admit_order.append(seq)
+        return True
+
+    # ---------------------------------------------------------- main loop
+
+    async def _engine_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._closed:
+            self._reap_cancelled()
+            admitted = await self._admit_phase(loop)
+            active = [s for s in self.slots if s is not None]
+            if not active:
+                if not self.waiting:
+                    self._wake.clear()
+                    if self._closed:
+                        return
+                    await self._wake.wait()
+                continue
+            await self._decode_phase(loop, active)
+            self._update_stats()
+            if not admitted:
+                await asyncio.sleep(0)  # fairness for producers/consumers
+
+    def _reap_cancelled(self) -> None:
+        for seq in list(self.waiting):
+            if seq.ctx.is_killed() or seq.ctx.is_stopped():
+                self.waiting.remove(seq)
+                seq.out.put_nowait(LLMEngineOutput.final(FinishReason.CANCELLED))
+        for seq in list(self._admit_order):
+            if seq.ctx.is_killed():
+                self._finish(seq, FinishReason.CANCELLED)
+
+    async def _admit_phase(self, loop) -> bool:
+        admitted = False
+        while self.waiting:
+            seq = self.waiting[0]
+            if not self._try_admit(seq):
+                break
+            self.waiting.pop(0)
+            admitted = True
+            # re-admission after preemption replays generated tokens too
+            replay = seq.token_ids
+            tok_arr = await loop.run_in_executor(
+                None,
+                lambda: np.asarray(
+                    self.runner.prefill(
+                        replay,
+                        seq.block_ids,
+                        seq.temperature,
+                        seq.top_p,
+                        seq.top_k,
+                    )
+                ),
+            )
+            token = int(tok_arr)
+            seq.hash_seq = TokenBlockSequence(
+                replay, self.config.block_size
+            )
+            self._emit_stored(seq)
+            self._append_token(seq, token)
+        return admitted
+
+    async def _decode_phase(self, loop, active: list[_Sequence]) -> None:
+        B = self.config.max_batch
+        self._block_tables.fill(0)
+        self._positions.fill(0)
+        self._slot_indices.fill(0)  # null block slot 0
+        self._temps.fill(0.0)
+        self._top_ps.fill(1.0)
+        self._top_ks.fill(0)
+        bs = self.config.block_size
+        for seq in active:
+            i = seq.slot
+            pos = seq.pos - 1  # position of the token being fed
+            self._tokens[i] = seq.token_ids[-1]
+            self._positions[i] = pos
+            nb = len(seq.block_ids)
+            self._block_tables[i, :nb] = seq.block_ids
+            self._slot_indices[i] = seq.block_ids[pos // bs] * bs + pos % bs
+            self._temps[i] = seq.temperature
+            self._top_ps[i] = seq.top_p
+            self._top_ks[i] = seq.top_k
+        toks = await loop.run_in_executor(
+            None,
+            lambda: np.asarray(
+                self.runner.decode(
+                    self._tokens,
+                    self._positions,
+                    self._block_tables,
+                    self._slot_indices,
+                    self._temps,
+                    self._top_ps,
+                    self._top_ks,
+                )
+            ),
+        )
+        for seq in active:
+            if seq.slot is None:
+                continue  # finished/cancelled concurrently
+            self._append_token(seq, int(toks[seq.slot]))
+
+    def _append_token(self, seq: _Sequence, token: int) -> None:
+        """Record a newly generated token: stream it, grow blocks, stop."""
+        self.stats.generated_tokens += 1
+        if seq.ctx.is_stopped():
+            self._finish(seq, FinishReason.CANCELLED)
+            return
+        if token in seq.eos:
+            self._finish(seq, FinishReason.EOS)  # eos token stays hidden
+            return
+        seq.token_ids.append(token)
+        if seq.hash_seq is not None:
+            seq.hash_seq.append(token)
+            self._emit_stored(seq)
+        seq.out.put_nowait(LLMEngineOutput(token_ids=[token]))
+        if (
+            seq.num_generated >= seq.max_new
+            or len(seq.token_ids) >= self.config.max_model_len
+        ):
+            self._finish(seq, FinishReason.LENGTH)
+            return
+        # the NEXT decode step writes KV at index pos-1; allocate its block
+        # just-in-time if the sequence crossed a block boundary
+        if (seq.pos - 1) // self.config.block_size >= len(seq.block_ids):
+            try:
+                seq.block_ids.extend(self.allocator.alloc(1))
+            except OutOfBlocks:
+                if self._preempt_youngest(exclude=seq):
+                    seq.block_ids.extend(self.allocator.alloc(1))
+                else:
+                    logger.error("seq %d: out of KV blocks", seq.seq_id)
+                    self._finish(seq, FinishReason.ERROR)
+
+    def _update_stats(self) -> None:
+        self.stats.active_slots = sum(1 for s in self.slots if s is not None)
+        self.stats.waiting = len(self.waiting)
+        self.stats.used_blocks = (
+            self.config.num_blocks - 1 - self.allocator.free_count
+        )

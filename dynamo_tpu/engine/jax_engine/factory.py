@@ -1,0 +1,139 @@
+"""Engine factory: HF model dir -> (JaxEngine, ModelDeploymentCard).
+
+The `out=jax` path of the CLI (role-equivalent of engine_for() in
+launch/dynamo-run/src/lib.rs, pointed at our own engine instead of a
+subprocess)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+from dynamo_tpu.engine.jax_engine.weights import load_or_init_params
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.engine.factory")
+
+
+async def build_jax_engine(
+    model_path: str,
+    name: Optional[str] = None,
+    *,
+    kv_block_size: int = 16,
+    context_length: Optional[int] = None,
+    tensor_parallel_size: int = 1,
+    max_batch: int = 8,
+    num_blocks: Optional[int] = None,
+    quantize: Optional[bool] = None,
+    rng_seed: int = 0,
+) -> tuple[JaxEngine, ModelDeploymentCard]:
+    config = LlamaConfig.from_model_dir(model_path)
+    max_len = min(
+        context_length or config.max_position_embeddings,
+        config.max_position_embeddings,
+    )
+    if quantize is None:
+        quantize = os.environ.get("DYN_JAX_QUANTIZE_INT8", "0") in ("1", "true")
+    mesh = None
+    kv_sharding = None
+    params = load_or_init_params(
+        model_path, config, quantize=quantize, seed=rng_seed
+    )
+    if num_blocks is None:
+        num_blocks = default_num_blocks(
+            config, max_len, max_batch,
+            block_size=kv_block_size, quantized=quantize,
+            tp=tensor_parallel_size,
+        )
+    if tensor_parallel_size > 1:
+        from dynamo_tpu.parallel.mesh import build_mesh
+        from dynamo_tpu.parallel.sharding import shard_llama
+
+        mesh = build_mesh(tp=tensor_parallel_size)
+        params, kv_sharding = shard_llama(mesh, config, params)
+    runner = ModelRunner(
+        config,
+        params,
+        num_blocks=num_blocks,
+        block_size=kv_block_size,
+        max_batch=max_batch,
+        max_model_len=max_len,
+        rng_seed=rng_seed,
+        mesh=mesh,
+        kv_sharding=kv_sharding,
+    )
+    engine = JaxEngine(
+        runner,
+        JaxEngineConfig(
+            max_batch=max_batch,
+            block_size=kv_block_size,
+            num_blocks=num_blocks,
+            max_model_len=max_len,
+            rng_seed=rng_seed,
+        ),
+    )
+    mdc = ModelDeploymentCard.from_model_dir(
+        model_path,
+        name or os.path.basename(os.path.normpath(model_path)),
+        kv_block_size=kv_block_size,
+        context_length=max_len,
+    )
+    return engine, mdc
+
+
+def hbm_budget_bytes() -> int:
+    """Per-device memory budget: probed from the device when possible, else
+    the DYN_HBM_GB override, else a v5e-class 16 GiB assumption."""
+    import os
+
+    override = os.environ.get("DYN_HBM_GB")
+    if override:
+        return int(float(override) * 2**30)
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 — platform may not expose stats
+        pass
+    return 16 * 2**30
+
+
+def default_num_blocks(
+    config: LlamaConfig,
+    max_len: int,
+    max_batch: int,
+    *,
+    block_size: int = 16,
+    quantized: bool = False,
+    tp: int = 1,
+    utilization: float = 0.85,
+) -> int:
+    """Blocks for every batch lane at full context plus slack, capped so
+    weights + KV fit the per-device HBM budget."""
+    per_seq = (max_len + block_size - 1) // block_size
+    want = max_batch * per_seq + 64
+    from dynamo_tpu.models.llama import param_count
+
+    weight_bytes = param_count(config) * (1 if quantized else 2) // tp
+    block_bytes = (
+        2  # k + v
+        * config.num_layers
+        * block_size
+        * (config.num_kv_heads // tp)
+        * config.head_dim
+        * 2  # bf16
+    )
+    budget = int(hbm_budget_bytes() * utilization) - weight_bytes
+    cap = max(16, budget // max(1, block_bytes))
+    if want > cap:
+        logger.warning(
+            "KV cache capped by HBM budget: want %d blocks, fit %d", want, cap
+        )
+    return min(want, cap)

@@ -1,0 +1,62 @@
+"""Host-side paged KV cache bookkeeping: block allocator + per-sequence block
+tables + KV event emission hooks.
+
+The device tensors live in the runner; this module owns WHICH blocks belong
+to WHOM. Block ids are stable across the engine, the router events, and the
+offload tiers — the same currency as the reference's block manager
+(lib/llm/src/block_manager), though the multi-tier pools arrive separately.
+
+Block 0 is reserved as the null block: padded/inactive lanes write there.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+class OutOfBlocks(RuntimeError):
+    pass
+
+
+class BlockAllocator:
+    def __init__(self, num_blocks: int) -> None:
+        # block 0 reserved as null block
+        self.num_blocks = num_blocks
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise OutOfBlocks(f"need {n} blocks, have {len(self._free)}")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks: list[int]) -> None:
+        self._free.extend(blocks)
+
+
+@dataclass
+class SequenceState:
+    """Engine-side state of one running sequence."""
+
+    seq_id: int
+    token_ids: list[int]  # prompt + generated
+    num_prompt: int
+    block_ids: list[int] = field(default_factory=list)
+    slot: Optional[int] = None  # decode batch lane
+    hash_seq: Optional[TokenBlockSequence] = None  # block-hash chain
+    emitted_hashes: int = 0  # how many block hashes already published
+
+    @property
+    def pos(self) -> int:
+        """Number of tokens whose KV is in cache."""
+        return len(self.token_ids)
+
+    def blocks_needed(self, block_size: int) -> int:
+        return (len(self.token_ids) + block_size - 1) // block_size

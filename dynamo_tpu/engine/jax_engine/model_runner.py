@@ -1,0 +1,212 @@
+"""ModelRunner: owns the device state (params + paged KV cache) and the
+jitted prefill/decode+sample executables.
+
+TPU discipline (SURVEY.md / pallas guide):
+  * caches are DONATED through every call — XLA updates them in place, no
+    copy of the multi-GB KV tensors;
+  * prompt lengths are padded to a small set of static buckets so XLA
+    compiles a handful of programs, never per-request shapes;
+  * sampling runs on device fused behind the decode step — the only
+    device->host transfer per step is the [B] int32 of sampled tokens;
+  * sharding: params/caches carry NamedShardings (parallel/sharding.py) and
+    jit propagates them — the same code runs single-chip or TP over a mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.ops.sampling import sample_tokens
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.engine.runner")
+
+
+def default_prefill_buckets(block_size: int, max_len: int) -> list[int]:
+    """Power-of-two padded prompt lengths; every bucket is a whole number of
+    KV blocks (prefill scatters whole blocks)."""
+
+    def round_up(n: int) -> int:
+        return ((n + block_size - 1) // block_size) * block_size
+
+    buckets = []
+    size = block_size
+    while size < max_len:
+        buckets.append(round_up(size))
+        size *= 2
+    top = round_up(max_len)
+    if not buckets or buckets[-1] != top:
+        buckets.append(top)
+    return buckets
+
+
+class ModelRunner:
+    def __init__(
+        self,
+        config: llama.LlamaConfig,
+        params: Any,
+        *,
+        num_blocks: int,
+        block_size: int,
+        max_batch: int,
+        max_model_len: int,
+        rng_seed: int = 0,
+        prefill_buckets: Optional[list[int]] = None,
+        kv_dtype: jnp.dtype = jnp.bfloat16,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        kv_sharding: Optional[jax.sharding.NamedSharding] = None,
+    ) -> None:
+        self.config = config
+        self.params = params
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.max_batch = max_batch
+        self.max_model_len = max_model_len
+        self.max_blocks_per_seq = (max_model_len + block_size - 1) // block_size
+        self.mesh = mesh
+        self._base_key = jax.random.PRNGKey(rng_seed)
+        self._step_counter = 0
+        self.prefill_buckets = sorted(
+            prefill_buckets or default_prefill_buckets(block_size, max_model_len)
+        )
+        cache_shape = (
+            config.num_layers,
+            num_blocks,
+            block_size,
+            config.num_kv_heads,
+            config.head_dim,
+        )
+        if kv_sharding is not None:
+            self.k_cache = jax.device_put(
+                jnp.zeros(cache_shape, kv_dtype), kv_sharding
+            )
+            self.v_cache = jax.device_put(
+                jnp.zeros(cache_shape, kv_dtype), kv_sharding
+            )
+        else:
+            self.k_cache = jnp.zeros(cache_shape, kv_dtype)
+            self.v_cache = jnp.zeros(cache_shape, kv_dtype)
+        logger.info(
+            "kv cache: %d blocks x %d tokens (%s), %.2f GiB",
+            num_blocks,
+            block_size,
+            str(kv_dtype.__name__ if hasattr(kv_dtype, "__name__") else kv_dtype),
+            2 * np.prod(cache_shape) * 2 / 2**30,
+        )
+        self._kv_sharding = kv_sharding
+        # Pin cache output shardings when running sharded: XLA would
+        # otherwise be free to re-propagate (e.g. shard head_dim instead of
+        # heads), breaking the megatron layout on the next step.
+        cache_out = (
+            (None, kv_sharding, kv_sharding) if kv_sharding is not None else None
+        )
+        jit_kwargs: dict[str, Any] = {}
+        if cache_out is not None:
+            jit_kwargs["out_shardings"] = cache_out
+        # one jitted callable each; jit's shape cache handles the buckets
+        self._prefill_jit = jax.jit(
+            functools.partial(self._prefill_impl, self.config),
+            donate_argnums=(1, 2),  # k_cache, v_cache
+            **jit_kwargs,
+        )
+        self._decode_fn = jax.jit(
+            functools.partial(self._decode_impl, self.config),
+            donate_argnums=(1, 2),  # k_cache, v_cache
+            **jit_kwargs,
+        )
+
+    # ------------------------------------------------------------- jitted
+
+    @staticmethod
+    def _sample(logits, key, temps, top_ps, top_ks):
+        return sample_tokens(logits, key, temps, top_ps, top_ks)
+
+    @staticmethod
+    def _prefill_impl(
+        cfg, params, k_cache, v_cache, tokens, valid_len, block_table,
+        key, temp, top_p, top_k,
+    ):
+        logits, k_cache, v_cache = llama.prefill(
+            params, cfg, tokens, valid_len, k_cache, v_cache, block_table
+        )
+        tok = sample_tokens(
+            logits[None, :], key, temp[None], top_p[None], top_k[None]
+        )[0]
+        return tok, k_cache, v_cache
+
+    @staticmethod
+    def _decode_impl(
+        cfg, params, k_cache, v_cache, tokens, positions, block_tables,
+        slot_indices, key, temps, top_ps, top_ks,
+    ):
+        logits, k_cache, v_cache = llama.decode(
+            params, cfg, tokens, positions, k_cache, v_cache,
+            block_tables, slot_indices,
+        )
+        toks = sample_tokens(logits, key, temps, top_ps, top_ks)
+        return toks, k_cache, v_cache
+
+    def _next_key(self) -> jax.Array:
+        self._step_counter += 1
+        return jax.random.fold_in(self._base_key, self._step_counter)
+
+    # -------------------------------------------------------------- calls
+
+    def pick_bucket(self, length: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= length:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds max_model_len {self.max_model_len}"
+        )
+
+    def prefill(
+        self,
+        token_ids: list[int],
+        block_ids: list[int],
+        temperature: float,
+        top_p: float,
+        top_k: int,
+    ) -> jax.Array:
+        """Run one prompt; returns the first sampled token (device array)."""
+        T = len(token_ids)
+        bucket = self.pick_bucket(T)
+        tokens = np.zeros(bucket, np.int32)
+        tokens[:T] = token_ids
+        nb = bucket // self.block_size
+        table = np.zeros(nb, np.int32)
+        used = (T + self.block_size - 1) // self.block_size
+        table[:used] = block_ids[:used]
+        # padding region scatters into the null block 0 — harmless
+        tok, self.k_cache, self.v_cache = self._prefill_jit(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(tokens), jnp.int32(T), jnp.asarray(table),
+            self._next_key(),
+            jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
+        )
+        return tok
+
+    def decode(
+        self,
+        tokens: np.ndarray,  # [B] int32
+        positions: np.ndarray,  # [B] int32
+        block_tables: np.ndarray,  # [B, max_blocks_per_seq] int32
+        slot_indices: np.ndarray,  # [B] int32
+        temps: np.ndarray,
+        top_ps: np.ndarray,
+        top_ks: np.ndarray,
+    ) -> jax.Array:
+        toks, self.k_cache, self.v_cache = self._decode_fn(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(block_tables), jnp.asarray(slot_indices),
+            self._next_key(),
+            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
+        )
+        return toks

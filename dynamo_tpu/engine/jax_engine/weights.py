@@ -1,0 +1,104 @@
+"""Weight loading: HF safetensors -> our param pytree, or random init.
+
+Role-equivalent of the weight-loading half of the reference's delegated
+engines (and of LocalModel resolution, lib/llm/src/local_model.rs): given an
+HF snapshot dir, map `model.layers.N.*` tensors into the functional param
+tree, with optional int8 weight-only quantization applied at load.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.llama import LlamaConfig, init_params
+from dynamo_tpu.ops.linear import maybe_quantize
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.engine.weights")
+
+
+def load_or_init_params(
+    model_dir: Optional[str],
+    config: LlamaConfig,
+    *,
+    quantize: bool = False,
+    dtype: jnp.dtype = jnp.bfloat16,
+    seed: int = 0,
+) -> Any:
+    if model_dir:
+        files = sorted(glob.glob(os.path.join(model_dir, "*.safetensors")))
+        if files:
+            return load_hf_safetensors(
+                model_dir, config, quantize=quantize, dtype=dtype
+            )
+        logger.warning(
+            "%s has no *.safetensors; falling back to random init", model_dir
+        )
+    return init_params(config, jax.random.PRNGKey(seed), dtype, quantize)
+
+
+def load_hf_safetensors(
+    model_dir: str,
+    config: LlamaConfig,
+    *,
+    quantize: bool = False,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> Any:
+    from safetensors import safe_open
+
+    tensors: dict[str, Any] = {}
+    for path in sorted(glob.glob(os.path.join(model_dir, "*.safetensors"))):
+        with safe_open(path, framework="flax") as f:
+            for name in f.keys():
+                tensors[name] = f.get_tensor(name)
+
+    def get(name: str) -> jax.Array:
+        t = tensors.pop(name)
+        return jnp.asarray(t).astype(dtype)
+
+    def lin(name: str) -> Any:
+        # HF stores [out, in]; we use [in, out]
+        return maybe_quantize(get(name).T, quantize)
+
+    layers = []
+    for i in range(config.num_layers):
+        p = f"model.layers.{i}."
+        layers.append(
+            {
+                "attn_norm": get(p + "input_layernorm.weight"),
+                "wq": lin(p + "self_attn.q_proj.weight"),
+                "wk": lin(p + "self_attn.k_proj.weight"),
+                "wv": lin(p + "self_attn.v_proj.weight"),
+                "wo": lin(p + "self_attn.o_proj.weight"),
+                "mlp_norm": get(p + "post_attention_layernorm.weight"),
+                "wg": lin(p + "mlp.gate_proj.weight"),
+                "wu": lin(p + "mlp.up_proj.weight"),
+                "wd": lin(p + "mlp.down_proj.weight"),
+            }
+        )
+    params: dict[str, Any] = {
+        "embed": get("model.embed_tokens.weight"),
+        "layers": layers,
+        "final_norm": get("model.norm.weight"),
+    }
+    if not config.tie_word_embeddings:
+        if "lm_head.weight" in tensors:
+            params["lm_head"] = lin("lm_head.weight")
+        # else: tied despite config — fall back to embed.T at logits time
+    if tensors:
+        logger.debug("unused tensors: %s", sorted(tensors)[:5])
+    mapped = 2 + 9 * config.num_layers + (1 if "lm_head" in params else 0)
+    logger.info(
+        "loaded %d HF tensors from %s (quantize=%s, %d unused)",
+        mapped,
+        model_dir,
+        quantize,
+        len(tensors),
+    )
+    return params

@@ -1,0 +1,2 @@
+"""Model zoo: pure-functional JAX implementations (params are pytrees, every
+forward is jit-safe) designed around the paged KV cache and GSPMD sharding."""

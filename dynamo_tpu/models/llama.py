@@ -1,0 +1,266 @@
+"""Llama-family model (Llama 2/3, DeepSeek-R1-Distill-Llama, TinyLlama...)
+as pure JAX functions over a paged KV cache.
+
+This is the engine-side model math the reference delegates to vLLM/SGLang —
+built TPU-first instead: bf16 (or int8-quantized) weights feeding the MXU,
+per-layer paged KV blocks, RoPE with llama3 scaling, GQA, SwiGLU. Layers are
+a Python loop with static indices so cache updates compile to in-place
+dynamic-update-slices under jit donation.
+
+Tensor-parallel sharding is applied externally (parallel/sharding.py) by
+placing NamedShardings on the param/cache pytrees; the einsums here are
+written so GSPMD propagates head/ffn shardings without code changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.ops.attention import (
+    causal_prefill_attention,
+    paged_decode_attention,
+    write_decode_kv,
+    write_prefill_kv,
+)
+from dynamo_tpu.ops.basics import apply_rope, rms_norm, rope_freqs, swiglu
+from dynamo_tpu.ops.linear import linear, maybe_quantize
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    max_position_embeddings: int = 8192
+    tie_word_embeddings: bool = False
+    rope_scaling: Optional[dict] = None
+
+    @classmethod
+    def from_hf_dict(cls, d: dict[str, Any]) -> "LlamaConfig":
+        num_heads = d.get("num_attention_heads", 32)
+        hidden = d.get("hidden_size", 4096)
+        return cls(
+            vocab_size=d.get("vocab_size", 32000),
+            hidden_size=hidden,
+            intermediate_size=d.get("intermediate_size", 4 * hidden),
+            num_layers=d.get("num_hidden_layers", 32),
+            num_heads=num_heads,
+            num_kv_heads=d.get("num_key_value_heads", num_heads),
+            head_dim=d.get("head_dim", hidden // num_heads),
+            rope_theta=d.get("rope_theta", 10000.0),
+            rms_eps=d.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=d.get("max_position_embeddings", 8192),
+            tie_word_embeddings=d.get("tie_word_embeddings", False),
+            rope_scaling=d.get("rope_scaling"),
+        )
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str) -> "LlamaConfig":
+        with open(os.path.join(model_dir, "config.json")) as f:
+            return cls.from_hf_dict(json.load(f))
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        """DeepSeek-R1-Distill-Llama-8B / Llama-3.1-8B shapes."""
+        return cls(
+            vocab_size=128256,
+            hidden_size=4096,
+            intermediate_size=14336,
+            num_layers=32,
+            num_heads=32,
+            num_kv_heads=8,
+            head_dim=128,
+            rope_theta=500000.0,
+        )
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 256) -> "LlamaConfig":
+        """CPU-test config (mirrors the reference's mocker: all logic, no scale)."""
+        return cls(
+            vocab_size=vocab_size,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            head_dim=16,
+            rope_theta=10000.0,
+            max_position_embeddings=512,
+        )
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+# ------------------------------------------------------------------ params
+
+
+def init_params(
+    config: LlamaConfig,
+    rng: jax.Array,
+    dtype: jnp.dtype = jnp.bfloat16,
+    quantize: bool = False,
+) -> dict:
+    """Random-init parameter pytree (bench/test path; loading is separate)."""
+    c = config
+    keys = iter(jax.random.split(rng, 4 + 9 * c.num_layers))
+
+    def dense(key, shape, scale_dim):
+        w = jax.random.normal(key, shape, dtype=jnp.float32) / jnp.sqrt(scale_dim)
+        return maybe_quantize(w.astype(dtype), quantize)
+
+    layers = []
+    for _ in range(c.num_layers):
+        layers.append(
+            {
+                "attn_norm": jnp.ones((c.hidden_size,), dtype),
+                "wq": dense(next(keys), (c.hidden_size, c.q_dim), c.hidden_size),
+                "wk": dense(next(keys), (c.hidden_size, c.kv_dim), c.hidden_size),
+                "wv": dense(next(keys), (c.hidden_size, c.kv_dim), c.hidden_size),
+                "wo": dense(next(keys), (c.q_dim, c.hidden_size), c.q_dim),
+                "mlp_norm": jnp.ones((c.hidden_size,), dtype),
+                "wg": dense(next(keys), (c.hidden_size, c.intermediate_size), c.hidden_size),
+                "wu": dense(next(keys), (c.hidden_size, c.intermediate_size), c.hidden_size),
+                "wd": dense(next(keys), (c.intermediate_size, c.hidden_size), c.intermediate_size),
+            }
+        )
+    params = {
+        "embed": (
+            jax.random.normal(next(keys), (c.vocab_size, c.hidden_size), jnp.float32)
+            * 0.02
+        ).astype(dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((c.hidden_size,), dtype),
+    }
+    if not c.tie_word_embeddings:
+        params["lm_head"] = dense(
+            next(keys), (c.hidden_size, c.vocab_size), c.hidden_size
+        )
+    return params
+
+
+def param_count(config: LlamaConfig) -> int:
+    c = config
+    per_layer = (
+        c.hidden_size * (c.q_dim + 2 * c.kv_dim)
+        + c.q_dim * c.hidden_size
+        + 3 * c.hidden_size * c.intermediate_size
+        + 2 * c.hidden_size
+    )
+    total = c.num_layers * per_layer + 2 * c.vocab_size * c.hidden_size
+    return total
+
+
+# ----------------------------------------------------------------- forward
+
+
+def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_cache_l, block_table):
+    P = x.shape[0]
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = linear(h, layer["wq"]).reshape(P, cfg.num_heads, cfg.head_dim)
+    k = linear(h, layer["wk"]).reshape(P, cfg.num_kv_heads, cfg.head_dim)
+    v = linear(h, layer["wv"]).reshape(P, cfg.num_kv_heads, cfg.head_dim)
+    q = apply_rope(q, positions, inv_freqs)
+    k = apply_rope(k, positions, inv_freqs)
+    k_cache_l, v_cache_l = write_prefill_kv(k_cache_l, v_cache_l, k, v, block_table)
+    attn = causal_prefill_attention(q, k, v, valid_len)
+    out = linear(attn.reshape(P, cfg.q_dim), layer["wo"])
+    return x + out, k_cache_l, v_cache_l
+
+
+def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, block_tables, slot_indices):
+    B = x.shape[0]
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = linear(h, layer["wq"]).reshape(B, cfg.num_heads, cfg.head_dim)
+    k = linear(h, layer["wk"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
+    v = linear(h, layer["wv"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
+    # positions [B] broadcasts over the head axis inside apply_rope
+    q = apply_rope(q, positions, inv_freqs)
+    k = apply_rope(k, positions, inv_freqs)
+    k_cache_l, v_cache_l = write_decode_kv(k_cache_l, v_cache_l, k, v, slot_indices)
+    attn = paged_decode_attention(
+        q, k_cache_l, v_cache_l, block_tables, positions + 1
+    )
+    out = linear(attn.reshape(B, cfg.q_dim), layer["wo"])
+    return x + out, k_cache_l, v_cache_l
+
+
+def _mlp(x, layer, cfg):
+    h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    gate = linear(h, layer["wg"])
+    up = linear(h, layer["wu"])
+    return x + linear(swiglu(gate, up), layer["wd"])
+
+
+def _logits(x, params, cfg):
+    h = rms_norm(x, params["final_norm"], cfg.rms_eps)
+    w = params.get("lm_head")
+    if w is None:
+        return jnp.matmul(h, params["embed"].T.astype(h.dtype)).astype(jnp.float32)
+    return linear(h, w).astype(jnp.float32)
+
+
+def prefill(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [P] int32, padded to a multiple of block_size
+    valid_len: jax.Array,  # scalar int32
+    k_cache: jax.Array,  # [L, num_blocks, block_size, Hkv, D]
+    v_cache: jax.Array,
+    block_table: jax.Array,  # [P // block_size] int32
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Process a prompt; returns (last_token_logits [V], k_cache, v_cache)."""
+    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    positions = jnp.arange(tokens.shape[0], dtype=jnp.int32)
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    for i, layer in enumerate(params["layers"]):
+        x, kc, vc = _attn_prefill(
+            x, layer, cfg, inv_freqs, positions, valid_len,
+            k_cache[i], v_cache[i], block_table,
+        )
+        k_cache = k_cache.at[i].set(kc)
+        v_cache = v_cache.at[i].set(vc)
+        x = _mlp(x, layer, cfg)
+    logits = _logits(x[valid_len - 1][None, :], params, cfg)[0]
+    return logits, k_cache, v_cache
+
+
+def decode(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B] int32
+    positions: jax.Array,  # [B] int32 (0-indexed position of this token)
+    k_cache: jax.Array,  # [L, num_blocks, block_size, Hkv, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32
+    slot_indices: jax.Array,  # [B] int32 flat cache slots for the new token
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for a batch; returns (logits [B, V], caches)."""
+    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    for i, layer in enumerate(params["layers"]):
+        x, kc, vc = _attn_decode(
+            x, layer, cfg, inv_freqs, positions,
+            k_cache[i], v_cache[i], block_tables, slot_indices,
+        )
+        k_cache = k_cache.at[i].set(kc)
+        v_cache = v_cache.at[i].set(vc)
+        x = _mlp(x, layer, cfg)
+    return _logits(x, params, cfg), k_cache, v_cache

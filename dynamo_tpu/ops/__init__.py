@@ -1,0 +1,5 @@
+"""TPU compute ops: norms, rotary embeddings, paged attention, sampling.
+
+The JAX/XLA compute path of the framework (pallas kernels live here too).
+Everything is functional and jit-safe: static shapes, no data-dependent
+Python control flow."""

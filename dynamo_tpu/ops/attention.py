@@ -1,0 +1,110 @@
+"""Attention over a paged KV cache: causal prefill + paged decode.
+
+TPU-native replacement for the engine-internal paged attention the reference
+delegates to vLLM/SGLang (and for the KV layout kernel block_copy.cu): the
+cache is a block-paged tensor per layer `[num_blocks, block_size, kv_heads,
+head_dim]`, addressed by per-sequence block tables. This module is the XLA
+reference implementation: correct everywhere, but the decode path
+materializes the gathered [B, max_blocks*block_size, Hkv, D] window each
+step — a planned pallas paged-attention kernel replaces it on TPU.
+
+All functions are jit-safe: static shapes, masks instead of dynamic slicing.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def causal_prefill_attention(
+    q: jax.Array,  # [P, Hq, D]
+    k: jax.Array,  # [P, Hkv, D]
+    v: jax.Array,  # [P, Hkv, D]
+    valid_len: jax.Array,  # scalar int32: true sequence length (<= P)
+) -> jax.Array:
+    """Single-sequence causal self-attention over a padded prompt window."""
+    P, Hq, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv
+    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    qr = q.reshape(P, Hkv, G, D)
+    scores = jnp.einsum(
+        "qhgd,khd->hgqk", qr.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    pos = jnp.arange(P)
+    causal = pos[None, :] <= pos[:, None]  # [q, k]
+    in_seq = pos[None, :] < valid_len
+    mask = (causal & in_seq)[None, None, :, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hgqk,khd->qhgd", weights, v.astype(jnp.float32))
+    return out.reshape(P, Hq, D).astype(q.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, Hq, D] — one new token per sequence
+    k_cache: jax.Array,  # [num_blocks, block_size, Hkv, D] (this layer)
+    v_cache: jax.Array,  # [num_blocks, block_size, Hkv, D]
+    block_tables: jax.Array,  # [B, max_blocks] int32 block ids
+    context_lens: jax.Array,  # [B] int32 — INCLUDING the token just written
+) -> jax.Array:
+    """Decode-step attention: gather each sequence's blocks and attend."""
+    B, Hq, D = q.shape
+    _, block_size, Hkv, _ = k_cache.shape
+    G = Hq // Hkv
+    max_blocks = block_tables.shape[1]
+    S = max_blocks * block_size
+    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    # [B, max_blocks, block_size, Hkv, D] -> [B, S, Hkv, D]
+    k = k_cache[block_tables].reshape(B, S, Hkv, D)
+    v = v_cache[block_tables].reshape(B, S, Hkv, D)
+    qr = q.reshape(B, Hkv, G, D)
+    scores = jnp.einsum(
+        "bhgd,bshd->bhgs", qr.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    mask = (jnp.arange(S)[None, :] < context_lens[:, None])[:, None, None, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgs,bshd->bhgd", weights, v.astype(jnp.float32))
+    return out.reshape(B, Hq, D).astype(q.dtype)
+
+
+def write_prefill_kv(
+    k_cache: jax.Array,  # [num_blocks, block_size, Hkv, D]
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [P, Hkv, D] (P = padded prompt, multiple of block)
+    v_new: jax.Array,
+    block_table: jax.Array,  # [P // block_size] int32
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter a prompt's computed K/V into its allocated blocks."""
+    _, block_size, Hkv, D = k_cache.shape
+    nb = k_new.shape[0] // block_size
+    k_blocks = k_new.reshape(nb, block_size, Hkv, D)
+    v_blocks = v_new.reshape(nb, block_size, Hkv, D)
+    k_cache = k_cache.at[block_table].set(k_blocks)
+    v_cache = v_cache.at[block_table].set(v_blocks)
+    return k_cache, v_cache
+
+
+def write_decode_kv(
+    k_cache: jax.Array,  # [num_blocks, block_size, Hkv, D]
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [B, Hkv, D]
+    v_new: jax.Array,
+    slot_indices: jax.Array,  # [B] int32 flat slot = block_id*block_size + offset
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter one new K/V token per sequence into its current block slot."""
+    num_blocks, block_size, Hkv, D = k_cache.shape
+    k_flat = k_cache.reshape(num_blocks * block_size, Hkv, D)
+    v_flat = v_cache.reshape(num_blocks * block_size, Hkv, D)
+    k_flat = k_flat.at[slot_indices].set(k_new)
+    v_flat = v_flat.at[slot_indices].set(v_new)
+    return (
+        k_flat.reshape(num_blocks, block_size, Hkv, D),
+        v_flat.reshape(num_blocks, block_size, Hkv, D),
+    )

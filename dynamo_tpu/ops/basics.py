@@ -1,0 +1,63 @@
+"""Elementwise building blocks: RMSNorm, RoPE, SwiGLU.
+
+Kept as small pure functions so XLA fuses them into the surrounding matmuls
+(HBM-bandwidth discipline: never materialize what the MXU can absorb).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """RMSNorm in f32 accumulation, output in input dtype."""
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    return (out * weight.astype(jnp.float32)).astype(orig_dtype)
+
+
+def rope_freqs(
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: dict | None = None,
+) -> jax.Array:
+    """Inverse frequencies [head_dim//2], with optional llama3-style scaling."""
+    inv = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if scaling and scaling.get("rope_type") in ("llama3",):
+        factor = scaling.get("factor", 8.0)
+        low_factor = scaling.get("low_freq_factor", 1.0)
+        high_factor = scaling.get("high_freq_factor", 4.0)
+        old_len = scaling.get("original_max_position_embeddings", 8192)
+        wavelen = 2.0 * jnp.pi / inv
+        low_wl = old_len / low_factor
+        high_wl = old_len / high_factor
+        scaled = inv / factor
+        smooth = (old_len / wavelen - low_factor) / (high_factor - low_factor)
+        smoothed = (1 - smooth) * scaled + smooth * inv
+        inv = jnp.where(
+            wavelen > low_wl, scaled, jnp.where(wavelen < high_wl, inv, smoothed)
+        )
+    return inv
+
+
+def apply_rope(
+    x: jax.Array,  # [..., seq_or_1, heads, head_dim]
+    positions: jax.Array,  # broadcastable to x's leading dims, int32
+    inv_freqs: jax.Array,  # [head_dim//2]
+) -> jax.Array:
+    """Rotary position embedding (interleaved-half convention, llama style)."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freqs  # [..., hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(gate: jax.Array, up: jax.Array) -> jax.Array:
+    return jax.nn.silu(gate) * up

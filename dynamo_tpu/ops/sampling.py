@@ -1,0 +1,49 @@
+"""On-device batched token sampling: greedy / temperature / top-k / top-p.
+
+Fully vectorized over the batch with per-sequence parameters so one jitted
+sample call serves a mixed batch (greedy and sampled requests together).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(
+    logits: jax.Array,  # [B, V] float32
+    rng: jax.Array,
+    temperature: jax.Array,  # [B] f32; <=0 means greedy
+    top_p: jax.Array,  # [B] f32 in (0, 1]; 1.0 disables
+    top_k: jax.Array,  # [B] int32; 0 disables
+) -> jax.Array:
+    """Returns sampled token ids [B] int32."""
+    B, V = logits.shape
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # top-k: mask everything below the k-th largest
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] descending
+    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+
+    # top-p (nucleus): keep the smallest prefix of the sorted distribution
+    # with cumulative probability >= top_p
+    sorted_desc2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_desc2, axis=-1)
+    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
+    # keep tokens while cumulative prob of STRICTLY better tokens < top_p
+    keep_sorted = (cumprobs - probs_sorted) < top_p[:, None]
+    # threshold = smallest logit still kept
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_desc2, jnp.inf), axis=-1, keepdims=True
+    )
+    scaled = jnp.where(scaled < thresh, NEG_INF, scaled)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy_ids, sampled)

@@ -1,0 +1,7 @@
+"""Parallelism: device meshes, sharding rules, collectives, KV transfer.
+
+The TPU-native replacement for the parallelism the reference delegates to
+engine-internal NCCL (SURVEY.md §2.7): TP/DP via NamedSharding over an ICI
+mesh with GSPMD-propagated collectives; multi-host bring-up via
+jax.distributed + the fabric leader/worker barrier; P/D KV movement via
+device-to-device transfers (transfer.py)."""

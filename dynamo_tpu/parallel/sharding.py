@@ -1,0 +1,75 @@
+"""GSPMD sharding rules for the llama family.
+
+Megatron-style tensor parallelism expressed as NamedShardings on the param
+and KV-cache pytrees; the model code stays unchanged — XLA propagates the
+shardings through the einsums and inserts the psum after the row-parallel
+projections (wo, wd). This is the TPU-idiomatic equivalent of the
+`--tensor-parallel-size` NCCL plumbing the reference passes to vLLM/SGLang.
+
+Layout:
+  wq/wk/wv  [E, heads*D]  -> shard out dim on tp (column parallel)
+  wo        [heads*D, E]  -> shard in dim on tp (row parallel, psum after)
+  wg/wu     [E, F]        -> column parallel
+  wd        [F, E]        -> row parallel
+  lm_head   [E, V]        -> vocab-sharded; logits all-gathered (few MB)
+  embed, norms            -> replicated
+  kv cache  [L, N, Bs, Hkv, D] -> heads on tp
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models.llama import LlamaConfig
+
+
+def _ns(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def _shard_linear(mesh: Mesh, w: Any, spec_in, spec_out) -> Any:
+    """Place a (possibly int8-quantized) linear weight."""
+    if isinstance(w, dict):
+        return {
+            "q": jax.device_put(w["q"], _ns(mesh, spec_in, spec_out)),
+            "s": jax.device_put(w["s"], _ns(mesh, spec_out)),
+        }
+    return jax.device_put(w, _ns(mesh, spec_in, spec_out))
+
+
+def shard_llama(
+    mesh: Mesh, config: LlamaConfig, params: dict
+) -> tuple[dict, NamedSharding]:
+    """Places params onto the mesh; returns (params, kv_cache_sharding)."""
+    if config.num_kv_heads % mesh.shape["tp"] != 0:
+        raise ValueError(
+            f"num_kv_heads={config.num_kv_heads} not divisible by "
+            f"tp={mesh.shape['tp']}"
+        )
+    repl = _ns(mesh, None)
+    out: dict = {
+        "embed": jax.device_put(params["embed"], _ns(mesh, None, None)),
+        "final_norm": jax.device_put(params["final_norm"], repl),
+        "layers": [],
+    }
+    for layer in params["layers"]:
+        out["layers"].append(
+            {
+                "attn_norm": jax.device_put(layer["attn_norm"], repl),
+                "wq": _shard_linear(mesh, layer["wq"], None, "tp"),
+                "wk": _shard_linear(mesh, layer["wk"], None, "tp"),
+                "wv": _shard_linear(mesh, layer["wv"], None, "tp"),
+                "wo": _shard_linear(mesh, layer["wo"], "tp", None),
+                "mlp_norm": jax.device_put(layer["mlp_norm"], repl),
+                "wg": _shard_linear(mesh, layer["wg"], None, "tp"),
+                "wu": _shard_linear(mesh, layer["wu"], None, "tp"),
+                "wd": _shard_linear(mesh, layer["wd"], "tp", None),
+            }
+        )
+    if "lm_head" in params:
+        out["lm_head"] = _shard_linear(mesh, params["lm_head"], None, "tp")
+    kv_sharding = _ns(mesh, None, None, None, "tp", None)
+    return out, kv_sharding

@@ -55,6 +55,11 @@ def parse_args(argv: Optional[list[str]] = None) -> argparse.Namespace:
         "--tensor-parallel-size", type=int, default=1,
         help="TP degree for out=jax engines",
     )
+    parser.add_argument(
+        "--num-blocks", type=int, default=None,
+        help="KV cache blocks (default: sized to the HBM budget)",
+    )
+    parser.add_argument("--max-batch", type=int, default=8)
     args = parser.parse_args(argv)
     args.in_opt = "http"
     args.out_opt = "echo_full"
@@ -88,7 +93,7 @@ async def amain(args: argparse.Namespace) -> None:
             engine = EchoEngineCore() if args.out_opt == "echo_core" else EchoEngineFull()
             config = EngineConfig.static_(engine, mdc)
         elif args.out_opt == "jax":
-            from dynamo_tpu.engine.jax.factory import build_jax_engine
+            from dynamo_tpu.engine.jax_engine.factory import build_jax_engine
 
             if not args.model_path:
                 raise SystemExit("out=jax requires a --model-path (HF dir)")
@@ -98,6 +103,8 @@ async def amain(args: argparse.Namespace) -> None:
                 kv_block_size=args.kv_block_size,
                 context_length=args.context_length,
                 tensor_parallel_size=args.tensor_parallel_size,
+                num_blocks=args.num_blocks,
+                max_batch=args.max_batch,
             )
             config = EngineConfig.static_(engine, mdc)
         else:

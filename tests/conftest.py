@@ -7,11 +7,19 @@ against local etcd instead of clusters — SURVEY.md §4).
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax backends initialize anywhere in the test process.
+# NOTE: the env var alone is not enough under the axon TPU tunnel — its
+# sitecustomize calls jax.config.update("jax_platforms", "axon,cpu") at
+# interpreter start, which overrides JAX_PLATFORMS. We update the config
+# again here (conftest imports before any test imports jax devices).
+os.environ["JAX_PLATFORMS"] = "cpu"
 _FLAG = "--xla_force_host_platform_device_count=8"
 if _FLAG not in os.environ.get("XLA_FLAGS", ""):
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _FLAG).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
