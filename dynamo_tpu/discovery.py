@@ -102,14 +102,17 @@ class ModelWatcher:
         drt: DistributedRuntime,
         manager: ModelManager,
         router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+        kv_router_config: Optional[Any] = None,
     ) -> None:
         self.drt = drt
         self.manager = manager
         self.router_mode = router_mode
+        self.kv_router_config = kv_router_config
         self._task: Optional[asyncio.Task] = None
         self._watch = None
         self._clients: dict[str, Any] = {}  # endpoint str -> Client
         self._key_to_model: dict[str, str] = {}
+        self._kv_routers: dict[str, Any] = {}
 
     async def start(self) -> None:
         self._watch = await self.drt.fabric.watch_prefix(MODEL_ROOT)
@@ -122,6 +125,9 @@ class ModelWatcher:
             await self._watch.cancel()
         if self._task is not None:
             self._task.cancel()
+        for kv_router in self._kv_routers.values():
+            await kv_router.close()
+        self._kv_routers.clear()
         for client in self._clients.values():
             await client.close()
         self._clients.clear()
@@ -153,7 +159,24 @@ class ModelWatcher:
         if client is None:
             client = await endpoint.client()
             self._clients[entry.endpoint] = client
-        router = PushRouter(client, self.router_mode)
+        if self.router_mode is RouterMode.KV:
+            from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+
+            kv_router = self._kv_routers.get(entry.endpoint)
+            if kv_router is None:
+                kv_router = KvRouter(
+                    endpoint.component,
+                    client,
+                    block_size=mdc.kv_block_size,
+                    config=self.kv_router_config,
+                )
+                await kv_router.start()
+                self._kv_routers[entry.endpoint] = kv_router
+            router = PushRouter(
+                client, RouterMode.KV, selector=KvPushRouter(kv_router)
+            )
+        else:
+            router = PushRouter(client, self.router_mode)
         execution = ModelExecution(mdc, RemoteEngine(router))
         self.manager.add_model(entry.name, execution, ref=key)
         self._key_to_model[key] = entry.name

@@ -121,6 +121,10 @@ class JaxEngine:
         self.stats = EngineStats(total_blocks=self.config.num_blocks - 1)
         self.on_blocks_stored = on_blocks_stored
         self.on_blocks_removed = on_blocks_removed
+        # hash -> number of active sequences that emitted a Stored for it;
+        # Removed is only published when the LAST holder frees (the router
+        # tree would otherwise lose blocks other sequences still cache)
+        self._hash_refs: dict[int, int] = {}
         # persistent host-side decode arrays
         B = self.config.max_batch
         self._tokens = np.zeros(B, np.int32)
@@ -185,6 +189,10 @@ class JaxEngine:
         if seq.hash_seq is None:
             return
         new = seq.hash_seq.blocks[seq.emitted_hashes :]
+        for b in new:
+            self._hash_refs[b.block_hash] = (
+                self._hash_refs.get(b.block_hash, 0) + 1
+            )
         if not new or self.on_blocks_stored is None:
             seq.emitted_hashes = len(seq.hash_seq.blocks)
             return
@@ -203,10 +211,18 @@ class JaxEngine:
         self.on_blocks_stored(events)
 
     def _emit_removed(self, seq: _Sequence) -> None:
-        if self.on_blocks_removed is not None and seq.hash_seq is not None:
-            self.on_blocks_removed(
-                [b.block_hash for b in seq.hash_seq.blocks]
-            )
+        if seq.hash_seq is None:
+            return
+        last_refs: list[int] = []
+        for b in seq.hash_seq.blocks[: seq.emitted_hashes]:
+            n = self._hash_refs.get(b.block_hash, 0) - 1
+            if n <= 0:
+                self._hash_refs.pop(b.block_hash, None)
+                last_refs.append(b.block_hash)
+            else:
+                self._hash_refs[b.block_hash] = n
+        if last_refs and self.on_blocks_removed is not None:
+            self.on_blocks_removed(last_refs)
 
     # ----------------------------------------------------------- schedule
 

@@ -1,0 +1,353 @@
+"""MockEngine: a simulated paged-KV engine (no JAX import).
+
+Role-equivalent of lib/llm/src/mocker/* (MockVllmEngine engine.rs:60,
+watermark Scheduler scheduler.rs:197, simulated KvManager kv_manager.rs:524,
+LRU evictor): real block bookkeeping with prefix reuse, LRU eviction, and
+genuine KV store/remove events — but fake compute, timed by a cost model
+(quadratic prefill + linear decode, scheduler.rs:28-43). Lets the KV router,
+disagg router, and planner run end-to-end with zero chips.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Callable, Optional
+
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+@dataclass
+class MockEngineArgs:
+    """Mirrors reference mocker/protocols.rs:160 MockEngineArgs."""
+
+    num_blocks: int = 1024
+    block_size: int = 16
+    max_batch: int = 64
+    watermark: float = 0.01  # fraction of blocks kept free for decode growth
+    speedup_ratio: float = 100.0  # sim time = real time / speedup
+    # cost model (seconds at speedup 1): prefill a*n + b*n^2, decode per-tok c
+    prefill_linear_s: float = 0.0001
+    prefill_quadratic_s: float = 1e-8
+    decode_per_token_s: float = 0.01
+    dp_rank: Optional[int] = None
+
+
+class _SimKvCache:
+    """Paged cache with hash-chain prefix reuse + LRU eviction, emitting
+    real KV events (reference mocker/kv_manager.rs:524)."""
+
+    def __init__(
+        self,
+        args: MockEngineArgs,
+        on_stored: Optional[Callable[[list[dict]], None]] = None,
+        on_removed: Optional[Callable[[list[int]], None]] = None,
+    ) -> None:
+        self.args = args
+        self.free_blocks = args.num_blocks
+        # block_hash -> refcount; 0-ref blocks stay cached until evicted
+        self.refs: dict[int, int] = {}
+        self.lru: collections.OrderedDict[int, None] = collections.OrderedDict()
+        self.on_stored = on_stored
+        self.on_removed = on_removed
+
+    @property
+    def used_blocks(self) -> int:
+        return self.args.num_blocks - self.free_blocks
+
+    @property
+    def usage(self) -> float:
+        return self.used_blocks / max(1, self.args.num_blocks)
+
+    @property
+    def available_blocks(self) -> int:
+        """Free + evictable (cached but unreferenced) blocks."""
+        return self.free_blocks + sum(
+            1 for h in self.lru if self.refs.get(h) == 0
+        )
+
+    def cached_prefix_blocks(self, hashes: list[int]) -> int:
+        n = 0
+        for h in hashes:
+            if h in self.refs:
+                n += 1
+            else:
+                break
+        return n
+
+    def _evict(self, need: int, protected: frozenset = frozenset()) -> bool:
+        evicted: list[int] = []
+        skipped: list[int] = []
+        while need > 0 and self.lru:
+            h, _ = self.lru.popitem(last=False)
+            if h in protected:
+                # cached block of the request being admitted — evicting it
+                # would un-cache what we just counted as a prefix hit
+                skipped.append(h)
+                continue
+            if self.refs.get(h, 1) == 0:
+                del self.refs[h]
+                self.free_blocks += 1
+                evicted.append(h)
+                need -= 1
+        for h in skipped:
+            self.lru[h] = None
+        if evicted and self.on_removed:
+            self.on_removed(evicted)
+        return need <= 0
+
+    def try_allocate(self, hashes: list[int], extra_unique: int) -> bool:
+        """Acquire refs on all chain blocks (+unique partial blocks)."""
+        new_hashes = [h for h in hashes if h not in self.refs]
+        need = len(new_hashes) + extra_unique
+        if need > self.free_blocks and not self._evict(
+            need - self.free_blocks, frozenset(hashes)
+        ):
+            return False
+        stored: list[dict] = []
+        parent = 0
+        for h in hashes:
+            if h in self.refs:
+                self.refs[h] += 1
+                self.lru.pop(h, None)
+            else:
+                self.refs[h] = 1
+                self.free_blocks -= 1
+                stored.append({"block_hash": h, "parent_hash": parent})
+            parent = h
+        self.free_blocks -= extra_unique
+        if stored and self.on_stored:
+            self.on_stored(stored)
+        return True
+
+    def grow(self, new_blocks: list) -> bool:
+        """A decode step completed new block(s) (TokenBlock instances)."""
+        stored = []
+        for b in new_blocks:
+            h = b.block_hash
+            if h in self.refs:
+                self.refs[h] += 1
+                self.lru.pop(h, None)
+            else:
+                if self.free_blocks <= 0 and not self._evict(1):
+                    return False
+                self.refs[h] = 1
+                self.free_blocks -= 1
+                stored.append({"block_hash": h, "parent_hash": b.parent_hash})
+        if stored and self.on_stored:
+            self.on_stored(stored)
+        return True
+
+    def release(self, hashes: list[int], unique: int) -> None:
+        """Drop refs; 0-ref blocks become evictable (stay cached)."""
+        for h in hashes:
+            n = self.refs.get(h)
+            if n is None:
+                continue
+            if n <= 1:
+                self.refs[h] = 0
+                self.lru[h] = None
+                self.lru.move_to_end(h)
+            else:
+                self.refs[h] = n - 1
+        self.free_blocks += unique
+
+
+@dataclass
+class _MockSeq:
+    request: PreprocessedRequest
+    context: Context
+    out: asyncio.Queue
+    hash_seq: TokenBlockSequence
+    generated: int = 0
+    acquired_hashes: list[int] = field(default_factory=list)
+    unique_blocks: int = 1
+
+
+class MockEngine:
+    """AsyncEngine-compatible: generate(request, context) -> LLMEngineOutput
+    stream, same surface as JaxEngine/EchoEngine."""
+
+    def __init__(
+        self,
+        args: Optional[MockEngineArgs] = None,
+        on_blocks_stored: Optional[Callable[[list[dict]], None]] = None,
+        on_blocks_removed: Optional[Callable[[list[int]], None]] = None,
+    ) -> None:
+        self.args = args or MockEngineArgs()
+        self.cache = _SimKvCache(self.args, on_blocks_stored, on_blocks_removed)
+        self.active: list[_MockSeq] = []
+        self.waiting: collections.deque[_MockSeq] = collections.deque()
+        self._loop_task: Optional[asyncio.Task] = None
+        self._wake = asyncio.Event()
+        self.generated_tokens = 0
+
+    # Hook properties matching JaxEngine's surface so worker hosting can
+    # attach a KvEventPublisher uniformly (entrypoint/inputs.py).
+    @property
+    def on_blocks_stored(self):
+        return self.cache.on_stored
+
+    @on_blocks_stored.setter
+    def on_blocks_stored(self, fn) -> None:
+        self.cache.on_stored = fn
+
+    @property
+    def on_blocks_removed(self):
+        return self.cache.on_removed
+
+    @on_blocks_removed.setter
+    def on_blocks_removed(self, fn) -> None:
+        self.cache.on_removed = fn
+
+    # ------------------------------------------------------------- public
+
+    async def generate(
+        self, request: PreprocessedRequest, context: Optional[Context] = None
+    ) -> AsyncIterator[LLMEngineOutput]:
+        ctx = context or Context()
+        seq = _MockSeq(
+            request=request,
+            context=ctx,
+            out=asyncio.Queue(),
+            hash_seq=TokenBlockSequence(
+                block_size=self.args.block_size,
+                tokens=list(request.token_ids),
+            ),
+        )
+        self.waiting.append(seq)
+        self._wake.set()
+        self._ensure_loop()
+        while True:
+            item = await seq.out.get()
+            yield item
+            if item.finish_reason is not None:
+                return
+
+    def stats(self) -> dict:
+        return {
+            "active_slots": len(self.active),
+            "waiting": len(self.waiting),
+            "used_blocks": self.cache.used_blocks,
+            "total_blocks": self.args.num_blocks,
+            "cache_usage": self.cache.usage,
+        }
+
+    async def close(self) -> None:
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            self._loop_task = None
+
+    # -------------------------------------------------------------- sched
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.create_task(self._run())
+
+    async def _sim_sleep(self, sim_s: float) -> None:
+        await asyncio.sleep(sim_s / self.args.speedup_ratio)
+
+    def _admit(self) -> float:
+        """Watermark admission (scheduler.rs:197); returns prefill sim-cost."""
+        cost = 0.0
+        watermark_blocks = int(self.args.num_blocks * self.args.watermark)
+        while self.waiting and len(self.active) < self.args.max_batch:
+            seq = self.waiting[0]
+            hashes = [b.block_hash for b in seq.hash_seq.blocks]
+            cached = self.cache.cached_prefix_blocks(hashes)
+            if (
+                self.cache.available_blocks - (len(hashes) - cached)
+                < watermark_blocks
+            ):
+                break
+            if not self.cache.try_allocate(hashes, extra_unique=1):
+                break
+            self.waiting.popleft()
+            seq.acquired_hashes = list(hashes)
+            self.active.append(seq)
+            n_prefill = max(0, len(seq.request.token_ids)
+                            - cached * self.args.block_size)
+            cost += (
+                self.args.prefill_linear_s * n_prefill
+                + self.args.prefill_quadratic_s * n_prefill * n_prefill
+            )
+        return cost
+
+    def _preempt(self) -> None:
+        """LIFO preemption under block pressure (mirrors mocker LRU-preempt)."""
+        if not self.active:
+            return
+        seq = self.active.pop()
+        self.cache.release(seq.acquired_hashes, seq.unique_blocks)
+        seq.acquired_hashes = []
+        self.waiting.appendleft(seq)
+
+    async def _run(self) -> None:
+        while True:
+            if not self.active and not self.waiting:
+                self._wake.clear()
+                await self._wake.wait()
+            prefill_cost = self._admit()
+            if prefill_cost:
+                await self._sim_sleep(prefill_cost)
+            if not self.active:
+                # blocked: waiting head cannot be admitted yet
+                if self.waiting:
+                    await asyncio.sleep(0.001)
+                continue
+            # one decode iteration for the whole batch
+            await self._sim_sleep(self.args.decode_per_token_s)
+            for seq in list(self.active):
+                self._step_seq(seq)
+
+    def _step_seq(self, seq: _MockSeq) -> None:
+        # Deterministic fake token: cycle over the prompt
+        tok = seq.request.token_ids[
+            seq.generated % max(1, len(seq.request.token_ids))
+        ]
+        seq.generated += 1
+        self.generated_tokens += 1
+        prev_blocks = len(seq.hash_seq.blocks)
+        seq.hash_seq.append(tok)
+        new_blocks = seq.hash_seq.blocks[prev_blocks:]
+        if new_blocks:
+            if not self.cache.grow(new_blocks):
+                self._preempt_for(seq)
+                return
+            seq.acquired_hashes.extend(b.block_hash for b in new_blocks)
+        max_tokens = seq.request.stop.max_tokens or 64
+        finished = seq.generated >= max_tokens or seq.context.is_stopped()
+        reason = None
+        if finished:
+            reason = (
+                FinishReason.CANCELLED
+                if seq.context.is_stopped()
+                else FinishReason.LENGTH
+            )
+        seq.out.put_nowait(
+            LLMEngineOutput(
+                token_ids=[tok],
+                finish_reason=reason,
+            )
+        )
+        if finished:
+            self.active.remove(seq)
+            self.cache.release(seq.acquired_hashes, seq.unique_blocks)
+
+    def _preempt_for(self, seq: _MockSeq) -> None:
+        if seq in self.active:
+            self.active.remove(seq)
+        self.cache.release(seq.acquired_hashes, seq.unique_blocks)
+        seq.acquired_hashes = []
+        self.waiting.appendleft(seq)

@@ -37,10 +37,15 @@ class EngineConfig:
     engine: Optional[AsyncEngine] = None
     mdc: Optional[ModelDeploymentCard] = None
     router_mode: RouterMode = RouterMode.ROUND_ROBIN
+    kv_router_config: Optional[Any] = None  # KvRouterConfig when mode=KV
 
     @classmethod
-    def dynamic(cls, router_mode: RouterMode = RouterMode.ROUND_ROBIN) -> "EngineConfig":
-        return cls(router_mode=router_mode)
+    def dynamic(
+        cls,
+        router_mode: RouterMode = RouterMode.ROUND_ROBIN,
+        kv_router_config: Optional[Any] = None,
+    ) -> "EngineConfig":
+        return cls(router_mode=router_mode, kv_router_config=kv_router_config)
 
     @classmethod
     def static_(cls, engine: AsyncEngine, mdc: ModelDeploymentCard) -> "EngineConfig":
@@ -92,7 +97,9 @@ async def run_http(
             config.mdc.name, ModelExecution(config.mdc, config.local_engine_fn())
         )
     else:
-        watcher = ModelWatcher(drt, manager, config.router_mode)
+        watcher = ModelWatcher(
+            drt, manager, config.router_mode, config.kv_router_config
+        )
         await watcher.start()
     await service.start()
     return service
@@ -242,8 +249,55 @@ async def run_endpoint(
 
     service = await endpoint.serve_endpoint(handler)
     await register_llm(drt, endpoint, config.mdc)
+
+    # KV-routing feeds: publish engine cache events + load metrics so a
+    # KV-mode frontend can prefix-route to this worker (kv_router/publisher).
+    from dynamo_tpu.kv_router.protocols import (
+        ForwardPassMetrics,
+        KvStats,
+        WorkerStats,
+    )
+    from dynamo_tpu.kv_router.publisher import (
+        KvEventPublisher,
+        WorkerMetricsPublisher,
+    )
+
+    kv_pub = KvEventPublisher(endpoint.component, service.instance_id)
+    if hasattr(engine, "on_blocks_stored"):
+        engine.on_blocks_stored = kv_pub.on_blocks_stored
+        engine.on_blocks_removed = kv_pub.on_blocks_removed
+
+    metrics_pub = WorkerMetricsPublisher(
+        endpoint.component, endpoint.id, service.instance_id
+    )
+    stats_fn = getattr(engine, "stats", None)
+
+    def snapshot() -> ForwardPassMetrics:
+        s = stats_fn() if callable(stats_fn) else stats_fn
+        d = s if isinstance(s, dict) else getattr(s, "__dict__", {})
+        total = d.get("total_blocks", 1) or 1
+        used = d.get("used_blocks", 0)
+        return ForwardPassMetrics(
+            worker_stats=WorkerStats(
+                request_active_slots=d.get("active_slots", 0),
+                request_total_slots=d.get("total_slots", 0),
+                num_requests_waiting=d.get("waiting", 0),
+            ),
+            kv_stats=KvStats(
+                kv_active_blocks=used,
+                kv_total_blocks=total,
+                gpu_cache_usage_perc=used / total,
+            ),
+        )
+
+    if stats_fn is not None:
+        await metrics_pub.start(snapshot)
+
     logger.info("worker serving %s (model %s)", eid, config.mdc.name)
-    await service.wait()
+    try:
+        await service.wait()
+    finally:
+        await metrics_pub.stop()
 
 
 # ----------------------------------------------------------------- util
@@ -257,7 +311,9 @@ async def _resolve_execution(
         return ModelExecution(config.mdc, config.local_engine_fn()), config.mdc.name
     # dynamic: wait for a discovered model
     manager = ModelManager()
-    watcher = ModelWatcher(drt, manager, config.router_mode)
+    watcher = ModelWatcher(
+        drt, manager, config.router_mode, config.kv_router_config
+    )
     await watcher.start()
     for _ in range(300):
         models = manager.list_models()

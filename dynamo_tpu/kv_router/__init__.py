@@ -1,0 +1,58 @@
+"""KV-cache-aware routing.
+
+Role-equivalent of the reference's lib/llm/src/kv_router.rs + kv_router/*:
+workers publish KV-cache events (block stored/removed, keyed by the token
+hash chain from dynamo_tpu.tokens) and load metrics; the router maintains a
+global radix tree over those events and picks the worker whose cached prefix
+overlaps the request best, weighed against its predicted load.
+
+Subjects mirror the reference (kv_router.rs:50-52): `kv_events` per
+component, `kv-hit-rate` for routing-quality events, `load_metrics` for
+worker ForwardPassMetrics.
+"""
+
+from dynamo_tpu.kv_router.indexer import (
+    ApproxKvIndexer,
+    KvIndexer,
+    OverlapScores,
+    RadixTree,
+)
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvStats,
+    RouterEvent,
+    SpecDecodeStats,
+    WorkerStats,
+)
+from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
+from dynamo_tpu.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KvRouterConfig,
+    KvScheduler,
+)
+
+KV_EVENT_SUBJECT = "kv_events"
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"
+KV_METRICS_ENDPOINT = "load_metrics"
+
+__all__ = [
+    "ApproxKvIndexer",
+    "DefaultWorkerSelector",
+    "ForwardPassMetrics",
+    "KV_EVENT_SUBJECT",
+    "KV_HIT_RATE_SUBJECT",
+    "KV_METRICS_ENDPOINT",
+    "KvCacheEvent",
+    "KvIndexer",
+    "KvPushRouter",
+    "KvRouter",
+    "KvRouterConfig",
+    "KvScheduler",
+    "KvStats",
+    "OverlapScores",
+    "RadixTree",
+    "RouterEvent",
+    "SpecDecodeStats",
+    "WorkerStats",
+]

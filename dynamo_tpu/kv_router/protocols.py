@@ -1,0 +1,172 @@
+"""Wire types for KV events and worker load metrics.
+
+Role-equivalent of lib/llm/src/kv_router/protocols.rs: KvCacheEvent
+{Stored, Removed, Cleared} (:142-183) and ForwardPassMetrics
+{WorkerStats, KvStats, SpecDecodeStats} (:43-104).
+
+One deliberate simplification vs the reference: it carries two hashes per
+block (`tokens_hash` keying the radix tree, `block_hash` as the engine's
+opaque id) because its engines (vLLM etc.) assign ids the router cannot
+recompute. Our engine's block ids ARE the content-derived chain hashes
+(dynamo_tpu.tokens), so a single hash serves both roles; `tokens_hash` is
+kept as an optional override for foreign engines.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass
+class KvCacheStoredBlock:
+    block_hash: int  # chained (prefix-unique) hash = engine block id
+    tokens_hash: Optional[int] = None  # foreign-engine override for tree edges
+
+    @property
+    def edge_hash(self) -> int:
+        return self.tokens_hash if self.tokens_hash is not None else self.block_hash
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"block_hash": self.block_hash}
+        if self.tokens_hash is not None:
+            d["tokens_hash"] = self.tokens_hash
+        return d
+
+
+@dataclass
+class KvCacheEvent:
+    """One cache mutation. Exactly one of stored/removed/cleared is set."""
+
+    event_id: int = 0
+    # stored: parent_hash + ordered new blocks extending that parent
+    parent_hash: Optional[int] = None
+    stored: Optional[list[KvCacheStoredBlock]] = None
+    # removed: block hashes no longer cached on the worker
+    removed: Optional[list[int]] = None
+    cleared: bool = False
+
+    @classmethod
+    def stored_event(
+        cls,
+        event_id: int,
+        parent_hash: Optional[int],
+        blocks: list[KvCacheStoredBlock],
+    ) -> "KvCacheEvent":
+        return cls(event_id=event_id, parent_hash=parent_hash, stored=blocks)
+
+    @classmethod
+    def removed_event(cls, event_id: int, block_hashes: list[int]) -> "KvCacheEvent":
+        return cls(event_id=event_id, removed=block_hashes)
+
+    @classmethod
+    def cleared_event(cls, event_id: int) -> "KvCacheEvent":
+        return cls(event_id=event_id, cleared=True)
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {"event_id": self.event_id}
+        if self.stored is not None:
+            d["parent_hash"] = self.parent_hash
+            d["stored"] = [b.to_dict() for b in self.stored]
+        elif self.removed is not None:
+            d["removed"] = self.removed
+        else:
+            d["cleared"] = True
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "KvCacheEvent":
+        if "stored" in d:
+            return cls(
+                event_id=d.get("event_id", 0),
+                parent_hash=d.get("parent_hash"),
+                stored=[
+                    KvCacheStoredBlock(b["block_hash"], b.get("tokens_hash"))
+                    for b in d["stored"]
+                ],
+            )
+        if "removed" in d:
+            return cls(event_id=d.get("event_id", 0), removed=list(d["removed"]))
+        return cls(event_id=d.get("event_id", 0), cleared=True)
+
+
+@dataclass
+class RouterEvent:
+    """A KvCacheEvent attributed to the worker instance that emitted it
+    (reference indexer.rs:138)."""
+
+    worker_id: int
+    event: KvCacheEvent
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"worker_id": self.worker_id, "event": self.event.to_dict()}
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "RouterEvent":
+        return cls(d["worker_id"], KvCacheEvent.from_dict(d["event"]))
+
+
+# --------------------------------------------------------------- load metrics
+
+
+@dataclass
+class WorkerStats:
+    request_active_slots: int = 0
+    request_total_slots: int = 0
+    num_requests_waiting: int = 0
+    data_parallel_rank: Optional[int] = None
+
+
+@dataclass
+class KvStats:
+    kv_active_blocks: int = 0
+    kv_total_blocks: int = 1
+    gpu_cache_usage_perc: float = 0.0
+    gpu_prefix_cache_hit_rate: float = 0.0
+
+
+@dataclass
+class SpecDecodeStats:
+    num_spec_tokens: Optional[int] = None
+    num_drafts: Optional[int] = None
+    num_draft_tokens: Optional[int] = None
+    num_accepted_tokens: Optional[int] = None
+    num_accepted_tokens_per_pos: Optional[list[int]] = None
+
+
+@dataclass
+class ForwardPassMetrics:
+    worker_stats: WorkerStats = field(default_factory=WorkerStats)
+    kv_stats: KvStats = field(default_factory=KvStats)
+    spec_decode_stats: Optional[SpecDecodeStats] = None
+
+    def to_dict(self) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "worker_stats": self.worker_stats.__dict__,
+            "kv_stats": self.kv_stats.__dict__,
+        }
+        if self.spec_decode_stats is not None:
+            d["spec_decode_stats"] = self.spec_decode_stats.__dict__
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ForwardPassMetrics":
+        spec = d.get("spec_decode_stats")
+        return cls(
+            worker_stats=WorkerStats(**d.get("worker_stats", {})),
+            kv_stats=KvStats(**d.get("kv_stats", {})),
+            spec_decode_stats=SpecDecodeStats(**spec) if spec else None,
+        )
+
+
+@dataclass
+class KVHitRateEvent:
+    """Routing-quality event published on `kv-hit-rate`
+    (reference scheduler.rs:37)."""
+
+    worker_id: int
+    isl_blocks: int
+    overlap_blocks: int
+
+    def to_dict(self) -> dict[str, Any]:
+        return self.__dict__

@@ -1,0 +1,212 @@
+"""Worker-side publishers: KV-cache events and load metrics.
+
+Role-equivalent of lib/llm/src/kv_router/publisher.rs (KvEventPublisher :99,
+WorkerMetricsPublisher :481) and metrics_aggregator.rs. The reference bridges
+engine ZMQ feeds into NATS; we own the engine, so the publisher hooks the
+JaxEngine's stored/removed callbacks directly (no shim process).
+
+Metrics ride a lease-bound fabric kv key (`stats/...`) instead of NATS $SRV
+request-reply: same pull-based scrape pattern, and worker death auto-expires
+the stats entry with the lease.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvCacheEvent,
+    KvCacheStoredBlock,
+    RouterEvent,
+)
+from dynamo_tpu.runtime.component import Component
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.protocols import EndpointId
+
+logger = get_logger("dynamo_tpu.kv_router.publisher")
+
+KV_EVENT_SUBJECT = "kv_events"
+STATS_ROOT = "stats/"
+
+
+def stats_key(endpoint: EndpointId, instance_id: int) -> str:
+    return (
+        f"{STATS_ROOT}{endpoint.namespace}/{endpoint.component}/"
+        f"{endpoint.name}:{instance_id:x}"
+    )
+
+
+class KvEventPublisher:
+    """Forwards engine block store/remove callbacks as RouterEvents on the
+    component's `kv_events` subject."""
+
+    def __init__(self, component: Component, worker_id: int) -> None:
+        self.component = component
+        self.worker_id = worker_id
+        self._event_id = itertools.count()
+        self._tasks: set[asyncio.Task] = set()
+
+    # These two match the JaxEngine hook signatures
+    # (engine/jax_engine/engine.py on_blocks_stored/on_blocks_removed).
+
+    def on_blocks_stored(self, blocks: list[dict]) -> None:
+        if not blocks:
+            return
+        # Split into contiguous chain runs: each block carries its own
+        # parent_hash, and the batch may skip already-cached blocks
+        # (e.g. mocker re-storing around a warm middle block).
+        run: list[dict] = []
+        for b in blocks:
+            if run and b.get("parent_hash") != run[-1]["block_hash"]:
+                self._emit_run(run)
+                run = []
+            run.append(b)
+        self._emit_run(run)
+
+    def _emit_run(self, run: list[dict]) -> None:
+        if not run:
+            return
+        event = KvCacheEvent.stored_event(
+            next(self._event_id),
+            run[0].get("parent_hash") or None,
+            [KvCacheStoredBlock(b["block_hash"]) for b in run],
+        )
+        self._publish(event)
+
+    def on_blocks_removed(self, block_hashes: list[int]) -> None:
+        if not block_hashes:
+            return
+        self._publish(
+            KvCacheEvent.removed_event(next(self._event_id), block_hashes)
+        )
+
+    def publish_cleared(self) -> None:
+        self._publish(KvCacheEvent.cleared_event(next(self._event_id)))
+
+    def _publish(self, event: KvCacheEvent) -> None:
+        payload = RouterEvent(self.worker_id, event).to_dict()
+
+        async def _send() -> None:
+            with contextlib.suppress(Exception):
+                await self.component.namespace.publish_event(
+                    KV_EVENT_SUBJECT, payload
+                )
+
+        try:
+            task = asyncio.get_running_loop().create_task(_send())
+        except RuntimeError:
+            return  # no loop: engine driven synchronously in tests
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def drain(self) -> None:
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+
+
+class WorkerMetricsPublisher:
+    """Periodically snapshots engine stats into the fabric stats key."""
+
+    def __init__(
+        self,
+        component: Component,
+        endpoint: EndpointId,
+        instance_id: int,
+        interval_s: float = 1.0,
+    ) -> None:
+        self.component = component
+        self.endpoint = endpoint
+        self.instance_id = instance_id
+        self.interval_s = interval_s
+        self._task: Optional[asyncio.Task] = None
+        self._latest: Optional[ForwardPassMetrics] = None
+
+    def publish(self, metrics: ForwardPassMetrics) -> None:
+        """Record the latest snapshot (watch-channel semantics: last wins)."""
+        self._latest = metrics
+
+    async def start(self, metrics_fn=None) -> None:
+        """metrics_fn: optional zero-arg callable polled each interval."""
+        if self._task is not None:
+            return
+        drt = self.component.drt
+        key = stats_key(self.endpoint, self.instance_id)
+
+        async def loop() -> None:
+            while True:
+                m = metrics_fn() if metrics_fn is not None else self._latest
+                if m is not None:
+                    with contextlib.suppress(Exception):
+                        await drt.fabric.kv_put(
+                            key,
+                            msgpack.packb(m.to_dict(), use_bin_type=True),
+                            lease_id=drt.primary_lease,
+                        )
+                await asyncio.sleep(self.interval_s)
+
+        self._task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+
+
+class KvMetricsAggregator:
+    """Frontend/metrics-side scrape of all workers' ForwardPassMetrics
+    (reference metrics_aggregator.rs:210 + scoring.rs ProcessedEndpoints)."""
+
+    def __init__(self, component: Component, endpoint: EndpointId) -> None:
+        self.component = component
+        self.endpoint = endpoint
+
+    async def collect(self) -> dict[int, ForwardPassMetrics]:
+        prefix = (
+            f"{STATS_ROOT}{self.endpoint.namespace}/"
+            f"{self.endpoint.component}/{self.endpoint.name}:"
+        )
+        raw = await self.component.drt.fabric.kv_get_prefix(prefix)
+        out: dict[int, ForwardPassMetrics] = {}
+        for key, value in raw.items():
+            try:
+                instance_id = int(key.rsplit(":", 1)[1], 16)
+                out[instance_id] = ForwardPassMetrics.from_dict(
+                    msgpack.unpackb(value, raw=False)
+                )
+            except Exception:
+                logger.exception("bad stats entry at %s", key)
+        return out
+
+    async def aggregate(self) -> ForwardPassMetrics:
+        """Sum across workers (gauges averaged)."""
+        per_worker = await self.collect()
+        agg = ForwardPassMetrics()
+        n = len(per_worker)
+        for m in per_worker.values():
+            agg.worker_stats.request_active_slots += (
+                m.worker_stats.request_active_slots
+            )
+            agg.worker_stats.request_total_slots += (
+                m.worker_stats.request_total_slots
+            )
+            agg.worker_stats.num_requests_waiting += (
+                m.worker_stats.num_requests_waiting
+            )
+            agg.kv_stats.kv_active_blocks += m.kv_stats.kv_active_blocks
+            agg.kv_stats.kv_total_blocks += m.kv_stats.kv_total_blocks
+            agg.kv_stats.gpu_cache_usage_perc += m.kv_stats.gpu_cache_usage_perc
+            agg.kv_stats.gpu_prefix_cache_hit_rate += (
+                m.kv_stats.gpu_prefix_cache_hit_rate
+            )
+        if n:
+            agg.kv_stats.gpu_cache_usage_perc /= n
+            agg.kv_stats.gpu_prefix_cache_hit_rate /= n
+        return agg

@@ -69,4 +69,47 @@ class PushRouter:
         assert self.selector is not None
         worker_id, overlap = await self.selector.select_worker(token_ids, ctx)
         ctx.metadata["kv_overlap_blocks"] = overlap
-        return await self.client.direct(request, worker_id, ctx)
+        on_complete = getattr(self.selector, "on_request_complete", None)
+        try:
+            stream = await self.client.direct(request, worker_id, ctx)
+        except BaseException:
+            # selection already recorded predicted load for this request —
+            # release it or the failed worker looks permanently loaded
+            if on_complete is not None:
+                on_complete(ctx)
+            raise
+        if on_complete is not None:
+            stream = _CompletionHookStream(stream, ctx, on_complete)
+        return stream
+
+
+class _CompletionHookStream:
+    """Wraps a ResponseStream; fires once when it ends (frees the KV
+    router's predicted-load entry for the request)."""
+
+    def __init__(self, inner, context: Context, on_complete) -> None:
+        self._inner = inner
+        self.context = context
+        self._on_complete = on_complete
+        self._fired = False
+
+    def _fire(self) -> None:
+        if not self._fired:
+            self._fired = True
+            self._on_complete(self.context)
+
+    def __aiter__(self):
+        inner_it = self._inner.__aiter__()
+
+        async def gen():
+            try:
+                async for item in inner_it:
+                    yield item
+            finally:
+                self._fire()
+
+        return gen()
+
+    async def close(self) -> None:
+        self._fire()
+        await self._inner.close()

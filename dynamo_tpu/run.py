@@ -60,6 +60,18 @@ def parse_args(argv: Optional[list[str]] = None) -> argparse.Namespace:
         help="KV cache blocks (default: sized to the HBM budget)",
     )
     parser.add_argument("--max-batch", type=int, default=8)
+    parser.add_argument(
+        "--kv-overlap-score-weight", type=float, default=1.0,
+        help="KV router: weight on prefill (non-cached) blocks in the cost",
+    )
+    parser.add_argument(
+        "--router-temperature", type=float, default=0.5,
+        help="KV router: softmax sampling temperature (0 = argmin)",
+    )
+    parser.add_argument(
+        "--no-kv-events", action="store_true",
+        help="KV router: use TTL-based ApproxKvIndexer instead of events",
+    )
     args = parser.parse_args(argv)
     args.in_opt = "http"
     args.out_opt = "echo_full"
@@ -79,7 +91,16 @@ async def amain(args: argparse.Namespace) -> None:
     try:
         name = args.model_name or (args.model_path or "echo-model")
         if args.out_opt == "dyn":
-            config = EngineConfig.dynamic(RouterMode(args.router_mode))
+            from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+
+            config = EngineConfig.dynamic(
+                RouterMode(args.router_mode),
+                kv_router_config=KvRouterConfig(
+                    overlap_score_weight=args.kv_overlap_score_weight,
+                    router_temperature=args.router_temperature,
+                    use_kv_events=not args.no_kv_events,
+                ),
+            )
         elif args.out_opt in ("echo_core", "echo_full"):
             if args.model_path:
                 mdc = ModelDeploymentCard.from_model_dir(
@@ -91,6 +112,27 @@ async def amain(args: argparse.Namespace) -> None:
             else:
                 mdc = build_test_mdc(name)
             engine = EchoEngineCore() if args.out_opt == "echo_core" else EchoEngineFull()
+            config = EngineConfig.static_(engine, mdc)
+        elif args.out_opt == "mocker":
+            from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+
+            mdc = (
+                ModelDeploymentCard.from_model_dir(
+                    args.model_path,
+                    name,
+                    kv_block_size=args.kv_block_size,
+                    context_length=args.context_length,
+                )
+                if args.model_path
+                else build_test_mdc(name)
+            )
+            engine = MockEngine(
+                MockEngineArgs(
+                    num_blocks=args.num_blocks or 1024,
+                    block_size=args.kv_block_size,
+                    max_batch=args.max_batch,
+                )
+            )
             config = EngineConfig.static_(engine, mdc)
         elif args.out_opt == "jax":
             from dynamo_tpu.engine.jax_engine.factory import build_jax_engine
