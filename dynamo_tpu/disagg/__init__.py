@@ -1,0 +1,33 @@
+"""Disaggregated prefill/decode serving on TPU.
+
+Role-equivalent of the reference's disaggregation stack:
+  * conditional P/D split decision   — lib/llm/src/disagg_router.rs
+  * prefill work queue (JetStream)   — lib/runtime/src/transports/nats.rs:345
+  * VRAM-to-VRAM KV block transfer   — NIXL (block_manager/storage/nixl.rs)
+    + the TP-mismatch layout kernel  — lib/llm/src/kernels/block_copy.cu
+
+The TPU design replaces RDMA with mesh-to-mesh array movement: KV blocks are
+extracted from the prefill worker's paged cache as dense [L, n, bs, Hkv, D]
+tensors (a jitted gather), shipped over the fabric (same-host: zero-copy
+numpy; cross-slice: serialized over the TCP response plane; same-pod meshes
+can use jax.device_put directly), and scattered into the decode worker's
+cache at its own block ids (a jitted donate-in-place scatter). Asymmetric
+TP between P and D is handled by XLA at the scatter — the incoming dense
+blocks carry no sharding, and the scatter's output sharding IS the decode
+cache's sharding, so the "layout-transpose kernel" is compiled for free.
+"""
+
+from dynamo_tpu.disagg.protocols import (
+    RemotePrefillRequest,
+    RemotePrefillResponse,
+)
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.disagg.router import DisaggregatedRouter, DisaggConfig
+
+__all__ = [
+    "RemotePrefillRequest",
+    "RemotePrefillResponse",
+    "PrefillQueue",
+    "DisaggregatedRouter",
+    "DisaggConfig",
+]

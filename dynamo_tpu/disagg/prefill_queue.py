@@ -1,0 +1,53 @@
+"""Pull-based prefill work queue over the fabric.
+
+Role-equivalent of the reference's NATS JetStream prefill queue
+(lib/runtime/src/transports/nats.rs:345-480 NatsQueue,
+examples/llm/utils/prefill_queue.py): decode workers enqueue
+RemotePrefillRequests; any prefill worker dequeues. Pull semantics give the
+same elasticity the reference documents (docs/architecture/
+disagg_serving.md:111-118): P workers can be added/removed with no global
+coordination, and unacked work is redelivered if a prefill worker dies
+mid-request.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.disagg.protocols import RemotePrefillRequest
+from dynamo_tpu.fabric.client import FabricClient
+
+
+class PrefillQueue:
+    """Namespaced prefill work queue handle (one per model namespace)."""
+
+    def __init__(self, fabric: FabricClient, namespace: str) -> None:
+        self._fabric = fabric
+        self.queue_name = f"{namespace}.prefill_queue"
+
+    async def enqueue(self, request: RemotePrefillRequest) -> int:
+        payload = msgpack.packb(request.to_wire(), use_bin_type=True)
+        return await self._fabric.queue_put(self.queue_name, payload)
+
+    async def dequeue(
+        self, timeout: Optional[float] = None
+    ) -> Optional[tuple[int, RemotePrefillRequest]]:
+        """Pop one request; returns (msg_id, request) or None on timeout.
+
+        The message stays in-flight until ack(msg_id); the fabric redelivers
+        it to another worker if no ack arrives (worker crash mid-prefill).
+        """
+        got = await self._fabric.queue_pop(self.queue_name, timeout=timeout)
+        if got is None:
+            return None
+        msg_id, payload = got
+        d = msgpack.unpackb(payload, raw=False)
+        return msg_id, RemotePrefillRequest.from_wire(d)
+
+    async def ack(self, msg_id: int) -> bool:
+        return await self._fabric.queue_ack(self.queue_name, msg_id)
+
+    async def depth(self) -> int:
+        return await self._fabric.queue_depth(self.queue_name)

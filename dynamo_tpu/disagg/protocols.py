@@ -1,0 +1,126 @@
+"""Wire types for remote prefill + KV block payload codec.
+
+Role-equivalent of the reference's RemotePrefillRequest/Response flowing
+through the NATS prefill queue (examples/llm/utils/prefill_queue.py,
+lib/runtime/src/transports/nats.rs:345) and of the NIXL serialized block
+descriptors (lib/llm/src/block_manager.rs:121-148).
+
+KV payloads move as raw bytes: bfloat16 has no numpy dtype, so device blocks
+are viewed as uint16 on the host and re-viewed on arrival — a pure
+reinterpret, no conversion pass.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+# dtype tag -> (numpy wire dtype, itemsize). bfloat16 travels as uint16.
+_WIRE_DTYPES = {
+    "bfloat16": np.uint16,
+    "float32": np.float32,
+    "float16": np.float16,
+    "int8": np.int8,
+}
+
+
+@dataclass
+class KvBlockPayload:
+    """Dense KV blocks for one sequence: k/v of shape [L, n, bs, Hkv, D]."""
+
+    shape: tuple[int, ...]
+    dtype: str  # logical dtype name ("bfloat16", ...)
+    k_bytes: bytes
+    v_bytes: bytes
+
+    @classmethod
+    def from_arrays(cls, k: np.ndarray, v: np.ndarray, dtype: str) -> "KvBlockPayload":
+        return cls(shape=tuple(k.shape), dtype=dtype,
+                   k_bytes=k.tobytes(), v_bytes=v.tobytes())
+
+    def to_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        wire = _WIRE_DTYPES[self.dtype]
+        k = np.frombuffer(self.k_bytes, dtype=wire).reshape(self.shape)
+        v = np.frombuffer(self.v_bytes, dtype=wire).reshape(self.shape)
+        return k, v
+
+    def to_wire(self) -> dict[str, Any]:
+        return {
+            "shape": list(self.shape),
+            "dtype": self.dtype,
+            "k": self.k_bytes,
+            "v": self.v_bytes,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict[str, Any]) -> "KvBlockPayload":
+        return cls(
+            shape=tuple(d["shape"]), dtype=d["dtype"],
+            k_bytes=d["k"], v_bytes=d["v"],
+        )
+
+
+@dataclass
+class RemotePrefillRequest:
+    """Enqueued by a decode worker; served by any prefill worker."""
+
+    request_id: str
+    token_ids: list[int]
+    # subject the prefill worker publishes the response to (decode worker
+    # subscribes before enqueueing — the reference's completion-notify path)
+    reply_subject: str
+    # sampling for the first token (prefill samples it, decode continues)
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    # blocks already cached on the decode worker (prefix hit): the prefill
+    # worker skips recomputing these leading blocks
+    cached_blocks: int = 0
+    block_size: int = 16
+    # opaque routing/annotation extras
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    def to_wire(self) -> dict[str, Any]:
+        return asdict(self)
+
+    @classmethod
+    def from_wire(cls, d: dict[str, Any]) -> "RemotePrefillRequest":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class RemotePrefillResponse:
+    """Published by the prefill worker to the reply subject."""
+
+    request_id: str
+    first_token: int
+    # dense blocks covering blocks [cached_blocks : ceil(T/bs)) — includes
+    # the partial tail block (its unused slots are whatever the prefill
+    # wrote there; decode attention masks by position, so they never read)
+    payload: Optional[KvBlockPayload] = None
+    # index (within the sequence) of the first block in the payload
+    first_block: int = 0
+    error: Optional[str] = None
+
+    def to_wire(self) -> dict[str, Any]:
+        return {
+            "request_id": self.request_id,
+            "first_token": self.first_token,
+            "payload": self.payload.to_wire() if self.payload else None,
+            "first_block": self.first_block,
+            "error": self.error,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict[str, Any]) -> "RemotePrefillResponse":
+        p = d.get("payload")
+        return cls(
+            request_id=d["request_id"],
+            first_token=d["first_token"],
+            payload=KvBlockPayload.from_wire(p) if p else None,
+            first_block=d.get("first_block", 0),
+            error=d.get("error"),
+        )

@@ -1,0 +1,135 @@
+"""Conditional prefill/decode split decision.
+
+Role-equivalent of lib/llm/src/disagg_router.rs: `prefill_remote(prefill_len,
+prefix_hit_len)` returns True when the *non-cached* prefill work is long
+enough to be worth shipping out (`> max_local_prefill_length`) AND the
+prefill queue is not backed up (`< max_prefill_queue_size`), mirroring
+disagg_router.rs:242-253. Thresholds are live-updatable through a fabric KV
+watch (disagg_router.rs:38-147 etcd watch), so operators can retune the
+split at runtime without restarting decode workers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.fabric.client import FabricClient
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.disagg.router")
+
+
+@dataclass
+class DisaggConfig:
+    # min non-cached prompt tokens before remote prefill pays off
+    max_local_prefill_length: int = 50
+    # back-pressure: above this queue depth, prefill locally instead
+    max_prefill_queue_size: int = 2
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DisaggConfig":
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+def _config_key(namespace: str, component: str) -> str:
+    return f"disagg_router/{namespace}/{component}"
+
+
+class DisaggregatedRouter:
+    """Decides local vs remote prefill for one decode worker."""
+
+    def __init__(
+        self,
+        fabric: FabricClient,
+        namespace: str,
+        config: Optional[DisaggConfig] = None,
+        component: str = "decode",
+        queue: Optional[PrefillQueue] = None,
+    ) -> None:
+        self._fabric = fabric
+        self.namespace = namespace
+        self.component = component
+        self.config = config or DisaggConfig()
+        self.queue = queue or PrefillQueue(fabric, namespace)
+        self._watch_task: Optional[asyncio.Task] = None
+        self._queue_depth_cache = 0
+        self._depth_checked_at = -1.0
+
+    # ------------------------------------------------------------ decision
+
+    def prefill_remote(self, prefill_len: int, prefix_hit_len: int = 0) -> bool:
+        """True => enqueue remote prefill; False => prefill locally."""
+        pending = prefill_len - prefix_hit_len
+        return (
+            pending > self.config.max_local_prefill_length
+            and self._queue_depth_cache < self.config.max_prefill_queue_size
+        )
+
+    async def refresh_queue_depth(self) -> int:
+        self._queue_depth_cache = await self.queue.depth()
+        self._depth_checked_at = asyncio.get_running_loop().time()
+        return self._queue_depth_cache
+
+    async def maybe_refresh(self, max_age: float = 0.25) -> None:
+        """Refresh the cached queue depth if it is older than max_age.
+
+        Called by the engine on every admission so the back-pressure half of
+        prefill_remote() actually sees the live queue (the reference polls
+        queue depth per-decision too, disagg_router.rs:242)."""
+        now = asyncio.get_running_loop().time()
+        if now - self._depth_checked_at >= max_age:
+            try:
+                await self.refresh_queue_depth()
+            except Exception as e:  # noqa: BLE001 — fabric hiccup
+                logger.warning("queue depth refresh failed: %s", e)
+                # fail toward local prefill: pretend the queue is saturated
+                self._queue_depth_cache = self.config.max_prefill_queue_size
+
+    # -------------------------------------------------- live config updates
+
+    async def publish_config(self, config: DisaggConfig) -> None:
+        """Write thresholds to the fabric KV (any process may call this)."""
+        await self._fabric.kv_put(
+            _config_key(self.namespace, self.component),
+            json.dumps(config.__dict__).encode(),
+        )
+
+    async def start_watching(self) -> None:
+        """Adopt published thresholds now and on every future change."""
+        key = _config_key(self.namespace, self.component)
+        cur = await self._fabric.kv_get(key)
+        if cur:
+            self._apply(cur)
+        watch = await self._fabric.watch_prefix(key)
+
+        async def loop() -> None:
+            async for ev in watch:
+                if ev.type == "put" and ev.value:
+                    self._apply(ev.value)
+
+        self._watch_task = asyncio.get_running_loop().create_task(loop())
+        self._watch = watch
+
+    def _apply(self, raw: bytes) -> None:
+        try:
+            self.config = DisaggConfig.from_dict(json.loads(raw))
+            logger.info(
+                "disagg thresholds updated: local<=%d, queue<%d",
+                self.config.max_local_prefill_length,
+                self.config.max_prefill_queue_size,
+            )
+        except (ValueError, TypeError) as e:
+            logger.warning("bad disagg config update ignored: %s", e)
+
+    async def close(self) -> None:
+        if self._watch_task is not None:
+            await self._watch.cancel()
+            self._watch_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._watch_task

@@ -1,0 +1,218 @@
+"""KV block movement between prefill and decode workers.
+
+TPU-native replacement for the reference's NIXL RDMA data plane
+(lib/llm/src/block_manager/storage/nixl.rs) + the TP-mismatch layout kernel
+(lib/llm/src/kernels/block_copy.cu):
+
+  * `RemotePrefillClient` — decode-worker side: subscribes a private reply
+    subject, enqueues work, resolves responses to futures (the reference's
+    completion-notify over NIXL metadata + NATS).
+  * `PrefillWorkerService` — prefill-worker side: pulls from the queue, runs
+    the engine's prefill, ships blocks back, acks.
+  * dtype helpers — bfloat16 crosses the host boundary as uint16 views
+    (pure reinterpret; ml_dtypes restores the logical dtype on arrival).
+
+Asymmetric TP (P-TP != D-TP) needs no explicit transpose kernel here: the
+payload is an unsharded dense host array, and the decode side's jitted
+scatter writes it THROUGH the decode cache's NamedSharding — XLA emits the
+required slicing/collectives, which is exactly what block_copy.cu does by
+hand for CUDA. Same-pod mesh-to-mesh transfers can instead pass device
+arrays to `jax.device_put` with the destination sharding (zero host hop);
+the wire path below is the general cross-slice/cross-host route.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import uuid
+from typing import Any, Optional
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.disagg.protocols import (
+    KvBlockPayload,
+    RemotePrefillRequest,
+    RemotePrefillResponse,
+)
+from dynamo_tpu.fabric.client import FabricClient
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.disagg.transfer")
+
+
+def to_wire_array(arr: np.ndarray) -> np.ndarray:
+    """View a device-fetched array as a msgpack-safe numpy dtype."""
+    if arr.dtype.name == "bfloat16":
+        return arr.view(np.uint16)
+    return arr
+
+
+def from_wire_array(arr: np.ndarray, dtype: str) -> np.ndarray:
+    """Restore the logical dtype of a wire array (reinterpret, no copy)."""
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        return arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
+class RemotePrefillClient:
+    """Decode-worker handle: request remote prefills, await responses."""
+
+    def __init__(
+        self,
+        fabric: FabricClient,
+        namespace: str,
+        block_size: int = 16,
+        timeout: float = 120.0,
+    ) -> None:
+        self._fabric = fabric
+        self.namespace = namespace
+        self.block_size = block_size
+        self.timeout = timeout
+        self.queue = PrefillQueue(fabric, namespace)
+        self.reply_subject = f"{namespace}.prefill_reply.{uuid.uuid4().hex[:12]}"
+        self._pending: dict[str, asyncio.Future] = {}
+        self._sub = None
+        self._pump_task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._sub = await self._fabric.subscribe(self.reply_subject)
+
+        async def pump() -> None:
+            assert self._sub is not None
+            async for _subject, payload in self._sub:
+                try:
+                    resp = RemotePrefillResponse.from_wire(
+                        msgpack.unpackb(payload, raw=False)
+                    )
+                except (ValueError, KeyError) as e:
+                    logger.warning("bad prefill response dropped: %s", e)
+                    continue
+                fut = self._pending.pop(resp.request_id, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(resp)
+
+        self._pump_task = asyncio.get_running_loop().create_task(pump())
+
+    async def close(self) -> None:
+        if self._sub is not None:
+            await self._sub.unsubscribe()
+        if self._pump_task is not None:
+            self._pump_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._pump_task
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
+
+    async def prefill(
+        self,
+        token_ids: list[int],
+        *,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        top_k: int = 0,
+        cached_blocks: int = 0,
+        extra: Optional[dict[str, Any]] = None,
+    ) -> RemotePrefillResponse:
+        """Enqueue a remote prefill and await its response."""
+        rid = uuid.uuid4().hex
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        req = RemotePrefillRequest(
+            request_id=rid,
+            token_ids=list(token_ids),
+            reply_subject=self.reply_subject,
+            temperature=temperature,
+            top_p=top_p,
+            top_k=top_k,
+            cached_blocks=cached_blocks,
+            block_size=self.block_size,
+            extra=extra or {},
+        )
+        await self.queue.enqueue(req)
+        try:
+            return await asyncio.wait_for(fut, timeout=self.timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(rid, None)
+            raise
+
+
+class PrefillWorkerService:
+    """Prefill-worker loop: dequeue -> engine.prefill_only -> reply -> ack.
+
+    `engine` is anything exposing
+        async prefill_only(req: RemotePrefillRequest) -> RemotePrefillResponse
+    (JaxEngine implements it; tests use fakes). Unacked work is redelivered
+    by the fabric queue if this worker dies mid-prefill — the elasticity
+    property the reference gets from JetStream.
+    """
+
+    def __init__(
+        self,
+        fabric: FabricClient,
+        namespace: str,
+        engine: Any,
+        max_inflight: int = 2,
+    ) -> None:
+        self._fabric = fabric
+        self.queue = PrefillQueue(fabric, namespace)
+        self.engine = engine
+        self._sem = asyncio.Semaphore(max_inflight)
+        self._task: Optional[asyncio.Task] = None
+        self._inflight: set[asyncio.Task] = set()
+        self._stopped = asyncio.Event()
+        self.served = 0
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        while not self._stopped.is_set():
+            await self._sem.acquire()
+            got = await self.queue.dequeue(timeout=0.2)
+            if got is None:
+                self._sem.release()
+                if self._stopped.is_set():
+                    return
+                continue
+            msg_id, req = got
+            t = asyncio.get_running_loop().create_task(
+                self._serve_one(msg_id, req)
+            )
+            self._inflight.add(t)
+            t.add_done_callback(self._inflight.discard)
+
+    async def _serve_one(self, msg_id: int, req: RemotePrefillRequest) -> None:
+        try:
+            try:
+                resp = await self.engine.prefill_only(req)
+            except Exception as e:  # noqa: BLE001 - error crosses the wire
+                logger.exception("remote prefill %s failed", req.request_id)
+                resp = RemotePrefillResponse(
+                    request_id=req.request_id, first_token=-1, error=str(e)
+                )
+            await self._fabric.publish(
+                req.reply_subject,
+                msgpack.packb(resp.to_wire(), use_bin_type=True),
+            )
+            await self.queue.ack(msg_id)
+            self.served += 1
+        finally:
+            self._sem.release()
+
+    async def close(self) -> None:
+        self._stopped.set()
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+        for t in list(self._inflight):
+            t.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await t

@@ -55,6 +55,7 @@ class EngineStats:
     """Live load/cache stats (feeds WorkerMetricsPublisher, M5)."""
 
     active_slots: int = 0
+    total_slots: int = 0
     waiting: int = 0
     used_blocks: int = 0
     total_blocks: int = 0
@@ -74,6 +75,7 @@ class _Sequence(SequenceState):
         )
         self.request = request
         self.ctx = ctx
+        self.pending_remote = False  # admitted, awaiting remote prefill KV
         self.out: asyncio.Queue = asyncio.Queue()
         self.eos: set[int] = set()
         if not request.stop.ignore_eos:
@@ -102,6 +104,8 @@ class JaxEngine:
         config: Optional[JaxEngineConfig] = None,
         on_blocks_stored: Optional[Callable[[list[dict]], None]] = None,
         on_blocks_removed: Optional[Callable[[list[int]], None]] = None,
+        disagg_router: Optional[Any] = None,
+        remote_prefill_client: Optional[Any] = None,
     ) -> None:
         self.runner = runner
         self.config = config or JaxEngineConfig(
@@ -118,9 +122,21 @@ class JaxEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._closed = False
-        self.stats = EngineStats(total_blocks=self.config.num_blocks - 1)
+        self.stats = EngineStats(
+            total_blocks=self.config.num_blocks - 1,
+            total_slots=self.config.max_batch,
+        )
         self.on_blocks_stored = on_blocks_stored
         self.on_blocks_removed = on_blocks_removed
+        # Disaggregation (SURVEY §7.6): when both are set, long prompts are
+        # shipped to the prefill fleet instead of running locally.
+        self.disagg_router = disagg_router
+        self.remote_prefill_client = remote_prefill_client
+        self._remote_tasks: set[asyncio.Task] = set()
+        # Serializes every runner call: the cache arrays are DONATED through
+        # prefill/decode/inject, so a concurrent caller (remote-prefill
+        # landing, prefill_only service task) would read a deleted array.
+        self._device_lock = asyncio.Lock()
         # hash -> number of active sequences that emitted a Stored for it;
         # Removed is only published when the LAST holder frees (the router
         # tree would otherwise lose blocks other sequences still cache)
@@ -172,6 +188,10 @@ class JaxEngine:
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
+        for t in list(self._remote_tasks):
+            t.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await t
         if self._loop_task is not None:
             with contextlib.suppress(asyncio.CancelledError):
                 await self._loop_task
@@ -244,7 +264,7 @@ class JaxEngine:
 
     def _preempt_youngest(self, exclude: _Sequence) -> bool:
         for victim in reversed(self._admit_order):
-            if victim is exclude or victim.slot is None:
+            if victim is exclude or victim.slot is None or victim.pending_remote:
                 continue
             logger.debug("preempting seq %d", victim.seq_id)
             # drop generated KV; it will re-prefill from its full token_ids
@@ -276,13 +296,22 @@ class JaxEngine:
         while not self._closed:
             self._reap_cancelled()
             admitted = await self._admit_phase(loop)
-            active = [s for s in self.slots if s is not None]
+            active = [
+                s for s in self.slots if s is not None and not s.pending_remote
+            ]
             if not active:
-                if not self.waiting:
+                pending = any(
+                    s is not None and s.pending_remote for s in self.slots
+                )
+                if not self.waiting and not pending:
                     self._wake.clear()
                     if self._closed:
                         return
                     await self._wake.wait()
+                else:
+                    # remote prefills in flight (or unadmittable backlog):
+                    # yield without busy-spinning
+                    await asyncio.sleep(0.001)
                 continue
             await self._decode_phase(loop, active)
             self._update_stats()
@@ -295,7 +324,10 @@ class JaxEngine:
                 self.waiting.remove(seq)
                 seq.out.put_nowait(LLMEngineOutput.final(FinishReason.CANCELLED))
         for seq in list(self._admit_order):
-            if seq.ctx.is_killed():
+            # pending_remote seqs keep their blocks until the in-flight
+            # inject lands — freeing now could hand the blocks to another
+            # sequence and have the late inject corrupt its KV
+            if seq.ctx.is_killed() and not seq.pending_remote:
                 self._finish(seq, FinishReason.CANCELLED)
 
     async def _admit_phase(self, loop) -> bool:
@@ -306,20 +338,40 @@ class JaxEngine:
                 break
             self.waiting.pop(0)
             admitted = True
+            use_remote = False
+            if (
+                self.disagg_router is not None
+                and self.remote_prefill_client is not None
+            ):
+                refresh = getattr(self.disagg_router, "maybe_refresh", None)
+                if refresh is not None:
+                    await refresh()
+                use_remote = self.disagg_router.prefill_remote(
+                    len(seq.token_ids), 0
+                )
+            if use_remote:
+                # ship the prefill out; the sequence holds its slot+blocks
+                # and joins the decode batch when the KV lands
+                seq.pending_remote = True
+                t = loop.create_task(self._remote_prefill_task(seq))
+                self._remote_tasks.add(t)
+                t.add_done_callback(self._remote_tasks.discard)
+                continue
             # re-admission after preemption replays generated tokens too
             replay = seq.token_ids
-            tok_arr = await loop.run_in_executor(
-                None,
-                lambda: np.asarray(
-                    self.runner.prefill(
-                        replay,
-                        seq.block_ids,
-                        seq.temperature,
-                        seq.top_p,
-                        seq.top_k,
-                    )
-                ),
-            )
+            async with self._device_lock:
+                tok_arr = await loop.run_in_executor(
+                    None,
+                    lambda: np.asarray(
+                        self.runner.prefill(
+                            replay,
+                            seq.block_ids,
+                            seq.temperature,
+                            seq.top_p,
+                            seq.top_k,
+                        )
+                    ),
+                )
             token = int(tok_arr)
             seq.hash_seq = TokenBlockSequence(
                 replay, self.config.block_size
@@ -327,6 +379,138 @@ class JaxEngine:
             self._emit_stored(seq)
             self._append_token(seq, token)
         return admitted
+
+    async def _remote_prefill_task(self, seq: _Sequence) -> None:
+        """Await a remote prefill, land its KV, and enter the decode batch.
+
+        Mirrors the decode-worker half of the reference's disagg flow
+        (examples/llm/components/worker.py): enqueue -> prefill fleet runs ->
+        computed blocks arrive -> request joins the in-flight decode batch.
+        Falls back to local prefill on any remote error.
+        """
+        loop = asyncio.get_running_loop()
+        try:
+            resp = await self.remote_prefill_client.prefill(
+                seq.token_ids,
+                temperature=seq.temperature,
+                top_p=seq.top_p,
+                top_k=seq.top_k,
+            )
+        except asyncio.CancelledError:
+            if self._closed:
+                raise  # engine shutdown cancelled us: propagate
+            # client-side cancellation (transport restart): fall back local
+            logger.warning("remote prefill cancelled; falling back local")
+            resp = None
+        except Exception as e:  # noqa: BLE001 — any transport failure
+            logger.warning("remote prefill failed (%s); falling back local", e)
+            resp = None
+        if seq.slot is None:  # cancelled/finished while in flight
+            return
+        try:
+            await self._land_prefill(seq, resp, loop)
+        except Exception:  # noqa: BLE001 — never strand the consumer
+            logger.exception("landing prefill for seq %d failed", seq.seq_id)
+            seq.pending_remote = False
+            self._finish(seq, FinishReason.ERROR)
+            self._wake.set()
+
+    async def _land_prefill(self, seq: _Sequence, resp, loop) -> None:
+        from dynamo_tpu.disagg.transfer import from_wire_array
+
+        if resp is not None and resp.error is None and resp.payload is not None:
+            k, v = resp.payload.to_arrays()
+            k = from_wire_array(k, resp.payload.dtype)
+            v = from_wire_array(v, resp.payload.dtype)
+            ids = seq.block_ids[resp.first_block : resp.first_block + k.shape[1]]
+            async with self._device_lock:
+                await loop.run_in_executor(
+                    None, self.runner.inject_blocks, ids, k, v
+                )
+            first_token = resp.first_token
+        else:
+            # local fallback (also covers error responses)
+            async with self._device_lock:
+                tok_arr = await loop.run_in_executor(
+                    None,
+                    lambda: np.asarray(
+                        self.runner.prefill(
+                            seq.token_ids,
+                            seq.block_ids,
+                            seq.temperature,
+                            seq.top_p,
+                            seq.top_k,
+                        )
+                    ),
+                )
+            first_token = int(tok_arr)
+        if seq.slot is None:
+            return
+        seq.hash_seq = TokenBlockSequence(
+            list(seq.token_ids), self.config.block_size
+        )
+        self._emit_stored(seq)
+        seq.pending_remote = False
+        self._append_token(seq, first_token)
+        self._wake.set()
+
+    async def prefill_only(self, req: Any) -> Any:
+        """Serve one RemotePrefillRequest (the prefill-worker role).
+
+        Recomputes the full prompt on scratch blocks, ships back blocks from
+        `req.cached_blocks` on (prefix-hit blocks already sit in the decode
+        worker's cache — bandwidth saved; compute is not, unlike the
+        reference's NIXL read-back of prefix blocks, which ICI cannot
+        replicate without the decode mesh's cooperation).
+        """
+        from dynamo_tpu.disagg.protocols import (
+            KvBlockPayload,
+            RemotePrefillResponse,
+        )
+        from dynamo_tpu.disagg.transfer import to_wire_array
+
+        loop = asyncio.get_running_loop()
+        bs = self.config.block_size
+        T = len(req.token_ids)
+        if T > self.config.max_model_len:
+            return RemotePrefillResponse(
+                request_id=req.request_id,
+                first_token=-1,
+                error=f"prompt {T} exceeds max_model_len",
+            )
+        need = (T + bs - 1) // bs
+        block_ids = self.allocator.alloc(need)
+        try:
+            async with self._device_lock:
+                tok_arr = await loop.run_in_executor(
+                    None,
+                    lambda: np.asarray(
+                        self.runner.prefill(
+                            list(req.token_ids),
+                            block_ids,
+                            req.temperature,
+                            req.top_p,
+                            req.top_k,
+                        )
+                    ),
+                )
+                ship = block_ids[req.cached_blocks :]
+                k, v = await loop.run_in_executor(
+                    None, self.runner.extract_blocks, ship
+                )
+            dtype = k.dtype.name
+            payload = KvBlockPayload.from_arrays(
+                to_wire_array(k), to_wire_array(v), dtype
+            )
+            self.stats.generated_tokens += 1
+            return RemotePrefillResponse(
+                request_id=req.request_id,
+                first_token=int(tok_arr),
+                payload=payload,
+                first_block=req.cached_blocks,
+            )
+        finally:
+            self.allocator.free(block_ids)
 
     async def _decode_phase(self, loop, active: list[_Sequence]) -> None:
         B = self.config.max_batch
@@ -348,20 +532,21 @@ class JaxEngine:
             self._temps[i] = seq.temperature
             self._top_ps[i] = seq.top_p
             self._top_ks[i] = seq.top_k
-        toks = await loop.run_in_executor(
-            None,
-            lambda: np.asarray(
-                self.runner.decode(
-                    self._tokens,
-                    self._positions,
-                    self._block_tables,
-                    self._slot_indices,
-                    self._temps,
-                    self._top_ps,
-                    self._top_ks,
-                )
-            ),
-        )
+        async with self._device_lock:
+            toks = await loop.run_in_executor(
+                None,
+                lambda: np.asarray(
+                    self.runner.decode(
+                        self._tokens,
+                        self._positions,
+                        self._block_tables,
+                        self._slot_indices,
+                        self._temps,
+                        self._top_ps,
+                        self._top_ks,
+                    )
+                ),
+            )
         for seq in active:
             if seq.slot is None:
                 continue  # finished/cancelled concurrently

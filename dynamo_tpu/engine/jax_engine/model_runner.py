@@ -120,6 +120,22 @@ class ModelRunner:
             donate_argnums=(1, 2),  # k_cache, v_cache
             **jit_kwargs,
         )
+        # Disagg KV movement (NIXL/block_copy.cu replacement): gather whole
+        # blocks out of the paged cache / scatter received blocks in. Block
+        # counts are padded to bucket sizes so each compiles once per bucket.
+        self._extract_jit = jax.jit(lambda k, v, ids: (k[:, ids], v[:, ids]))
+        self._inject_jit = jax.jit(
+            lambda k, v, ids, kb, vb: (
+                k.at[:, ids].set(kb.astype(k.dtype)),
+                v.at[:, ids].set(vb.astype(v.dtype)),
+            ),
+            donate_argnums=(0, 1),
+            **(
+                {"out_shardings": (kv_sharding, kv_sharding)}
+                if kv_sharding is not None
+                else {}
+            ),
+        )
 
     # ------------------------------------------------------------- jitted
 
@@ -191,6 +207,54 @@ class ModelRunner:
             jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
         )
         return tok
+
+    def _pad_block_count(self, n: int) -> int:
+        """Smallest bucket block count >= n (bounds compiled program count)."""
+        for b in self.prefill_buckets:
+            nb = b // self.block_size
+            if nb >= n:
+                return nb
+        return (self.prefill_buckets[-1] // self.block_size)
+
+    def extract_blocks(
+        self, block_ids: list[int]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Gather dense KV blocks [L, n, bs, Hkv, D] for disagg shipping."""
+        n = len(block_ids)
+        padded = self._pad_block_count(n)
+        ids = np.zeros(padded, np.int32)
+        ids[:n] = block_ids
+        k, v = self._extract_jit(self.k_cache, self.v_cache, jnp.asarray(ids))
+        return (
+            np.asarray(jax.device_get(k))[:, :n],
+            np.asarray(jax.device_get(v))[:, :n],
+        )
+
+    def inject_blocks(
+        self, block_ids: list[int], k_blocks: np.ndarray, v_blocks: np.ndarray
+    ) -> None:
+        """Scatter received dense KV blocks into this cache at block_ids.
+
+        Padding lanes target the null block 0 (a designated garbage sink).
+        When the cache is TP-sharded, the scatter's pinned out_sharding makes
+        XLA reshard the incoming dense blocks — the block_copy.cu equivalent.
+        """
+        n = len(block_ids)
+        padded = self._pad_block_count(n)
+        ids = np.zeros(padded, np.int32)
+        ids[:n] = block_ids
+        if padded != n:
+            pad_shape = (k_blocks.shape[0], padded - n) + k_blocks.shape[2:]
+            zpad = np.zeros(pad_shape, k_blocks.dtype)
+            k_blocks = np.concatenate([k_blocks, zpad], axis=1)
+            v_blocks = np.concatenate([v_blocks, zpad], axis=1)
+        self.k_cache, self.v_cache = self._inject_jit(
+            self.k_cache,
+            self.v_cache,
+            jnp.asarray(ids),
+            jnp.asarray(k_blocks),
+            jnp.asarray(v_blocks),
+        )
 
     def decode(
         self,

@@ -225,15 +225,23 @@ class MockEngine:
         self.waiting.append(seq)
         self._wake.set()
         self._ensure_loop()
-        while True:
-            item = await seq.out.get()
-            yield item
-            if item.finish_reason is not None:
-                return
+        try:
+            while True:
+                item = await seq.out.get()
+                yield item
+                if item.finish_reason is not None:
+                    return
+        finally:
+            # consumer disconnected mid-stream: mark the request dead so the
+            # sim loop releases its cache blocks instead of generating into
+            # a queue nobody reads (mirrors JaxEngine.generate)
+            ctx.kill()
+            self._wake.set()
 
     def stats(self) -> dict:
         return {
             "active_slots": len(self.active),
+            "total_slots": self.args.max_batch,
             "waiting": len(self.waiting),
             "used_blocks": self.cache.used_blocks,
             "total_blocks": self.args.num_blocks,
@@ -262,6 +270,10 @@ class MockEngine:
         """Watermark admission (scheduler.rs:197); returns prefill sim-cost."""
         cost = 0.0
         watermark_blocks = int(self.args.num_blocks * self.args.watermark)
+        # reap abandoned requests before they consume sim capacity
+        for seq in [s for s in self.waiting if s.context.is_killed()]:
+            self.waiting.remove(seq)
+            seq.out.put_nowait(LLMEngineOutput.final(FinishReason.CANCELLED))
         while self.waiting and len(self.active) < self.args.max_batch:
             seq = self.waiting[0]
             hashes = [b.block_hash for b in seq.hash_seq.blocks]
@@ -283,15 +295,6 @@ class MockEngine:
                 + self.args.prefill_quadratic_s * n_prefill * n_prefill
             )
         return cost
-
-    def _preempt(self) -> None:
-        """LIFO preemption under block pressure (mirrors mocker LRU-preempt)."""
-        if not self.active:
-            return
-        seq = self.active.pop()
-        self.cache.release(seq.acquired_hashes, seq.unique_blocks)
-        seq.acquired_hashes = []
-        self.waiting.appendleft(seq)
 
     async def _run(self) -> None:
         while True:

@@ -43,12 +43,23 @@ class OverlapScores:
 
 
 class _Node:
-    __slots__ = ("children", "workers", "recent_uses")
+    __slots__ = ("children", "workers", "recent_uses", "parent", "edge")
 
     def __init__(self) -> None:
         self.children: dict[int, _Node] = {}
         self.workers: set[int] = set()
         self.recent_uses: Deque[float] = collections.deque()
+        # back-link for detaching emptied nodes (leak prevention: a
+        # long-running router sees unbounded distinct block hashes)
+        self.parent: Optional["_Node"] = None
+        self.edge: int = 0
+
+    def detach(self) -> None:
+        """Unlink from the parent if this node is empty (no workers)."""
+        p = self.parent
+        if p is not None and p.children.get(self.edge) is self:
+            del p.children[self.edge]
+        self.parent = None
 
 
 class RadixTree:
@@ -109,7 +120,11 @@ class RadixTree:
                     # Re-link an existing worker block if the engine re-stored
                     # it under a new parent, else create fresh.
                     node = worker_lookup.get(blk.block_hash) or _Node()
+                    if node.parent is not None and node.parent is not current:
+                        node.detach()
                     current.children[blk.edge_hash] = node
+                    node.parent = current
+                    node.edge = blk.edge_hash
                 node.workers.add(worker_id)
                 worker_lookup[blk.block_hash] = node
                 current = node
@@ -128,6 +143,7 @@ class RadixTree:
                 if not node.workers:
                     # No worker holds this block => none holds any child.
                     node.children.clear()
+                    node.detach()
         else:  # cleared
             self.clear_all_blocks(worker_id)
 
@@ -136,12 +152,18 @@ class RadixTree:
         if blocks:
             for node in blocks.values():
                 node.workers.discard(worker_id)
+                if not node.workers:
+                    node.children.clear()
+                    node.detach()
 
     def clear_all_blocks(self, worker_id: int) -> None:
         blocks = self.lookup.get(worker_id)
         if blocks:
             for node in blocks.values():
                 node.workers.discard(worker_id)
+                if not node.workers:
+                    node.children.clear()
+                    node.detach()
             blocks.clear()
 
     # -- introspection (used by tests / metrics) --

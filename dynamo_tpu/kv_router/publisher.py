@@ -189,6 +189,10 @@ class KvMetricsAggregator:
         """Sum across workers (gauges averaged)."""
         per_worker = await self.collect()
         agg = ForwardPassMetrics()
+        # the dataclass defaults are "one healthy idle worker" sentinels;
+        # an aggregate must start from true zero or it over-counts by one
+        agg.kv_stats.kv_total_blocks = 0
+        agg.worker_stats.request_total_slots = 0
         n = len(per_worker)
         for m in per_worker.values():
             agg.worker_stats.request_active_slots += (

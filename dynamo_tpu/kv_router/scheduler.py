@@ -112,9 +112,9 @@ class DefaultWorkerSelector:
     ) -> WorkerSelectionResult:
         if not worker_ids:
             raise NoEndpointsError("no workers to select from")
-        assert request.isl_tokens > 0
-
-        request_blocks = -(-request.isl_tokens // block_size)
+        # empty prompts are legal (some clients probe with them) — they
+        # simply carry zero prefill cost and route on load alone
+        request_blocks = -(-max(0, request.isl_tokens) // block_size)
         logits: dict[int, float] = {}
         max_logit = -math.inf
         for worker_id in worker_ids:

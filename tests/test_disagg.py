@@ -1,0 +1,266 @@
+"""Disaggregated prefill/decode tests (tiny model, CPU, in-process fabric).
+
+Covers the role-equivalents of the reference's disagg stack: prefill queue
+(NatsQueue), DisaggregatedRouter thresholds + live updates
+(disagg_router.rs), KV payload codec + extract/inject (NIXL/block_copy.cu),
+and the full decode-worker <-> prefill-worker flow (examples/llm disagg
+graph). The gold check everywhere: disaggregated output must be
+token-identical to single-engine output under greedy sampling.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.disagg.protocols import (
+    KvBlockPayload,
+    RemotePrefillRequest,
+    RemotePrefillResponse,
+)
+from dynamo_tpu.disagg.router import DisaggConfig, DisaggregatedRouter
+from dynamo_tpu.disagg.transfer import (
+    PrefillWorkerService,
+    RemotePrefillClient,
+    from_wire_array,
+    to_wire_array,
+)
+from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+from dynamo_tpu.fabric.client import FabricClient
+from dynamo_tpu.models import llama as L
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+BLOCK = 4
+
+
+def make_engine(**kw):
+    cfg = L.LlamaConfig.tiny(vocab_size=64)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    runner = ModelRunner(
+        cfg,
+        params,
+        num_blocks=64,
+        block_size=BLOCK,
+        max_batch=4,
+        max_model_len=64,
+    )
+    return JaxEngine(
+        runner,
+        JaxEngineConfig(
+            max_batch=4,
+            block_size=BLOCK,
+            num_blocks=64,
+            max_model_len=64,
+            watermark_blocks=2,
+        ),
+        **kw,
+    )
+
+
+def greedy_request(prompt, max_tokens=8):
+    return PreprocessedRequest(
+        token_ids=prompt,
+        sampling=SamplingOptions(greedy=True),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+
+
+async def collect_tokens(engine, prompt, max_tokens=8):
+    out = []
+    async for o in engine.generate(greedy_request(prompt, max_tokens), Context()):
+        out.extend(o.token_ids)
+    return out
+
+
+# --------------------------------------------------------------- unit level
+
+
+async def test_prefill_queue_roundtrip():
+    fabric = FabricClient.in_process()
+    q = PrefillQueue(fabric, "ns1")
+    req = RemotePrefillRequest(
+        request_id="r1", token_ids=[1, 2, 3], reply_subject="s", block_size=4
+    )
+    await q.enqueue(req)
+    assert await q.depth() == 1
+    got = await q.dequeue(timeout=1)
+    assert got is not None
+    msg_id, back = got
+    assert back.token_ids == [1, 2, 3]
+    assert back.request_id == "r1"
+    assert await q.ack(msg_id)
+    assert await q.depth() == 0
+    assert await q.dequeue(timeout=0.05) is None
+
+
+async def test_disagg_router_thresholds_and_live_update():
+    fabric = FabricClient.in_process()
+    r = DisaggregatedRouter(
+        fabric, "ns2", DisaggConfig(max_local_prefill_length=50)
+    )
+    assert not r.prefill_remote(50, 0)  # not strictly greater
+    assert r.prefill_remote(51, 0)
+    assert not r.prefill_remote(100, 60)  # prefix hit shrinks pending work
+    # queue back-pressure: depth >= max_prefill_queue_size forces local
+    q = PrefillQueue(fabric, "ns2")
+    for i in range(2):
+        await q.enqueue(
+            RemotePrefillRequest(request_id=str(i), token_ids=[1], reply_subject="x")
+        )
+    await r.refresh_queue_depth()
+    assert not r.prefill_remote(500, 0)
+    # live threshold update through the fabric kv watch
+    await r.start_watching()
+    await r.publish_config(DisaggConfig(max_local_prefill_length=5))
+    for _ in range(100):
+        if r.config.max_local_prefill_length == 5:
+            break
+        await asyncio.sleep(0.01)
+    assert r.config.max_local_prefill_length == 5
+    await r.close()
+
+
+def test_kv_payload_bf16_roundtrip():
+    import ml_dtypes
+
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((2, 3, 4, 2, 8)).astype(ml_dtypes.bfloat16)
+    v = rng.standard_normal((2, 3, 4, 2, 8)).astype(ml_dtypes.bfloat16)
+    p = KvBlockPayload.from_arrays(to_wire_array(k), to_wire_array(v), "bfloat16")
+    wire = RemotePrefillResponse(
+        request_id="a", first_token=7, payload=p
+    ).to_wire()
+    back = RemotePrefillResponse.from_wire(wire)
+    k2, v2 = back.payload.to_arrays()
+    k2 = from_wire_array(k2, back.payload.dtype)
+    v2 = from_wire_array(v2, back.payload.dtype)
+    assert k2.dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(np.asarray(k, np.float32), np.asarray(k2, np.float32))
+    np.testing.assert_array_equal(np.asarray(v, np.float32), np.asarray(v2, np.float32))
+
+
+async def test_extract_inject_transfers_kv_exactly():
+    """Prefill on engine A, ship blocks to engine B, decode must continue
+    exactly as if B had prefilled locally."""
+    a, b = make_engine(), make_engine()
+    prompt = list(range(2, 19))  # 17 tokens -> 4 full blocks + tail
+    # local reference: run fully on B's twin (same weights)
+    ref = await collect_tokens(make_engine(), prompt)
+
+    req = RemotePrefillRequest(
+        request_id="x",
+        token_ids=prompt,
+        reply_subject="unused",
+        temperature=0.0,
+        block_size=BLOCK,
+    )
+    resp = await a.prefill_only(req)
+    assert resp.error is None
+    k, v = resp.payload.to_arrays()
+    k = from_wire_array(k, resp.payload.dtype)
+    v = from_wire_array(v, resp.payload.dtype)
+    assert k.shape[1] == (len(prompt) + BLOCK - 1) // BLOCK
+
+    # hand-land into B: allocate blocks, inject, then generate with the
+    # prompt KV present by faking the remote path through a client stub
+    class StubClient:
+        block_size = BLOCK
+
+        async def prefill(self, token_ids, **kw):
+            return resp
+
+    router = DisaggregatedRouter(
+        FabricClient.in_process(), "x", DisaggConfig(max_local_prefill_length=1)
+    )
+    router._queue_depth_cache = 0
+    b.disagg_router = router
+    b.remote_prefill_client = StubClient()
+    got = await collect_tokens(b, prompt)
+    assert got == ref
+    await a.close()
+    await b.close()
+
+
+# ---------------------------------------------------------------- e2e level
+
+
+async def test_disagg_end_to_end_matches_local():
+    fabric = FabricClient.in_process()
+    ns = "disagg-e2e"
+
+    prefill_engine = make_engine()
+    service = PrefillWorkerService(fabric, ns, prefill_engine)
+    await service.start()
+
+    client = RemotePrefillClient(fabric, ns, block_size=BLOCK, timeout=30)
+    await client.start()
+    router = DisaggregatedRouter(
+        fabric,
+        ns,
+        DisaggConfig(max_local_prefill_length=4, max_prefill_queue_size=100),
+    )
+    decode_engine = make_engine(
+        disagg_router=router, remote_prefill_client=client
+    )
+
+    prompts = [list(range(2, 2 + n)) for n in (9, 17, 23)]
+    refs = [await collect_tokens(make_engine(), p) for p in prompts]
+    outs = await asyncio.gather(
+        *(collect_tokens(decode_engine, p) for p in prompts)
+    )
+    assert list(outs) == refs
+    assert service.served == len(prompts)  # all went remote
+
+    await decode_engine.close()
+    await client.close()
+    await service.close()
+    await prefill_engine.close()
+
+
+async def test_disagg_short_prompt_stays_local():
+    fabric = FabricClient.in_process()
+    ns = "disagg-local"
+    client = RemotePrefillClient(fabric, ns, block_size=BLOCK)
+    await client.start()
+    router = DisaggregatedRouter(
+        fabric, ns, DisaggConfig(max_local_prefill_length=100)
+    )
+    engine = make_engine(disagg_router=router, remote_prefill_client=client)
+    prompt = [3, 4, 5]
+    ref = await collect_tokens(make_engine(), prompt)
+    # no prefill worker exists: if this went remote it would time out
+    got = await asyncio.wait_for(collect_tokens(engine, prompt), timeout=20)
+    assert got == ref
+    await engine.close()
+    await client.close()
+
+
+async def test_remote_failure_falls_back_local():
+    fabric = FabricClient.in_process()
+    ns = "disagg-fb"
+
+    class FailingClient:
+        block_size = BLOCK
+
+        async def prefill(self, token_ids, **kw):
+            raise RuntimeError("prefill fleet down")
+
+    router = DisaggregatedRouter(
+        fabric, ns, DisaggConfig(max_local_prefill_length=1)
+    )
+    engine = make_engine(
+        disagg_router=router, remote_prefill_client=FailingClient()
+    )
+    prompt = list(range(2, 14))
+    ref = await collect_tokens(make_engine(), prompt)
+    got = await collect_tokens(engine, prompt)
+    assert got == ref
+    await engine.close()

@@ -89,6 +89,34 @@ def test_radix_store_under_parent_and_remove():
     assert t.find_matches([55]).scores == {}
 
 
+def test_radix_removal_detaches_nodes():
+    """Emptied nodes must unlink from their parents — a long-running router
+    sees unbounded distinct block hashes, so leaks here are fatal."""
+
+    def count_nodes(node):
+        return 1 + sum(count_nodes(c) for c in node.children.values())
+
+    t = RadixTree()
+    for i in range(50):
+        base = 1000 * i
+        t.apply_event(stored(1, [base, base + 1, base + 2], eid=i))
+    assert count_nodes(t.root) == 1 + 150
+    for i in range(50):
+        base = 1000 * i
+        t.apply_event(
+            RouterEvent(
+                1,
+                KvCacheEvent.removed_event(100 + i, [base, base + 1, base + 2]),
+            )
+        )
+    assert count_nodes(t.root) == 1
+    # remove_worker must also detach, not just discard worker ids
+    t2 = RadixTree()
+    t2.apply_event(stored(1, [1, 2, 3]))
+    t2.remove_worker(1)
+    assert count_nodes(t2.root) == 1
+
+
 def test_radix_remove_worker_and_clear():
     t = RadixTree()
     t.apply_event(stored(1, [1, 2, 3]))
