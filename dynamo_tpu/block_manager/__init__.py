@@ -1,0 +1,35 @@
+"""Multi-tier KV-cache block management (the KVBM equivalent).
+
+Role-equivalent of lib/llm/src/block_manager (13.5k LoC Rust + CUDA/NIXL):
+a tiered pool of KV blocks addressed by sequence hash —
+
+    G1  device HBM   — the engine's paged cache (jax arrays, lives in the
+                       ModelRunner; this package moves blocks in/out of it
+                       through the runner's jitted extract/inject ops)
+    G2  host RAM     — a preallocated numpy arena (the reference's pinned
+                       host pool; on TPU hosts plain numpy is DMA-able)
+    G3  local disk   — one file per block under a spill directory
+
+Blocks follow the reference's lifecycle (block_manager/block.rs state
+machine): RESET -> PARTIAL -> COMPLETE -> REGISTERED, with a sequence-hash
+registry deduplicating identical content across requests
+(block/registry.rs). Offload flows G1->G2 on sequence completion and
+G2->G3 under host pressure (offload.rs priority queues); onboarding walks
+the other way on prefix hits.
+
+TPU-specific design: no RDMA descriptors — G1 movement is jitted
+gather/scatter on the cache (model_runner.extract_blocks/inject_blocks),
+so the device side stays inside XLA and reshards automatically under TP.
+"""
+
+from dynamo_tpu.block_manager.block import Block, BlockState
+from dynamo_tpu.block_manager.layout import LayoutConfig, LayoutKind
+from dynamo_tpu.block_manager.manager import TieredBlockManager
+
+__all__ = [
+    "Block",
+    "BlockState",
+    "LayoutConfig",
+    "LayoutKind",
+    "TieredBlockManager",
+]

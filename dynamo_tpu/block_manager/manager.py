@@ -1,0 +1,247 @@
+"""Tiered block pool: host arena (G2) + disk spill (G3) + registry.
+
+Role-equivalent of the reference's pool/offload/registry trio
+(block_manager/pool.rs active+inactive pools with sequence-hash reuse,
+offload.rs G1->G2->G3 priority offload + onboarding, block/registry.rs
+dedupe). The device tier (G1) is the engine's paged cache; this manager
+receives blocks the engine extracts on sequence completion and serves them
+back on prefix hits.
+
+Interfaces use blocks-dense numpy arrays `[L, n, bs, Hkv, D]` — exactly what
+ModelRunner.extract_blocks yields and inject_blocks accepts, so engine
+integration is two calls. All bookkeeping is synchronous and cheap; the
+data copies are numpy slice assignments (host) and single-file IO (disk).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+from dynamo_tpu.block_manager.layout import LayoutConfig
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.block_manager")
+
+_NP_DTYPES = {
+    "bfloat16": np.uint16,  # stored bit-exact as u16 words
+    "float16": np.float16,
+    "float32": np.float32,
+}
+
+
+@dataclass
+class BlockHandle:
+    seq_hash: int
+    tier: int  # 2=host, 3=disk
+    index: int  # host arena slot (tier 2) or -1 (disk)
+
+
+@dataclass
+class BlockManagerStats:
+    host_blocks_used: int = 0
+    host_blocks_total: int = 0
+    disk_blocks_used: int = 0
+    offloaded_g2: int = 0
+    spilled_g3: int = 0
+    onboarded: int = 0
+    hits: int = 0
+    misses: int = 0
+
+
+class TieredBlockManager:
+    """Host+disk KV block cache keyed by sequence hash.
+
+    Eviction: host arena is LRU over unreferenced blocks; evicted blocks
+    spill to disk when a spill dir is configured (else dropped, like the
+    reference without a G3 target). Disk obeys a block-count cap with LRU
+    delete. `on_event(kind, seq_hashes, tier)` mirrors the reference's
+    KVBM events.rs publishes (feeds metrics / remote G4 tiers later).
+    """
+
+    def __init__(
+        self,
+        layout: LayoutConfig,
+        host_blocks: int,
+        disk_dir: Optional[str] = None,
+        disk_blocks: int = 0,
+        on_event: Optional[Callable[[str, list[int], int], None]] = None,
+    ) -> None:
+        self.layout = layout
+        self.host_blocks = host_blocks
+        self.disk_dir = disk_dir
+        self.disk_blocks = disk_blocks
+        self.on_event = on_event
+        wire = _NP_DTYPES[layout.dtype]
+        # blocks-first host arenas: [n, L, bs, H, D] so one block is one
+        # contiguous slice (cheap memcpy in, cheap file write out)
+        shape = (host_blocks, *layout.block_shape)
+        self._k_arena = np.zeros(shape, wire)
+        self._v_arena = np.zeros(shape, wire)
+        self._free_slots = list(range(host_blocks - 1, -1, -1))
+        # seq_hash -> handle; OrderedDict doubles as the LRU (move_to_end)
+        self._host: OrderedDict[int, BlockHandle] = OrderedDict()
+        self._disk: OrderedDict[int, str] = OrderedDict()
+        if disk_dir:
+            os.makedirs(disk_dir, exist_ok=True)
+        self.stats = BlockManagerStats(host_blocks_total=host_blocks)
+
+    # ------------------------------------------------------------ queries
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._host or seq_hash in self._disk
+
+    def lookup_prefix(self, seq_hashes: list[int]) -> int:
+        """Longest prefix (in blocks) of the hash chain present in any tier
+        (reference: pool.rs match_sequence_hashes)."""
+        n = 0
+        for h in seq_hashes:
+            if h in self._host or h in self._disk:
+                n += 1
+            else:
+                break
+        if n:
+            self.stats.hits += 1
+        else:
+            self.stats.misses += 1
+        return n
+
+    # ------------------------------------------------------------- stores
+
+    def store_blocks(
+        self,
+        seq_hashes: list[int],
+        k_blocks: np.ndarray,  # [L, n, bs, H, D] — runner.extract output
+        v_blocks: np.ndarray,
+    ) -> int:
+        """Offload dense blocks into the host tier; returns #newly stored.
+
+        Already-present hashes are skipped (registry dedupe). Under host
+        pressure, LRU blocks spill to disk first (offload.rs G2->G3).
+        """
+        # swapaxes is a view and the same-itemsize bf16->u16 view is legal
+        # on strided arrays; the only copies are the per-block arena writes
+        kb = np.swapaxes(k_blocks, 0, 1)
+        vb = np.swapaxes(v_blocks, 0, 1)
+        if kb.dtype.name == "bfloat16":
+            kb, vb = kb.view(np.uint16), vb.view(np.uint16)
+        stored = []
+        for i, h in enumerate(seq_hashes):
+            if h in self._host:
+                self._host.move_to_end(h)
+                continue
+            if h in self._disk:
+                continue
+            slot = self._alloc_host_slot()
+            if slot is None:
+                break
+            self._k_arena[slot] = kb[i]
+            self._v_arena[slot] = vb[i]
+            self._host[h] = BlockHandle(h, tier=2, index=slot)
+            stored.append(h)
+        if stored:
+            self.stats.offloaded_g2 += len(stored)
+            self.stats.host_blocks_used = len(self._host)
+            if self.on_event:
+                self.on_event("stored", stored, 2)
+        return len(stored)
+
+    def _alloc_host_slot(self) -> Optional[int]:
+        if self._free_slots:
+            return self._free_slots.pop()
+        # LRU-evict the oldest host block (spill to disk if configured)
+        if not self._host:
+            return None
+        old_hash, old = self._host.popitem(last=False)
+        if self.disk_dir:
+            self._spill_to_disk(old_hash, old.index)
+        elif self.on_event:
+            self.on_event("removed", [old_hash], 2)
+        return old.index
+
+    def _spill_to_disk(self, seq_hash: int, slot: int) -> None:
+        path = os.path.join(self.disk_dir, f"{seq_hash:#x}.kvb")
+        with open(path, "wb") as f:
+            f.write(self._k_arena[slot].tobytes())
+            f.write(self._v_arena[slot].tobytes())
+        self._disk[seq_hash] = path
+        self.stats.spilled_g3 += 1
+        self.stats.disk_blocks_used = len(self._disk)
+        if self.on_event:
+            self.on_event("stored", [seq_hash], 3)
+        while self.disk_blocks and len(self._disk) > self.disk_blocks:
+            h, p = self._disk.popitem(last=False)
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+            if self.on_event:
+                self.on_event("removed", [h], 3)
+        self.stats.disk_blocks_used = len(self._disk)
+
+    # -------------------------------------------------------------- loads
+
+    def load_blocks(
+        self, seq_hashes: list[int]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Fetch blocks for onboarding; returns [L, n, bs, H, D] pairs.
+
+        Disk blocks are promoted back into the host arena on read
+        (offload.rs onboarding path G3->G2->G1).
+        """
+        L = self.layout
+        wire = _NP_DTYPES[L.dtype]
+        n = len(seq_hashes)
+        k = np.zeros((n, *L.block_shape), wire)
+        v = np.zeros((n, *L.block_shape), wire)
+        for i, h in enumerate(seq_hashes):
+            hnd = self._host.get(h)
+            if hnd is not None:
+                self._host.move_to_end(h)
+                k[i] = self._k_arena[hnd.index]
+                v[i] = self._v_arena[hnd.index]
+                continue
+            path = self._disk.get(h)
+            if path is None:
+                raise KeyError(f"block {h:#x} not cached")
+            raw = np.fromfile(path, dtype=wire)
+            half = L.block_numel
+            k[i] = raw[:half].reshape(L.block_shape)
+            v[i] = raw[half:].reshape(L.block_shape)
+            self._promote(h, k[i], v[i], path)
+        self.stats.onboarded += n
+        return np.swapaxes(k, 0, 1), np.swapaxes(v, 0, 1)
+
+    def _promote(self, h: int, kb: np.ndarray, vb: np.ndarray, path: str) -> None:
+        slot = self._alloc_host_slot()
+        if slot is None:
+            return
+        self._k_arena[slot] = kb
+        self._v_arena[slot] = vb
+        self._host[h] = BlockHandle(h, tier=2, index=slot)
+        self._disk.pop(h, None)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        self.stats.host_blocks_used = len(self._host)
+        self.stats.disk_blocks_used = len(self._disk)
+
+    # ------------------------------------------------------------- admin
+
+    def clear(self) -> None:
+        for h, hnd in self._host.items():
+            self._free_slots.append(hnd.index)
+        self._host.clear()
+        for h, p in self._disk.items():
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        self._disk.clear()
+        self.stats.host_blocks_used = 0
+        self.stats.disk_blocks_used = 0

@@ -1,0 +1,4 @@
+"""Standalone service components (the reference's components/ directory):
+metrics aggregator, prefill/decode workers, standalone KV router. Each is a
+library class plus a `python -m` entry so deployments can run them as
+dedicated processes, mirroring components/{metrics,router,http} bins."""

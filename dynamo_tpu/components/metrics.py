@@ -1,0 +1,231 @@
+"""Standalone metrics aggregation component.
+
+Role-equivalent of components/metrics/src/{main,lib}.rs: every second,
+collect `ForwardPassMetrics` from all workers of a target endpoint (their
+`load_metrics` stats endpoints on the fabric), aggregate, export Prometheus
+gauges, and subscribe to `kv-hit-rate` events from the KV router
+(lib.rs:96-597). `MockWorkerMetrics` mirrors bin/mock_worker.rs: a fake
+worker publishing synthetic stats so dashboards and the planner can be
+exercised with zero engines.
+
+Run: python -m dynamo_tpu.components.metrics --namespace NS --component C \
+         --endpoint E --port 9091
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import math
+from typing import Optional
+
+import msgpack
+
+from prometheus_client import CollectorRegistry, Counter, Gauge
+
+from dynamo_tpu.kv_router import KV_HIT_RATE_SUBJECT
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.kv_router.publisher import KvMetricsAggregator, WorkerMetricsPublisher
+from dynamo_tpu.runtime.component import Component, Endpoint
+from dynamo_tpu.runtime.http_server import SystemStatusServer
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.runtime.protocols import EndpointId
+
+logger = get_logger("dynamo_tpu.components.metrics")
+
+PREFIX = "dyn_llm"
+
+
+class MetricsComponent:
+    """Scrape -> aggregate -> Prometheus, plus kv-hit-rate accounting."""
+
+    def __init__(
+        self,
+        component: Component,
+        endpoint: EndpointId,
+        poll_interval: float = 1.0,
+        port: int = 0,
+    ) -> None:
+        self.component = component
+        self.endpoint = endpoint
+        self.poll_interval = poll_interval
+        self.aggregator = KvMetricsAggregator(component, endpoint)
+        self.registry = CollectorRegistry()
+        self.server = SystemStatusServer(port=port, registry=self.registry)
+
+        def g(name: str, doc: str) -> Gauge:
+            return Gauge(f"{PREFIX}_{name}", doc, registry=self.registry)
+
+        self.g_active_slots = g("requests_active_slots", "Busy request slots")
+        self.g_total_slots = g("requests_total_slots", "Total request slots")
+        self.g_waiting = g("requests_waiting", "Queued requests")
+        self.g_kv_active = g("kv_blocks_active", "Active KV blocks")
+        self.g_kv_total = g("kv_blocks_total", "Total KV blocks")
+        self.g_cache_usage = g("kv_cache_usage_percent", "Mean cache usage")
+        self.g_hit_rate = g(
+            "kv_prefix_cache_hit_rate", "Mean engine prefix hit rate"
+        )
+        self.g_workers = g("worker_count", "Workers reporting stats")
+        self.c_hit_events = Counter(
+            f"{PREFIX}_kv_hit_rate_events_total",
+            "kv-hit-rate events seen",
+            registry=self.registry,
+        )
+        self.g_event_isl = g("kv_hit_isl_blocks", "Last event ISL blocks")
+        self.g_event_overlap = g(
+            "kv_hit_overlap_blocks", "Last event overlap blocks"
+        )
+        self.g_cumulative_hit_rate = g(
+            "kv_hit_rate_cumulative", "Cumulative router overlap / ISL"
+        )
+        self._isl_sum = 0
+        self._overlap_sum = 0
+        self._tasks: list[asyncio.Task] = []
+        self.last: Optional[ForwardPassMetrics] = None
+
+    async def start(self) -> int:
+        port = await self.server.start()
+        # subscribe before returning so no pre-start event is missed
+        sub = await self.component.namespace.subscribe_event(
+            KV_HIT_RATE_SUBJECT
+        )
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._poll_loop()))
+        self._tasks.append(loop.create_task(self._hit_rate_loop(sub)))
+        return port
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await t
+        await self.server.close()
+
+    # -------------------------------------------------------------- loops
+
+    async def _poll_loop(self) -> None:
+        while True:
+            try:
+                per_worker = await self.aggregator.collect()
+                agg = await self.aggregator.aggregate()
+                self.last = agg
+                self.g_workers.set(len(per_worker))
+                self.g_active_slots.set(agg.worker_stats.request_active_slots)
+                self.g_total_slots.set(agg.worker_stats.request_total_slots)
+                self.g_waiting.set(agg.worker_stats.num_requests_waiting)
+                self.g_kv_active.set(agg.kv_stats.kv_active_blocks)
+                self.g_kv_total.set(agg.kv_stats.kv_total_blocks)
+                self.g_cache_usage.set(agg.kv_stats.gpu_cache_usage_perc)
+                self.g_hit_rate.set(agg.kv_stats.gpu_prefix_cache_hit_rate)
+            except Exception:  # noqa: BLE001 — scrape failures are transient
+                logger.exception("metrics poll failed")
+            await asyncio.sleep(self.poll_interval)
+
+    async def _hit_rate_loop(self, sub) -> None:
+        async for _subject, payload in sub:
+            try:
+                data = msgpack.unpackb(payload, raw=False)
+                isl = int(data.get("isl_blocks", 0))
+                overlap = int(data.get("overlap_blocks", 0))
+            except (TypeError, AttributeError, ValueError):
+                continue
+            self.c_hit_events.inc()
+            self.g_event_isl.set(isl)
+            self.g_event_overlap.set(overlap)
+            self._isl_sum += isl
+            self._overlap_sum += overlap
+            if self._isl_sum:
+                self.g_cumulative_hit_rate.set(
+                    self._overlap_sum / self._isl_sum
+                )
+
+
+class MockWorkerMetrics:
+    """Synthetic stats publisher (components/metrics/src/bin/mock_worker.rs):
+    registers on the endpoint and publishes a slow sine-wave load so the
+    metrics plane and planner can run with no engine at all."""
+
+    def __init__(
+        self,
+        endpoint: Endpoint,
+        instance_id: int,
+        period_s: float = 30.0,
+        total_slots: int = 16,
+        total_blocks: int = 512,
+    ) -> None:
+        self.publisher = WorkerMetricsPublisher(
+            endpoint.component, endpoint.id, instance_id
+        )
+        self.period_s = period_s
+        self.total_slots = total_slots
+        self.total_blocks = total_blocks
+        self._t = 0.0
+
+    def snapshot(self) -> ForwardPassMetrics:
+        self._t += 1.0
+        phase = (self._t % self.period_s) / self.period_s * 2 * math.pi
+        load = (math.sin(phase) + 1) / 2  # 0..1
+        active_blocks = int(self.total_blocks * load)
+        return ForwardPassMetrics(
+            worker_stats=WorkerStats(
+                request_active_slots=int(self.total_slots * load),
+                request_total_slots=self.total_slots,
+                num_requests_waiting=int(4 * max(0.0, load - 0.75)),
+            ),
+            kv_stats=KvStats(
+                kv_active_blocks=active_blocks,
+                kv_total_blocks=self.total_blocks,
+                gpu_cache_usage_perc=load,
+                gpu_prefix_cache_hit_rate=0.5,
+            ),
+        )
+
+    async def start(self) -> None:
+        await self.publisher.start(self.snapshot)
+
+    async def stop(self) -> None:
+        await self.publisher.stop()
+
+
+async def _main() -> None:
+    import argparse
+
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--component", default="backend")
+    p.add_argument("--endpoint", default="generate")
+    p.add_argument("--port", type=int, default=9091)
+    p.add_argument("--poll-interval", type=float, default=1.0)
+    p.add_argument(
+        "--mock-worker",
+        action="store_true",
+        help="also run a synthetic stats publisher against the endpoint",
+    )
+    args = p.parse_args()
+
+    drt = await DistributedRuntime.from_settings()
+    comp = drt.namespace(args.namespace).component(args.component)
+    eid = EndpointId(args.namespace, args.component, args.endpoint)
+    metrics = MetricsComponent(
+        comp, eid, poll_interval=args.poll_interval, port=args.port
+    )
+    port = await metrics.start()
+    logger.info("metrics component scraping %s on :%d", eid, port)
+    mock = None
+    if args.mock_worker:
+        ep = comp.endpoint(args.endpoint)
+        mock = MockWorkerMetrics(ep, instance_id=0)
+        await mock.start()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        if mock:
+            await mock.stop()
+        await metrics.close()
+        await drt.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(_main())

@@ -101,12 +101,16 @@ class DisaggregatedRouter:
         )
 
     async def start_watching(self) -> None:
-        """Adopt published thresholds now and on every future change."""
+        """Adopt published thresholds now and on every future change.
+
+        The watch's own initial snapshot is the current value — using it
+        (rather than a separate get) closes the get/watch race where a put
+        landing in between would never be applied."""
         key = _config_key(self.namespace, self.component)
-        cur = await self._fabric.kv_get(key)
-        if cur:
-            self._apply(cur)
         watch = await self._fabric.watch_prefix(key)
+        for ev in watch.initial:
+            if ev.type == "put" and ev.value:
+                self._apply(ev.value)
 
         async def loop() -> None:
             async for ev in watch:

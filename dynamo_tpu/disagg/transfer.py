@@ -135,10 +135,10 @@ class RemotePrefillClient:
             block_size=self.block_size,
             extra=extra or {},
         )
-        await self.queue.enqueue(req)
         try:
+            await self.queue.enqueue(req)
             return await asyncio.wait_for(fut, timeout=self.timeout)
-        except asyncio.TimeoutError:
+        except BaseException:
             self._pending.pop(rid, None)
             raise
 
@@ -175,7 +175,18 @@ class PrefillWorkerService:
     async def _loop(self) -> None:
         while not self._stopped.is_set():
             await self._sem.acquire()
-            got = await self.queue.dequeue(timeout=0.2)
+            try:
+                got = await self.queue.dequeue(timeout=0.2)
+            except asyncio.CancelledError:
+                self._sem.release()
+                raise
+            except Exception as e:  # noqa: BLE001 — transient fabric error
+                # a dead service loop silently breaks the whole prefill
+                # fleet; log, back off, keep serving
+                logger.warning("prefill dequeue failed (%s); retrying", e)
+                self._sem.release()
+                await asyncio.sleep(0.5)
+                continue
             if got is None:
                 self._sem.release()
                 if self._stopped.is_set():

@@ -76,6 +76,9 @@ class _Sequence(SequenceState):
         self.request = request
         self.ctx = ctx
         self.pending_remote = False  # admitted, awaiting remote prefill KV
+        self.prefix_hashes: list[int] = []  # full-block hash chain
+        self.cached_prefix_blocks = 0  # leading blocks found in G2/G3
+        self.pending_chain: Optional[TokenBlockSequence] = None  # prebuilt
         self.out: asyncio.Queue = asyncio.Queue()
         self.eos: set[int] = set()
         if not request.stop.ignore_eos:
@@ -106,6 +109,7 @@ class JaxEngine:
         on_blocks_removed: Optional[Callable[[list[int]], None]] = None,
         disagg_router: Optional[Any] = None,
         remote_prefill_client: Optional[Any] = None,
+        block_manager: Optional[Any] = None,
     ) -> None:
         self.runner = runner
         self.config = config or JaxEngineConfig(
@@ -132,7 +136,15 @@ class JaxEngine:
         # shipped to the prefill fleet instead of running locally.
         self.disagg_router = disagg_router
         self.remote_prefill_client = remote_prefill_client
+        # Tiered KV offload (KVBM equivalent): finished sequences' blocks
+        # are copied to the host/disk tiers keyed by sequence hash and
+        # onboarded on later prefix hits.
+        self.block_manager = block_manager
         self._remote_tasks: set[asyncio.Task] = set()
+        # Landed remote prefills / failures, processed by the engine loop so
+        # _append_token (which can preempt and reallocate blocks) never runs
+        # concurrently with an in-flight decode step.
+        self._landed: list[tuple[_Sequence, Optional[int], Optional[FinishReason]]] = []
         # Serializes every runner call: the cache arrays are DONATED through
         # prefill/decode/inject, so a concurrent caller (remote-prefill
         # landing, prefill_only service task) would read a deleted array.
@@ -259,8 +271,61 @@ class JaxEngine:
             self._emit_removed(seq)
 
     def _finish(self, seq: _Sequence, reason: FinishReason) -> None:
+        self._maybe_offload(seq, reason)
         self._free_seq(seq)
         seq.out.put_nowait(LLMEngineOutput.final(reason))
+
+    def _maybe_offload(self, seq: _Sequence, reason: FinishReason) -> None:
+        """On normal completion, copy this sequence's full blocks to the
+        host tier before the device blocks are recycled (KVBM G1->G2,
+        reference offload.rs). Block ownership moves to the offload task so
+        the allocator can't hand the blocks out mid-copy."""
+        if (
+            self.block_manager is None
+            or self._closed
+            or seq.hash_seq is None
+            or not seq.block_ids
+            or reason in (FinishReason.ERROR, FinishReason.CANCELLED)
+        ):
+            return
+        full = seq.hash_seq.blocks
+        pairs = [
+            (b.block_hash, seq.block_ids[i])
+            for i, b in enumerate(full)
+            if i < len(seq.block_ids) and b.block_hash not in self.block_manager
+        ]
+        if not pairs:
+            return
+        owned, seq.block_ids = seq.block_ids, []
+        t = asyncio.get_running_loop().create_task(
+            self._offload_task(owned, pairs)
+        )
+        self._remote_tasks.add(t)
+        t.add_done_callback(self._remote_tasks.discard)
+
+    async def _offload_task(
+        self, owned_ids: list[int], pairs: list[tuple[int, int]]
+    ) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            ids = [bid for _, bid in pairs]
+            async with self._device_lock:
+                k, v = await loop.run_in_executor(
+                    None, self.runner.extract_blocks, ids
+                )
+            # host memcpys + possible disk spill: keep off the event loop
+            await loop.run_in_executor(
+                None,
+                self.block_manager.store_blocks,
+                [h for h, _ in pairs],
+                k,
+                v,
+            )
+        except Exception:  # noqa: BLE001 — offload is best-effort
+            logger.exception("block offload failed")
+        finally:
+            self.allocator.free(owned_ids)
+            self._wake.set()
 
     def _preempt_youngest(self, exclude: _Sequence) -> bool:
         for victim in reversed(self._admit_order):
@@ -295,6 +360,7 @@ class JaxEngine:
         loop = asyncio.get_running_loop()
         while not self._closed:
             self._reap_cancelled()
+            self._process_landed()
             admitted = await self._admit_phase(loop)
             active = [
                 s for s in self.slots if s is not None and not s.pending_remote
@@ -338,6 +404,17 @@ class JaxEngine:
                 break
             self.waiting.pop(0)
             admitted = True
+            hit_len = 0
+            if self.block_manager is not None:
+                seq.pending_chain = TokenBlockSequence(
+                    list(seq.token_ids), self.config.block_size
+                )
+                chain = seq.pending_chain.blocks
+                seq.prefix_hashes = [b.block_hash for b in chain]
+                seq.cached_prefix_blocks = self.block_manager.lookup_prefix(
+                    seq.prefix_hashes
+                )
+                hit_len = seq.cached_prefix_blocks * self.config.block_size
             use_remote = False
             if (
                 self.disagg_router is not None
@@ -347,7 +424,7 @@ class JaxEngine:
                 if refresh is not None:
                     await refresh()
                 use_remote = self.disagg_router.prefill_remote(
-                    len(seq.token_ids), 0
+                    len(seq.token_ids), hit_len
                 )
             if use_remote:
                 # ship the prefill out; the sequence holds its slot+blocks
@@ -373,12 +450,31 @@ class JaxEngine:
                     ),
                 )
             token = int(tok_arr)
-            seq.hash_seq = TokenBlockSequence(
+            # the admission pass may have prebuilt the identical chain for
+            # the prefix lookup — reuse instead of re-hashing the prompt
+            seq.hash_seq = seq.pending_chain or TokenBlockSequence(
                 replay, self.config.block_size
             )
             self._emit_stored(seq)
             self._append_token(seq, token)
         return admitted
+
+    def _process_landed(self) -> None:
+        """Complete landed remote prefills on the engine loop (serialized
+        with decode, so preemption in _append_token can't race a step)."""
+        landed, self._landed = self._landed, []
+        for seq, first_token, fail in landed:
+            if seq.slot is None:  # reaped while queued
+                continue
+            seq.pending_remote = False
+            if fail is not None or first_token is None:
+                self._finish(seq, fail or FinishReason.ERROR)
+                continue
+            seq.hash_seq = seq.pending_chain or TokenBlockSequence(
+                list(seq.token_ids), self.config.block_size
+            )
+            self._emit_stored(seq)
+            self._append_token(seq, first_token)
 
     async def _remote_prefill_task(self, seq: _Sequence) -> None:
         """Await a remote prefill, land its KV, and enter the decode batch.
@@ -389,12 +485,14 @@ class JaxEngine:
         Falls back to local prefill on any remote error.
         """
         loop = asyncio.get_running_loop()
+        cached = await self._onboard_prefix(seq, loop)
         try:
             resp = await self.remote_prefill_client.prefill(
                 seq.token_ids,
                 temperature=seq.temperature,
                 top_p=seq.top_p,
                 top_k=seq.top_k,
+                cached_blocks=cached,
             )
         except asyncio.CancelledError:
             if self._closed:
@@ -408,25 +506,63 @@ class JaxEngine:
         if seq.slot is None:  # cancelled/finished while in flight
             return
         try:
-            await self._land_prefill(seq, resp, loop)
+            first_token = await self._land_prefill(seq, resp, loop)
+            self._landed.append((seq, first_token, None))
         except Exception:  # noqa: BLE001 — never strand the consumer
             logger.exception("landing prefill for seq %d failed", seq.seq_id)
-            seq.pending_remote = False
-            self._finish(seq, FinishReason.ERROR)
-            self._wake.set()
+            self._landed.append((seq, None, FinishReason.ERROR))
+        self._wake.set()
 
-    async def _land_prefill(self, seq: _Sequence, resp, loop) -> None:
+    async def _onboard_prefix(self, seq: _Sequence, loop) -> int:
+        """Inject cached prefix blocks (G2/G3 tiers) into this sequence's
+        device blocks so the prefill worker needn't ship them back
+        (reference: KVBM onboarding, offload.rs)."""
+        cached = seq.cached_prefix_blocks
+        if self.block_manager is None or not cached:
+            return 0
         from dynamo_tpu.disagg.transfer import from_wire_array
 
-        if resp is not None and resp.error is None and resp.payload is not None:
-            k, v = resp.payload.to_arrays()
-            k = from_wire_array(k, resp.payload.dtype)
-            v = from_wire_array(v, resp.payload.dtype)
-            ids = seq.block_ids[resp.first_block : resp.first_block + k.shape[1]]
+        try:
+            kw, vw = await loop.run_in_executor(
+                None, self.block_manager.load_blocks, seq.prefix_hashes[:cached]
+            )
+            dtype = self.block_manager.layout.dtype
+            k = from_wire_array(kw, dtype)
+            v = from_wire_array(vw, dtype)
             async with self._device_lock:
                 await loop.run_in_executor(
-                    None, self.runner.inject_blocks, ids, k, v
+                    None,
+                    self.runner.inject_blocks,
+                    seq.block_ids[:cached],
+                    k,
+                    v,
                 )
+            return cached
+        except Exception:  # noqa: BLE001 — cache miss races are fine
+            logger.exception("prefix onboard failed; full remote prefill")
+            return 0
+
+    async def _land_prefill(self, seq: _Sequence, resp, loop) -> int:
+        """Device-side landing only: inject blocks / fallback prefill.
+        Returns the first sampled token; scheduler-visible completion
+        happens later in _process_landed on the engine loop."""
+        from dynamo_tpu.disagg.transfer import from_wire_array
+
+        if resp is not None and resp.error is None:
+            if resp.payload is not None:
+                # payload may be absent when every shippable block was a
+                # prefix hit already sitting in this worker's cache
+                k, v = resp.payload.to_arrays()
+                k = from_wire_array(k, resp.payload.dtype)
+                v = from_wire_array(v, resp.payload.dtype)
+                ids = seq.block_ids[
+                    resp.first_block : resp.first_block + k.shape[1]
+                ]
+                if ids:
+                    async with self._device_lock:
+                        await loop.run_in_executor(
+                            None, self.runner.inject_blocks, ids, k, v
+                        )
             first_token = resp.first_token
         else:
             # local fallback (also covers error responses)
@@ -444,15 +580,7 @@ class JaxEngine:
                     ),
                 )
             first_token = int(tok_arr)
-        if seq.slot is None:
-            return
-        seq.hash_seq = TokenBlockSequence(
-            list(seq.token_ids), self.config.block_size
-        )
-        self._emit_stored(seq)
-        seq.pending_remote = False
-        self._append_token(seq, first_token)
-        self._wake.set()
+        return first_token
 
     async def prefill_only(self, req: Any) -> Any:
         """Serve one RemotePrefillRequest (the prefill-worker role).
@@ -495,13 +623,15 @@ class JaxEngine:
                     ),
                 )
                 ship = block_ids[req.cached_blocks :]
-                k, v = await loop.run_in_executor(
-                    None, self.runner.extract_blocks, ship
+                if ship:
+                    k, v = await loop.run_in_executor(
+                        None, self.runner.extract_blocks, ship
+                    )
+            payload = None
+            if ship:
+                payload = KvBlockPayload.from_arrays(
+                    to_wire_array(k), to_wire_array(v), k.dtype.name
                 )
-            dtype = k.dtype.name
-            payload = KvBlockPayload.from_arrays(
-                to_wire_array(k), to_wire_array(v), dtype
-            )
             self.stats.generated_tokens += 1
             return RemotePrefillResponse(
                 request_id=req.request_id,

@@ -209,12 +209,16 @@ class ModelRunner:
         return tok
 
     def _pad_block_count(self, n: int) -> int:
-        """Smallest bucket block count >= n (bounds compiled program count)."""
+        """Smallest bucket block count >= n (bounds compiled program count).
+
+        Sequences longer than the largest bucket (possible with custom
+        prefill_buckets below max_model_len) pad to their exact length —
+        one extra compiled program beats broken offload/shipping."""
         for b in self.prefill_buckets:
             nb = b // self.block_size
             if nb >= n:
                 return nb
-        return (self.prefill_buckets[-1] // self.block_size)
+        return n
 
     def extract_blocks(
         self, block_ids: list[int]

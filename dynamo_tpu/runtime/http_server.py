@@ -1,0 +1,114 @@
+"""Per-process system status server: /health, /live, /metrics.
+
+Role-equivalent of lib/runtime/src/http_server.rs (:90-91 health + metrics
+routes), off by default exactly like the reference
+(DYN_RUNTIME_HTTP_ENABLED, config.rs:87). Every worker/frontend process can
+expose liveness for supervisors and process-level Prometheus metrics
+(uptime, registered health checks' status) independent of the LLM frontend.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Awaitable, Callable, Optional
+
+from aiohttp import web
+from prometheus_client import (
+    CollectorRegistry,
+    Gauge,
+    generate_latest,
+)
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.runtime.http_server")
+
+HealthCheck = Callable[[], Awaitable[bool]]
+
+
+class SystemStatusServer:
+    """Health/liveness + Prometheus endpoint for one process."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        host: str = "0.0.0.0",
+        registry: Optional[CollectorRegistry] = None,
+    ) -> None:
+        self.host = host
+        self.port = port
+        self.registry = registry or CollectorRegistry()
+        self._start_time = time.monotonic()
+        self._checks: dict[str, HealthCheck] = {}
+        self._uptime = Gauge(
+            "dyn_runtime_uptime_seconds",
+            "Process uptime",
+            registry=self.registry,
+        )
+        self._health_gauge = Gauge(
+            "dyn_runtime_health",
+            "1 if all health checks pass",
+            registry=self.registry,
+        )
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/health", self._health),
+                web.get("/live", self._live),
+                web.get("/metrics", self._metrics),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
+
+    def add_health_check(self, name: str, check: HealthCheck) -> None:
+        self._checks[name] = check
+
+    async def start(self) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
+        actual = self._site._server.sockets[0].getsockname()[1]
+        self.port = actual
+        logger.info("system status server on :%d", actual)
+        return actual
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # ------------------------------------------------------------ handlers
+
+    async def _run_checks(self) -> dict[str, bool]:
+        out = {}
+        for name, check in self._checks.items():
+            try:
+                out[name] = bool(await check())
+            except Exception:  # noqa: BLE001 — a failing check is "false"
+                out[name] = False
+        return out
+
+    async def _health(self, request: web.Request) -> web.Response:
+        checks = await self._run_checks()
+        healthy = all(checks.values())
+        self._health_gauge.set(1.0 if healthy else 0.0)
+        return web.json_response(
+            {
+                "status": "healthy" if healthy else "unhealthy",
+                "uptime_s": round(time.monotonic() - self._start_time, 3),
+                "checks": checks,
+            },
+            status=200 if healthy else 503,
+        )
+
+    async def _live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        self._uptime.set(time.monotonic() - self._start_time)
+        return web.Response(
+            body=generate_latest(self.registry),
+            content_type="text/plain",
+        )

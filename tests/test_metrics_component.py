@@ -1,0 +1,86 @@
+"""Metrics plane tests: system status server, aggregator component, mock
+worker, kv-hit-rate accounting (components/metrics + http_server.rs
+equivalents)."""
+
+import asyncio
+
+import aiohttp
+import msgpack
+
+from dynamo_tpu.components.metrics import MetricsComponent, MockWorkerMetrics
+from dynamo_tpu.kv_router import KV_HIT_RATE_SUBJECT
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.http_server import SystemStatusServer
+from dynamo_tpu.runtime.protocols import EndpointId
+
+
+async def test_system_status_server():
+    srv = SystemStatusServer(port=0)
+    healthy = True
+
+    async def check() -> bool:
+        return healthy
+
+    srv.add_health_check("engine", check)
+    port = await srv.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/live") as r:
+                assert r.status == 200
+            async with s.get(f"{base}/health") as r:
+                assert r.status == 200
+                body = await r.json()
+                assert body["checks"] == {"engine": True}
+            healthy = False
+            async with s.get(f"{base}/health") as r:
+                assert r.status == 503
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+                assert "dyn_runtime_uptime_seconds" in text
+    finally:
+        await srv.close()
+
+
+async def test_metrics_component_scrapes_mock_worker():
+    drt = await DistributedRuntime.from_settings()
+    try:
+        ns = drt.namespace("metrics-test")
+        comp = ns.component("backend")
+        ep = comp.endpoint("generate")
+        eid = EndpointId("metrics-test", "backend", "generate")
+
+        mock = MockWorkerMetrics(ep, instance_id=7, total_blocks=512)
+        await mock.start()
+
+        metrics = MetricsComponent(comp, eid, poll_interval=0.05, port=0)
+        port = await metrics.start()
+
+        # publish a couple of router hit-rate events
+        for overlap in (2, 4):
+            await ns.publish_event(
+                KV_HIT_RATE_SUBJECT,
+                {"worker_id": 7, "isl_blocks": 8, "overlap_blocks": overlap},
+            )
+
+        for _ in range(100):
+            if metrics.last is not None and metrics.last.kv_stats.kv_total_blocks:
+                break
+            await asyncio.sleep(0.05)
+        assert metrics.last is not None
+        assert metrics.last.kv_stats.kv_total_blocks == 512
+        assert metrics.last.worker_stats.request_total_slots == 16
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/metrics") as r:
+                text = await r.text()
+        assert "dyn_llm_kv_blocks_total 512.0" in text
+        assert "dyn_llm_worker_count 1.0" in text
+        assert "dyn_llm_kv_hit_rate_events_total 2.0" in text
+        # cumulative hit rate = (2+4)/(8+8)
+        assert "dyn_llm_kv_hit_rate_cumulative 0.375" in text
+
+        await metrics.close()
+        await mock.stop()
+    finally:
+        await drt.close()
