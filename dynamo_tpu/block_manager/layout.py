@@ -4,8 +4,9 @@ Role-equivalent of lib/llm/src/block_manager/layout.rs (FullyContiguous /
 LayerSeparate, LayoutConfig{num_blocks,num_layers,page_size,inner_dim,
 dtype}): describes how a tier arranges block data in memory and converts
 between the two arrangements. The engine's device cache is FULLY_CONTIGUOUS
-`[L, nb, bs, H, D]`; LAYER_SEPARATE (`L x [nb, bs, H, D]`) matches engines
-that stream per-layer (and halves peak staging memory when spilling).
+head-major `[L, H, nb, bs, D]` (each (head, page) a contiguous pallas
+tile); LAYER_SEPARATE (`L x [H, nb, bs, D]`) matches engines that stream
+per-layer (and halves peak staging memory when spilling).
 """
 
 from __future__ import annotations
@@ -35,8 +36,8 @@ class LayoutConfig:
         """Shape of ONE block's K (or V) across all layers."""
         return (
             self.num_layers,
-            self.page_size,
             self.num_kv_heads,
+            self.page_size,
             self.head_dim,
         )
 
@@ -58,29 +59,29 @@ class LayoutConfig:
         if self.kind is LayoutKind.FULLY_CONTIGUOUS:
             return (
                 self.num_layers,
+                self.num_kv_heads,
                 num_blocks,
                 self.page_size,
-                self.num_kv_heads,
                 self.head_dim,
             )
         return (
             num_blocks,
             self.num_layers,
-            self.page_size,
             self.num_kv_heads,
+            self.page_size,
             self.head_dim,
         )
 
 
 def to_blocks_first(arr: np.ndarray, kind: LayoutKind) -> np.ndarray:
-    """View/transpose an arena slice as [n, L, bs, H, D] (blocks leading)."""
+    """View/transpose an arena slice as [n, L, H, bs, D] (blocks leading)."""
     if kind is LayoutKind.FULLY_CONTIGUOUS:
-        return np.swapaxes(arr, 0, 1)
+        return np.moveaxis(arr, 2, 0)
     return arr
 
 
 def to_layers_first(arr: np.ndarray, kind: LayoutKind) -> np.ndarray:
     """View/transpose blocks-first data into the arena's own arrangement."""
     if kind is LayoutKind.FULLY_CONTIGUOUS:
-        return np.swapaxes(arr, 0, 1)
+        return np.moveaxis(arr, 0, 2)
     return arr

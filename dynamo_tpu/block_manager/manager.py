@@ -16,6 +16,7 @@ data copies are numpy slice assignments (host) and single-file IO (disk).
 from __future__ import annotations
 
 import os
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -77,7 +78,7 @@ class TieredBlockManager:
         self.disk_blocks = disk_blocks
         self.on_event = on_event
         wire = _NP_DTYPES[layout.dtype]
-        # blocks-first host arenas: [n, L, bs, H, D] so one block is one
+        # blocks-first host arenas: [n, L, H, bs, D] so one block is one
         # contiguous slice (cheap memcpy in, cheap file write out)
         shape = (host_blocks, *layout.block_shape)
         self._k_arena = np.zeros(shape, wire)
@@ -89,33 +90,39 @@ class TieredBlockManager:
         if disk_dir:
             os.makedirs(disk_dir, exist_ok=True)
         self.stats = BlockManagerStats(host_blocks_total=host_blocks)
+        # engine calls arrive from run_in_executor threads; all tier state
+        # (arenas, LRU dicts, free list) is guarded by one coarse lock —
+        # the hot paths are short and the big copies stay outside jit
+        self._lock = threading.RLock()
 
     # ------------------------------------------------------------ queries
 
     def __contains__(self, seq_hash: int) -> bool:
-        return seq_hash in self._host or seq_hash in self._disk
+        with self._lock:
+            return seq_hash in self._host or seq_hash in self._disk
 
     def lookup_prefix(self, seq_hashes: list[int]) -> int:
         """Longest prefix (in blocks) of the hash chain present in any tier
         (reference: pool.rs match_sequence_hashes)."""
-        n = 0
-        for h in seq_hashes:
-            if h in self._host or h in self._disk:
-                n += 1
+        with self._lock:
+            n = 0
+            for h in seq_hashes:
+                if h in self._host or h in self._disk:
+                    n += 1
+                else:
+                    break
+            if n:
+                self.stats.hits += 1
             else:
-                break
-        if n:
-            self.stats.hits += 1
-        else:
-            self.stats.misses += 1
-        return n
+                self.stats.misses += 1
+            return n
 
     # ------------------------------------------------------------- stores
 
     def store_blocks(
         self,
         seq_hashes: list[int],
-        k_blocks: np.ndarray,  # [L, n, bs, H, D] — runner.extract output
+        k_blocks: np.ndarray,  # [L, H, n, bs, D] — runner.extract output
         v_blocks: np.ndarray,
     ) -> int:
         """Offload dense blocks into the host tier; returns #newly stored.
@@ -123,31 +130,32 @@ class TieredBlockManager:
         Already-present hashes are skipped (registry dedupe). Under host
         pressure, LRU blocks spill to disk first (offload.rs G2->G3).
         """
-        # swapaxes is a view and the same-itemsize bf16->u16 view is legal
+        # moveaxis is a view and the same-itemsize bf16->u16 view is legal
         # on strided arrays; the only copies are the per-block arena writes
-        kb = np.swapaxes(k_blocks, 0, 1)
-        vb = np.swapaxes(v_blocks, 0, 1)
+        kb = np.moveaxis(k_blocks, 2, 0)
+        vb = np.moveaxis(v_blocks, 2, 0)
         if kb.dtype.name == "bfloat16":
             kb, vb = kb.view(np.uint16), vb.view(np.uint16)
         stored = []
-        for i, h in enumerate(seq_hashes):
-            if h in self._host:
-                self._host.move_to_end(h)
-                continue
-            if h in self._disk:
-                continue
-            slot = self._alloc_host_slot()
-            if slot is None:
-                break
-            self._k_arena[slot] = kb[i]
-            self._v_arena[slot] = vb[i]
-            self._host[h] = BlockHandle(h, tier=2, index=slot)
-            stored.append(h)
-        if stored:
-            self.stats.offloaded_g2 += len(stored)
-            self.stats.host_blocks_used = len(self._host)
-            if self.on_event:
-                self.on_event("stored", stored, 2)
+        with self._lock:
+            for i, h in enumerate(seq_hashes):
+                if h in self._host:
+                    self._host.move_to_end(h)
+                    continue
+                if h in self._disk:
+                    continue
+                slot = self._alloc_host_slot()
+                if slot is None:
+                    break
+                self._k_arena[slot] = kb[i]
+                self._v_arena[slot] = vb[i]
+                self._host[h] = BlockHandle(h, tier=2, index=slot)
+                stored.append(h)
+            if stored:
+                self.stats.offloaded_g2 += len(stored)
+                self.stats.host_blocks_used = len(self._host)
+        if stored and self.on_event:
+            self.on_event("stored", stored, 2)
         return len(stored)
 
     def _alloc_host_slot(self) -> Optional[int]:
@@ -188,7 +196,7 @@ class TieredBlockManager:
     def load_blocks(
         self, seq_hashes: list[int]
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Fetch blocks for onboarding; returns [L, n, bs, H, D] pairs.
+        """Fetch blocks for onboarding; returns [L, H, n, bs, D] pairs.
 
         Disk blocks are promoted back into the host arena on read
         (offload.rs onboarding path G3->G2->G1).
@@ -198,23 +206,24 @@ class TieredBlockManager:
         n = len(seq_hashes)
         k = np.zeros((n, *L.block_shape), wire)
         v = np.zeros((n, *L.block_shape), wire)
-        for i, h in enumerate(seq_hashes):
-            hnd = self._host.get(h)
-            if hnd is not None:
-                self._host.move_to_end(h)
-                k[i] = self._k_arena[hnd.index]
-                v[i] = self._v_arena[hnd.index]
-                continue
-            path = self._disk.get(h)
-            if path is None:
-                raise KeyError(f"block {h:#x} not cached")
-            raw = np.fromfile(path, dtype=wire)
-            half = L.block_numel
-            k[i] = raw[:half].reshape(L.block_shape)
-            v[i] = raw[half:].reshape(L.block_shape)
-            self._promote(h, k[i], v[i], path)
-        self.stats.onboarded += n
-        return np.swapaxes(k, 0, 1), np.swapaxes(v, 0, 1)
+        with self._lock:
+            for i, h in enumerate(seq_hashes):
+                hnd = self._host.get(h)
+                if hnd is not None:
+                    self._host.move_to_end(h)
+                    k[i] = self._k_arena[hnd.index]
+                    v[i] = self._v_arena[hnd.index]
+                    continue
+                path = self._disk.get(h)
+                if path is None:
+                    raise KeyError(f"block {h:#x} not cached")
+                raw = np.fromfile(path, dtype=wire)
+                half = L.block_numel
+                k[i] = raw[:half].reshape(L.block_shape)
+                v[i] = raw[half:].reshape(L.block_shape)
+                self._promote(h, k[i], v[i], path)
+            self.stats.onboarded += n
+        return np.moveaxis(k, 0, 2), np.moveaxis(v, 0, 2)
 
     def _promote(self, h: int, kb: np.ndarray, vb: np.ndarray, path: str) -> None:
         slot = self._alloc_host_slot()
@@ -234,6 +243,10 @@ class TieredBlockManager:
     # ------------------------------------------------------------- admin
 
     def clear(self) -> None:
+        with self._lock:
+            self._clear_locked()
+
+    def _clear_locked(self) -> None:
         for h, hnd in self._host.items():
             self._free_slots.append(hnd.index)
         self._host.clear()

@@ -107,7 +107,7 @@ class MetricsComponent:
         while True:
             try:
                 per_worker = await self.aggregator.collect()
-                agg = await self.aggregator.aggregate()
+                agg = await self.aggregator.aggregate(per_worker)
                 self.last = agg
                 self.g_workers.set(len(per_worker))
                 self.g_active_slots.set(agg.worker_stats.request_active_slots)

@@ -28,7 +28,7 @@ _WIRE_DTYPES = {
 
 @dataclass
 class KvBlockPayload:
-    """Dense KV blocks for one sequence: k/v of shape [L, n, bs, Hkv, D]."""
+    """Dense KV blocks for one sequence: k/v of shape [L, Hkv, n, bs, D]."""
 
     shape: tuple[int, ...]
     dtype: str  # logical dtype name ("bfloat16", ...)

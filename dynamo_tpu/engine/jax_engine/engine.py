@@ -556,7 +556,7 @@ class JaxEngine:
                 k = from_wire_array(k, resp.payload.dtype)
                 v = from_wire_array(v, resp.payload.dtype)
                 ids = seq.block_ids[
-                    resp.first_block : resp.first_block + k.shape[1]
+                    resp.first_block : resp.first_block + k.shape[2]
                 ]
                 if ids:
                     async with self._device_lock:

@@ -61,7 +61,23 @@ class ModelRunner:
         kv_dtype: jnp.dtype = jnp.bfloat16,
         mesh: Optional[jax.sharding.Mesh] = None,
         kv_sharding: Optional[jax.sharding.NamedSharding] = None,
+        attn_impl: str = "auto",
     ) -> None:
+        # "auto": flash pallas kernels on a single TPU chip, XLA reference
+        # otherwise (under a mesh the XLA path stays GSPMD-partitionable;
+        # the pallas path there needs an explicit shard_map wrapper). The
+        # choice is pinned into THIS runner's config so concurrent runners
+        # with different setups don't stomp each other.
+        import dataclasses
+
+        if attn_impl == "auto":
+            attn_impl = (
+                "pallas"
+                if jax.default_backend() == "tpu" and mesh is None
+                else "xla"
+            )
+        self.attn_impl = attn_impl
+        config = dataclasses.replace(config, attn_impl=attn_impl)
         self.config = config
         self.params = params
         self.num_blocks = num_blocks
@@ -75,11 +91,13 @@ class ModelRunner:
         self.prefill_buckets = sorted(
             prefill_buckets or default_prefill_buckets(block_size, max_model_len)
         )
+        # head-major layout: each (head, page) is a contiguous [bs, D] tile
+        # (what the pallas kernel streams; TP shards the leading head axis)
         cache_shape = (
             config.num_layers,
+            config.num_kv_heads,
             num_blocks,
             block_size,
-            config.num_kv_heads,
             config.head_dim,
         )
         if kv_sharding is not None:
@@ -123,11 +141,13 @@ class ModelRunner:
         # Disagg KV movement (NIXL/block_copy.cu replacement): gather whole
         # blocks out of the paged cache / scatter received blocks in. Block
         # counts are padded to bucket sizes so each compiles once per bucket.
-        self._extract_jit = jax.jit(lambda k, v, ids: (k[:, ids], v[:, ids]))
+        self._extract_jit = jax.jit(
+            lambda k, v, ids: (k[:, :, ids], v[:, :, ids])
+        )
         self._inject_jit = jax.jit(
             lambda k, v, ids, kb, vb: (
-                k.at[:, ids].set(kb.astype(k.dtype)),
-                v.at[:, ids].set(vb.astype(v.dtype)),
+                k.at[:, :, ids].set(kb.astype(k.dtype)),
+                v.at[:, :, ids].set(vb.astype(v.dtype)),
             ),
             donate_argnums=(0, 1),
             **(
@@ -223,15 +243,15 @@ class ModelRunner:
     def extract_blocks(
         self, block_ids: list[int]
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Gather dense KV blocks [L, n, bs, Hkv, D] for disagg shipping."""
+        """Gather dense KV blocks [L, Hkv, n, bs, D] for disagg shipping."""
         n = len(block_ids)
         padded = self._pad_block_count(n)
         ids = np.zeros(padded, np.int32)
         ids[:n] = block_ids
         k, v = self._extract_jit(self.k_cache, self.v_cache, jnp.asarray(ids))
         return (
-            np.asarray(jax.device_get(k))[:, :n],
-            np.asarray(jax.device_get(v))[:, :n],
+            np.asarray(jax.device_get(k))[:, :, :n],
+            np.asarray(jax.device_get(v))[:, :, :n],
         )
 
     def inject_blocks(
@@ -248,10 +268,10 @@ class ModelRunner:
         ids = np.zeros(padded, np.int32)
         ids[:n] = block_ids
         if padded != n:
-            pad_shape = (k_blocks.shape[0], padded - n) + k_blocks.shape[2:]
+            pad_shape = k_blocks.shape[:2] + (padded - n,) + k_blocks.shape[3:]
             zpad = np.zeros(pad_shape, k_blocks.dtype)
-            k_blocks = np.concatenate([k_blocks, zpad], axis=1)
-            v_blocks = np.concatenate([v_blocks, zpad], axis=1)
+            k_blocks = np.concatenate([k_blocks, zpad], axis=2)
+            v_blocks = np.concatenate([v_blocks, zpad], axis=2)
         self.k_cache, self.v_cache = self._inject_jit(
             self.k_cache,
             self.v_cache,

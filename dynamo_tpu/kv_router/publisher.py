@@ -185,9 +185,14 @@ class KvMetricsAggregator:
                 logger.exception("bad stats entry at %s", key)
         return out
 
-    async def aggregate(self) -> ForwardPassMetrics:
-        """Sum across workers (gauges averaged)."""
-        per_worker = await self.collect()
+    async def aggregate(
+        self, per_worker: Optional[dict[int, ForwardPassMetrics]] = None
+    ) -> ForwardPassMetrics:
+        """Sum across workers (gauges averaged). Pass an already-collected
+        snapshot to avoid a second fabric scrape (and to keep derived
+        gauges consistent with it)."""
+        if per_worker is None:
+            per_worker = await self.collect()
         agg = ForwardPassMetrics()
         # the dataclass defaults are "one healthy idle worker" sentinels;
         # an aggregate must start from true zero or it over-counts by one

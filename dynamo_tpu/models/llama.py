@@ -46,6 +46,11 @@ class LlamaConfig:
     max_position_embeddings: int = 8192
     tie_word_embeddings: bool = False
     rope_scaling: Optional[dict] = None
+    # attention kernel choice for THIS model instance (None -> process
+    # default): lets two runners in one process use different impls
+    # without stomping the ops-level global (e.g. a TP-meshed engine on
+    # the XLA path next to a single-chip engine on the pallas path)
+    attn_impl: Optional[str] = None
 
     @classmethod
     def from_hf_dict(cls, d: dict[str, Any]) -> "LlamaConfig":
@@ -180,7 +185,7 @@ def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_c
     q = apply_rope(q, positions, inv_freqs)
     k = apply_rope(k, positions, inv_freqs)
     k_cache_l, v_cache_l = write_prefill_kv(k_cache_l, v_cache_l, k, v, block_table)
-    attn = causal_prefill_attention(q, k, v, valid_len)
+    attn = causal_prefill_attention(q, k, v, valid_len, impl=cfg.attn_impl)
     out = linear(attn.reshape(P, cfg.q_dim), layer["wo"])
     return x + out, k_cache_l, v_cache_l
 
@@ -196,7 +201,8 @@ def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, bloc
     k = apply_rope(k, positions, inv_freqs)
     k_cache_l, v_cache_l = write_decode_kv(k_cache_l, v_cache_l, k, v, slot_indices)
     attn = paged_decode_attention(
-        q, k_cache_l, v_cache_l, block_tables, positions + 1
+        q, k_cache_l, v_cache_l, block_tables, positions + 1,
+        impl=cfg.attn_impl,
     )
     out = linear(attn.reshape(B, cfg.q_dim), layer["wo"])
     return x + out, k_cache_l, v_cache_l
@@ -222,7 +228,7 @@ def prefill(
     cfg: LlamaConfig,
     tokens: jax.Array,  # [P] int32, padded to a multiple of block_size
     valid_len: jax.Array,  # scalar int32
-    k_cache: jax.Array,  # [L, num_blocks, block_size, Hkv, D]
+    k_cache: jax.Array,  # [L, Hkv, num_blocks, block_size, D]
     v_cache: jax.Array,
     block_table: jax.Array,  # [P // block_size] int32
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -247,7 +253,7 @@ def decode(
     cfg: LlamaConfig,
     tokens: jax.Array,  # [B] int32
     positions: jax.Array,  # [B] int32 (0-indexed position of this token)
-    k_cache: jax.Array,  # [L, num_blocks, block_size, Hkv, D]
+    k_cache: jax.Array,  # [L, Hkv, num_blocks, block_size, D]
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, max_blocks] int32
     slot_indices: jax.Array,  # [B] int32 flat cache slots for the new token

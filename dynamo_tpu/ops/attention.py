@@ -2,17 +2,22 @@
 
 TPU-native replacement for the engine-internal paged attention the reference
 delegates to vLLM/SGLang (and for the KV layout kernel block_copy.cu): the
-cache is a block-paged tensor per layer `[num_blocks, block_size, kv_heads,
-head_dim]`, addressed by per-sequence block tables. This module is the XLA
-reference implementation: correct everywhere, but the decode path
-materializes the gathered [B, max_blocks*block_size, Hkv, D] window each
-step — a planned pallas paged-attention kernel replaces it on TPU.
+cache is a head-major block-paged tensor per layer `[kv_heads, num_blocks,
+block_size, head_dim]`, addressed by per-sequence block tables. Two
+implementations share this public API:
+
+  * "xla" (below) — gather-based reference: correct everywhere, fully
+    GSPMD-partitionable, but the decode path materializes the gathered
+    [Hkv, B, max_blocks*block_size, D] window every step;
+  * "pallas"/"pallas_interpret" — flash kernels (ops/pallas_attention.py)
+    that stream only the live pages (decode) / blockwise tiles (prefill).
 
 All functions are jit-safe: static shapes, masks instead of dynamic slicing.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -20,14 +25,55 @@ import jax.numpy as jnp
 
 NEG_INF = -1e30
 
+# Attention implementation selector. "xla" = gather reference (runs
+# anywhere, GSPMD-partitionable); "pallas" = TPU flash kernels
+# (ops/pallas_attention.py); "pallas_interpret" = same kernels in
+# interpreter mode (CPU tests). The engine picks per its config
+# (ModelRunner: pallas on TPU when the kernel's layout constraints hold);
+# DYN_ATTN_IMPL overrides everything.
+_ATTN_IMPL = "xla"
+
+
+def set_attention_impl(impl: str) -> None:
+    global _ATTN_IMPL
+    assert impl in ("xla", "pallas", "pallas_interpret"), impl
+    _ATTN_IMPL = impl
+
+
+def get_attention_impl(override: Optional[str] = None) -> str:
+    """Env var wins, then an explicit per-model override, then the global."""
+    return os.environ.get("DYN_ATTN_IMPL") or override or _ATTN_IMPL
+
+
+def _prefill_block(P: int) -> Optional[int]:
+    """Largest flash block size evenly dividing the padded prompt length."""
+    for d in (256, 128, 64, 32, 16, 8):
+        if P % d == 0:
+            return d
+    return None
+
 
 def causal_prefill_attention(
     q: jax.Array,  # [P, Hq, D]
     k: jax.Array,  # [P, Hkv, D]
     v: jax.Array,  # [P, Hkv, D]
     valid_len: jax.Array,  # scalar int32: true sequence length (<= P)
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Single-sequence causal self-attention over a padded prompt window."""
+    impl = get_attention_impl(impl)
+    if impl != "xla":
+        bq = _prefill_block(q.shape[0])
+        if bq is not None:
+            from dynamo_tpu.ops.pallas_attention import (
+                flash_prefill_attention_pallas,
+            )
+
+            return flash_prefill_attention_pallas(
+                q, k, v, valid_len,
+                block_q=bq, block_k=bq,
+                interpret=impl == "pallas_interpret",
+            )
     P, Hq, D = q.shape
     Hkv = k.shape[1]
     G = Hq // Hkv
@@ -48,63 +94,78 @@ def causal_prefill_attention(
 
 def paged_decode_attention(
     q: jax.Array,  # [B, Hq, D] — one new token per sequence
-    k_cache: jax.Array,  # [num_blocks, block_size, Hkv, D] (this layer)
-    v_cache: jax.Array,  # [num_blocks, block_size, Hkv, D]
+    k_cache: jax.Array,  # [Hkv, num_blocks, block_size, D] (this layer)
+    v_cache: jax.Array,  # [Hkv, num_blocks, block_size, D]
     block_tables: jax.Array,  # [B, max_blocks] int32 block ids
     context_lens: jax.Array,  # [B] int32 — INCLUDING the token just written
+    impl: Optional[str] = None,
 ) -> jax.Array:
-    """Decode-step attention: gather each sequence's blocks and attend."""
+    """Decode-step attention: gather each sequence's blocks and attend.
+
+    The cache is head-major [Hkv, blocks, bs, D]: each (head, page) is a
+    contiguous [bs, D] tile — the layout the pallas kernel streams directly,
+    and the layout whose leading axis TP shards cleanly.
+    """
+    impl = get_attention_impl(impl)
+    if impl != "xla":
+        from dynamo_tpu.ops.pallas_attention import paged_decode_attention_pallas
+
+        return paged_decode_attention_pallas(
+            q, k_cache, v_cache, block_tables, context_lens,
+            interpret=impl == "pallas_interpret",
+        )
     B, Hq, D = q.shape
-    _, block_size, Hkv, _ = k_cache.shape
+    Hkv, _, block_size, _ = k_cache.shape
     G = Hq // Hkv
     max_blocks = block_tables.shape[1]
     S = max_blocks * block_size
     scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
-    # [B, max_blocks, block_size, Hkv, D] -> [B, S, Hkv, D]
-    k = k_cache[block_tables].reshape(B, S, Hkv, D)
-    v = v_cache[block_tables].reshape(B, S, Hkv, D)
+    # [Hkv, B, max_blocks, block_size, D] -> [Hkv, B, S, D]
+    k = k_cache[:, block_tables].reshape(Hkv, B, S, D)
+    v = v_cache[:, block_tables].reshape(Hkv, B, S, D)
     qr = q.reshape(B, Hkv, G, D)
     scores = jnp.einsum(
-        "bhgd,bshd->bhgs", qr.astype(jnp.float32), k.astype(jnp.float32)
+        "bhgd,hbsd->bhgs", qr.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale
     mask = (jnp.arange(S)[None, :] < context_lens[:, None])[:, None, None, :]
     scores = jnp.where(mask, scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhgs,bshd->bhgd", weights, v.astype(jnp.float32))
+    out = jnp.einsum("bhgs,hbsd->bhgd", weights, v.astype(jnp.float32))
     return out.reshape(B, Hq, D).astype(q.dtype)
 
 
 def write_prefill_kv(
-    k_cache: jax.Array,  # [num_blocks, block_size, Hkv, D]
+    k_cache: jax.Array,  # [Hkv, num_blocks, block_size, D]
     v_cache: jax.Array,
     k_new: jax.Array,  # [P, Hkv, D] (P = padded prompt, multiple of block)
     v_new: jax.Array,
     block_table: jax.Array,  # [P // block_size] int32
 ) -> tuple[jax.Array, jax.Array]:
     """Scatter a prompt's computed K/V into its allocated blocks."""
-    _, block_size, Hkv, D = k_cache.shape
+    Hkv, _, block_size, D = k_cache.shape
     nb = k_new.shape[0] // block_size
-    k_blocks = k_new.reshape(nb, block_size, Hkv, D)
-    v_blocks = v_new.reshape(nb, block_size, Hkv, D)
-    k_cache = k_cache.at[block_table].set(k_blocks)
-    v_cache = v_cache.at[block_table].set(v_blocks)
+    # [P, Hkv, D] -> [Hkv, nb, block_size, D]
+    k_blocks = k_new.reshape(nb, block_size, Hkv, D).transpose(2, 0, 1, 3)
+    v_blocks = v_new.reshape(nb, block_size, Hkv, D).transpose(2, 0, 1, 3)
+    k_cache = k_cache.at[:, block_table].set(k_blocks)
+    v_cache = v_cache.at[:, block_table].set(v_blocks)
     return k_cache, v_cache
 
 
 def write_decode_kv(
-    k_cache: jax.Array,  # [num_blocks, block_size, Hkv, D]
+    k_cache: jax.Array,  # [Hkv, num_blocks, block_size, D]
     v_cache: jax.Array,
     k_new: jax.Array,  # [B, Hkv, D]
     v_new: jax.Array,
     slot_indices: jax.Array,  # [B] int32 flat slot = block_id*block_size + offset
 ) -> tuple[jax.Array, jax.Array]:
     """Scatter one new K/V token per sequence into its current block slot."""
-    num_blocks, block_size, Hkv, D = k_cache.shape
-    k_flat = k_cache.reshape(num_blocks * block_size, Hkv, D)
-    v_flat = v_cache.reshape(num_blocks * block_size, Hkv, D)
-    k_flat = k_flat.at[slot_indices].set(k_new)
-    v_flat = v_flat.at[slot_indices].set(v_new)
+    Hkv, num_blocks, block_size, D = k_cache.shape
+    k_flat = k_cache.reshape(Hkv, num_blocks * block_size, D)
+    v_flat = v_cache.reshape(Hkv, num_blocks * block_size, D)
+    k_flat = k_flat.at[:, slot_indices].set(k_new.transpose(1, 0, 2))
+    v_flat = v_flat.at[:, slot_indices].set(v_new.transpose(1, 0, 2))
     return (
-        k_flat.reshape(num_blocks, block_size, Hkv, D),
-        v_flat.reshape(num_blocks, block_size, Hkv, D),
+        k_flat.reshape(Hkv, num_blocks, block_size, D),
+        v_flat.reshape(Hkv, num_blocks, block_size, D),
     )

@@ -1,0 +1,332 @@
+"""Pallas TPU kernels for paged attention.
+
+The hot op of the serving engine: decode-step attention over the paged KV
+cache. The XLA reference path (ops/attention.py) gathers every sequence's
+blocks into a dense [B, S, Hkv, D] window each step — O(B*S) HBM traffic
+even for short sequences, plus a materialized gather. This kernel instead
+streams exactly the blocks named by each sequence's block table:
+
+  grid = (B, Hkv); the cache stays in HBM (memory_space=ANY). Each grid
+  step runs a dynamic-length fori_loop over chunks of W pages, manually
+  DMA-gathering the pages named by the scalar-prefetched block table into
+  double-buffered VMEM scratch (chunk c+1's copies are in flight while
+  chunk c computes), folding each [W*bs, D] chunk into an online-softmax
+  (flash) accumulator. The loop bound is ceil(ctx_len / W*bs), so a short
+  sequence costs neither FLOPs nor HBM bandwidth for its unused pages —
+  the cache layout is head-major [Hkv, pages, bs, D] precisely so each
+  (head, page) is one contiguous DMA-able tile.
+
+GQA: q for one kv head is the [G, D] group slice; scores are a [G, W*bs]
+matmul per chunk.
+
+Replaces what the reference leaves to vLLM's CUDA paged_attention kernels
+(vLLM is engine-delegated at lib/llm/src/engines.rs; see also the CUDA
+block-copy kernel lib/llm/src/kernels/block_copy.cu for the layout-aware
+precedent). Runs in interpret mode on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, max_blocks] int32 (SMEM)
+    context_lens_ref,  # [B] int32 (SMEM)
+    # inputs
+    q_ref,  # [1, 1, G, D] VMEM — this (seq, kv head)'s query group
+    k_hbm,  # [Hkv, num_blocks, block_size, D] — full cache, stays in HBM
+    v_hbm,
+    # blocked output
+    o_ref,  # [1, 1, G, D]
+    # scratch
+    k_buf,  # [2, W*block_size, D] VMEM — double-buffered gathered pages
+    v_buf,
+    sems,  # DMA semaphores [2 slots, 2 (k/v), W pages]
+    m_ref,  # [G, 128] f32 — running max (replicated over lanes)
+    l_ref,  # [G, 128] f32 — running sum
+    acc_ref,  # [G, D] f32 — running weighted values
+    *,
+    block_size: int,
+    pages_per_chunk: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    ctx_len = context_lens_ref[b]
+    W = pages_per_chunk
+    chunk_tokens = W * block_size
+    n_chunks = lax.div(ctx_len + chunk_tokens - 1, chunk_tokens)
+    last_page = jnp.maximum((ctx_len - 1) // block_size, 0)
+
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def dma(c, slot, i, buf, hbm, kv):
+        # page i of chunk c; pages past the end clamp to the last valid page
+        # (fetched redundantly, masked in compute)
+        page = block_tables_ref[b, jnp.minimum(c * W + i, last_page)]
+        return pltpu.make_async_copy(
+            hbm.at[h, page],
+            buf.at[slot, pl.ds(i * block_size, block_size), :],
+            sems.at[slot, kv, i],
+        )
+
+    def issue(c, slot):
+        for i in range(W):  # static unroll: W outstanding copies each way
+            dma(c, slot, i, k_buf, k_hbm, 0).start()
+            dma(c, slot, i, v_buf, v_hbm, 1).start()
+
+    @pl.when(n_chunks > 0)
+    def _go():
+        issue(0, 0)
+
+        def loop_body(c, _):
+            slot = c % 2
+
+            @pl.when(c + 1 < n_chunks)
+            def _prefetch():
+                issue(c + 1, (c + 1) % 2)
+
+            for i in range(W):
+                dma(c, slot, i, k_buf, k_hbm, 0).wait()
+                dma(c, slot, i, v_buf, v_hbm, 1).wait()
+
+            q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
+            k = k_buf[slot].astype(jnp.float32)  # [W*bs, D]
+            v = v_buf[slot].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [G, W*bs]
+            pos = c * chunk_tokens + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, dimension=1
+            )
+            s = jnp.where(pos < ctx_len, s, NEG_INF)
+
+            m_prev = m_ref[:, :1]  # [G, 1]
+            l_prev = l_ref[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+            return 0
+
+        lax.fori_loop(0, n_chunks, loop_body, 0)
+
+    l = l_ref[:, :1]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
+def paged_decode_attention_pallas(
+    q: jax.Array,  # [B, Hq, D]
+    k_cache: jax.Array,  # [Hkv, num_blocks, block_size, D] (head-major)
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32
+    context_lens: jax.Array,  # [B] int32, INCLUDING the token just written
+    *,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash paged decode attention; numerics match the XLA reference."""
+    B, Hq, D = q.shape
+    Hkv, num_blocks, block_size, _ = k_cache.shape
+    G = Hq // Hkv
+    max_blocks = block_tables.shape[1]
+    W = max(1, min(pages_per_chunk, max_blocks))
+    scale = 1.0 / float(D) ** 0.5
+
+    # index maps receive (b, h, *prefetch_refs); units are block-sized
+    def q_index(b, h, bt, cl):
+        return (b, h, 0, 0)
+
+    def o_index(b, h, bt, cl):
+        return (b, h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D), q_index),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # K cache stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),  # V cache stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D), o_index),
+        scratch_shapes=[
+            pltpu.VMEM((2, W * block_size, D), k_cache.dtype),
+            pltpu.VMEM((2, W * block_size, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, W)),
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(
+            _decode_kernel,
+            block_size=block_size,
+            pages_per_chunk=W,
+            scale=scale,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )
+    q_grouped = q.reshape(B, Hkv, G, D)
+    out = kernel(
+        block_tables.astype(jnp.int32),
+        context_lens.astype(jnp.int32),
+        q_grouped,
+        k_cache,
+        v_cache,
+    )
+    return out.reshape(B, Hq, D)
+
+
+# --------------------------------------------------------- flash prefill
+
+
+def flash_prefill_attention_pallas(
+    q: jax.Array,  # [P, Hq, D]
+    k: jax.Array,  # [P, Hkv, D]
+    v: jax.Array,
+    valid_len: jax.Array,  # scalar int32
+    *,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Blockwise causal flash attention for the prefill pass (GQA-aware).
+
+    Requires P % block_q == 0 (callers pad prompts to the KV page size and
+    choose block sizes accordingly). KV heads are the outer grid dim; q is
+    group-expanded so each kv head attends its [G * P, D] query slab.
+    """
+    P, Hq, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv
+    block_q = min(block_q, P)
+    block_k = min(block_k, P)
+    assert P % block_q == 0 and P % block_k == 0
+    scale = 1.0 / float(D) ** 0.5
+
+    # [P, Hkv, G, D] -> [Hkv, P, G, D] -> per-head queries stay position-major
+    qh = q.reshape(P, Hkv, G, D).transpose(1, 0, 2, 3)  # [Hkv, P, G, D]
+    kh = k.transpose(1, 0, 2)  # [Hkv, P, D]
+    vh = v.transpose(1, 0, 2)
+
+    def q_index(h, iq, jk, vl):
+        return (h, iq, 0, 0)
+
+    def kv_index(h, iq, jk, vl):
+        # Clamp skipped k blocks (acausal or fully padded) to the last
+        # useful one so their DMAs are elided (repeated index rule).
+        causal_last = (iq * block_q + block_q - 1) // block_k
+        valid_last = jnp.maximum((vl[0] - 1) // block_k, 0)
+        jj = jnp.minimum(jk, jnp.minimum(causal_last, valid_last))
+        return (h, jj, 0)
+
+    def o_index(h, iq, jk, vl):
+        return (h, iq, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(Hkv, P // block_q, P // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, G, D), q_index),
+            pl.BlockSpec((1, block_k, D), kv_index),
+            pl.BlockSpec((1, block_k, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, G, D), o_index),
+        scratch_shapes=[
+            pltpu.VMEM((block_q * G, 128), jnp.float32),
+            pltpu.VMEM((block_q * G, 128), jnp.float32),
+            pltpu.VMEM((block_q * G, D), jnp.float32),
+        ],
+    )
+
+    def kernel_body(vl_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+        # flatten the group dim into rows: [1, bq, G, D] -> [bq*G, D]; causal
+        # positions are per q row (each group row shares its token position)
+        iq = pl.program_id(1)
+        jk = pl.program_id(2)
+        valid_len = vl_ref[0]
+
+        @pl.when(jk == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        @pl.when(
+            (jk * block_k <= iq * block_q + block_q - 1)
+            & (jk * block_k < valid_len)
+        )
+        def _attend():
+            qb = q_ref[0].astype(jnp.float32).reshape(block_q * G, D)
+            kb = k_ref[0].astype(jnp.float32)  # [bk, D]
+            vb = v_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [bq*G, bk]
+            row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // G
+            qpos = iq * block_q + row
+            kpos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = (kpos <= qpos) & (kpos < valid_len)
+            s = jnp.where(mask, s, NEG_INF)
+            m_prev = m_ref[:, :1]
+            l_prev = l_ref[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_ref[...] = jnp.broadcast_to(
+                l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+            )
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+                p, vb, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+
+        @pl.when(jk == pl.num_programs(2) - 1)
+        def _finish():
+            l = l_ref[:, :1]
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0] = (
+                (acc_ref[...] / safe_l).reshape(block_q, G, D).astype(o_ref.dtype)
+            )
+
+    kernel = pl.pallas_call(
+        kernel_body,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((Hkv, P, G, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )
+    out = kernel(
+        jnp.asarray(valid_len, jnp.int32).reshape(1), qh, kh, vh
+    )  # [Hkv, P, G, D]
+    return out.transpose(1, 0, 2, 3).reshape(P, Hq, D)

@@ -13,7 +13,8 @@ Layout:
   wd        [F, E]        -> row parallel
   lm_head   [E, V]        -> vocab-sharded; logits all-gathered (few MB)
   embed, norms            -> replicated
-  kv cache  [L, N, Bs, Hkv, D] -> heads on tp
+  kv cache  [L, Hkv, N, Bs, D] -> heads on tp (head-major: each
+                             (head, page) a contiguous [Bs, D] pallas tile)
 """
 
 from __future__ import annotations
@@ -71,5 +72,5 @@ def shard_llama(
         )
     if "lm_head" in params:
         out["lm_head"] = _shard_linear(mesh, params["lm_head"], None, "tp")
-    kv_sharding = _ns(mesh, None, None, None, "tp", None)
+    kv_sharding = _ns(mesh, None, "tp", None, None, None)
     return out, kv_sharding

@@ -38,7 +38,7 @@ def rand_blocks(n, seed=0):
     import ml_dtypes
 
     rng = np.random.default_rng(seed)
-    shape = (LAYOUT.num_layers, n, BS, LAYOUT.num_kv_heads, LAYOUT.head_dim)
+    shape = (LAYOUT.num_layers, LAYOUT.num_kv_heads, n, BS, LAYOUT.head_dim)
     k = rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
     v = rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
     return k, v
@@ -48,15 +48,15 @@ def rand_blocks(n, seed=0):
 
 
 def test_layout_shapes_and_bytes():
-    assert LAYOUT.block_shape == (2, BS, 2, 16)
+    assert LAYOUT.block_shape == (2, 2, BS, 16)
     assert LAYOUT.block_numel == 2 * BS * 2 * 16
     assert LAYOUT.block_nbytes == 2 * LAYOUT.block_numel * 2
-    assert LAYOUT.arena_shape(10) == (2, 10, BS, 2, 16)
+    assert LAYOUT.arena_shape(10) == (2, 2, 10, BS, 16)
     ls = LayoutConfig(
         num_layers=2, page_size=BS, num_kv_heads=2, head_dim=16,
         kind=LayoutKind.LAYER_SEPARATE,
     )
-    assert ls.arena_shape(10) == (10, 2, BS, 2, 16)
+    assert ls.arena_shape(10) == (10, 2, 2, BS, 16)
 
 
 def test_block_state_machine():
@@ -88,7 +88,7 @@ def test_host_tier_roundtrip_and_dedupe():
     assert m.lookup_prefix([11, 22, 33, 44]) == 3
     assert m.lookup_prefix([99]) == 0
     # dedupe: re-storing is a no-op
-    assert m.store_blocks([11, 22], k[:, :2], v[:, :2]) == 0
+    assert m.store_blocks([11, 22], k[:, :, :2], v[:, :, :2]) == 0
     k2, v2 = m.load_blocks([11, 22, 33])
     np.testing.assert_array_equal(k2, k.view(np.uint16))
     np.testing.assert_array_equal(v2, v.view(np.uint16))
@@ -108,7 +108,7 @@ def test_lru_spill_to_disk_and_promote(tmp_path):
     assert m.lookup_prefix(hashes) == 4  # all still reachable
     # loading a disk block promotes it back to host (evicting LRU again)
     k1, v1 = m.load_blocks([1])
-    np.testing.assert_array_equal(k1[:, 0], k.view(np.uint16)[:, 0])
+    np.testing.assert_array_equal(k1[:, :, 0], k.view(np.uint16)[:, :, 0])
     assert 1 in m._host
     assert m.stats.onboarded == 1
 

@@ -167,7 +167,7 @@ async def test_extract_inject_transfers_kv_exactly():
     k, v = resp.payload.to_arrays()
     k = from_wire_array(k, resp.payload.dtype)
     v = from_wire_array(v, resp.payload.dtype)
-    assert k.shape[1] == (len(prompt) + BLOCK - 1) // BLOCK
+    assert k.shape[2] == (len(prompt) + BLOCK - 1) // BLOCK
 
     # hand-land into B: allocate blocks, inject, then generate with the
     # prompt KV present by faking the remote path through a client stub

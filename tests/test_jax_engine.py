@@ -70,7 +70,7 @@ async def test_greedy_generation_matches_reference_loop():
     cfg = engine.runner.config
     params = engine.runner.params
     bsz = 4
-    kc = jnp.zeros((cfg.num_layers, 16, bsz, cfg.num_kv_heads, cfg.head_dim), jnp.bfloat16)
+    kc = jnp.zeros((cfg.num_layers, cfg.num_kv_heads, 16, bsz, cfg.head_dim), jnp.bfloat16)
     vc = jnp.zeros_like(kc)
     table = jnp.array([1, 2], jnp.int32)
     padded = jnp.asarray(np.pad(np.array(prompt, np.int32), (0, 8 - len(prompt))))

@@ -19,7 +19,7 @@ def tiny_setup():
 
 
 def _empty_cache(cfg, num_blocks=32, block_size=4):
-    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size, cfg.head_dim)
     return jnp.zeros(shape, jnp.bfloat16), jnp.zeros(shape, jnp.bfloat16)
 
 

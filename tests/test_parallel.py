@@ -20,7 +20,7 @@ def test_tp_sharded_prefill_matches_single_device():
 
     toks = jax.random.randint(jax.random.PRNGKey(1), (8,), 0, 64)
     table = jnp.array([1, 2], jnp.int32)
-    shape = (cfg.num_layers, 8, 4, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, cfg.num_kv_heads, 8, 4, cfg.head_dim)
     kc = jnp.zeros(shape, jnp.bfloat16)
     vc = jnp.zeros_like(kc)
     logits_ref, kc_ref, _ = L.prefill(
