@@ -29,6 +29,7 @@ async def build_jax_engine(
     kv_block_size: int = 16,
     context_length: Optional[int] = None,
     tensor_parallel_size: int = 1,
+    context_parallel_size: int = 1,
     max_batch: int = 8,
     num_blocks: Optional[int] = None,
     quantize: Optional[bool] = None,
@@ -52,11 +53,13 @@ async def build_jax_engine(
             block_size=kv_block_size, quantized=quantize,
             tp=tensor_parallel_size,
         )
-    if tensor_parallel_size > 1:
+    if tensor_parallel_size > 1 or context_parallel_size > 1:
         from dynamo_tpu.parallel.mesh import build_mesh
         from dynamo_tpu.parallel.sharding import shard_llama
 
-        mesh = build_mesh(tp=tensor_parallel_size)
+        mesh = build_mesh(
+            tp=tensor_parallel_size, sp=context_parallel_size
+        )
         params, kv_sharding = shard_llama(mesh, config, params)
     runner = ModelRunner(
         config,

@@ -62,6 +62,7 @@ class ModelRunner:
         mesh: Optional[jax.sharding.Mesh] = None,
         kv_sharding: Optional[jax.sharding.NamedSharding] = None,
         attn_impl: str = "auto",
+        cp_min_tokens: int = 512,
     ) -> None:
         # "auto": flash pallas kernels on a single TPU chip, XLA reference
         # otherwise (under a mesh the XLA path stays GSPMD-partitionable;
@@ -86,6 +87,7 @@ class ModelRunner:
         self.max_model_len = max_model_len
         self.max_blocks_per_seq = (max_model_len + block_size - 1) // block_size
         self.mesh = mesh
+        self.cp_min_tokens = cp_min_tokens
         self._base_key = jax.random.PRNGKey(rng_seed)
         self._step_counter = 0
         self.prefill_buckets = sorted(
@@ -133,6 +135,26 @@ class ModelRunner:
             donate_argnums=(1, 2),  # k_cache, v_cache
             **jit_kwargs,
         )
+        # context-parallel (ring attention) prefill when the mesh has an sp
+        # axis: the prompt is sequence-sharded, KV chunks rotate over ICI,
+        # then the produced K/V paginate into this cache (long-context
+        # first-class — the reference routes long prefills away instead)
+        self._use_cp_prefill = (
+            mesh is not None
+            and "sp" in mesh.axis_names
+            and mesh.shape["sp"] > 1
+        )
+        if self._use_cp_prefill:
+            head_axis = (
+                "tp" if mesh.shape.get("tp", 1) > 1 else None
+            )
+            self._prefill_cp_jit = jax.jit(
+                functools.partial(
+                    self._prefill_cp_impl, self.config, mesh, head_axis
+                ),
+                donate_argnums=(1, 2),
+                **jit_kwargs,
+            )
         self._decode_fn = jax.jit(
             functools.partial(self._decode_impl, self.config),
             donate_argnums=(1, 2),  # k_cache, v_cache
@@ -170,6 +192,22 @@ class ModelRunner:
     ):
         logits, k_cache, v_cache = llama.prefill(
             params, cfg, tokens, valid_len, k_cache, v_cache, block_table
+        )
+        tok = sample_tokens(
+            logits[None, :], key, temp[None], top_p[None], top_k[None]
+        )[0]
+        return tok, k_cache, v_cache
+
+    @staticmethod
+    def _prefill_cp_impl(
+        cfg, mesh, head_axis, params, k_cache, v_cache, tokens, valid_len,
+        block_table, key, temp, top_p, top_k,
+    ):
+        # per-layer pagination inside the model loop: peak transient is one
+        # layer's [P, Hkv, D], never the full [L, P, Hkv, D] stack
+        logits, k_cache, v_cache = llama.prefill_context_parallel(
+            params, cfg, mesh, tokens, valid_len, head_axis=head_axis,
+            k_cache=k_cache, v_cache=v_cache, block_table=block_table,
         )
         tok = sample_tokens(
             logits[None, :], key, temp[None], top_p[None], top_k[None]
@@ -219,8 +257,19 @@ class ModelRunner:
         table = np.zeros(nb, np.int32)
         used = (T + self.block_size - 1) // self.block_size
         table[:used] = block_ids[:used]
-        # padding region scatters into the null block 0 — harmless
-        tok, self.k_cache, self.v_cache = self._prefill_jit(
+        # padding region scatters into the null block 0 — harmless.
+        # Ring attention only pays off past a length threshold: short
+        # prompts skip the sp ppermute rounds and run the serial path.
+        prefill_fn = (
+            self._prefill_cp_jit
+            if (
+                self._use_cp_prefill
+                and bucket >= self.cp_min_tokens
+                and bucket % self.mesh.shape["sp"] == 0
+            )
+            else self._prefill_jit
+        )
+        tok, self.k_cache, self.v_cache = prefill_fn(
             self.params, self.k_cache, self.v_cache,
             jnp.asarray(tokens), jnp.int32(T), jnp.asarray(table),
             self._next_key(),

@@ -176,14 +176,22 @@ def param_count(config: LlamaConfig) -> int:
 # ----------------------------------------------------------------- forward
 
 
-def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_cache_l, block_table):
-    P = x.shape[0]
+def _qkv(x, layer, cfg, inv_freqs, positions):
+    """Shared projection head: norm -> q/k/v -> RoPE. One definition so the
+    serial, context-parallel, and decode paths cannot drift."""
+    T = x.shape[0]
     h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = linear(h, layer["wq"]).reshape(P, cfg.num_heads, cfg.head_dim)
-    k = linear(h, layer["wk"]).reshape(P, cfg.num_kv_heads, cfg.head_dim)
-    v = linear(h, layer["wv"]).reshape(P, cfg.num_kv_heads, cfg.head_dim)
+    q = linear(h, layer["wq"]).reshape(T, cfg.num_heads, cfg.head_dim)
+    k = linear(h, layer["wk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    v = linear(h, layer["wv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, inv_freqs)
     k = apply_rope(k, positions, inv_freqs)
+    return q, k, v
+
+
+def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_cache_l, block_table):
+    P = x.shape[0]
+    q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
     k_cache_l, v_cache_l = write_prefill_kv(k_cache_l, v_cache_l, k, v, block_table)
     attn = causal_prefill_attention(q, k, v, valid_len, impl=cfg.attn_impl)
     out = linear(attn.reshape(P, cfg.q_dim), layer["wo"])
@@ -192,13 +200,7 @@ def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_c
 
 def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, block_tables, slot_indices):
     B = x.shape[0]
-    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = linear(h, layer["wq"]).reshape(B, cfg.num_heads, cfg.head_dim)
-    k = linear(h, layer["wk"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
-    v = linear(h, layer["wv"]).reshape(B, cfg.num_kv_heads, cfg.head_dim)
-    # positions [B] broadcasts over the head axis inside apply_rope
-    q = apply_rope(q, positions, inv_freqs)
-    k = apply_rope(k, positions, inv_freqs)
+    q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
     k_cache_l, v_cache_l = write_decode_kv(k_cache_l, v_cache_l, k, v, slot_indices)
     attn = paged_decode_attention(
         q, k_cache_l, v_cache_l, block_tables, positions + 1,
@@ -246,6 +248,58 @@ def prefill(
         x = _mlp(x, layer, cfg)
     logits = _logits(x[valid_len - 1][None, :], params, cfg)[0]
     return logits, k_cache, v_cache
+
+
+def prefill_context_parallel(
+    params: dict,
+    cfg: LlamaConfig,
+    mesh,  # jax.sharding.Mesh with an "sp" axis (optionally "tp")
+    tokens: jax.Array,  # [P] int32, P divisible by sp size (pad with 0s)
+    valid_len: jax.Array,  # scalar int32
+    *,
+    head_axis=None,  # "tp" when kv heads are TP-sharded
+    k_cache=None,  # [L, Hkv, nb, bs, D] — paginate per layer when given
+    v_cache=None,
+    block_table=None,  # [P // bs] int32
+):
+    """Long-context prefill with the sequence sharded over the `sp` mesh
+    axis (ring attention, parallel/ring_attention.py). The reference has no
+    sequence parallelism (SURVEY.md §2.7) — long prefills there are just
+    routed to dedicated engines; here one prefill worker spans a slice.
+
+    With a cache: each layer's K/V scatters into the (donated) paged cache
+    inside the layer loop — peak extra memory is ONE layer's [P, Hkv, D],
+    not all L of them (the long-context regime is exactly where an
+    [L, P, Hkv, D] stack would blow HBM). Returns (logits [V], k_cache,
+    v_cache). Without a cache: returns (logits, k_new [L, P, Hkv, D],
+    v_new) for shipping to a decode worker (disagg).
+    """
+    from dynamo_tpu.parallel.ring_attention import ring_prefill_attention
+
+    paginate = k_cache is not None
+    P_len = tokens.shape[0]
+    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    positions = jnp.arange(P_len, dtype=jnp.int32)
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    k_all, v_all = [], []
+    for i, layer in enumerate(params["layers"]):
+        q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
+        attn = ring_prefill_attention(
+            mesh, q, k, v, valid_len, head_axis=head_axis
+        )
+        x = x + linear(attn.reshape(P_len, cfg.q_dim), layer["wo"])
+        x = _mlp(x, layer, cfg)
+        if paginate:
+            kc, vc = write_prefill_kv(k_cache[i], v_cache[i], k, v, block_table)
+            k_cache = k_cache.at[i].set(kc)
+            v_cache = v_cache.at[i].set(vc)
+        else:
+            k_all.append(k)
+            v_all.append(v)
+    logits = _logits(x[valid_len - 1][None, :], params, cfg)[0]
+    if paginate:
+        return logits, k_cache, v_cache
+    return logits, jnp.stack(k_all), jnp.stack(v_all)
 
 
 def decode(

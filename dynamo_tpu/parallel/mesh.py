@@ -3,6 +3,7 @@
 Axes (any may be 1 and is then collapsed away by GSPMD):
   dp — data parallel (batch lanes / replicas inside one engine)
   pp — pipeline stages (layer partition, over ICI or DCN)
+  sp — sequence/context parallel (ring attention over long prefills)
   tp — tensor parallel (heads / ffn, always innermost => fastest ICI rings)
 """
 
@@ -14,26 +15,27 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "pp", "tp")
+AXES = ("dp", "pp", "sp", "tp")
 
 
 def build_mesh(
     tp: int = 1,
     dp: int = 1,
     pp: int = 1,
+    sp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
-    need = tp * dp * pp
+    need = tp * dp * pp * sp
     if need > len(devs):
         raise ValueError(
-            f"mesh dp={dp} pp={pp} tp={tp} needs {need} devices, "
+            f"mesh dp={dp} pp={pp} sp={sp} tp={tp} needs {need} devices, "
             f"have {len(devs)}"
         )
-    grid = np.array(devs[:need]).reshape(dp, pp, tp)
+    grid = np.array(devs[:need]).reshape(dp, pp, sp, tp)
     return Mesh(grid, AXES)
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     dev = device or jax.devices()[0]
-    return Mesh(np.array([dev]).reshape(1, 1, 1), AXES)
+    return Mesh(np.array([dev]).reshape(1, 1, 1, 1), AXES)
