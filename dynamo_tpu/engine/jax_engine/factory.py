@@ -6,6 +6,7 @@ subprocess)."""
 
 from __future__ import annotations
 
+import dataclasses
 import os
 from typing import Optional
 
@@ -30,6 +31,7 @@ async def build_jax_engine(
     context_length: Optional[int] = None,
     tensor_parallel_size: int = 1,
     context_parallel_size: int = 1,
+    expert_parallel_size: int = 1,
     max_batch: int = 8,
     num_blocks: Optional[int] = None,
     quantize: Optional[bool] = None,
@@ -53,12 +55,18 @@ async def build_jax_engine(
             block_size=kv_block_size, quantized=quantize,
             tp=tensor_parallel_size,
         )
-    if tensor_parallel_size > 1 or context_parallel_size > 1:
+    if (
+        tensor_parallel_size > 1
+        or context_parallel_size > 1
+        or expert_parallel_size > 1
+    ):
         from dynamo_tpu.parallel.mesh import build_mesh
         from dynamo_tpu.parallel.sharding import shard_llama
 
         mesh = build_mesh(
-            tp=tensor_parallel_size, sp=context_parallel_size
+            tp=tensor_parallel_size,
+            sp=context_parallel_size,
+            ep=expert_parallel_size,
         )
         params, kv_sharding = shard_llama(mesh, config, params)
     runner = ModelRunner(
@@ -124,7 +132,17 @@ def default_num_blocks(
     want = max_batch * per_seq + 64
     from dynamo_tpu.models.llama import param_count
 
-    weight_bytes = param_count(config) * (1 if quantized else 2) // tp
+    # int8 quantization applies to dense projections only; MoE expert
+    # stacks stay bf16 (see init_params / load_hf_safetensors), so count
+    # them at 2 bytes regardless. Experts also divide over ep, not tp,
+    # but tp is the conservative divisor available here.
+    dense_params = param_count(
+        dataclasses.replace(config, num_experts=0)
+    )
+    expert_params = param_count(config) - dense_params
+    weight_bytes = (
+        dense_params * (1 if quantized else 2) + expert_params * 2
+    ) // tp
     block_bytes = (
         2  # k + v
         * config.num_layers

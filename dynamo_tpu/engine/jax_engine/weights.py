@@ -69,19 +69,39 @@ def load_hf_safetensors(
     layers = []
     for i in range(config.num_layers):
         p = f"model.layers.{i}."
-        layers.append(
-            {
-                "attn_norm": get(p + "input_layernorm.weight"),
-                "wq": lin(p + "self_attn.q_proj.weight"),
-                "wk": lin(p + "self_attn.k_proj.weight"),
-                "wv": lin(p + "self_attn.v_proj.weight"),
-                "wo": lin(p + "self_attn.o_proj.weight"),
-                "mlp_norm": get(p + "post_attention_layernorm.weight"),
-                "wg": lin(p + "mlp.gate_proj.weight"),
-                "wu": lin(p + "mlp.up_proj.weight"),
-                "wd": lin(p + "mlp.down_proj.weight"),
-            }
-        )
+        layer = {
+            "attn_norm": get(p + "input_layernorm.weight"),
+            "wq": lin(p + "self_attn.q_proj.weight"),
+            "wk": lin(p + "self_attn.k_proj.weight"),
+            "wv": lin(p + "self_attn.v_proj.weight"),
+            "wo": lin(p + "self_attn.o_proj.weight"),
+            "mlp_norm": get(p + "post_attention_layernorm.weight"),
+        }
+        if config.num_experts:
+            # Mixtral block_sparse_moe: gate = router; per-expert
+            # w1 = gate proj, w3 = up proj, w2 = down proj. Experts stay
+            # unquantized bf16 stacks [E, D, F] / [E, F, D].
+            m = p + "block_sparse_moe."
+            layer["router"] = get(m + "gate.weight").T
+            layer["wg"] = jnp.stack(
+                [get(f"{m}experts.{e}.w1.weight").T
+                 for e in range(config.num_experts)]
+            )
+            layer["wu"] = jnp.stack(
+                [get(f"{m}experts.{e}.w3.weight").T
+                 for e in range(config.num_experts)]
+            )
+            layer["wd"] = jnp.stack(
+                [get(f"{m}experts.{e}.w2.weight").T
+                 for e in range(config.num_experts)]
+            )
+        else:
+            layer.update(
+                wg=lin(p + "mlp.gate_proj.weight"),
+                wu=lin(p + "mlp.up_proj.weight"),
+                wd=lin(p + "mlp.down_proj.weight"),
+            )
+        layers.append(layer)
     params: dict[str, Any] = {
         "embed": get("model.embed_tokens.weight"),
         "layers": layers,
@@ -93,7 +113,10 @@ def load_hf_safetensors(
         # else: tied despite config — fall back to embed.T at logits time
     if tensors:
         logger.debug("unused tensors: %s", sorted(tensors)[:5])
-    mapped = 2 + 9 * config.num_layers + (1 if "lm_head" in params else 0)
+    per_layer = 6 + (1 + 3 * config.num_experts if config.num_experts else 3)
+    mapped = 2 + per_layer * config.num_layers + (
+        1 if "lm_head" in params else 0
+    )
     logger.info(
         "loaded %d HF tensors from %s (quantize=%s, %d unused)",
         mapped,

@@ -51,6 +51,10 @@ class LlamaConfig:
     # without stomping the ops-level global (e.g. a TP-meshed engine on
     # the XLA path next to a single-chip engine on the pallas path)
     attn_impl: Optional[str] = None
+    # MoE (Mixtral-style): num_experts == 0 means dense SwiGLU FFN
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def from_hf_dict(cls, d: dict[str, Any]) -> "LlamaConfig":
@@ -69,6 +73,8 @@ class LlamaConfig:
             max_position_embeddings=d.get("max_position_embeddings", 8192),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
             rope_scaling=d.get("rope_scaling"),
+            num_experts=d.get("num_local_experts", 0),
+            num_experts_per_tok=d.get("num_experts_per_tok", 2),
         )
 
     @classmethod
@@ -125,7 +131,7 @@ def init_params(
 ) -> dict:
     """Random-init parameter pytree (bench/test path; loading is separate)."""
     c = config
-    keys = iter(jax.random.split(rng, 4 + 9 * c.num_layers))
+    keys = iter(jax.random.split(rng, 4 + 10 * c.num_layers))
 
     def dense(key, shape, scale_dim):
         w = jax.random.normal(key, shape, dtype=jnp.float32) / jnp.sqrt(scale_dim)
@@ -133,19 +139,34 @@ def init_params(
 
     layers = []
     for _ in range(c.num_layers):
-        layers.append(
-            {
-                "attn_norm": jnp.ones((c.hidden_size,), dtype),
-                "wq": dense(next(keys), (c.hidden_size, c.q_dim), c.hidden_size),
-                "wk": dense(next(keys), (c.hidden_size, c.kv_dim), c.hidden_size),
-                "wv": dense(next(keys), (c.hidden_size, c.kv_dim), c.hidden_size),
-                "wo": dense(next(keys), (c.q_dim, c.hidden_size), c.q_dim),
-                "mlp_norm": jnp.ones((c.hidden_size,), dtype),
-                "wg": dense(next(keys), (c.hidden_size, c.intermediate_size), c.hidden_size),
-                "wu": dense(next(keys), (c.hidden_size, c.intermediate_size), c.hidden_size),
-                "wd": dense(next(keys), (c.intermediate_size, c.hidden_size), c.intermediate_size),
-            }
-        )
+        layer = {
+            "attn_norm": jnp.ones((c.hidden_size,), dtype),
+            "wq": dense(next(keys), (c.hidden_size, c.q_dim), c.hidden_size),
+            "wk": dense(next(keys), (c.hidden_size, c.kv_dim), c.hidden_size),
+            "wv": dense(next(keys), (c.hidden_size, c.kv_dim), c.hidden_size),
+            "wo": dense(next(keys), (c.q_dim, c.hidden_size), c.q_dim),
+            "mlp_norm": jnp.ones((c.hidden_size,), dtype),
+        }
+        if c.num_experts:
+            # Mixtral MoE FFN: router + stacked expert SwiGLU weights
+            # (experts kept bf16; expert einsums go through ops/moe.py)
+            E, D, F = c.num_experts, c.hidden_size, c.intermediate_size
+            def expert(key, shape, scale_dim):
+                w = jax.random.normal(key, shape, dtype=jnp.float32)
+                return (w / jnp.sqrt(scale_dim)).astype(dtype)
+            layer.update(
+                router=expert(next(keys), (D, E), D),
+                wg=expert(next(keys), (E, D, F), D),
+                wu=expert(next(keys), (E, D, F), D),
+                wd=expert(next(keys), (E, F, D), F),
+            )
+        else:
+            layer.update(
+                wg=dense(next(keys), (c.hidden_size, c.intermediate_size), c.hidden_size),
+                wu=dense(next(keys), (c.hidden_size, c.intermediate_size), c.hidden_size),
+                wd=dense(next(keys), (c.intermediate_size, c.hidden_size), c.intermediate_size),
+            )
+        layers.append(layer)
     params = {
         "embed": (
             jax.random.normal(next(keys), (c.vocab_size, c.hidden_size), jnp.float32)
@@ -163,10 +184,14 @@ def init_params(
 
 def param_count(config: LlamaConfig) -> int:
     c = config
+    ffn = 3 * c.hidden_size * c.intermediate_size
+    if c.num_experts:
+        # MoE: E expert FFNs + the router table
+        ffn = c.num_experts * ffn + c.hidden_size * c.num_experts
     per_layer = (
         c.hidden_size * (c.q_dim + 2 * c.kv_dim)
         + c.q_dim * c.hidden_size
-        + 3 * c.hidden_size * c.intermediate_size
+        + ffn
         + 2 * c.hidden_size
     )
     total = c.num_layers * per_layer + 2 * c.vocab_size * c.hidden_size
@@ -212,6 +237,14 @@ def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, bloc
 
 def _mlp(x, layer, cfg):
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
+    if "router" in layer:
+        from dynamo_tpu.ops.moe import moe_ffn
+
+        return x + moe_ffn(
+            h, layer["router"], layer["wg"], layer["wu"], layer["wd"],
+            top_k=cfg.num_experts_per_tok,
+            capacity_factor=cfg.moe_capacity_factor,
+        )
     gate = linear(h, layer["wg"])
     up = linear(h, layer["wu"])
     return x + linear(swiglu(gate, up), layer["wd"])

@@ -1,0 +1,195 @@
+"""Mixture-of-Experts: top-k router + expert-parallel FFN dispatch.
+
+The reference reaches MoE only through SGLang's DeepEP integration
+(examples/sglang dsr1-wideep: --enable-deepep-moe, --ep-num-redundant-
+experts, NVSHMEM all-to-all). Here MoE is a first-class op built the TPU
+way, two interchangeable dispatch paths:
+
+  * `moe_ffn` — GShard-style dispatch/combine einsums over a capacity-
+    bucketed [T, E, C] routing tensor. Under a mesh with experts sharded
+    over the `ep` axis, XLA lowers the dispatch einsum to exactly the
+    all-to-all DeepEP hand-codes — "annotate shardings, let XLA insert
+    collectives".
+  * `moe_ffn_shard_map` — explicit shard_map variant: tokens all-gathered
+    per ep shard, each shard computes only ITS experts' assignments, then
+    psum_scatter combines partial outputs. Used when manual overlap
+    control beats GSPMD's schedule.
+
+Routing: softmax over router logits, top-k experts per token, weights
+renormalized over the selected k (Mixtral semantics). Tokens overflowing
+an expert's capacity are dropped (standard Switch behavior); capacity
+defaults generously (cap_factor * T * k / E).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dynamo_tpu.ops.basics import rms_norm, swiglu
+from dynamo_tpu.ops.linear import linear
+
+
+def default_capacity(T: int, E: int, top_k: int, factor: float) -> int:
+    """Expert capacity: DROPLESS (capacity = T) for decode-sized batches,
+    where routing collisions are routine (B=4, E=8, top_k=2 gives only 1
+    slot/expert under the classic T*k/E rule — a dropped token silently
+    corrupts its logits). Large prefill T keeps the capacity-factor bucket:
+    the [T, E, C] dispatch tensor at C=T would be quadratic in prompt
+    length, and balanced routers essentially never overflow factor*mean.
+    """
+    if T <= 64:
+        return T
+    return max(int(factor * T * top_k / E), top_k)
+
+
+def router_topk(
+    logits: jax.Array,  # [T, E] f32 router logits
+    top_k: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k expert ids + renormalized softmax weights ([T, k] each)."""
+    weights, idx = lax.top_k(logits, top_k)  # [T, k]
+    weights = jax.nn.softmax(weights, axis=-1)  # renormalize over chosen k
+    return idx, weights
+
+
+def make_dispatch(
+    idx: jax.Array,  # [T, k] int32 expert ids
+    weights: jax.Array,  # [T, k] f32
+    num_experts: int,
+    capacity: int,
+    mask: Optional[jax.Array] = None,  # [T, k] bool: valid assignments
+) -> tuple[jax.Array, jax.Array]:
+    """Build GShard dispatch/combine tensors.
+
+    dispatch [T, E, C] bool: token t occupies slot c of expert e.
+    combine  [T, E, C] f32: same positions carrying the routing weight.
+    Slot assignment is order-of-arrival per expert (cumsum); tokens past
+    capacity are dropped from that expert. Masked-out assignments neither
+    dispatch nor consume capacity (used by the EP shard_map path to keep
+    only this shard's experts).
+    """
+    T, k = idx.shape
+    onehot = jax.nn.one_hot(idx, num_experts, dtype=jnp.int32)  # [T, k, E]
+    if mask is not None:
+        onehot = onehot * mask[..., None].astype(jnp.int32)
+    # position of (t, k) within expert e's queue, counting over t-major
+    flat = onehot.reshape(T * k, num_experts)
+    pos = jnp.cumsum(flat, axis=0) - flat  # [T*k, E]
+    pos = pos.reshape(T, k, num_experts)
+    in_cap = pos < capacity
+    slot = jnp.clip(pos, 0, capacity - 1)
+    disp = (
+        jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        * (onehot * in_cap)[..., None]
+    )  # [T, k, E, C]
+    combine = disp * weights[:, :, None, None]
+    return disp.sum(1), combine.sum(1)  # [T, E, C] each
+
+
+def _expert_ffn(xe: jax.Array, wg, wu, wd) -> jax.Array:
+    """Per-expert SwiGLU FFN on dispatched tokens xe [E, C, D]."""
+    gate = jnp.einsum("ecd,edf->ecf", xe, wg)
+    up = jnp.einsum("ecd,edf->ecf", xe, wu)
+    return jnp.einsum("ecf,efd->ecd", swiglu(gate, up), wd)
+
+
+def moe_ffn(
+    x: jax.Array,  # [T, D]
+    router_w: jax.Array,  # [D, E]
+    wg: jax.Array,  # [E, D, F] expert gate projections
+    wu: jax.Array,  # [E, D, F]
+    wd: jax.Array,  # [E, F, D]
+    top_k: int,
+    capacity_factor: float = 1.25,
+    capacity: Optional[int] = None,
+) -> jax.Array:
+    """GShard-dispatch MoE FFN (GSPMD path).
+
+    With wg/wu/wd sharded P("ep", ...) and x dp/sp-sharded, XLA inserts the
+    token all-to-all at the dispatch einsum and the reverse at combine.
+    """
+    T, D = x.shape
+    E = router_w.shape[-1]
+    logits = jnp.einsum(
+        "td,de->te", x.astype(jnp.float32), router_w.astype(jnp.float32)
+    )
+    idx, weights = router_topk(logits, top_k)
+    if capacity is None:
+        capacity = default_capacity(T, E, top_k, capacity_factor)
+    disp, combine = make_dispatch(idx, weights, E, capacity)
+    xe = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), disp)  # a2a here
+    ye = _expert_ffn(
+        xe.astype(x.dtype), wg, wu, wd
+    )  # [E, C, D], expert-sharded
+    y = jnp.einsum("ecd,tec->td", ye.astype(jnp.float32), combine)  # a2a back
+    return y.astype(x.dtype)
+
+
+def moe_ffn_shard_map(
+    mesh: Mesh,
+    x: jax.Array,  # [T, D] (T sharded over dp/sp outside, or replicated)
+    router_w: jax.Array,
+    wg: jax.Array,  # [E, D, F] sharded over ep on E
+    wu: jax.Array,
+    wd: jax.Array,
+    top_k: int,
+    capacity_factor: float = 1.25,
+    *,
+    ep_axis: str = "ep",
+) -> jax.Array:
+    """Explicit expert-parallel MoE: each ep shard computes its local
+    experts' contribution for ALL tokens, then a psum over the ep axis
+    combines (capacity bookkeeping stays per-shard and local).
+
+    Equivalent math to moe_ffn; communication is one psum of [T, D]
+    instead of two [T, .., C] all-to-alls — the right trade when T is
+    modest (decode steps) and E is large (wide EP).
+    """
+    ep = mesh.shape[ep_axis]
+    E = router_w.shape[-1]
+    assert E % ep == 0, (E, ep)
+
+    def body(x, router_w, wg, wu, wd):
+        # local expert slab: e_loc = E / ep experts on this shard
+        my = lax.axis_index(ep_axis)
+        e_loc = wg.shape[0]
+        T = x.shape[0]
+        logits = jnp.einsum(
+            "td,de->te", x.astype(jnp.float32), router_w.astype(jnp.float32)
+        )  # router is replicated: identical top-k on every shard
+        idx, weights = router_topk(logits, top_k)
+        lo = my * e_loc
+        # mask weights of experts not on this shard, shift ids local
+        local = (idx >= lo) & (idx < lo + e_loc)
+        idx_loc = jnp.clip(idx - lo, 0, e_loc - 1)
+        w_loc = jnp.where(local, weights, 0.0)
+        capacity = default_capacity(T, E, top_k, capacity_factor)
+        disp, combine = make_dispatch(
+            idx_loc, w_loc, e_loc, capacity, mask=local
+        )
+        xe = jnp.einsum("td,tec->ecd", x.astype(jnp.float32), disp)
+        ye = _expert_ffn(xe.astype(x.dtype), wg, wu, wd)
+        y = jnp.einsum("ecd,tec->td", ye.astype(jnp.float32), combine)
+        return lax.psum(y.astype(x.dtype), ep_axis)
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(),  # x replicated within the ep group
+            P(),  # router replicated
+            P(ep_axis, None, None),
+            P(ep_axis, None, None),
+            P(ep_axis, None, None),
+        ),
+        out_specs=P(),
+        check_rep=False,
+    )
+    return fn(x, router_w, wg, wu, wd)

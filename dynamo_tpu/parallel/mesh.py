@@ -4,6 +4,7 @@ Axes (any may be 1 and is then collapsed away by GSPMD):
   dp — data parallel (batch lanes / replicas inside one engine)
   pp — pipeline stages (layer partition, over ICI or DCN)
   sp — sequence/context parallel (ring attention over long prefills)
+  ep — expert parallel (MoE expert slabs; DeepEP/WideEP equivalent)
   tp — tensor parallel (heads / ffn, always innermost => fastest ICI rings)
 """
 
@@ -15,7 +16,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "pp", "sp", "tp")
+AXES = ("dp", "pp", "sp", "ep", "tp")
 
 
 def build_mesh(
@@ -23,19 +24,20 @@ def build_mesh(
     dp: int = 1,
     pp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
-    need = tp * dp * pp * sp
+    need = tp * dp * pp * sp * ep
     if need > len(devs):
         raise ValueError(
-            f"mesh dp={dp} pp={pp} sp={sp} tp={tp} needs {need} devices, "
-            f"have {len(devs)}"
+            f"mesh dp={dp} pp={pp} sp={sp} ep={ep} tp={tp} needs "
+            f"{need} devices, have {len(devs)}"
         )
-    grid = np.array(devs[:need]).reshape(dp, pp, sp, tp)
+    grid = np.array(devs[:need]).reshape(dp, pp, sp, ep, tp)
     return Mesh(grid, AXES)
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
     dev = device or jax.devices()[0]
-    return Mesh(np.array([dev]).reshape(1, 1, 1, 1), AXES)
+    return Mesh(np.array([dev]).reshape(1, 1, 1, 1, 1), AXES)

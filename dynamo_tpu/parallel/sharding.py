@@ -50,6 +50,11 @@ def shard_llama(
             f"num_kv_heads={config.num_kv_heads} not divisible by "
             f"tp={mesh.shape['tp']}"
         )
+    ep = mesh.shape.get("ep", 1)
+    if config.num_experts and config.num_experts % ep != 0:
+        raise ValueError(
+            f"num_experts={config.num_experts} not divisible by ep={ep}"
+        )
     repl = _ns(mesh, None)
     out: dict = {
         "embed": jax.device_put(params["embed"], _ns(mesh, None, None)),
@@ -57,19 +62,30 @@ def shard_llama(
         "layers": [],
     }
     for layer in params["layers"]:
-        out["layers"].append(
-            {
-                "attn_norm": jax.device_put(layer["attn_norm"], repl),
-                "wq": _shard_linear(mesh, layer["wq"], None, "tp"),
-                "wk": _shard_linear(mesh, layer["wk"], None, "tp"),
-                "wv": _shard_linear(mesh, layer["wv"], None, "tp"),
-                "wo": _shard_linear(mesh, layer["wo"], "tp", None),
-                "mlp_norm": jax.device_put(layer["mlp_norm"], repl),
-                "wg": _shard_linear(mesh, layer["wg"], None, "tp"),
-                "wu": _shard_linear(mesh, layer["wu"], None, "tp"),
-                "wd": _shard_linear(mesh, layer["wd"], "tp", None),
-            }
-        )
+        placed = {
+            "attn_norm": jax.device_put(layer["attn_norm"], repl),
+            "wq": _shard_linear(mesh, layer["wq"], None, "tp"),
+            "wk": _shard_linear(mesh, layer["wk"], None, "tp"),
+            "wv": _shard_linear(mesh, layer["wv"], None, "tp"),
+            "wo": _shard_linear(mesh, layer["wo"], "tp", None),
+            "mlp_norm": jax.device_put(layer["mlp_norm"], repl),
+        }
+        if "router" in layer:
+            # WideEP: experts sharded over ep, each expert's FFN over tp
+            # (dsr1-wideep equivalent: dp-attention + deepep-moe flags)
+            placed.update(
+                router=jax.device_put(layer["router"], _ns(mesh, None, None)),
+                wg=jax.device_put(layer["wg"], _ns(mesh, "ep", None, "tp")),
+                wu=jax.device_put(layer["wu"], _ns(mesh, "ep", None, "tp")),
+                wd=jax.device_put(layer["wd"], _ns(mesh, "ep", "tp", None)),
+            )
+        else:
+            placed.update(
+                wg=_shard_linear(mesh, layer["wg"], None, "tp"),
+                wu=_shard_linear(mesh, layer["wu"], None, "tp"),
+                wd=_shard_linear(mesh, layer["wd"], "tp", None),
+            )
+        out["layers"].append(placed)
     if "lm_head" in params:
         out["lm_head"] = _shard_linear(mesh, params["lm_head"], None, "tp")
     kv_sharding = _ns(mesh, None, "tp", None, None, None)

@@ -60,6 +60,6 @@ def test_tp_sharded_prefill_matches_single_device():
 
 def test_mesh_axes():
     mesh = build_mesh(tp=2, dp=2, pp=2)
-    assert mesh.shape == {"dp": 2, "pp": 2, "sp": 1, "tp": 2}
+    assert mesh.shape == {"dp": 2, "pp": 2, "sp": 1, "ep": 1, "tp": 2}
     with pytest.raises(ValueError):
         build_mesh(tp=100)
