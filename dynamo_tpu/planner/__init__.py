@@ -1,0 +1,44 @@
+"""Planner: the autoscaler that sizes prefill/decode fleets.
+
+Role-equivalent of components/planner/src/dynamo/planner in the reference
+(utils/planner_core.py observe->predict->interpolate->scale loop,
+load_predictor.py, perf_interpolation.py, local/kube connectors) — built
+against OUR metrics plane (fabric stats + Prometheus text) and OUR process
+supervisor instead of circus/k8s CRDs.
+"""
+
+from dynamo_tpu.planner.connectors import (
+    Connector,
+    LocalProcessConnector,
+    VirtualConnector,
+)
+from dynamo_tpu.planner.load_predictor import (
+    ConstantPredictor,
+    LinearTrendPredictor,
+    MovingAveragePredictor,
+    make_predictor,
+)
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.planner.planner_core import (
+    Planner,
+    PlannerConfig,
+    ScaleDecision,
+)
+
+__all__ = [
+    "Connector",
+    "ConstantPredictor",
+    "DecodeInterpolator",
+    "LinearTrendPredictor",
+    "LocalProcessConnector",
+    "MovingAveragePredictor",
+    "Planner",
+    "PlannerConfig",
+    "PrefillInterpolator",
+    "ScaleDecision",
+    "VirtualConnector",
+    "make_predictor",
+]

@@ -1,0 +1,116 @@
+"""`python -m dynamo_tpu.planner` — run the autoscaler as a service.
+
+Role-equivalent of the reference's planner component entrypoint
+(components/planner). Load mode needs only the fabric; SLA mode wants the
+frontend metrics URL and a profiled .npz (benchmarks/profiler output).
+
+    python -m dynamo_tpu.planner --mode load \
+        --namespace demo --component decode --endpoint generate \
+        --prefill-cmd "python -m my_prefill_worker" \
+        --decode-cmd "python -m my_decode_worker"
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import shlex
+
+from dynamo_tpu.planner import (
+    DecodeInterpolator,
+    LocalProcessConnector,
+    Planner,
+    PlannerConfig,
+    PrefillInterpolator,
+    VirtualConnector,
+)
+from dynamo_tpu.planner.samplers import FrontendFabricSampler
+from dynamo_tpu.runtime import logging as dlog
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dynamo_tpu planner")
+    ap.add_argument("--mode", choices=("sla", "load"), default="load")
+    ap.add_argument("--interval", type=float, default=10.0)
+    ap.add_argument("--metrics-url", default=None)
+    ap.add_argument("--profile", default=None, help="profiler .npz path")
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--component", default="backend")
+    ap.add_argument("--endpoint", default="generate")
+    ap.add_argument("--prefill-cmd", default=None)
+    ap.add_argument("--decode-cmd", default=None)
+    ap.add_argument("--ttft-target-ms", type=float, default=200.0)
+    ap.add_argument("--itl-target-ms", type=float, default=20.0)
+    ap.add_argument("--min-prefill", type=int, default=1)
+    ap.add_argument("--max-prefill", type=int, default=8)
+    ap.add_argument("--min-decode", type=int, default=1)
+    ap.add_argument("--max-decode", type=int, default=8)
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+    dlog.init()
+
+    async def run() -> None:
+        aggregator = None
+        drt = None
+        try:
+            from dynamo_tpu.runtime.distributed import DistributedRuntime
+            from dynamo_tpu.runtime.protocols import EndpointId
+            from dynamo_tpu.kv_router.publisher import KvMetricsAggregator
+
+            drt = await DistributedRuntime.from_settings()
+            component = (
+                await drt.namespace(args.namespace)
+            ).component(args.component)
+            aggregator = KvMetricsAggregator(
+                component,
+                EndpointId(args.namespace, args.component, args.endpoint),
+            )
+        except Exception:  # noqa: BLE001 — frontend-only SLA mode still works
+            dlog.get_logger("dynamo_tpu.planner").warning(
+                "no fabric available; kv_usage/queue_depth stay 0"
+            )
+        sample = FrontendFabricSampler(args.metrics_url, aggregator)
+        if args.dry_run or not (args.prefill_cmd and args.decode_cmd):
+            connector = VirtualConnector()
+        else:
+            connector = LocalProcessConnector(
+                {
+                    "prefill_worker": shlex.split(args.prefill_cmd),
+                    "decode_worker": shlex.split(args.decode_cmd),
+                }
+            )
+        pre = dec = None
+        if args.profile:
+            pre = PrefillInterpolator.from_npz(args.profile)
+            dec = DecodeInterpolator.from_npz(args.profile)
+        planner = Planner(
+            PlannerConfig(
+                mode=args.mode,
+                interval_s=args.interval,
+                ttft_target_ms=args.ttft_target_ms,
+                itl_target_ms=args.itl_target_ms,
+                min_prefill=args.min_prefill,
+                max_prefill=args.max_prefill,
+                min_decode=args.min_decode,
+                max_decode=args.max_decode,
+            ),
+            sample,
+            connector,
+            prefill_interp=pre,
+            decode_interp=dec,
+        )
+        await planner.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await planner.close()
+            if hasattr(connector, "close"):
+                await connector.close()
+            if drt is not None:
+                await drt.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

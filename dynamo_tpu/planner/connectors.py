@@ -1,0 +1,98 @@
+"""Scale actuators: turn replica targets into running workers.
+
+Role-equivalent of planner LocalConnector (circus-based) and
+KubernetesConnector (DynamoGraphDeployment CRD patch). Ours:
+
+  * VirtualConnector — bookkeeping only; planner tests and dry-run mode.
+  * LocalProcessConnector — spawns/kills worker subprocesses from a
+    command template (the supervisor-backed analogue; the SDK process
+    supervisor builds on the same mechanism).
+  * (k8s: deploy/ manifests patch `replicas:` — documented there; the
+    planner emits ScaleDecision objects any operator glue can consume.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import os
+import signal
+from typing import Optional, Protocol
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.planner.connectors")
+
+
+class Connector(Protocol):
+    async def set_replicas(self, component: str, n: int) -> None: ...
+
+    def replicas(self, component: str) -> int: ...
+
+
+class VirtualConnector:
+    """Records targets; asserts planner decisions in tests / dry runs."""
+
+    def __init__(self) -> None:
+        self.targets: dict[str, int] = {}
+        self.history: list[tuple[str, int]] = []
+
+    async def set_replicas(self, component: str, n: int) -> None:
+        self.targets[component] = n
+        self.history.append((component, n))
+
+    def replicas(self, component: str) -> int:
+        return self.targets.get(component, 0)
+
+
+class LocalProcessConnector:
+    """Spawn one OS process per replica from a per-component command.
+
+    commands: {"decode_worker": ["python", "-m", "...", "--flag"], ...}
+    Extra env per replica: DYN_REPLICA_INDEX. Scale-down kills the
+    newest replicas first (graceful TERM, KILL after grace).
+    """
+
+    def __init__(
+        self,
+        commands: dict[str, list[str]],
+        env: Optional[dict[str, str]] = None,
+        grace_s: float = 5.0,
+    ) -> None:
+        self.commands = commands
+        self.env = env or {}
+        self.grace_s = grace_s
+        self._procs: dict[str, list[asyncio.subprocess.Process]] = {}
+
+    def replicas(self, component: str) -> int:
+        procs = self._procs.get(component, [])
+        return sum(1 for p in procs if p.returncode is None)
+
+    async def set_replicas(self, component: str, n: int) -> None:
+        procs = self._procs.setdefault(component, [])
+        procs[:] = [p for p in procs if p.returncode is None]
+        while len(procs) < n:
+            idx = len(procs)
+            env = dict(os.environ, **self.env, DYN_REPLICA_INDEX=str(idx))
+            proc = await asyncio.create_subprocess_exec(
+                *self.commands[component], env=env
+            )
+            logger.info(
+                "scaled up %s -> replica %d (pid %d)", component, idx, proc.pid
+            )
+            procs.append(proc)
+        while len(procs) > n:
+            proc = procs.pop()
+            logger.info("scaling down %s (pid %d)", component, proc.pid)
+            with contextlib.suppress(ProcessLookupError):
+                proc.send_signal(signal.SIGTERM)
+            try:
+                await asyncio.wait_for(proc.wait(), timeout=self.grace_s)
+            except asyncio.TimeoutError:
+                with contextlib.suppress(ProcessLookupError):
+                    proc.kill()
+                await proc.wait()
+
+    async def close(self) -> None:
+        for component in list(self._procs):
+            await self.set_replicas(component, 0)

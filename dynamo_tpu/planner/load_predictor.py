@@ -1,0 +1,74 @@
+"""Load predictors: next-interval request rate / ISL / OSL forecasts.
+
+Role-equivalent of planner utils/load_predictor.py (constant, ARIMA,
+Prophet). Prophet/statsmodels aren't in the image, so the trend family is
+a linear least-squares fit over a sliding window — which is what ARIMA
+degenerates to at planner horizons of a few intervals anyway.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+
+class ConstantPredictor:
+    """Predict next = last observed (reference: constant mode)."""
+
+    def __init__(self, window: int = 1) -> None:
+        self._last: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        self._last = value
+
+    def predict(self) -> Optional[float]:
+        return self._last
+
+
+class MovingAveragePredictor:
+    def __init__(self, window: int = 6) -> None:
+        self._buf: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._buf.append(value)
+
+    def predict(self) -> Optional[float]:
+        if not self._buf:
+            return None
+        return sum(self._buf) / len(self._buf)
+
+
+class LinearTrendPredictor:
+    """Least-squares linear extrapolation one step ahead over a window.
+
+    Captures ramps (the case that matters for scale-ahead) without the
+    heavyweight ARIMA dependency; clamps at zero.
+    """
+
+    def __init__(self, window: int = 8) -> None:
+        self._buf: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._buf.append(value)
+
+    def predict(self) -> Optional[float]:
+        n = len(self._buf)
+        if n == 0:
+            return None
+        if n < 3:
+            return self._buf[-1]
+        xs = range(n)
+        mean_x = (n - 1) / 2
+        mean_y = sum(self._buf) / n
+        cov = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, self._buf))
+        var = sum((x - mean_x) ** 2 for x in xs)
+        slope = cov / var if var else 0.0
+        return max(0.0, mean_y + slope * (n - mean_x))
+
+
+def make_predictor(kind: str, window: int = 8):
+    return {
+        "constant": ConstantPredictor,
+        "moving_average": MovingAveragePredictor,
+        "linear": LinearTrendPredictor,
+    }[kind](window)

@@ -1,0 +1,102 @@
+"""Performance interpolators over profiled engine data.
+
+Role-equivalent of planner utils/perf_interpolation.py: the profiler
+(benchmarks/profiler equivalent) sweeps the engine offline and records
+  prefill: isl -> (ttft_ms, prefill_tok_s_per_chip)
+  decode:  (kv_usage, context_len) -> (itl_ms, decode_tok_s_per_chip)
+saved as .npz; the planner interpolates these surfaces to turn predicted
+load into required replica counts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import numpy as np
+
+
+class PrefillInterpolator:
+    """ttft(isl) and throughput(isl) by 1-D linear interpolation."""
+
+    def __init__(
+        self,
+        isl: np.ndarray,
+        ttft_ms: np.ndarray,
+        tok_s: np.ndarray,
+    ) -> None:
+        order = np.argsort(isl)
+        self.isl = np.asarray(isl, float)[order]
+        self.ttft_ms = np.asarray(ttft_ms, float)[order]
+        self.tok_s = np.asarray(tok_s, float)[order]
+
+    @classmethod
+    def from_npz(cls, path: str) -> "PrefillInterpolator":
+        d = np.load(path)
+        return cls(d["prefill_isl"], d["prefill_ttft_ms"], d["prefill_tok_s"])
+
+    def ttft(self, isl: float) -> float:
+        return float(np.interp(isl, self.isl, self.ttft_ms))
+
+    def throughput(self, isl: float) -> float:
+        """Prefill tokens/s/chip at this ISL."""
+        return float(np.interp(isl, self.isl, self.tok_s))
+
+
+class DecodeInterpolator:
+    """itl(kv_usage) and per-chip decode throughput at that operating point.
+
+    The reference interpolates over (kv_usage, context); a 1-D curve over
+    kv_usage with context folded into the profile grid is enough for the
+    replica computation and keeps the profile cheap to collect.
+    """
+
+    def __init__(
+        self,
+        kv_usage: np.ndarray,
+        itl_ms: np.ndarray,
+        tok_s: np.ndarray,
+    ) -> None:
+        order = np.argsort(kv_usage)
+        self.kv_usage = np.asarray(kv_usage, float)[order]
+        self.itl_ms = np.asarray(itl_ms, float)[order]
+        self.tok_s = np.asarray(tok_s, float)[order]
+
+    @classmethod
+    def from_npz(cls, path: str) -> "DecodeInterpolator":
+        d = np.load(path)
+        return cls(d["decode_kv_usage"], d["decode_itl_ms"], d["decode_tok_s"])
+
+    def itl(self, kv_usage: float) -> float:
+        return float(np.interp(kv_usage, self.kv_usage, self.itl_ms))
+
+    def throughput(self, kv_usage: float) -> float:
+        return float(np.interp(kv_usage, self.kv_usage, self.tok_s))
+
+    def max_usage_for_itl(self, itl_target_ms: float) -> float:
+        """Highest kv_usage whose ITL still meets target (SLA inversion)."""
+        ok = self.kv_usage[self.itl_ms <= itl_target_ms]
+        if len(ok) == 0:
+            return float(self.kv_usage[0])
+        return float(ok[-1])
+
+
+def save_profile(
+    path: str,
+    *,
+    prefill_isl,
+    prefill_ttft_ms,
+    prefill_tok_s,
+    decode_kv_usage,
+    decode_itl_ms,
+    decode_tok_s,
+) -> None:
+    """Write the .npz consumed by the interpolators (profiler output)."""
+    np.savez(
+        path,
+        prefill_isl=np.asarray(prefill_isl, float),
+        prefill_ttft_ms=np.asarray(prefill_ttft_ms, float),
+        prefill_tok_s=np.asarray(prefill_tok_s, float),
+        decode_kv_usage=np.asarray(decode_kv_usage, float),
+        decode_itl_ms=np.asarray(decode_itl_ms, float),
+        decode_tok_s=np.asarray(decode_tok_s, float),
+    )

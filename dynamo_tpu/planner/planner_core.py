@@ -1,0 +1,230 @@
+"""Planner core: observe -> predict -> interpolate -> scale.
+
+Role-equivalent of planner utils/planner_core.py (:51-436): every
+adjustment interval the planner samples the serving metrics, predicts the
+next interval's load, converts SLA targets into per-replica capacity via
+the profiled interpolators, and actuates replica counts through a
+Connector. Two modes, like the reference:
+
+  * sla  — TTFT/ITL targets drive both fleets' sizes (planner_sla.py)
+  * load — threshold rules on kv usage / queue depth (load-based mode)
+
+Correction factors: observed TTFT/ITL vs interpolated at the same
+operating point scale the model continuously, so a mis-profiled surface
+still converges (reference :170-196).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import math
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+from dynamo_tpu.planner.connectors import Connector
+from dynamo_tpu.planner.load_predictor import make_predictor
+from dynamo_tpu.planner.perf_interpolation import (
+    DecodeInterpolator,
+    PrefillInterpolator,
+)
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.planner")
+
+PREFILL = "prefill_worker"
+DECODE = "decode_worker"
+
+
+@dataclass
+class ObservedMetrics:
+    """One interval's aggregate serving observation."""
+
+    req_per_s: float = 0.0
+    avg_isl: float = 0.0  # input tokens per request
+    avg_osl: float = 0.0  # output tokens per request
+    ttft_ms: Optional[float] = None
+    itl_ms: Optional[float] = None
+    kv_usage: float = 0.0  # 0..1 decode fleet cache usage
+    queue_depth: float = 0.0  # waiting prefill requests
+
+
+@dataclass
+class PlannerConfig:
+    mode: str = "sla"  # "sla" | "load"
+    interval_s: float = 10.0
+    predictor: str = "linear"  # constant | moving_average | linear
+    predictor_window: int = 8
+    # SLA targets
+    ttft_target_ms: float = 200.0
+    itl_target_ms: float = 20.0
+    # replica bounds
+    min_prefill: int = 1
+    max_prefill: int = 8
+    min_decode: int = 1
+    max_decode: int = 8
+    # load-mode thresholds
+    kv_usage_high: float = 0.85
+    kv_usage_low: float = 0.3
+    queue_high: float = 4.0
+    queue_low: float = 0.5
+    # headroom multiplier on computed demand
+    headroom: float = 1.15
+
+
+@dataclass
+class ScaleDecision:
+    prefill: int
+    decode: int
+    reason: str = ""
+
+
+class Planner:
+    """Drives a Connector from a metrics sampler + profiled interpolators.
+
+    `sample` is any async callable returning ObservedMetrics (fabric
+    aggregation, Prometheus scrape, or a test stub).
+    """
+
+    def __init__(
+        self,
+        config: PlannerConfig,
+        sample: Callable[[], Awaitable[ObservedMetrics]],
+        connector: Connector,
+        prefill_interp: Optional[PrefillInterpolator] = None,
+        decode_interp: Optional[DecodeInterpolator] = None,
+    ) -> None:
+        self.config = config
+        self.sample = sample
+        self.connector = connector
+        self.prefill_interp = prefill_interp
+        self.decode_interp = decode_interp
+        self._rate = make_predictor(config.predictor, config.predictor_window)
+        self._isl = make_predictor("moving_average", config.predictor_window)
+        self._osl = make_predictor("moving_average", config.predictor_window)
+        # correction factors: observed/interpolated latency at the same
+        # operating point; start neutral
+        self._ttft_corr = 1.0
+        self._itl_corr = 1.0
+        self._task: Optional[asyncio.Task] = None
+        self.decisions: list[ScaleDecision] = []
+
+    # ------------------------------------------------------------ decide
+
+    def _decide_sla(self, m: ObservedMetrics) -> ScaleDecision:
+        cfg = self.config
+        rate = self._rate.predict() or m.req_per_s
+        isl = self._isl.predict() or m.avg_isl or 1.0
+        osl = self._osl.predict() or m.avg_osl or 1.0
+
+        # --- prefill fleet: demand tokens/s vs per-replica capacity at a
+        # TTFT-feasible operating point
+        if self.prefill_interp is not None and isl > 0:
+            base_ttft = self.prefill_interp.ttft(isl)
+            if m.ttft_ms and base_ttft > 0:
+                self._ttft_corr = 0.7 * self._ttft_corr + 0.3 * (
+                    m.ttft_ms / base_ttft
+                )
+            # per-replica prefill throughput, degraded by correction
+            cap = self.prefill_interp.throughput(isl) / max(
+                self._ttft_corr, 1e-6
+            )
+            demand = rate * isl * cfg.headroom
+            n_p = math.ceil(demand / max(cap, 1e-6))
+            # if even the corrected model misses TTFT at this ISL, scale out
+            if base_ttft * self._ttft_corr > cfg.ttft_target_ms:
+                n_p += 1
+        else:
+            n_p = self.connector.replicas(PREFILL) or cfg.min_prefill
+
+        # --- decode fleet: run each replica at the highest kv_usage that
+        # still meets the ITL target; size fleet for predicted token rate
+        if self.decode_interp is not None:
+            target_usage = self.decode_interp.max_usage_for_itl(
+                cfg.itl_target_ms / max(self._itl_corr, 1e-6)
+            )
+            base_itl = self.decode_interp.itl(m.kv_usage)
+            if m.itl_ms and base_itl > 0:
+                self._itl_corr = 0.7 * self._itl_corr + 0.3 * (
+                    m.itl_ms / base_itl
+                )
+            cap = self.decode_interp.throughput(target_usage)
+            demand = rate * osl * cfg.headroom
+            n_d = math.ceil(demand / max(cap, 1e-6))
+        else:
+            n_d = self.connector.replicas(DECODE) or cfg.min_decode
+
+        return ScaleDecision(
+            prefill=min(max(n_p, cfg.min_prefill), cfg.max_prefill),
+            decode=min(max(n_d, cfg.min_decode), cfg.max_decode),
+            reason=(
+                f"sla rate={rate:.2f}/s isl={isl:.0f} osl={osl:.0f} "
+                f"corr=({self._ttft_corr:.2f},{self._itl_corr:.2f})"
+            ),
+        )
+
+    def _decide_load(self, m: ObservedMetrics) -> ScaleDecision:
+        cfg = self.config
+        n_p = self.connector.replicas(PREFILL) or cfg.min_prefill
+        n_d = self.connector.replicas(DECODE) or cfg.min_decode
+        why = []
+        if m.queue_depth > cfg.queue_high:
+            n_p += 1
+            why.append("queue_high")
+        elif m.queue_depth < cfg.queue_low and n_p > cfg.min_prefill:
+            n_p -= 1
+            why.append("queue_low")
+        if m.kv_usage > cfg.kv_usage_high:
+            n_d += 1
+            why.append("kv_high")
+        elif m.kv_usage < cfg.kv_usage_low and n_d > cfg.min_decode:
+            n_d -= 1
+            why.append("kv_low")
+        return ScaleDecision(
+            prefill=min(max(n_p, cfg.min_prefill), cfg.max_prefill),
+            decode=min(max(n_d, cfg.min_decode), cfg.max_decode),
+            reason="load " + "+".join(why) if why else "load steady",
+        )
+
+    async def step(self) -> ScaleDecision:
+        """One observe->decide->actuate cycle (the testable unit)."""
+        m = await self.sample()
+        self._rate.observe(m.req_per_s)
+        if m.avg_isl:
+            self._isl.observe(m.avg_isl)
+        if m.avg_osl:
+            self._osl.observe(m.avg_osl)
+        decision = (
+            self._decide_sla(m)
+            if self.config.mode == "sla"
+            else self._decide_load(m)
+        )
+        self.decisions.append(decision)
+        if decision.prefill != self.connector.replicas(PREFILL):
+            await self.connector.set_replicas(PREFILL, decision.prefill)
+        if decision.decode != self.connector.replicas(DECODE):
+            await self.connector.set_replicas(DECODE, decision.decode)
+        logger.info(
+            "planner: prefill=%d decode=%d (%s)",
+            decision.prefill, decision.decode, decision.reason,
+        )
+        return decision
+
+    # ------------------------------------------------------------- loop
+
+    async def start(self) -> None:
+        async def loop() -> None:
+            while True:
+                try:
+                    await self.step()
+                except Exception:  # noqa: BLE001 — keep planning
+                    logger.exception("planner step failed")
+                await asyncio.sleep(self.config.interval_s)
+
+        self._task = asyncio.get_running_loop().create_task(loop())
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task

@@ -1,0 +1,111 @@
+"""Live metrics samplers for the planner.
+
+The reference planner scrapes Prometheus (planner_core.observe_metrics
+:132-166). Ours samples the two planes the framework already exposes:
+
+  * the frontend's Prometheus text endpoint (http/metrics.py —
+    dyn_llm_http_service_* counters/histograms) for request rate, ISL,
+    OSL, interval-mean TTFT and ITL;
+  * the fabric stats plane (kv_router/publisher.KvMetricsAggregator —
+    ForwardPassMetrics) for decode kv_usage and prefill queue depth.
+
+Counters/histogram sums are cumulative, so each sample differences
+against the previous scrape to produce interval rates/means.
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.request
+from typing import Optional
+
+from dynamo_tpu.planner.planner_core import ObservedMetrics
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.planner.samplers")
+
+PREFIX = "dyn_llm_http_service"
+
+
+def parse_prometheus_text(text: str) -> dict[str, float]:
+    """Sum samples by metric name (labels folded together)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value = line.rsplit(None, 1)
+            name = name_part.split("{", 1)[0]
+            out[name] = out.get(name, 0.0) + float(value)
+        except ValueError:
+            continue
+    return out
+
+
+class FrontendFabricSampler:
+    """ObservedMetrics from the frontend /metrics URL + fabric stats."""
+
+    def __init__(
+        self,
+        metrics_url: Optional[str] = None,  # e.g. http://127.0.0.1:8080/metrics
+        aggregator=None,  # KvMetricsAggregator (fabric plane)
+    ) -> None:
+        self.metrics_url = metrics_url
+        self.aggregator = aggregator
+        self._prev: Optional[dict[str, float]] = None
+        self._prev_t = 0.0
+
+    def _fetch_text(self) -> dict[str, float]:
+        assert self.metrics_url is not None
+        with urllib.request.urlopen(self.metrics_url, timeout=5) as resp:
+            return parse_prometheus_text(resp.read().decode())
+
+    async def __call__(self) -> ObservedMetrics:
+        import asyncio
+
+        m = ObservedMetrics()
+        if self.metrics_url:
+            try:
+                cur = await asyncio.get_running_loop().run_in_executor(
+                    None, self._fetch_text
+                )
+                now = time.monotonic()
+                if self._prev is not None and now > self._prev_t:
+                    dt = now - self._prev_t
+
+                    def delta(name: str) -> float:
+                        return max(
+                            0.0,
+                            cur.get(name, 0.0) - self._prev.get(name, 0.0),
+                        )
+
+                    dreq = delta(f"{PREFIX}_requests_total")
+                    m.req_per_s = dreq / dt
+                    if dreq > 0:
+                        m.avg_isl = delta(f"{PREFIX}_prompt_tokens_total") / dreq
+                        m.avg_osl = delta(f"{PREFIX}_output_tokens_total") / dreq
+                    dttft_n = delta(f"{PREFIX}_time_to_first_token_seconds_count")
+                    if dttft_n > 0:
+                        m.ttft_ms = (
+                            delta(f"{PREFIX}_time_to_first_token_seconds_sum")
+                            / dttft_n * 1e3
+                        )
+                    ditl_n = delta(f"{PREFIX}_inter_token_latency_seconds_count")
+                    if ditl_n > 0:
+                        m.itl_ms = (
+                            delta(f"{PREFIX}_inter_token_latency_seconds_sum")
+                            / ditl_n * 1e3
+                        )
+                self._prev, self._prev_t = cur, now
+            except Exception:  # noqa: BLE001 — scrape failures are transient
+                logger.exception("frontend metrics scrape failed")
+        if self.aggregator is not None:
+            try:
+                per_worker = await self.aggregator.collect()
+                agg = await self.aggregator.aggregate(per_worker)
+                m.kv_usage = agg.kv_stats.gpu_cache_usage_perc
+                m.queue_depth = float(agg.worker_stats.num_requests_waiting)
+            except Exception:  # noqa: BLE001
+                logger.exception("fabric stats scrape failed")
+        return m

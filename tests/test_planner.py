@@ -1,0 +1,189 @@
+"""Planner: predictors, interpolators, SLA/load decisions, actuation.
+
+Mirrors the reference's planner testability (planner_core is pure logic
+driven by injected metrics — no GPUs, no Prometheus server needed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner import (
+    DecodeInterpolator,
+    LinearTrendPredictor,
+    MovingAveragePredictor,
+    Planner,
+    PlannerConfig,
+    PrefillInterpolator,
+    VirtualConnector,
+)
+from dynamo_tpu.planner.perf_interpolation import save_profile
+from dynamo_tpu.planner.planner_core import DECODE, PREFILL, ObservedMetrics
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------- predictors
+
+
+def test_linear_trend_extrapolates_ramp():
+    p = LinearTrendPredictor(window=4)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        p.observe(v)
+    assert p.predict() > 4.0  # scale ahead of the ramp
+
+
+def test_moving_average_smooths():
+    p = MovingAveragePredictor(window=4)
+    for v in (10.0, 0.0, 10.0, 0.0):
+        p.observe(v)
+    assert p.predict() == pytest.approx(5.0)
+
+
+# -------------------------------------------------------- interpolators
+
+
+def _interps(tmp_path=None):
+    pre = PrefillInterpolator(
+        isl=np.array([128, 512, 2048]),
+        ttft_ms=np.array([20.0, 60.0, 240.0]),
+        tok_s=np.array([8000.0, 12000.0, 14000.0]),
+    )
+    dec = DecodeInterpolator(
+        kv_usage=np.array([0.2, 0.5, 0.8, 0.95]),
+        itl_ms=np.array([8.0, 12.0, 20.0, 45.0]),
+        tok_s=np.array([3000.0, 5000.0, 6000.0, 6200.0]),
+    )
+    return pre, dec
+
+
+def test_interpolation_and_sla_inversion(tmp_path):
+    pre, dec = _interps()
+    assert pre.ttft(128) == 20.0
+    assert 20.0 < pre.ttft(300) < 60.0
+    # ITL target 20ms -> highest profiled usage meeting it is 0.8
+    assert dec.max_usage_for_itl(20.0) == pytest.approx(0.8)
+    # npz roundtrip
+    path = str(tmp_path / "profile.npz")
+    save_profile(
+        path,
+        prefill_isl=pre.isl, prefill_ttft_ms=pre.ttft_ms,
+        prefill_tok_s=pre.tok_s,
+        decode_kv_usage=dec.kv_usage, decode_itl_ms=dec.itl_ms,
+        decode_tok_s=dec.tok_s,
+    )
+    pre2 = PrefillInterpolator.from_npz(path)
+    assert pre2.ttft(512) == 60.0
+
+
+# ------------------------------------------------------------ sla mode
+
+
+def make_planner(metrics_seq, mode="sla", **cfg_kw):
+    it = iter(metrics_seq)
+    last = metrics_seq[-1]
+
+    async def sample():
+        try:
+            return next(it)
+        except StopIteration:
+            return last
+
+    pre, dec = _interps()
+    conn = VirtualConnector()
+    planner = Planner(
+        PlannerConfig(mode=mode, **cfg_kw),
+        sample,
+        conn,
+        prefill_interp=pre,
+        decode_interp=dec,
+    )
+    return planner, conn
+
+
+def test_sla_scales_with_demand():
+    # 2 req/s @ isl 512 -> 1024*1.15 tok/s prefill demand vs 12000 cap = 1
+    low = ObservedMetrics(req_per_s=2, avg_isl=512, avg_osl=256, kv_usage=0.5)
+    planner, conn = make_planner([low])
+    d1 = run(planner.step())
+    assert d1.prefill == 1
+    # 40 req/s: prefill demand 23.5k tok/s -> 2+, decode 10240*1.15/6000 -> 2
+    high = ObservedMetrics(req_per_s=40, avg_isl=512, avg_osl=256, kv_usage=0.5)
+    planner2, conn2 = make_planner([high])
+    d2 = run(planner2.step())
+    assert d2.prefill >= 2
+    assert d2.decode >= 2
+    assert conn2.replicas(PREFILL) == d2.prefill
+
+
+def test_sla_correction_factor_reacts_to_slow_ttft():
+    # observed TTFT 4x the profile: correction shrinks per-replica capacity
+    m = ObservedMetrics(
+        req_per_s=20, avg_isl=512, avg_osl=128, ttft_ms=240.0, kv_usage=0.5
+    )
+    planner, conn = make_planner([m, m, m, m])
+
+    async def go():
+        first = await planner.step()
+        for _ in range(3):
+            last = await planner.step()
+        return first, last
+
+    first, last = run(go())
+    assert last.prefill > first.prefill  # degraded reality -> more replicas
+
+
+def test_sla_respects_bounds():
+    huge = ObservedMetrics(req_per_s=10000, avg_isl=2048, avg_osl=512)
+    planner, conn = make_planner([huge], max_prefill=3, max_decode=4)
+    d = run(planner.step())
+    assert d.prefill == 3 and d.decode == 4
+
+
+# ----------------------------------------------------------- load mode
+
+
+def test_load_mode_thresholds():
+    seq = [
+        ObservedMetrics(kv_usage=0.9, queue_depth=6),  # both scale up
+        ObservedMetrics(kv_usage=0.9, queue_depth=6),  # again
+        ObservedMetrics(kv_usage=0.1, queue_depth=0),  # both scale down
+    ]
+    planner, conn = make_planner(seq, mode="load", max_prefill=4, max_decode=4)
+
+    async def go():
+        return [await planner.step() for _ in range(3)]
+
+    d = run(go())
+    assert (d[0].prefill, d[0].decode) == (2, 2)
+    assert (d[1].prefill, d[1].decode) == (3, 3)
+    assert (d[2].prefill, d[2].decode) == (2, 2)
+
+
+# ----------------------------------------------------------- actuation
+
+
+def test_local_process_connector_spawns_and_kills(tmp_path):
+    from dynamo_tpu.planner import LocalProcessConnector
+
+    async def go():
+        conn = LocalProcessConnector(
+            {"decode_worker": ["sleep", "30"]}, grace_s=2.0
+        )
+        await conn.set_replicas("decode_worker", 2)
+        assert conn.replicas("decode_worker") == 2
+        await conn.set_replicas("decode_worker", 1)
+        assert conn.replicas("decode_worker") == 1
+        await conn.close()
+        assert conn.replicas("decode_worker") == 0
+
+    run(go())
