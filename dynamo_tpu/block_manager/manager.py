@@ -7,9 +7,9 @@ dedupe). The device tier (G1) is the engine's paged cache; this manager
 receives blocks the engine extracts on sequence completion and serves them
 back on prefix hits.
 
-Interfaces use blocks-dense numpy arrays `[L, n, bs, Hkv, D]` — exactly what
-ModelRunner.extract_blocks yields and inject_blocks accepts, so engine
-integration is two calls. All bookkeeping is synchronous and cheap; the
+Interfaces use head-major blocks-dense numpy arrays `[L, Hkv, n, bs, D]` —
+exactly what ModelRunner.extract_blocks yields and inject_blocks accepts, so
+engine integration is two calls. All bookkeeping is synchronous and cheap; the
 data copies are numpy slice assignments (host) and single-file IO (disk).
 """
 

@@ -7,8 +7,8 @@ Role-equivalent of the reference's disaggregation stack:
     + the TP-mismatch layout kernel  — lib/llm/src/kernels/block_copy.cu
 
 The TPU design replaces RDMA with mesh-to-mesh array movement: KV blocks are
-extracted from the prefill worker's paged cache as dense [L, n, bs, Hkv, D]
-tensors (a jitted gather), shipped over the fabric (same-host: zero-copy
+extracted from the prefill worker's paged cache as head-major dense
+[L, Hkv, n, bs, D] tensors (a jitted gather), shipped over the fabric (same-host: zero-copy
 numpy; cross-slice: serialized over the TCP response plane; same-pod meshes
 can use jax.device_put directly), and scattered into the decode worker's
 cache at its own block ids (a jitted donate-in-place scatter). Asymmetric

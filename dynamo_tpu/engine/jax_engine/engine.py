@@ -76,6 +76,8 @@ class _Sequence(SequenceState):
         self.request = request
         self.ctx = ctx
         self.pending_remote = False  # admitted, awaiting remote prefill KV
+        self.prefilling = False  # admitted, chunked prefill in progress
+        self.prefill_pos = 0  # tokens already prefilled into the cache
         self.prefix_hashes: list[int] = []  # full-block hash chain
         self.cached_prefix_blocks = 0  # leading blocks found in G2/G3
         self.pending_chain: Optional[TokenBlockSequence] = None  # prebuilt
@@ -121,6 +123,9 @@ class JaxEngine:
         self.allocator = BlockAllocator(self.config.num_blocks)
         self.slots: list[Optional[_Sequence]] = [None] * self.config.max_batch
         self.waiting: list[_Sequence] = []
+        # long prompts being prefilled one chunk at a time; the loop runs
+        # one chunk then a decode step so decode never stalls > one chunk
+        self._prefilling: list[_Sequence] = []
         self._seq_ids = itertools.count(1)
         self._admit_order: list[_Sequence] = []  # for LIFO preemption
         self._loop_task: Optional[asyncio.Task] = None
@@ -267,6 +272,10 @@ class JaxEngine:
             seq.block_ids = []
         if seq in self._admit_order:
             self._admit_order.remove(seq)
+        if seq in self._prefilling:
+            self._prefilling.remove(seq)
+        seq.prefilling = False
+        seq.prefill_pos = 0  # a preempted seq re-prefills from scratch
         if emit_remove:
             self._emit_removed(seq)
 
@@ -362,12 +371,24 @@ class JaxEngine:
             self._reap_cancelled()
             self._process_landed()
             admitted = await self._admit_phase(loop)
+            # one chunk of at most one long prefill per iteration, so the
+            # decode step below never waits longer than one chunk
+            chunked = False
+            if self._prefilling:
+                await self._prefill_chunk_step(loop)
+                chunked = True
             active = [
-                s for s in self.slots if s is not None and not s.pending_remote
+                s
+                for s in self.slots
+                if s is not None and not s.pending_remote and not s.prefilling
             ]
             if not active:
+                if chunked:
+                    self._update_stats()
+                    continue
                 pending = any(
-                    s is not None and s.pending_remote for s in self.slots
+                    s is not None and (s.pending_remote or s.prefilling)
+                    for s in self.slots
                 )
                 if not self.waiting and not pending:
                     self._wake.clear()
@@ -436,6 +457,14 @@ class JaxEngine:
                 continue
             # re-admission after preemption replays generated tokens too
             replay = seq.token_ids
+            chunk_c = getattr(self.runner, "prefill_chunk_tokens", 0)
+            if chunk_c and len(replay) > chunk_c:
+                # long prompt: prefill one chunk per loop iteration so the
+                # in-flight decode batch never stalls more than one chunk
+                seq.prefilling = True
+                seq.prefill_pos = 0
+                self._prefilling.append(seq)
+                continue
             async with self._device_lock:
                 tok_arr = await loop.run_in_executor(
                     None,
@@ -458,6 +487,39 @@ class JaxEngine:
             self._emit_stored(seq)
             self._append_token(seq, token)
         return admitted
+
+    async def _prefill_chunk_step(self, loop) -> None:
+        """Run ONE chunk of the oldest in-progress chunked prefill."""
+        seq = self._prefilling[0]
+        if seq.slot is None:  # freed while queued
+            if seq in self._prefilling:
+                self._prefilling.remove(seq)
+            return
+        c = self.runner.prefill_chunk_tokens
+        start = seq.prefill_pos
+        total = len(seq.token_ids)
+        chunk = seq.token_ids[start : start + c]
+        async with self._device_lock:
+            tok_arr = await loop.run_in_executor(
+                None,
+                lambda: np.asarray(
+                    self.runner.prefill_chunk(
+                        chunk, start, total, seq.block_ids,
+                        seq.temperature, seq.top_p, seq.top_k,
+                    )
+                ),
+            )
+        if seq.slot is None:  # cancelled during the device call
+            return
+        seq.prefill_pos = min(start + c, total)
+        if seq.prefill_pos >= total:
+            self._prefilling.remove(seq)
+            seq.prefilling = False
+            seq.hash_seq = seq.pending_chain or TokenBlockSequence(
+                list(seq.token_ids), self.config.block_size
+            )
+            self._emit_stored(seq)
+            self._append_token(seq, int(tok_arr))
 
     def _process_landed(self) -> None:
         """Complete landed remote prefills on the engine loop (serialized

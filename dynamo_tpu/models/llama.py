@@ -24,7 +24,9 @@ import jax.numpy as jnp
 
 from dynamo_tpu.ops.attention import (
     causal_prefill_attention,
+    chunked_prefill_attention,
     paged_decode_attention,
+    write_chunk_kv,
     write_decode_kv,
     write_prefill_kv,
 )
@@ -214,22 +216,24 @@ def _qkv(x, layer, cfg, inv_freqs, positions):
     return q, k, v
 
 
-def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_cache_l, block_table):
+def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_cache_l, block_table, mesh=None, head_axis=None):
     P = x.shape[0]
     q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
     k_cache_l, v_cache_l = write_prefill_kv(k_cache_l, v_cache_l, k, v, block_table)
-    attn = causal_prefill_attention(q, k, v, valid_len, impl=cfg.attn_impl)
+    attn = causal_prefill_attention(
+        q, k, v, valid_len, impl=cfg.attn_impl, mesh=mesh, head_axis=head_axis
+    )
     out = linear(attn.reshape(P, cfg.q_dim), layer["wo"])
     return x + out, k_cache_l, v_cache_l
 
 
-def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, block_tables, slot_indices):
+def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, block_tables, slot_indices, mesh=None, head_axis=None):
     B = x.shape[0]
     q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
     k_cache_l, v_cache_l = write_decode_kv(k_cache_l, v_cache_l, k, v, slot_indices)
     attn = paged_decode_attention(
         q, k_cache_l, v_cache_l, block_tables, positions + 1,
-        impl=cfg.attn_impl,
+        impl=cfg.attn_impl, mesh=mesh, head_axis=head_axis,
     )
     out = linear(attn.reshape(B, cfg.q_dim), layer["wo"])
     return x + out, k_cache_l, v_cache_l
@@ -266,6 +270,9 @@ def prefill(
     k_cache: jax.Array,  # [L, Hkv, num_blocks, block_size, D]
     v_cache: jax.Array,
     block_table: jax.Array,  # [P // block_size] int32
+    *,
+    mesh=None,  # with attn_head_axis: run pallas attention under shard_map
+    attn_head_axis=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process a prompt; returns (last_token_logits [V], k_cache, v_cache)."""
     inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
@@ -275,11 +282,50 @@ def prefill(
         x, kc, vc = _attn_prefill(
             x, layer, cfg, inv_freqs, positions, valid_len,
             k_cache[i], v_cache[i], block_table,
+            mesh=mesh, head_axis=attn_head_axis,
         )
         k_cache = k_cache.at[i].set(kc)
         v_cache = v_cache.at[i].set(vc)
         x = _mlp(x, layer, cfg)
     logits = _logits(x[valid_len - 1][None, :], params, cfg)[0]
+    return logits, k_cache, v_cache
+
+
+def prefill_chunk(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [C] int32 — one chunk (C = fixed chunk size)
+    chunk_start: jax.Array,  # scalar int32 — position of tokens[0]
+    valid_len: jax.Array,  # scalar int32 — TOTAL prompt length
+    k_cache: jax.Array,  # [L, Hkv, num_blocks, block_size, D]
+    v_cache: jax.Array,
+    block_table: jax.Array,  # [max_nb] int32 — the whole prompt's blocks
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One chunk of a chunked prefill (vLLM-style; the reference's engines
+    chunk prefill and its mocker models it — mocker/scheduler.rs:28-43).
+
+    Chunks are processed in order; each writes its K/V into the paged cache
+    then attends over everything written so far. ONE compiled program
+    serves every chunk of every prompt (C and the table width are static;
+    chunk_start/valid_len are dynamic scalars). Returns (last-valid-token
+    logits [V], caches) — logits are meaningful only on the final chunk.
+    """
+    C = tokens.shape[0]
+    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    positions = chunk_start + jnp.arange(C, dtype=jnp.int32)
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    for i, layer in enumerate(params["layers"]):
+        q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
+        kc, vc = write_chunk_kv(
+            k_cache[i], v_cache[i], k, v, block_table, chunk_start
+        )
+        attn = chunked_prefill_attention(q, kc, vc, block_table, chunk_start)
+        x = x + linear(attn.reshape(C, cfg.q_dim), layer["wo"])
+        x = _mlp(x, layer, cfg)
+        k_cache = k_cache.at[i].set(kc)
+        v_cache = v_cache.at[i].set(vc)
+    idx = jnp.clip(valid_len - 1 - chunk_start, 0, C - 1)
+    logits = _logits(x[idx][None, :], params, cfg)[0]
     return logits, k_cache, v_cache
 
 
@@ -344,6 +390,9 @@ def decode(
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, max_blocks] int32
     slot_indices: jax.Array,  # [B] int32 flat cache slots for the new token
+    *,
+    mesh=None,  # with attn_head_axis: run pallas attention under shard_map
+    attn_head_axis=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for a batch; returns (logits [B, V], caches)."""
     inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
@@ -352,6 +401,7 @@ def decode(
         x, kc, vc = _attn_decode(
             x, layer, cfg, inv_freqs, positions,
             k_cache[i], v_cache[i], block_tables, slot_indices,
+            mesh=mesh, head_axis=attn_head_axis,
         )
         k_cache = k_cache.at[i].set(kc)
         v_cache = v_cache.at[i].set(vc)

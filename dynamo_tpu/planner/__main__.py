@@ -83,6 +83,15 @@ def main() -> None:
         if args.profile:
             pre = PrefillInterpolator.from_npz(args.profile)
             dec = DecodeInterpolator.from_npz(args.profile)
+        elif args.mode == "sla":
+            # SLA mode without interpolators silently holds replica counts
+            # (planner_core falls back to connector.replicas) — refuse the
+            # foot-gun instead of appearing to run (ADVICE r1).
+            ap.error(
+                "--mode sla requires --profile <npz> (profiler output); "
+                "without it the planner would never scale. Use --mode load "
+                "or supply a profile."
+            )
         planner = Planner(
             PlannerConfig(
                 mode=args.mode,
