@@ -36,7 +36,26 @@ async def build_jax_engine(
     num_blocks: Optional[int] = None,
     quantize: Optional[bool] = None,
     rng_seed: int = 0,
-) -> tuple[JaxEngine, ModelDeploymentCard]:
+    multinode: Optional[object] = None,  # parallel.multihost.MultiNodeConfig
+    fabric: Optional[object] = None,  # FabricClient for rendezvous
+    lease_id: int = 0,
+) -> tuple[object, ModelDeploymentCard]:
+    """Build the serving engine. Single-host: returns (JaxEngine, mdc).
+
+    Multi-host (multinode.num_nodes > 1): rendezvous over the fabric
+    barrier, `jax.distributed.initialize`, build the mesh over the GLOBAL
+    device set, and wrap the runner in the SPMD step channel. The leader
+    gets the (JaxEngine, mdc) as usual — its engine loop drives every
+    host. Followers get a (FollowerHandle, mdc); call .serve() to replay
+    the leader's device calls. Mirrors the reference's MultiNodeConfig +
+    etcd barrier bring-up (lib/llm/src/engines.rs:43,
+    leader_worker_barrier.rs:137).
+    """
+    is_multihost = multinode is not None and multinode.num_nodes > 1
+    if is_multihost:
+        from dynamo_tpu.parallel.multihost import rendezvous_and_initialize
+
+        await rendezvous_and_initialize(multinode, fabric, lease_id)
     config = LlamaConfig.from_model_dir(model_path)
     max_len = min(
         context_length or config.max_position_embeddings,
@@ -59,16 +78,24 @@ async def build_jax_engine(
         tensor_parallel_size > 1
         or context_parallel_size > 1
         or expert_parallel_size > 1
+        or is_multihost
     ):
         from dynamo_tpu.parallel.mesh import build_mesh
-        from dynamo_tpu.parallel.sharding import shard_llama
+        from dynamo_tpu.parallel.sharding import (
+            put_global,
+            put_local,
+            shard_llama,
+        )
 
         mesh = build_mesh(
             tp=tensor_parallel_size,
             sp=context_parallel_size,
             ep=expert_parallel_size,
         )
-        params, kv_sharding = shard_llama(mesh, config, params)
+        params, kv_sharding = shard_llama(
+            mesh, config, params,
+            put=put_global if is_multihost else put_local,
+        )
     runner = ModelRunner(
         config,
         params,
@@ -79,7 +106,25 @@ async def build_jax_engine(
         rng_seed=rng_seed,
         mesh=mesh,
         kv_sharding=kv_sharding,
+        global_arrays=is_multihost,
     )
+    mdc = ModelDeploymentCard.from_model_dir(
+        model_path,
+        name or os.path.basename(os.path.normpath(model_path)),
+        kv_block_size=kv_block_size,
+        context_length=max_len,
+    )
+    if is_multihost:
+        from dynamo_tpu.parallel.multihost import (
+            FollowerHandle,
+            SpmdModelRunner,
+            SpmdStepChannel,
+        )
+
+        channel = SpmdStepChannel(is_leader=multinode.is_leader)
+        if not multinode.is_leader:
+            return FollowerHandle(runner, channel), mdc
+        runner = SpmdModelRunner(runner, channel)
     engine = JaxEngine(
         runner,
         JaxEngineConfig(
@@ -89,12 +134,6 @@ async def build_jax_engine(
             max_model_len=max_len,
             rng_seed=rng_seed,
         ),
-    )
-    mdc = ModelDeploymentCard.from_model_dir(
-        model_path,
-        name or os.path.basename(os.path.normpath(model_path)),
-        kv_block_size=kv_block_size,
-        context_length=max_len,
     )
     return engine, mdc
 

@@ -64,7 +64,13 @@ class ModelRunner:
         attn_impl: str = "auto",
         cp_min_tokens: int = 512,
         prefill_chunk_tokens: int = 512,
+        global_arrays: bool = False,
     ) -> None:
+        # global_arrays: multi-controller mode (mesh spans hosts after
+        # jax.distributed.initialize). Host inputs are committed as
+        # fully-replicated GLOBAL arrays, scalar/token outputs are pinned
+        # to a replicated sharding so every process can read its local
+        # copy, and extract outputs are all-gathered before fetch.
         # "auto": flash pallas kernels on TPU — single-chip directly, under
         # a mesh via a shard_map wrapper over the head-sharded cache (each
         # tp shard's kernel streams only its own heads' pages; round-1
@@ -112,13 +118,21 @@ class ModelRunner:
             block_size,
             config.head_dim,
         )
+        self.global_arrays = global_arrays
+        self._repl = (
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+            if (mesh is not None and global_arrays)
+            else None
+        )
         if kv_sharding is not None:
-            self.k_cache = jax.device_put(
-                jnp.zeros(cache_shape, kv_dtype), kv_sharding
+            # allocate ON device under the sharding (works single- and
+            # multi-controller; never materializes host zeros)
+            make_zeros = jax.jit(
+                lambda: jnp.zeros(cache_shape, kv_dtype),
+                out_shardings=kv_sharding,
             )
-            self.v_cache = jax.device_put(
-                jnp.zeros(cache_shape, kv_dtype), kv_sharding
-            )
+            self.k_cache = make_zeros()
+            self.v_cache = make_zeros()
         else:
             self.k_cache = jnp.zeros(cache_shape, kv_dtype)
             self.v_cache = jnp.zeros(cache_shape, kv_dtype)
@@ -132,18 +146,25 @@ class ModelRunner:
         self._kv_sharding = kv_sharding
         # Pin cache output shardings when running sharded: XLA would
         # otherwise be free to re-propagate (e.g. shard head_dim instead of
-        # heads), breaking the megatron layout on the next step.
+        # heads), breaking the megatron layout on the next step. Under
+        # multi-controller, the token output is pinned replicated so each
+        # process holds a full local copy to fetch.
         cache_out = (
-            (None, kv_sharding, kv_sharding) if kv_sharding is not None else None
+            (self._repl, kv_sharding, kv_sharding)
+            if kv_sharding is not None
+            else None
         )
         jit_kwargs: dict[str, Any] = {}
         if cache_out is not None:
             jit_kwargs["out_shardings"] = cache_out
-        # one jitted callable each; jit's shape cache handles the buckets
+        # one jitted callable each; jit's shape cache handles the buckets.
+        # The FULL mesh rides along (MoE dispatch-path selection in _mlp
+        # keys on its ep size); attention shard_maps only when head_axis
+        # is set.
         self._prefill_jit = jax.jit(
             functools.partial(
                 self._prefill_impl, self.config,
-                self._attn_mesh, self._attn_head_axis,
+                self.mesh, self._attn_head_axis,
             ),
             donate_argnums=(1, 2),  # k_cache, v_cache
             **jit_kwargs,
@@ -171,7 +192,7 @@ class ModelRunner:
         self._decode_fn = jax.jit(
             functools.partial(
                 self._decode_impl, self.config,
-                self._attn_mesh, self._attn_head_axis,
+                self.mesh, self._attn_head_axis,
             ),
             donate_argnums=(1, 2),  # k_cache, v_cache
             **jit_kwargs,
@@ -188,15 +209,24 @@ class ModelRunner:
             prefill_chunk_tokens, self.prefill_buckets[-1]
         )
         self._chunk_jit = jax.jit(
-            functools.partial(self._prefill_chunk_impl, self.config),
+            functools.partial(
+                self._prefill_chunk_impl, self.config, self.mesh
+            ),
             donate_argnums=(1, 2),  # k_cache, v_cache
             **jit_kwargs,
         )
         # Disagg KV movement (NIXL/block_copy.cu replacement): gather whole
         # blocks out of the paged cache / scatter received blocks in. Block
-        # counts are padded to bucket sizes so each compiles once per bucket.
+        # counts are padded to bucket sizes so each compiles once per
+        # bucket. Under multi-controller the gathered blocks are pinned
+        # replicated (an all-gather) so every process can fetch them.
         self._extract_jit = jax.jit(
-            lambda k, v, ids: (k[:, :, ids], v[:, :, ids])
+            lambda k, v, ids: (k[:, :, ids], v[:, :, ids]),
+            **(
+                {"out_shardings": (self._repl, self._repl)}
+                if self._repl is not None
+                else {}
+            ),
         )
         self._inject_jit = jax.jit(
             lambda k, v, ids, kb, vb: (
@@ -250,12 +280,12 @@ class ModelRunner:
 
     @staticmethod
     def _prefill_chunk_impl(
-        cfg, params, k_cache, v_cache, tokens, chunk_start, valid_len,
+        cfg, mesh, params, k_cache, v_cache, tokens, chunk_start, valid_len,
         block_table, key, temp, top_p, top_k,
     ):
         logits, k_cache, v_cache = llama.prefill_chunk(
             params, cfg, tokens, chunk_start, valid_len,
-            k_cache, v_cache, block_table,
+            k_cache, v_cache, block_table, mesh=mesh,
         )
         tok = sample_tokens(
             logits[None, :], key, temp[None], top_p[None], top_k[None]
@@ -278,7 +308,27 @@ class ModelRunner:
 
     def _next_key(self) -> jax.Array:
         self._step_counter += 1
-        return jax.random.fold_in(self._base_key, self._step_counter)
+        key = jax.random.fold_in(self._base_key, self._step_counter)
+        # multi-controller: every process derives the identical key (the
+        # follower replays calls in order, keeping step counters in sync)
+        return self._to_dev(np.asarray(key)) if self._repl else key
+
+    def _to_dev(self, a) -> jax.Array:
+        """Commit a host input: local array normally; fully-replicated
+        GLOBAL array under multi-controller (all processes pass the same
+        value — the SPMD step channel guarantees it)."""
+        if self._repl is not None:
+            a = np.asarray(a)
+            return jax.make_array_from_process_local_data(
+                self._repl, a, global_shape=a.shape
+            )
+        return jnp.asarray(a)
+
+    def _fetch(self, x) -> np.ndarray:
+        """Host-side read of a (replicated) device result."""
+        if self._repl is not None:
+            return np.asarray(x.addressable_data(0))
+        return np.asarray(jax.device_get(x))
 
     # -------------------------------------------------------------- calls
 
@@ -321,9 +371,10 @@ class ModelRunner:
         )
         tok, self.k_cache, self.v_cache = prefill_fn(
             self.params, self.k_cache, self.v_cache,
-            jnp.asarray(tokens), jnp.int32(T), jnp.asarray(table),
-            self._next_key(),
-            jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
+            self._to_dev(tokens), self._to_dev(np.int32(T)),
+            self._to_dev(table), self._next_key(),
+            self._to_dev(np.float32(temperature)),
+            self._to_dev(np.float32(top_p)), self._to_dev(np.int32(top_k)),
         )
         return tok
 
@@ -354,9 +405,11 @@ class ModelRunner:
         table[: len(block_ids)] = block_ids
         tok, self.k_cache, self.v_cache = self._chunk_jit(
             self.params, self.k_cache, self.v_cache,
-            jnp.asarray(tokens), jnp.int32(chunk_start), jnp.int32(total_len),
-            jnp.asarray(table), self._next_key(),
-            jnp.float32(temperature), jnp.float32(top_p), jnp.int32(top_k),
+            self._to_dev(tokens), self._to_dev(np.int32(chunk_start)),
+            self._to_dev(np.int32(total_len)),
+            self._to_dev(table), self._next_key(),
+            self._to_dev(np.float32(temperature)),
+            self._to_dev(np.float32(top_p)), self._to_dev(np.int32(top_k)),
         )
         return tok
 
@@ -380,11 +433,10 @@ class ModelRunner:
         padded = self._pad_block_count(n)
         ids = np.zeros(padded, np.int32)
         ids[:n] = block_ids
-        k, v = self._extract_jit(self.k_cache, self.v_cache, jnp.asarray(ids))
-        return (
-            np.asarray(jax.device_get(k))[:, :, :n],
-            np.asarray(jax.device_get(v))[:, :, :n],
+        k, v = self._extract_jit(
+            self.k_cache, self.v_cache, self._to_dev(ids)
         )
+        return self._fetch(k)[:, :, :n], self._fetch(v)[:, :, :n]
 
     def inject_blocks(
         self, block_ids: list[int], k_blocks: np.ndarray, v_blocks: np.ndarray
@@ -407,9 +459,9 @@ class ModelRunner:
         self.k_cache, self.v_cache = self._inject_jit(
             self.k_cache,
             self.v_cache,
-            jnp.asarray(ids),
-            jnp.asarray(k_blocks),
-            jnp.asarray(v_blocks),
+            self._to_dev(ids),
+            self._to_dev(k_blocks),
+            self._to_dev(v_blocks),
         )
 
     def decode(
@@ -424,9 +476,9 @@ class ModelRunner:
     ) -> jax.Array:
         toks, self.k_cache, self.v_cache = self._decode_fn(
             self.params, self.k_cache, self.v_cache,
-            jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(block_tables), jnp.asarray(slot_indices),
+            self._to_dev(tokens), self._to_dev(positions),
+            self._to_dev(block_tables), self._to_dev(slot_indices),
             self._next_key(),
-            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
+            self._to_dev(temps), self._to_dev(top_ps), self._to_dev(top_ks),
         )
         return toks

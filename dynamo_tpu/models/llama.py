@@ -57,6 +57,9 @@ class LlamaConfig:
     num_experts: int = 0
     num_experts_per_tok: int = 2
     moe_capacity_factor: float = 1.25
+    # None -> regime-based (a2a / psum / dropless, see _mlp);
+    # "gshard" -> force the capacity-bucketed GSPMD einsum dispatch
+    moe_impl: Optional[str] = None
 
     @classmethod
     def from_hf_dict(cls, d: dict[str, Any]) -> "LlamaConfig":
@@ -239,16 +242,45 @@ def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, bloc
     return x + out, k_cache_l, v_cache_l
 
 
-def _mlp(x, layer, cfg):
+def _mlp(x, layer, cfg, mesh=None):
     h = rms_norm(x, layer["mlp_norm"], cfg.rms_eps)
     if "router" in layer:
-        from dynamo_tpu.ops.moe import moe_ffn
-
-        return x + moe_ffn(
-            h, layer["router"], layer["wg"], layer["wu"], layer["wd"],
-            top_k=cfg.num_experts_per_tok,
-            capacity_factor=cfg.moe_capacity_factor,
+        from dynamo_tpu.ops.moe import (
+            moe_ffn,
+            moe_ffn_dropless,
+            moe_ffn_ep_a2a,
+            moe_ffn_shard_map,
         )
+
+        T = x.shape[0]
+        args = (
+            h, layer["router"], layer["wg"], layer["wu"], layer["wd"],
+        )
+        k = cfg.num_experts_per_tok
+        if mesh is not None and mesh.shape.get("ep", 1) > 1:
+            ep = mesh.shape["ep"]
+            tp_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+            if T % ep == 0 and T >= 4 * ep:
+                # prefill-size batches: token-sharded all-to-all dispatch
+                y = moe_ffn_ep_a2a(
+                    mesh, *args, top_k=k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    tp_axis=tp_axis,
+                )
+            else:
+                # decode-size batches: replicated-token psum (dropless)
+                y = moe_ffn_shard_map(mesh, *args, top_k=k)
+        elif cfg.moe_impl == "gshard":
+            # explicit opt-in to the capacity-bucketed GSPMD einsum path
+            # (params GSPMD-ep-sharded without an explicit mesh in hand)
+            y = moe_ffn(
+                *args, top_k=k, capacity_factor=cfg.moe_capacity_factor
+            )
+        else:
+            # single chip / pure-TP mesh: dropless grouped-GEMM (exact
+            # serving semantics); GSPMD shards the FFN feature dim over tp
+            y = moe_ffn_dropless(*args, top_k=k)
+        return x + y
     gate = linear(h, layer["wg"])
     up = linear(h, layer["wu"])
     return x + linear(swiglu(gate, up), layer["wd"])
@@ -286,7 +318,7 @@ def prefill(
         )
         k_cache = k_cache.at[i].set(kc)
         v_cache = v_cache.at[i].set(vc)
-        x = _mlp(x, layer, cfg)
+        x = _mlp(x, layer, cfg, mesh)
     logits = _logits(x[valid_len - 1][None, :], params, cfg)[0]
     return logits, k_cache, v_cache
 
@@ -300,6 +332,8 @@ def prefill_chunk(
     k_cache: jax.Array,  # [L, Hkv, num_blocks, block_size, D]
     v_cache: jax.Array,
     block_table: jax.Array,  # [max_nb] int32 — the whole prompt's blocks
+    *,
+    mesh=None,  # for MoE dispatch-path selection in _mlp
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One chunk of a chunked prefill (vLLM-style; the reference's engines
     chunk prefill and its mocker models it — mocker/scheduler.rs:28-43).
@@ -321,7 +355,7 @@ def prefill_chunk(
         )
         attn = chunked_prefill_attention(q, kc, vc, block_table, chunk_start)
         x = x + linear(attn.reshape(C, cfg.q_dim), layer["wo"])
-        x = _mlp(x, layer, cfg)
+        x = _mlp(x, layer, cfg, mesh)
         k_cache = k_cache.at[i].set(kc)
         v_cache = v_cache.at[i].set(vc)
     idx = jnp.clip(valid_len - 1 - chunk_start, 0, C - 1)
@@ -367,7 +401,7 @@ def prefill_context_parallel(
             mesh, q, k, v, valid_len, head_axis=head_axis
         )
         x = x + linear(attn.reshape(P_len, cfg.q_dim), layer["wo"])
-        x = _mlp(x, layer, cfg)
+        x = _mlp(x, layer, cfg, mesh)
         if paginate:
             kc, vc = write_prefill_kv(k_cache[i], v_cache[i], k, v, block_table)
             k_cache = k_cache.at[i].set(kc)
@@ -405,5 +439,5 @@ def decode(
         )
         k_cache = k_cache.at[i].set(kc)
         v_cache = v_cache.at[i].set(vc)
-        x = _mlp(x, layer, cfg)
+        x = _mlp(x, layer, cfg, mesh)
     return _logits(x, params, cfg), k_cache, v_cache

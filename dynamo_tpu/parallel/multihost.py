@@ -1,0 +1,385 @@
+"""Multi-host engine bring-up: fabric rendezvous + jax.distributed + the
+leader/follower SPMD step protocol.
+
+Role-equivalent of the reference's multi-node engine plumbing:
+  * `MultiNodeConfig {num_nodes, node_rank, leader_addr}` mirrors
+    lib/llm/src/engines.rs:43;
+  * rendezvous rides the fabric LeaderBarrier/WorkerBarrier
+    (runtime/barrier.py), the same etcd-barrier pattern as
+    lib/runtime/src/utils/leader_worker_barrier.rs:137,230;
+  * after rendezvous every process calls `jax.distributed.initialize`, so
+    `jax.devices()` spans the slice and one `Mesh` covers all hosts —
+    collectives ride ICI/DCN, exactly how a v5e-16 (4 hosts x 4 chips)
+    runs one engine.
+
+Multi-controller discipline: JAX requires every process to issue the SAME
+program order. The asyncio engine loop is inherently dynamic, so only the
+leader (process 0) runs it; followers run `follower_loop`, which receives
+each device call's host-side inputs via a broadcast and replays it. The
+broadcast is `multihost_utils.broadcast_one_to_all` — a device all-gather
+under the hood, so step metadata moves over ICI with the step itself, not
+over a side TCP channel. Wire format: a fixed [8] int32 header (opcode +
+shape info) followed by one payload pytree whose structure is derivable
+from the header on every rank.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.parallel.multihost")
+
+_BARRIER_ID = "engine-bringup"
+
+# opcodes for the leader -> follower step broadcast
+OP_DECODE = 1
+OP_PREFILL = 2
+OP_CHUNK = 3
+OP_EXTRACT = 4
+OP_INJECT = 5
+OP_STOP = 0
+
+
+@dataclass
+class MultiNodeConfig:
+    """Mirrors the reference's MultiNodeConfig (engines.rs:43)."""
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    leader_addr: Optional[str] = None  # host:port of the jax coordinator
+
+    @classmethod
+    def from_env(cls) -> "MultiNodeConfig":
+        return cls(
+            num_nodes=int(os.environ.get("DYN_NUM_NODES", "1")),
+            node_rank=int(os.environ.get("DYN_NODE_RANK", "0")),
+            leader_addr=os.environ.get("DYN_LEADER_ADDR") or None,
+        )
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node_rank == 0
+
+
+def _local_ip() -> str:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect(("8.8.8.8", 80))  # no packets sent; picks the route
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def rendezvous_and_initialize(
+    cfg: MultiNodeConfig,
+    fabric: Optional[Any] = None,
+    lease_id: int = 0,
+    *,
+    barrier_id: str = _BARRIER_ID,
+    timeout: float = 120.0,
+) -> None:
+    """Bring this process into the multi-host slice.
+
+    Leader: pick/publish the coordinator address through the fabric
+    barrier, wait for every worker to check in, then initialize. Worker:
+    read the address, check in, initialize (the connect retries until the
+    leader's coordinator is up). Without a fabric, `leader_addr` must be
+    preconfigured on every node (static mode, like the reference's
+    sglang --dist-init-addr).
+    """
+    import jax
+
+    if cfg.num_nodes <= 1:
+        return
+    addr = cfg.leader_addr
+    if fabric is not None:
+        from dynamo_tpu.runtime.barrier import LeaderBarrier, WorkerBarrier
+
+        if cfg.is_leader:
+            addr = addr or f"{_local_ip()}:{_free_port()}"
+            barrier = LeaderBarrier(
+                barrier_id, cfg.num_nodes - 1, timeout=timeout
+            )
+            await barrier.sync(fabric, lease_id, {"coordinator": addr})
+        else:
+            barrier = WorkerBarrier(
+                barrier_id, f"node-{cfg.node_rank}", timeout=timeout
+            )
+            data = await barrier.sync(fabric, lease_id)
+            addr = data["coordinator"]
+    if not addr:
+        raise ValueError(
+            "multi-node bring-up needs a leader_addr (DYN_LEADER_ADDR) "
+            "or a fabric for rendezvous"
+        )
+    logger.info(
+        "jax.distributed.initialize: node %d/%d, coordinator %s",
+        cfg.node_rank, cfg.num_nodes, addr,
+    )
+    loop = asyncio.get_running_loop()
+    await loop.run_in_executor(
+        None,
+        lambda: jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=cfg.num_nodes,
+            process_id=cfg.node_rank,
+        ),
+    )
+
+
+# ------------------------------------------------------ SPMD step protocol
+
+
+def _broadcast(pytree, is_source: bool):
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(pytree, is_source=is_source)
+
+
+class SpmdStepChannel:
+    """Leader->follower replay channel for ModelRunner device calls.
+
+    Every runner call the leader makes is mirrored on every follower in
+    the same order with identical host inputs, so the jitted SPMD
+    programs launch collectively. Payload shapes ride in the header so
+    followers can mirror the broadcast's pytree structure.
+    """
+
+    def __init__(self, is_leader: bool):
+        self.is_leader = is_leader
+
+    # ---- leader side
+
+    def send(self, op: int, dims: list[int], payload: tuple) -> tuple:
+        header = np.zeros(8, np.int32)
+        header[0] = op
+        header[1 : 1 + len(dims)] = dims
+        _broadcast(header, is_source=self.is_leader)
+        if payload:
+            payload = _broadcast(tuple(payload), is_source=self.is_leader)
+        return payload
+
+    # ---- follower side
+
+    def recv_header(self) -> np.ndarray:
+        return np.asarray(_broadcast(np.zeros(8, np.int32), is_source=False))
+
+    def recv_payload(self, template: tuple) -> tuple:
+        return _broadcast(tuple(template), is_source=False)
+
+
+class SpmdModelRunner:
+    """Wraps a ModelRunner so its device calls replay on every host.
+
+    Leader processes call the usual runner surface; each call first
+    broadcasts (opcode, host inputs) over the step channel, then runs the
+    SPMD program — which followers, having received the same inputs, are
+    launching simultaneously from `follower_loop`. The wrapped runner's
+    params/caches must be GLOBAL arrays (built under the global mesh), so
+    every launch is one collective program over the slice.
+    """
+
+    def __init__(self, runner, channel: SpmdStepChannel):
+        self._runner = runner
+        self._channel = channel
+
+    def __getattr__(self, name):  # delegate everything not intercepted
+        return getattr(self._runner, name)
+
+    # -- intercepted calls (must match follower_loop's dispatch table) --
+
+    def prefill(self, token_ids, block_ids, temperature, top_p, top_k):
+        t = np.asarray(token_ids, np.int32)
+        b = np.asarray(block_ids, np.int32)
+        self._channel.send(
+            OP_PREFILL,
+            [len(t), len(b)],
+            (t, b, np.float32(temperature), np.float32(top_p), np.int32(top_k)),
+        )
+        return self._runner._fetch(
+            self._runner.prefill(
+                list(token_ids), list(block_ids), temperature, top_p, top_k
+            )
+        )
+
+    def prefill_chunk(
+        self, token_chunk, chunk_start, total_len, block_ids, temperature,
+        top_p, top_k,
+    ):
+        t = np.asarray(token_chunk, np.int32)
+        b = np.asarray(block_ids, np.int32)
+        self._channel.send(
+            OP_CHUNK,
+            [len(t), len(b), int(chunk_start), int(total_len)],
+            (t, b, np.float32(temperature), np.float32(top_p), np.int32(top_k)),
+        )
+        return self._runner._fetch(
+            self._runner.prefill_chunk(
+                list(token_chunk), int(chunk_start), int(total_len),
+                list(block_ids), temperature, top_p, top_k,
+            )
+        )
+
+    def decode(self, tokens, positions, block_tables, slot_indices, temps,
+               top_ps, top_ks):
+        self._channel.send(
+            OP_DECODE,
+            [tokens.shape[0], block_tables.shape[1]],
+            (
+                np.asarray(tokens, np.int32),
+                np.asarray(positions, np.int32),
+                np.asarray(block_tables, np.int32),
+                np.asarray(slot_indices, np.int32),
+                np.asarray(temps, np.float32),
+                np.asarray(top_ps, np.float32),
+                np.asarray(top_ks, np.int32),
+            ),
+        )
+        return self._runner._fetch(
+            self._runner.decode(
+                tokens, positions, block_tables, slot_indices, temps,
+                top_ps, top_ks,
+            )
+        )
+
+    def extract_blocks(self, block_ids):
+        b = np.asarray(block_ids, np.int32)
+        self._channel.send(OP_EXTRACT, [len(b)], (b,))
+        return self._runner.extract_blocks(list(block_ids))
+
+    def inject_blocks(self, block_ids, k_blocks, v_blocks):
+        b = np.asarray(block_ids, np.int32)
+        k = np.asarray(k_blocks)
+        # bf16 can't ride numpy broadcasts; reinterpret as uint16 (the same
+        # trick the disagg wire uses — disagg/transfer.to_wire_array)
+        if k.dtype.name == "bfloat16":
+            k = k.view(np.uint16)
+            v = np.asarray(v_blocks).view(np.uint16)
+            dt_code = 2
+        else:
+            v = np.asarray(v_blocks)
+            dt_code = {"float16": 0, "float32": 1}.get(k.dtype.name, 1)
+            k = k.astype(_DT[dt_code])
+            v = v.astype(_DT[dt_code])
+        self._channel.send(
+            OP_INJECT, [len(b), k.shape[2], dt_code], (b, k, v)
+        )
+        return self._runner.inject_blocks(list(block_ids), k_blocks, v_blocks)
+
+    def stop_followers(self) -> None:
+        self._channel.send(OP_STOP, [], ())
+
+
+class FollowerHandle:
+    """What a non-leader process gets instead of an engine: call serve()
+    (blocking) to replay the leader's device calls until shutdown."""
+
+    def __init__(self, runner, channel: SpmdStepChannel):
+        self.runner = runner
+        self.channel = channel
+
+    def serve(self) -> None:
+        follower_loop(self.runner, self.channel)
+
+    async def serve_async(self) -> None:
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.serve)
+
+
+_DT = {0: np.float16, 1: np.float32, 2: np.uint16}  # 2 = bf16-as-bits
+
+
+def follower_loop(runner, channel: SpmdStepChannel) -> None:
+    """Run on every non-leader process: replay the leader's device calls
+    until OP_STOP. Blocking (call from a plain thread/process main)."""
+    L = runner.config.num_layers
+    Hkv = runner.config.num_kv_heads
+    Dh = runner.config.head_dim
+    bs = runner.block_size
+    while True:
+        h = channel.recv_header()
+        op = int(h[0])
+        if op == OP_STOP:
+            return
+        if op == OP_DECODE:
+            B, nb = int(h[1]), int(h[2])
+            (tok, pos, bt, slot, te, tp_, tk) = channel.recv_payload(
+                (
+                    np.zeros(B, np.int32), np.zeros(B, np.int32),
+                    np.zeros((B, nb), np.int32), np.zeros(B, np.int32),
+                    np.zeros(B, np.float32), np.zeros(B, np.float32),
+                    np.zeros(B, np.int32),
+                )
+            )
+            runner.decode(
+                np.asarray(tok), np.asarray(pos), np.asarray(bt),
+                np.asarray(slot), np.asarray(te), np.asarray(tp_),
+                np.asarray(tk),
+            )
+        elif op == OP_PREFILL:
+            T, nb = int(h[1]), int(h[2])
+            (t, b, te, tp_, tk) = channel.recv_payload(
+                (
+                    np.zeros(T, np.int32), np.zeros(nb, np.int32),
+                    np.float32(0), np.float32(0), np.int32(0),
+                )
+            )
+            runner.prefill(
+                np.asarray(t).tolist(), np.asarray(b).tolist(),
+                float(te), float(tp_), int(tk),
+            )
+        elif op == OP_CHUNK:
+            T, nb, start, total = int(h[1]), int(h[2]), int(h[3]), int(h[4])
+            (t, b, te, tp_, tk) = channel.recv_payload(
+                (
+                    np.zeros(T, np.int32), np.zeros(nb, np.int32),
+                    np.float32(0), np.float32(0), np.int32(0),
+                )
+            )
+            runner.prefill_chunk(
+                np.asarray(t).tolist(), start, total,
+                np.asarray(b).tolist(), float(te), float(tp_), int(tk),
+            )
+        elif op == OP_EXTRACT:
+            n = int(h[1])
+            (b,) = channel.recv_payload((np.zeros(n, np.int32),))
+            runner.extract_blocks(np.asarray(b).tolist())
+        elif op == OP_INJECT:
+            n, ship, dt_code = int(h[1]), int(h[2]), int(h[3])
+            kv_dtype = np.dtype(_DT[dt_code])
+            shape = (L, Hkv, ship, bs, Dh)
+            (b, k, v) = channel.recv_payload(
+                (
+                    np.zeros(n, np.int32),
+                    np.zeros(shape, kv_dtype),
+                    np.zeros(shape, kv_dtype),
+                )
+            )
+            k = np.asarray(k)
+            v = np.asarray(v)
+            if dt_code == 2:  # restore the logical bf16 dtype
+                import ml_dtypes
+
+                k = k.view(ml_dtypes.bfloat16)
+                v = v.view(ml_dtypes.bfloat16)
+            runner.inject_blocks(np.asarray(b).tolist(), k, v)
+        else:
+            raise RuntimeError(f"unknown spmd opcode {op}")

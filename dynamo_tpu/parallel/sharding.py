@@ -31,20 +31,43 @@ def _ns(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
-def _shard_linear(mesh: Mesh, w: Any, spec_in, spec_out) -> Any:
+def put_local(arr, sharding: NamedSharding):
+    """Single-controller placement (the default)."""
+    return jax.device_put(arr, sharding)
+
+
+def put_global(arr, sharding: NamedSharding):
+    """Multi-controller placement: every process holds the same FULL host
+    array and contributes its addressable shards — how params land on a
+    mesh spanning hosts (multihost bring-up, parallel/multihost.py).
+    global_shape == the local shape tells jax the local data is the whole
+    array, not this process's slice."""
+    import numpy as np
+
+    arr = np.asarray(arr)
+    return jax.make_array_from_process_local_data(
+        sharding, arr, global_shape=arr.shape
+    )
+
+
+def _shard_linear(mesh: Mesh, w: Any, spec_in, spec_out, put=put_local) -> Any:
     """Place a (possibly int8-quantized) linear weight."""
     if isinstance(w, dict):
         return {
-            "q": jax.device_put(w["q"], _ns(mesh, spec_in, spec_out)),
-            "s": jax.device_put(w["s"], _ns(mesh, spec_out)),
+            "q": put(w["q"], _ns(mesh, spec_in, spec_out)),
+            "s": put(w["s"], _ns(mesh, spec_out)),
         }
-    return jax.device_put(w, _ns(mesh, spec_in, spec_out))
+    return put(w, _ns(mesh, spec_in, spec_out))
 
 
 def shard_llama(
-    mesh: Mesh, config: LlamaConfig, params: dict
+    mesh: Mesh, config: LlamaConfig, params: dict, put=put_local
 ) -> tuple[dict, NamedSharding]:
-    """Places params onto the mesh; returns (params, kv_cache_sharding)."""
+    """Places params onto the mesh; returns (params, kv_cache_sharding).
+
+    `put` is the placement primitive: jax.device_put on one controller,
+    put_global under multi-host (every process passes identical host
+    params; each contributes its local shards)."""
     if config.num_kv_heads % mesh.shape["tp"] != 0:
         raise ValueError(
             f"num_kv_heads={config.num_kv_heads} not divisible by "
@@ -57,36 +80,36 @@ def shard_llama(
         )
     repl = _ns(mesh, None)
     out: dict = {
-        "embed": jax.device_put(params["embed"], _ns(mesh, None, None)),
-        "final_norm": jax.device_put(params["final_norm"], repl),
+        "embed": put(params["embed"], _ns(mesh, None, None)),
+        "final_norm": put(params["final_norm"], repl),
         "layers": [],
     }
     for layer in params["layers"]:
         placed = {
-            "attn_norm": jax.device_put(layer["attn_norm"], repl),
-            "wq": _shard_linear(mesh, layer["wq"], None, "tp"),
-            "wk": _shard_linear(mesh, layer["wk"], None, "tp"),
-            "wv": _shard_linear(mesh, layer["wv"], None, "tp"),
-            "wo": _shard_linear(mesh, layer["wo"], "tp", None),
-            "mlp_norm": jax.device_put(layer["mlp_norm"], repl),
+            "attn_norm": put(layer["attn_norm"], repl),
+            "wq": _shard_linear(mesh, layer["wq"], None, "tp", put),
+            "wk": _shard_linear(mesh, layer["wk"], None, "tp", put),
+            "wv": _shard_linear(mesh, layer["wv"], None, "tp", put),
+            "wo": _shard_linear(mesh, layer["wo"], "tp", None, put),
+            "mlp_norm": put(layer["mlp_norm"], repl),
         }
         if "router" in layer:
             # WideEP: experts sharded over ep, each expert's FFN over tp
             # (dsr1-wideep equivalent: dp-attention + deepep-moe flags)
             placed.update(
-                router=jax.device_put(layer["router"], _ns(mesh, None, None)),
-                wg=jax.device_put(layer["wg"], _ns(mesh, "ep", None, "tp")),
-                wu=jax.device_put(layer["wu"], _ns(mesh, "ep", None, "tp")),
-                wd=jax.device_put(layer["wd"], _ns(mesh, "ep", "tp", None)),
+                router=put(layer["router"], _ns(mesh, None, None)),
+                wg=put(layer["wg"], _ns(mesh, "ep", None, "tp")),
+                wu=put(layer["wu"], _ns(mesh, "ep", None, "tp")),
+                wd=put(layer["wd"], _ns(mesh, "ep", "tp", None)),
             )
         else:
             placed.update(
-                wg=_shard_linear(mesh, layer["wg"], None, "tp"),
-                wu=_shard_linear(mesh, layer["wu"], None, "tp"),
-                wd=_shard_linear(mesh, layer["wd"], "tp", None),
+                wg=_shard_linear(mesh, layer["wg"], None, "tp", put),
+                wu=_shard_linear(mesh, layer["wu"], None, "tp", put),
+                wd=_shard_linear(mesh, layer["wd"], "tp", None, put),
             )
         out["layers"].append(placed)
     if "lm_head" in params:
-        out["lm_head"] = _shard_linear(mesh, params["lm_head"], None, "tp")
+        out["lm_head"] = _shard_linear(mesh, params["lm_head"], None, "tp", put)
     kv_sharding = _ns(mesh, None, "tp", None, None, None)
     return out, kv_sharding

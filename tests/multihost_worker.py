@@ -1,0 +1,79 @@
+"""Per-rank worker for the multi-host bring-up test (test_multihost.py).
+
+Usage: python multihost_worker.py <rank> <num_nodes> <model_dir>
+Env: DYN_FABRIC_ADDR must point at a running fabric server.
+
+Rank 0 builds the engine (leader), serves two greedy requests over a
+tp=<num_nodes> mesh spanning every process, prints the generated tokens as
+one JSON line, and stops the followers. Other ranks replay the leader's
+device calls via the SPMD step channel until told to stop.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # axon sitecustomize override
+
+RANK = int(sys.argv[1])
+NODES = int(sys.argv[2])
+MODEL_DIR = sys.argv[3]
+
+
+async def main() -> None:
+    from dynamo_tpu.engine.jax_engine.factory import build_jax_engine
+    from dynamo_tpu.fabric.client import FabricClient
+    from dynamo_tpu.parallel.multihost import MultiNodeConfig
+
+    fabric = await FabricClient.connect(os.environ["DYN_FABRIC_ADDR"])
+    lease = await fabric.lease_grant(60.0)
+    cfg = MultiNodeConfig(num_nodes=NODES, node_rank=RANK)
+    engine_or_handle, _mdc = await build_jax_engine(
+        MODEL_DIR,
+        name="tiny",
+        kv_block_size=4,
+        max_batch=4,
+        num_blocks=64,
+        tensor_parallel_size=NODES,  # one chip per host in this test
+        multinode=cfg,
+        fabric=fabric,
+        lease_id=lease,
+    )
+    if RANK != 0:
+        await engine_or_handle.serve_async()
+        print("FOLLOWER DONE", flush=True)
+        await fabric.close()
+        return
+
+    from dynamo_tpu.pipeline.context import Context
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    engine = engine_or_handle
+
+    async def one(prompt, n):
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(greedy=True),
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+        )
+        toks = []
+        async for out in engine.generate(req, Context()):
+            toks.extend(out.token_ids)
+        return toks
+
+    t1 = await one(list(range(2, 14)), 5)
+    t2 = await one(list(range(3, 9)), 4)
+    await engine.close()
+    engine.runner.stop_followers()
+    print("TOKENS " + json.dumps([t1, t2]), flush=True)
+    await fabric.close()
+
+
+asyncio.run(main())

