@@ -26,6 +26,9 @@ class StepResult:
     text: str = ""
     finish_reason: Optional[FinishReason] = None
     tokens_emitted: int = 0
+    # OpenAI chat logprobs content entries for tokens emitted this step
+    # ({"token", "logprob", "bytes", "top_logprobs"}), when requested
+    logprobs: Optional[list[dict]] = None
 
 
 class SequenceDecoder:
@@ -37,6 +40,7 @@ class SequenceDecoder:
         stop: StopConditions,
         eos_token_ids: list[int],
     ) -> None:
+        self._tokenizer = tokenizer
         self._stream = tokenizer.decode_stream()
         self._stop = stop
         self._eos = set(eos_token_ids) | set(stop.stop_token_ids_hidden)
@@ -84,13 +88,23 @@ class SequenceDecoder:
             if hit:
                 self.finished = FinishReason.STOP_SEQUENCE
         else:
-            for tok in output.token_ids:
+            for j, tok in enumerate(output.token_ids):
                 if not self._stop.ignore_eos and tok in self._eos:
                     self.finished = FinishReason.EOS
                     break
                 piece = self._stream.step(tok)
                 self._emitted_tokens += 1
                 result.tokens_emitted += 1
+                if output.log_probs is not None and j < len(output.log_probs):
+                    entry = self._logprob_entry(
+                        tok,
+                        piece,
+                        output.log_probs[j],
+                        output.top_logprobs[j]
+                        if output.top_logprobs and j < len(output.top_logprobs)
+                        else None,
+                    )
+                    result.logprobs = (result.logprobs or []) + [entry]
                 if piece:
                     released, hit = self._scan_stop(piece)
                     result.text += released
@@ -107,6 +121,39 @@ class SequenceDecoder:
             self.finished = output.finish_reason
         result.finish_reason = self.finished
         return result
+
+    def _logprob_entry(
+        self,
+        token_id: int,
+        piece: str,
+        logprob: float,
+        top: Optional[list],
+    ) -> dict:
+        """One OpenAI chat-logprobs content entry (openai.rs logprobs
+        surface). `piece` may be '' when the byte-level stream is holding
+        back an incomplete codepoint — fall back to a solo decode."""
+        text = piece or self._decode_one(token_id)
+        entry: dict = {
+            "token": text,
+            "logprob": float(logprob),
+            "bytes": list(text.encode("utf-8")),
+        }
+        if top:
+            entry["top_logprobs"] = [
+                {
+                    "token": self._decode_one(int(tid)),
+                    "logprob": float(lp),
+                    "bytes": list(self._decode_one(int(tid)).encode("utf-8")),
+                }
+                for tid, lp in top
+            ]
+        return entry
+
+    def _decode_one(self, token_id: int) -> str:
+        try:
+            return self._tokenizer.decode([token_id], skip_special_tokens=False)
+        except Exception:  # noqa: BLE001 — display-only fallback
+            return f"<{token_id}>"
 
     @property
     def emitted_tokens(self) -> int:

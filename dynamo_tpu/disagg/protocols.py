@@ -104,6 +104,10 @@ class RemotePrefillResponse:
     # index (within the sequence) of the first block in the payload
     first_block: int = 0
     error: Optional[str] = None
+    # logprob surface for the first sampled token (None when the requester
+    # didn't ask — keeps the wire lean)
+    first_logprob: Optional[float] = None
+    first_top: Optional[list] = None  # [[token_id, logprob], ...]
 
     def to_wire(self) -> dict[str, Any]:
         return {
@@ -112,6 +116,8 @@ class RemotePrefillResponse:
             "payload": self.payload.to_wire() if self.payload else None,
             "first_block": self.first_block,
             "error": self.error,
+            "first_logprob": self.first_logprob,
+            "first_top": self.first_top,
         }
 
     @classmethod
@@ -123,4 +129,6 @@ class RemotePrefillResponse:
             payload=KvBlockPayload.from_wire(p) if p else None,
             first_block=d.get("first_block", 0),
             error=d.get("error"),
+            first_logprob=d.get("first_logprob"),
+            first_top=d.get("first_top"),
         )

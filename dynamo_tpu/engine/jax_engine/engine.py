@@ -94,6 +94,34 @@ class _Sequence(SequenceState):
         self.top_p = s.top_p if s.top_p is not None else 1.0
         self.top_k = s.top_k if s.top_k is not None else 0
         self.max_new = request.stop.max_tokens or 16
+        self.min_tokens = request.stop.min_tokens or 0
+        # penalties + per-request RNG stream + logprobs (reference
+        # validate.rs:95-125 — implemented, not accepted-and-dropped)
+        self.freq_pen = float(s.frequency_penalty or 0.0)
+        self.pres_pen = float(s.presence_penalty or 0.0)
+        self.rep_pen = float(s.repetition_penalty or 1.0) or 1.0
+        self.has_penalties = bool(
+            self.freq_pen or self.pres_pen or self.rep_pen != 1.0
+        )
+        self.seed = s.seed
+        self.want_logprobs = bool(s.logprobs)
+        self.num_top_lp = min(int(s.top_logprobs or 0), 20)
+        # min_tokens: EOS logits are masked ON DEVICE until the minimum is
+        # generated (appending a suppressed EOS would still stop the
+        # HTTP-layer decoder); first MAX_EOS_IDS ids ride into the program
+        from dynamo_tpu.ops.sampling import MAX_EOS_IDS
+
+        self.eos_row = np.full(MAX_EOS_IDS, -1, np.int32)
+        for j, t in enumerate(sorted(self.eos)[:MAX_EOS_IDS]):
+            self.eos_row[j] = t
+
+    @property
+    def needs_eos_suppress(self) -> bool:
+        return (
+            self.min_tokens > 0
+            and self.num_generated < self.min_tokens
+            and bool(self.eos)
+        )
 
     @property
     def num_generated(self) -> int:
@@ -149,7 +177,10 @@ class JaxEngine:
         # Landed remote prefills / failures, processed by the engine loop so
         # _append_token (which can preempt and reallocate blocks) never runs
         # concurrently with an in-flight decode step.
-        self._landed: list[tuple[_Sequence, Optional[int], Optional[FinishReason]]] = []
+        # entries: (seq, sample | None, fail); sample = (token, logprob,
+        # top [[id, lp], ...]) — logprobs ride along so the first token's
+        # entry isn't missing from logprobs responses
+        self._landed: list[tuple[_Sequence, Optional[tuple], Optional[FinishReason]]] = []
         # Serializes every runner call: the cache arrays are DONATED through
         # prefill/decode/inject, so a concurrent caller (remote-prefill
         # landing, prefill_only service task) would read a deleted array.
@@ -169,6 +200,10 @@ class JaxEngine:
         self._temps = np.ones(B, np.float32)
         self._top_ps = np.ones(B, np.float32)
         self._top_ks = np.zeros(B, np.int32)
+        self._keys = np.zeros((B, 2), np.uint32)
+        # unseeded sequences draw from (engine seed base + seq_id) streams:
+        # deterministic per engine run AND stable across preemption replay
+        self._seed_base = (self.config.rng_seed ^ 0x9E3779B9) & 0x7FFFFFFF
 
     # --------------------------------------------------------------- api
 
@@ -336,6 +371,19 @@ class JaxEngine:
             self.allocator.free(owned_ids)
             self._wake.set()
 
+    def _key_row(self, seq: _Sequence) -> np.ndarray:
+        """Raw threefry key row for this sequence's next sampled token:
+        (stream, counter) = (per-request seed | engine-derived stream,
+        num_generated) — same seed + same prompt ⇒ same output, regardless
+        of batch composition or preemption."""
+        from dynamo_tpu.ops.sampling import make_key_data
+
+        stream = (
+            seq.seed if seq.seed is not None
+            else self._seed_base + seq.seq_id
+        )
+        return make_key_data(stream, seq.num_generated)
+
     def _preempt_youngest(self, exclude: _Sequence) -> bool:
         for victim in reversed(self._admit_order):
             if victim is exclude or victim.slot is None or victim.pending_remote:
@@ -419,6 +467,11 @@ class JaxEngine:
 
     async def _admit_phase(self, loop) -> bool:
         admitted = False
+        to_pack: list[_Sequence] = []
+        chunk_c = getattr(self.runner, "prefill_chunk_tokens", 0)
+        can_pack = bool(chunk_c) and hasattr(
+            self.runner, "prefill_packed_arrays"
+        )
         while self.waiting:
             seq = self.waiting[0]
             if not self._try_admit(seq):
@@ -457,7 +510,6 @@ class JaxEngine:
                 continue
             # re-admission after preemption replays generated tokens too
             replay = seq.token_ids
-            chunk_c = getattr(self.runner, "prefill_chunk_tokens", 0)
             if chunk_c and len(replay) > chunk_c:
                 # long prompt: prefill one chunk per loop iteration so the
                 # in-flight decode batch never stalls more than one chunk
@@ -465,28 +517,85 @@ class JaxEngine:
                 seq.prefill_pos = 0
                 self._prefilling.append(seq)
                 continue
+            if can_pack:
+                # short prompt: batch with other waiting prompts into one
+                # packed-prefill program (flushed below)
+                to_pack.append(seq)
+                continue
+            key_row = self._key_row(seq)
             async with self._device_lock:
-                tok_arr = await loop.run_in_executor(
+                sample = await loop.run_in_executor(
                     None,
-                    lambda: np.asarray(
-                        self.runner.prefill(
+                    lambda: tuple(
+                        np.asarray(x)
+                        for x in self.runner.prefill(
                             replay,
                             seq.block_ids,
                             seq.temperature,
                             seq.top_p,
                             seq.top_k,
+                            rep_pen=seq.rep_pen,
+                            key_data=key_row,
+                            eos_ids=seq.eos_row,
+                            eos_suppress=seq.needs_eos_suppress,
                         )
                     ),
                 )
-            token = int(tok_arr)
             # the admission pass may have prebuilt the identical chain for
             # the prefix lookup — reuse instead of re-hashing the prompt
             seq.hash_seq = seq.pending_chain or TokenBlockSequence(
                 replay, self.config.block_size
             )
             self._emit_stored(seq)
-            self._append_token(seq, token)
+            self._append_sample(seq, sample)
+        # flush the packed batches: greedily fill the token budget, one
+        # program launch per group (TTFT under many short prompts scales
+        # with ceil(total_tokens / budget), not with request count)
+        while to_pack:
+            group, total = [], 0
+            while (
+                to_pack
+                and total + len(to_pack[0].token_ids) <= chunk_c
+                and len(group) < self.config.max_batch
+            ):
+                s = to_pack.pop(0)
+                group.append(s)
+                total += len(s.token_ids)
+            await self._run_packed_prefill(loop, group)
         return admitted
+
+    async def _run_packed_prefill(
+        self, loop, group: list[_Sequence]
+    ) -> None:
+        specs = [
+            (
+                list(s.token_ids), s.block_ids, s.temperature, s.top_p,
+                s.top_k, s.rep_pen, self._key_row(s), s.eos_row,
+                s.needs_eos_suppress,
+            )
+            for s in group
+        ]
+        packed = self.runner.pack_prefill(specs)
+        async with self._device_lock:
+            sample = await loop.run_in_executor(
+                None,
+                lambda: tuple(
+                    np.asarray(x)
+                    for x in self.runner.prefill_packed_arrays(**packed)
+                ),
+            )
+        toks, lps, tids, tlps = sample
+        for i, seq in enumerate(group):
+            if seq.slot is None:  # cancelled during the device call
+                continue
+            seq.hash_seq = seq.pending_chain or TokenBlockSequence(
+                list(seq.token_ids), self.config.block_size
+            )
+            self._emit_stored(seq)
+            self._append_token(
+                seq, int(toks[i]), lp=float(lps[i]),
+                top_ids=tids[i], top_lps=tlps[i],
+            )
 
     async def _prefill_chunk_step(self, loop) -> None:
         """Run ONE chunk of the oldest in-progress chunked prefill."""
@@ -499,13 +608,18 @@ class JaxEngine:
         start = seq.prefill_pos
         total = len(seq.token_ids)
         chunk = seq.token_ids[start : start + c]
+        key_row = self._key_row(seq)
         async with self._device_lock:
-            tok_arr = await loop.run_in_executor(
+            sample = await loop.run_in_executor(
                 None,
-                lambda: np.asarray(
-                    self.runner.prefill_chunk(
+                lambda: tuple(
+                    np.asarray(x)
+                    for x in self.runner.prefill_chunk(
                         chunk, start, total, seq.block_ids,
                         seq.temperature, seq.top_p, seq.top_k,
+                        rep_pen=seq.rep_pen, key_data=key_row,
+                        eos_ids=seq.eos_row,
+                        eos_suppress=seq.needs_eos_suppress,
                     )
                 ),
             )
@@ -519,24 +633,27 @@ class JaxEngine:
                 list(seq.token_ids), self.config.block_size
             )
             self._emit_stored(seq)
-            self._append_token(seq, int(tok_arr))
+            self._append_sample(seq, sample)
 
     def _process_landed(self) -> None:
         """Complete landed remote prefills on the engine loop (serialized
         with decode, so preemption in _append_token can't race a step)."""
         landed, self._landed = self._landed, []
-        for seq, first_token, fail in landed:
+        for seq, sample, fail in landed:
             if seq.slot is None:  # reaped while queued
                 continue
             seq.pending_remote = False
-            if fail is not None or first_token is None:
+            if fail is not None or sample is None:
                 self._finish(seq, fail or FinishReason.ERROR)
                 continue
+            token, lp, top = sample
             seq.hash_seq = seq.pending_chain or TokenBlockSequence(
                 list(seq.token_ids), self.config.block_size
             )
             self._emit_stored(seq)
-            self._append_token(seq, first_token)
+            top_ids = np.array([t for t, _ in top], np.int32) if top else None
+            top_lps = np.array([l for _, l in top], np.float32) if top else None
+            self._append_token(seq, token, lp=lp, top_ids=top_ids, top_lps=top_lps)
 
     async def _remote_prefill_task(self, seq: _Sequence) -> None:
         """Await a remote prefill, land its KV, and enter the decode batch.
@@ -568,8 +685,8 @@ class JaxEngine:
         if seq.slot is None:  # cancelled/finished while in flight
             return
         try:
-            first_token = await self._land_prefill(seq, resp, loop)
-            self._landed.append((seq, first_token, None))
+            sample = await self._land_prefill(seq, resp, loop)
+            self._landed.append((seq, sample, None))
         except Exception:  # noqa: BLE001 — never strand the consumer
             logger.exception("landing prefill for seq %d failed", seq.seq_id)
             self._landed.append((seq, None, FinishReason.ERROR))
@@ -604,10 +721,10 @@ class JaxEngine:
             logger.exception("prefix onboard failed; full remote prefill")
             return 0
 
-    async def _land_prefill(self, seq: _Sequence, resp, loop) -> int:
+    async def _land_prefill(self, seq: _Sequence, resp, loop) -> tuple:
         """Device-side landing only: inject blocks / fallback prefill.
-        Returns the first sampled token; scheduler-visible completion
-        happens later in _process_landed on the engine loop."""
+        Returns (first_token, logprob | None, top | None); scheduler-visible
+        completion happens later in _process_landed on the engine loop."""
         from dynamo_tpu.disagg.transfer import from_wire_array
 
         if resp is not None and resp.error is None:
@@ -625,24 +742,30 @@ class JaxEngine:
                         await loop.run_in_executor(
                             None, self.runner.inject_blocks, ids, k, v
                         )
-            first_token = resp.first_token
-        else:
-            # local fallback (also covers error responses)
-            async with self._device_lock:
-                tok_arr = await loop.run_in_executor(
-                    None,
-                    lambda: np.asarray(
-                        self.runner.prefill(
-                            seq.token_ids,
-                            seq.block_ids,
-                            seq.temperature,
-                            seq.top_p,
-                            seq.top_k,
-                        )
-                    ),
-                )
-            first_token = int(tok_arr)
-        return first_token
+            return (resp.first_token, resp.first_logprob, resp.first_top)
+        # local fallback (also covers error responses)
+        key_row = self._key_row(seq)
+        async with self._device_lock:
+            sample = await loop.run_in_executor(
+                None,
+                lambda: tuple(
+                    np.asarray(x)
+                    for x in self.runner.prefill(
+                        seq.token_ids,
+                        seq.block_ids,
+                        seq.temperature,
+                        seq.top_p,
+                        seq.top_k,
+                        rep_pen=seq.rep_pen,
+                        key_data=key_row,
+                        eos_ids=seq.eos_row,
+                        eos_suppress=seq.needs_eos_suppress,
+                    )
+                ),
+            )
+        tok, lp, tids, tlps = sample
+        top = [[int(t), float(l)] for t, l in zip(tids, tlps)]
+        return (int(tok), float(lp), top)
 
     async def prefill_only(self, req: Any) -> Any:
         """Serve one RemotePrefillRequest (the prefill-worker role).
@@ -672,10 +795,11 @@ class JaxEngine:
         block_ids = self.allocator.alloc(need)
         try:
             async with self._device_lock:
-                tok_arr = await loop.run_in_executor(
+                sample = await loop.run_in_executor(
                     None,
-                    lambda: np.asarray(
-                        self.runner.prefill(
+                    lambda: tuple(
+                        np.asarray(x)
+                        for x in self.runner.prefill(
                             list(req.token_ids),
                             block_ids,
                             req.temperature,
@@ -684,6 +808,7 @@ class JaxEngine:
                         )
                     ),
                 )
+                tok_arr, lp_arr, tids_arr, tlps_arr = sample
                 ship = block_ids[req.cached_blocks :]
                 if ship:
                     k, v = await loop.run_in_executor(
@@ -700,6 +825,10 @@ class JaxEngine:
                 first_token=int(tok_arr),
                 payload=payload,
                 first_block=req.cached_blocks,
+                first_logprob=float(lp_arr),
+                first_top=[
+                    [int(t), float(l)] for t, l in zip(tids_arr, tlps_arr)
+                ],
             )
         finally:
             self.allocator.free(block_ids)
@@ -724,11 +853,44 @@ class JaxEngine:
             self._temps[i] = seq.temperature
             self._top_ps[i] = seq.top_p
             self._top_ks[i] = seq.top_k
+            self._keys[i] = self._key_row(seq)
+        penalties = None
+        if any(
+            seq.has_penalties or seq.needs_eos_suppress for seq in active
+        ):
+            # full-history penalties ride a separate (lazily compiled)
+            # program; the plain path never pays the [B, L] input
+            L = self.config.max_model_len
+            hist = np.zeros((B, L), np.int32)
+            hist_len = np.zeros(B, np.int32)
+            prompt_len = np.zeros(B, np.int32)
+            freq = np.zeros(B, np.float32)
+            pres = np.zeros(B, np.float32)
+            rep = np.ones(B, np.float32)
+            from dynamo_tpu.ops.sampling import MAX_EOS_IDS
+
+            eos_ids = np.full((B, MAX_EOS_IDS), -1, np.int32)
+            eos_sup = np.zeros(B, bool)
+            for seq in active:
+                i = seq.slot
+                n = min(len(seq.token_ids), L)
+                hist[i, :n] = seq.token_ids[:n]
+                hist_len[i] = n
+                prompt_len[i] = min(seq.num_prompt, n)
+                freq[i] = seq.freq_pen
+                pres[i] = seq.pres_pen
+                rep[i] = seq.rep_pen
+                eos_ids[i] = seq.eos_row
+                eos_sup[i] = seq.needs_eos_suppress
+            penalties = (
+                hist, hist_len, prompt_len, freq, pres, rep, eos_ids, eos_sup
+            )
         async with self._device_lock:
-            toks = await loop.run_in_executor(
+            sample = await loop.run_in_executor(
                 None,
-                lambda: np.asarray(
-                    self.runner.decode(
+                lambda: tuple(
+                    np.asarray(x)
+                    for x in self.runner.decode(
                         self._tokens,
                         self._positions,
                         self._block_tables,
@@ -736,28 +898,63 @@ class JaxEngine:
                         self._temps,
                         self._top_ps,
                         self._top_ks,
+                        keys=self._keys,
+                        penalties=penalties,
                     )
                 ),
             )
+        toks, lps, tids, tlps = sample
         for seq in active:
             if seq.slot is None:
                 continue  # finished/cancelled concurrently
-            self._append_token(seq, int(toks[seq.slot]))
+            i = seq.slot
+            self._append_token(
+                seq, int(toks[i]), lp=float(lps[i]),
+                top_ids=tids[i], top_lps=tlps[i],
+            )
 
-    def _append_token(self, seq: _Sequence, token: int) -> None:
+    def _append_sample(
+        self, seq: _Sequence, sample: tuple[np.ndarray, ...]
+    ) -> None:
+        """Unpack a (tok, logprob, top_ids, top_lps) runner sample for a
+        single sequence and append it."""
+        tok, lp, tids, tlps = sample
+        self._append_token(
+            seq, int(tok), lp=float(lp), top_ids=tids, top_lps=tlps
+        )
+
+    def _append_token(
+        self,
+        seq: _Sequence,
+        token: int,
+        lp: Optional[float] = None,
+        top_ids: Optional[np.ndarray] = None,
+        top_lps: Optional[np.ndarray] = None,
+    ) -> None:
         """Record a newly generated token: stream it, grow blocks, stop."""
         self.stats.generated_tokens += 1
         if seq.ctx.is_stopped():
             self._finish(seq, FinishReason.CANCELLED)
             return
-        if token in seq.eos:
+        if token in seq.eos and seq.num_generated >= seq.min_tokens:
             self._finish(seq, FinishReason.EOS)  # eos token stays hidden
             return
         seq.token_ids.append(token)
         if seq.hash_seq is not None:
             seq.hash_seq.append(token)
             self._emit_stored(seq)
-        seq.out.put_nowait(LLMEngineOutput(token_ids=[token]))
+        out = LLMEngineOutput(token_ids=[token])
+        if seq.want_logprobs and lp is not None:
+            out.log_probs = [lp]
+            k = seq.num_top_lp
+            if k and top_ids is not None and top_lps is not None:
+                out.top_logprobs = [
+                    [
+                        [int(t), float(l)]
+                        for t, l in zip(top_ids[:k], top_lps[:k])
+                    ]
+                ]
+        seq.out.put_nowait(out)
         if (
             seq.num_generated >= seq.max_new
             or len(seq.token_ids) >= self.config.max_model_len

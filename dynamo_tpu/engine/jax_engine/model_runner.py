@@ -22,7 +22,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from dynamo_tpu.models import llama
-from dynamo_tpu.ops.sampling import sample_tokens
+from dynamo_tpu.ops.sampling import (
+    MAX_EOS_IDS,
+    apply_penalties,
+    apply_repetition_penalty_from_prompt,
+    apply_repetition_penalty_packed,
+    mask_eos_logits,
+    sample_tokens_full,
+)
 from dynamo_tpu.runtime.logging import get_logger
 
 logger = get_logger("dynamo_tpu.engine.runner")
@@ -104,7 +111,7 @@ class ModelRunner:
         self.max_blocks_per_seq = (max_model_len + block_size - 1) // block_size
         self.mesh = mesh
         self.cp_min_tokens = cp_min_tokens
-        self._base_key = jax.random.PRNGKey(rng_seed)
+        self._rng_seed = rng_seed
         self._step_counter = 0
         self.prefill_buckets = sorted(
             prefill_buckets or default_prefill_buckets(block_size, max_model_len)
@@ -149,8 +156,10 @@ class ModelRunner:
         # heads), breaking the megatron layout on the next step. Under
         # multi-controller, the token output is pinned replicated so each
         # process holds a full local copy to fetch.
+        # sample outputs: (tok, logprob, top_ids, top_lps) — pinned
+        # replicated under multi-controller so every process can fetch.
         cache_out = (
-            (self._repl, kv_sharding, kv_sharding)
+            ((self._repl,) * 4, kv_sharding, kv_sharding)
             if kv_sharding is not None
             else None
         )
@@ -194,6 +203,26 @@ class ModelRunner:
                 self._decode_impl, self.config,
                 self.mesh, self._attn_head_axis,
             ),
+            donate_argnums=(1, 2),  # k_cache, v_cache
+            **jit_kwargs,
+        )
+        # penalty-enabled decode variant: compiled lazily on the first
+        # request that sets a penalty, so the hot path (and the bench) stays
+        # on the slim program with no history input.
+        self._decode_pen_fn = jax.jit(
+            functools.partial(
+                self._decode_pen_impl, self.config,
+                self.mesh, self._attn_head_axis,
+            ),
+            donate_argnums=(1, 2),  # k_cache, v_cache
+            **jit_kwargs,
+        )
+        # packed batched prefill: N short prompts in ONE [P] program
+        # (segment-masked attention); admission batches prompts up to this
+        # token budget per engine iteration. Shares the chunk budget so the
+        # compile surface stays at one packed + one chunk program.
+        self._packed_jit = jax.jit(
+            functools.partial(self._prefill_packed_impl, self.config, self.mesh),
             donate_argnums=(1, 2),  # k_cache, v_cache
             **jit_kwargs,
         )
@@ -244,28 +273,42 @@ class ModelRunner:
     # ------------------------------------------------------------- jitted
 
     @staticmethod
-    def _sample(logits, key, temps, top_ps, top_ks):
-        return sample_tokens(logits, key, temps, top_ps, top_ks)
+    def _sample_one(logits, prompt, n_prompt, key_data, temp, top_p, top_k,
+                    rep_pen, eos_ids, eos_suppress):
+        """Shared prefill tail: prompt repetition penalty + min_tokens EOS
+        mask + sample + logprobs for the single first token (freq/presence
+        are zero by definition)."""
+        logits = apply_repetition_penalty_from_prompt(
+            logits, prompt, n_prompt, rep_pen
+        )
+        logits = mask_eos_logits(logits, eos_ids, eos_suppress)
+        tok, lp, tids, tlps = sample_tokens_full(
+            logits[None, :], None, temp[None], top_p[None], top_k[None],
+            keys=key_data[None, :],
+        )
+        return tok[0], lp[0], tids[0], tlps[0]
 
     @staticmethod
     def _prefill_impl(
         cfg, attn_mesh, attn_head_axis,
         params, k_cache, v_cache, tokens, valid_len, block_table,
-        key, temp, top_p, top_k,
+        key_data, temp, top_p, top_k, rep_pen, eos_ids, eos_suppress,
     ):
         logits, k_cache, v_cache = llama.prefill(
             params, cfg, tokens, valid_len, k_cache, v_cache, block_table,
             mesh=attn_mesh, attn_head_axis=attn_head_axis,
         )
-        tok = sample_tokens(
-            logits[None, :], key, temp[None], top_p[None], top_k[None]
-        )[0]
-        return tok, k_cache, v_cache
+        out = ModelRunner._sample_one(
+            logits, tokens, valid_len, key_data, temp, top_p, top_k, rep_pen,
+            eos_ids, eos_suppress,
+        )
+        return out, k_cache, v_cache
 
     @staticmethod
     def _prefill_cp_impl(
         cfg, mesh, head_axis, params, k_cache, v_cache, tokens, valid_len,
-        block_table, key, temp, top_p, top_k,
+        block_table, key_data, temp, top_p, top_k, rep_pen, eos_ids,
+        eos_suppress,
     ):
         # per-layer pagination inside the model loop: peak transient is one
         # layer's [P, Hkv, D], never the full [L, P, Hkv, D] stack
@@ -273,45 +316,92 @@ class ModelRunner:
             params, cfg, mesh, tokens, valid_len, head_axis=head_axis,
             k_cache=k_cache, v_cache=v_cache, block_table=block_table,
         )
-        tok = sample_tokens(
-            logits[None, :], key, temp[None], top_p[None], top_k[None]
-        )[0]
-        return tok, k_cache, v_cache
+        out = ModelRunner._sample_one(
+            logits, tokens, valid_len, key_data, temp, top_p, top_k, rep_pen,
+            eos_ids, eos_suppress,
+        )
+        return out, k_cache, v_cache
 
     @staticmethod
     def _prefill_chunk_impl(
         cfg, mesh, params, k_cache, v_cache, tokens, chunk_start, valid_len,
-        block_table, key, temp, top_p, top_k,
+        block_table, key_data, temp, top_p, top_k, rep_pen, eos_ids,
+        eos_suppress,
     ):
         logits, k_cache, v_cache = llama.prefill_chunk(
             params, cfg, tokens, chunk_start, valid_len,
             k_cache, v_cache, block_table, mesh=mesh,
         )
-        tok = sample_tokens(
-            logits[None, :], key, temp[None], top_p[None], top_k[None]
-        )[0]
-        return tok, k_cache, v_cache
+        # repetition penalty sees this chunk's tokens only (earlier chunks
+        # already left the program); documented approximation for the FIRST
+        # token of a chunked long prompt — decode steps use the full history
+        n_in_chunk = jnp.clip(valid_len - chunk_start, 0, tokens.shape[0])
+        out = ModelRunner._sample_one(
+            logits, tokens, n_in_chunk, key_data, temp, top_p, top_k, rep_pen,
+            eos_ids, eos_suppress,
+        )
+        return out, k_cache, v_cache
+
+    @staticmethod
+    def _prefill_packed_impl(
+        cfg, mesh, params, k_cache, v_cache, tokens, positions, segment_ids,
+        slot_indices, last_idx, keys, temps, top_ps, top_ks, rep_pens,
+        eos_ids, eos_suppress,
+    ):
+        logits, k_cache, v_cache = llama.prefill_packed(
+            params, cfg, tokens, positions, segment_ids, slot_indices,
+            k_cache, v_cache, last_idx, mesh=mesh,
+        )
+        logits = apply_repetition_penalty_packed(
+            logits, tokens, segment_ids, rep_pens
+        )
+        logits = mask_eos_logits(logits, eos_ids, eos_suppress)
+        out = sample_tokens_full(logits, None, temps, top_ps, top_ks, keys=keys)
+        return out, k_cache, v_cache
 
     @staticmethod
     def _decode_impl(
         cfg, attn_mesh, attn_head_axis,
         params, k_cache, v_cache, tokens, positions, block_tables,
-        slot_indices, key, temps, top_ps, top_ks,
+        slot_indices, keys, temps, top_ps, top_ks,
     ):
         logits, k_cache, v_cache = llama.decode(
             params, cfg, tokens, positions, k_cache, v_cache,
             block_tables, slot_indices,
             mesh=attn_mesh, attn_head_axis=attn_head_axis,
         )
-        toks = sample_tokens(logits, key, temps, top_ps, top_ks)
-        return toks, k_cache, v_cache
+        out = sample_tokens_full(logits, None, temps, top_ps, top_ks, keys=keys)
+        return out, k_cache, v_cache
 
-    def _next_key(self) -> jax.Array:
+    @staticmethod
+    def _decode_pen_impl(
+        cfg, attn_mesh, attn_head_axis,
+        params, k_cache, v_cache, tokens, positions, block_tables,
+        slot_indices, keys, temps, top_ps, top_ks,
+        hist, hist_len, prompt_len, freq_pen, pres_pen, rep_pen,
+        eos_ids, eos_suppress,
+    ):
+        logits, k_cache, v_cache = llama.decode(
+            params, cfg, tokens, positions, k_cache, v_cache,
+            block_tables, slot_indices,
+            mesh=attn_mesh, attn_head_axis=attn_head_axis,
+        )
+        logits = apply_penalties(
+            logits, hist, hist_len, prompt_len, freq_pen, pres_pen, rep_pen
+        )
+        logits = mask_eos_logits(logits, eos_ids, eos_suppress)
+        out = sample_tokens_full(logits, None, temps, top_ps, top_ks, keys=keys)
+        return out, k_cache, v_cache
+
+    def _next_key_data(self) -> np.ndarray:
+        """Default per-call RNG stream: raw threefry key data built on the
+        host with numpy (ops/sampling.make_key_data). Multi-controller:
+        every process derives the identical row because followers replay
+        calls in order, keeping step counters in sync."""
+        from dynamo_tpu.ops.sampling import make_key_data
+
         self._step_counter += 1
-        key = jax.random.fold_in(self._base_key, self._step_counter)
-        # multi-controller: every process derives the identical key (the
-        # follower replays calls in order, keeping step counters in sync)
-        return self._to_dev(np.asarray(key)) if self._repl else key
+        return make_key_data(self._rng_seed, self._step_counter)
 
     def _to_dev(self, a) -> jax.Array:
         """Commit a host input: local array normally; fully-replicated
@@ -347,8 +437,13 @@ class ModelRunner:
         temperature: float,
         top_p: float,
         top_k: int,
-    ) -> jax.Array:
-        """Run one prompt; returns the first sampled token (device array)."""
+        rep_pen: float = 1.0,
+        key_data: Optional[np.ndarray] = None,
+        eos_ids: Optional[np.ndarray] = None,  # [MAX_EOS_IDS] i32, -1 pad
+        eos_suppress: bool = False,  # min_tokens not yet reached
+    ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Run one prompt; returns (token, logprob, top_ids, top_logprobs)
+        device arrays for the first sampled token."""
         T = len(token_ids)
         bucket = self.pick_bucket(T)
         tokens = np.zeros(bucket, np.int32)
@@ -369,14 +464,21 @@ class ModelRunner:
             )
             else self._prefill_jit
         )
-        tok, self.k_cache, self.v_cache = prefill_fn(
+        if key_data is None:
+            key_data = self._next_key_data()
+        if eos_ids is None:
+            eos_ids = np.full(MAX_EOS_IDS, -1, np.int32)
+        out, self.k_cache, self.v_cache = prefill_fn(
             self.params, self.k_cache, self.v_cache,
             self._to_dev(tokens), self._to_dev(np.int32(T)),
-            self._to_dev(table), self._next_key(),
+            self._to_dev(table), self._to_dev(key_data),
             self._to_dev(np.float32(temperature)),
             self._to_dev(np.float32(top_p)), self._to_dev(np.int32(top_k)),
+            self._to_dev(np.float32(rep_pen)),
+            self._to_dev(np.asarray(eos_ids, np.int32)),
+            self._to_dev(np.bool_(eos_suppress)),
         )
-        return tok
+        return out
 
     def prefill_chunk(
         self,
@@ -387,10 +489,15 @@ class ModelRunner:
         temperature: float,
         top_p: float,
         top_k: int,
-    ) -> jax.Array:
+        rep_pen: float = 1.0,
+        key_data: Optional[np.ndarray] = None,
+        eos_ids: Optional[np.ndarray] = None,
+        eos_suppress: bool = False,
+    ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
         """Run one chunk of a chunked prefill; chunks must arrive in order.
 
-        Returns the sampled token (meaningful only on the final chunk)."""
+        Returns (token, logprob, top_ids, top_logprobs) — meaningful only
+        on the final chunk."""
         C = self.prefill_chunk_tokens
         n = len(token_chunk)
         tokens = np.zeros(C, np.int32)
@@ -403,15 +510,94 @@ class ModelRunner:
         nb_table = self.pick_bucket(total_len) // self.block_size
         table = np.zeros(nb_table, np.int32)
         table[: len(block_ids)] = block_ids
-        tok, self.k_cache, self.v_cache = self._chunk_jit(
+        if key_data is None:
+            key_data = self._next_key_data()
+        if eos_ids is None:
+            eos_ids = np.full(MAX_EOS_IDS, -1, np.int32)
+        out, self.k_cache, self.v_cache = self._chunk_jit(
             self.params, self.k_cache, self.v_cache,
             self._to_dev(tokens), self._to_dev(np.int32(chunk_start)),
             self._to_dev(np.int32(total_len)),
-            self._to_dev(table), self._next_key(),
+            self._to_dev(table), self._to_dev(key_data),
             self._to_dev(np.float32(temperature)),
             self._to_dev(np.float32(top_p)), self._to_dev(np.int32(top_k)),
+            self._to_dev(np.float32(rep_pen)),
+            self._to_dev(np.asarray(eos_ids, np.int32)),
+            self._to_dev(np.bool_(eos_suppress)),
         )
-        return tok
+        return out
+
+    def pack_prefill(self, seqs: list[tuple]) -> dict[str, np.ndarray]:
+        """Pure host-side packing for the batched-prefill program.
+
+        seqs: [(token_ids, block_ids, temp, top_p, top_k, rep_pen,
+        key_row [2] uint32, eos_row [MAX_EOS_IDS] i32, suppress bool), ...]
+        with total tokens <= prefill_chunk_tokens and len(seqs) <=
+        max_batch. Padding lanes carry segment -1 and scatter into null
+        block 0."""
+        P = self.prefill_chunk_tokens
+        N = self.max_batch
+        bs = self.block_size
+        assert len(seqs) <= N, f"{len(seqs)} segments > max_batch {N}"
+        tokens = np.zeros(P, np.int32)
+        positions = np.zeros(P, np.int32)
+        segment_ids = np.full(P, -1, np.int32)
+        slot_indices = np.zeros(P, np.int32)
+        last_idx = np.zeros(N, np.int32)
+        temps = np.zeros(N, np.float32)
+        top_ps = np.ones(N, np.float32)
+        top_ks = np.zeros(N, np.int32)
+        rep_pens = np.ones(N, np.float32)
+        keys = np.zeros((N, 2), np.uint32)
+        eos_ids = np.full((N, MAX_EOS_IDS), -1, np.int32)
+        eos_suppress = np.zeros(N, bool)
+        off = 0
+        for i, (tids, bids, te, tp_, tk, rp, kd, er, sup) in enumerate(seqs):
+            T = len(tids)
+            assert off + T <= P, f"pack overflow: {off}+{T} > {P}"
+            tokens[off : off + T] = tids
+            positions[off : off + T] = np.arange(T)
+            segment_ids[off : off + T] = i
+            t_idx = np.arange(T)
+            slot_indices[off : off + T] = (
+                np.asarray(bids, np.int64)[t_idx // bs] * bs + t_idx % bs
+            )
+            last_idx[i] = off + T - 1
+            temps[i], top_ps[i], top_ks[i], rep_pens[i] = te, tp_, tk, rp
+            keys[i] = kd
+            eos_ids[i] = er
+            eos_suppress[i] = sup
+            off += T
+        return dict(
+            tokens=tokens, positions=positions, segment_ids=segment_ids,
+            slot_indices=slot_indices, last_idx=last_idx, temps=temps,
+            top_ps=top_ps, top_ks=top_ks, rep_pens=rep_pens, keys=keys,
+            eos_ids=eos_ids, eos_suppress=eos_suppress,
+        )
+
+    def prefill_packed_arrays(
+        self, tokens, positions, segment_ids, slot_indices, last_idx,
+        temps, top_ps, top_ks, rep_pens, keys, eos_ids=None,
+        eos_suppress=None,
+    ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Run the packed batched-prefill program (arrays from
+        pack_prefill). Returns (tokens, logprobs, top_ids, top_lps), each
+        [max_batch]-major; only the first len(seqs) rows are meaningful."""
+        N = len(last_idx)
+        if eos_ids is None:
+            eos_ids = np.full((N, MAX_EOS_IDS), -1, np.int32)
+        if eos_suppress is None:
+            eos_suppress = np.zeros(N, bool)
+        out, self.k_cache, self.v_cache = self._packed_jit(
+            self.params, self.k_cache, self.v_cache,
+            self._to_dev(tokens), self._to_dev(positions),
+            self._to_dev(segment_ids), self._to_dev(slot_indices),
+            self._to_dev(last_idx), self._to_dev(keys),
+            self._to_dev(temps), self._to_dev(top_ps), self._to_dev(top_ks),
+            self._to_dev(rep_pens), self._to_dev(np.asarray(eos_ids, np.int32)),
+            self._to_dev(np.asarray(eos_suppress, bool)),
+        )
+        return out
 
     def _pad_block_count(self, n: int) -> int:
         """Smallest bucket block count >= n (bounds compiled program count).
@@ -473,12 +659,38 @@ class ModelRunner:
         temps: np.ndarray,
         top_ps: np.ndarray,
         top_ks: np.ndarray,
-    ) -> jax.Array:
-        toks, self.k_cache, self.v_cache = self._decode_fn(
+        keys: Optional[np.ndarray] = None,  # [B, 2] uint32 threefry rows
+        penalties: Optional[tuple] = None,
+        # penalties = (hist [B, L] i32, hist_len [B] i32, prompt_len [B]
+        # i32, freq [B] f32, pres [B] f32, rep [B] f32,
+        # eos_ids [B, MAX_EOS_IDS] i32, eos_suppress [B] bool); routes to
+        # the lazily-compiled penalty program (ref validate.rs:95-125 — the
+        # options are implemented here, not accepted-and-dropped; the eos
+        # mask implements min_tokens)
+    ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """One batched decode step. Returns (tokens, logprobs, top_ids,
+        top_logprobs) device arrays, each batch-major."""
+        if keys is None:
+            self._step_counter += 1
+            B = tokens.shape[0]
+            keys = np.stack(
+                [
+                    np.full(B, self._rng_seed & 0xFFFFFFFF, np.uint32),
+                    (np.arange(B, dtype=np.uint32)
+                     + np.uint32((self._step_counter * B) & 0xFFFFFFFF)),
+                ],
+                axis=1,
+            )
+        args = [
             self.params, self.k_cache, self.v_cache,
             self._to_dev(tokens), self._to_dev(positions),
             self._to_dev(block_tables), self._to_dev(slot_indices),
-            self._next_key(),
+            self._to_dev(keys),
             self._to_dev(temps), self._to_dev(top_ps), self._to_dev(top_ks),
-        )
-        return toks
+        ]
+        if penalties is not None:
+            args.extend(self._to_dev(p) for p in penalties)
+            out, self.k_cache, self.v_cache = self._decode_pen_fn(*args)
+        else:
+            out, self.k_cache, self.v_cache = self._decode_fn(*args)
+        return out

@@ -54,6 +54,82 @@ class ModelExecution:
         self.preprocessor = OpenAIPreprocessor(mdc)
         self.backend = Backend(self.preprocessor.tokenizer)
 
+    @staticmethod
+    def _fanout(pre: PreprocessedRequest) -> list[PreprocessedRequest]:
+        """n>1: n independent engine requests, one per choice index. A
+        seeded request derives seed+i per choice so choices differ but the
+        whole response stays reproducible (ref openai.rs n handling)."""
+        import dataclasses
+
+        n = max(1, pre.sampling.n or 1)
+        if n == 1:
+            return [pre]
+        out = []
+        for i in range(n):
+            s = dataclasses.replace(pre.sampling, n=1)
+            if s.seed is not None:
+                s = dataclasses.replace(s, seed=s.seed + i)
+            out.append(dataclasses.replace(pre, sampling=s))
+        return out
+
+    async def _merged_choices(
+        self,
+        choices: list[PreprocessedRequest],
+        ctx: Context,
+        timer: Optional[TokenTimer],
+        emit_chunk,
+        emit_finish,
+        counters: dict,
+    ) -> AsyncIterator[Any]:
+        """Run every choice's engine stream concurrently; yield OpenAI
+        chunks in arrival order (choice index rides inside each chunk)."""
+        queue: asyncio.Queue = asyncio.Queue()
+
+        async def run_choice(i: int, pre_i: PreprocessedRequest) -> None:
+            decoder = self.backend.decoder(pre_i.stop, pre_i.eos_token_ids)
+            finish: Optional[FinishReason] = None
+            try:
+                async for out in self.engine_fn(pre_i, ctx):
+                    step = decoder.step(out)
+                    counters["completion"] += step.tokens_emitted or (
+                        1 if out.text is not None else 0
+                    )
+                    if step.text or step.logprobs:
+                        if timer:
+                            timer.on_token(max(step.tokens_emitted, 1))
+                        queue.put_nowait(
+                            ("chunk", emit_chunk(step, i))
+                        )
+                    if step.finish_reason is not None:
+                        finish = step.finish_reason
+                        break
+                if not ctx.is_killed():
+                    queue.put_nowait(
+                        ("chunk", emit_finish(finish or FinishReason.STOP, i))
+                    )
+            except Exception as e:  # noqa: BLE001 — surface as SSE error
+                queue.put_nowait(("error", e))
+            finally:
+                queue.put_nowait(("done", i))
+
+        loop = asyncio.get_running_loop()
+        tasks = [
+            loop.create_task(run_choice(i, p)) for i, p in enumerate(choices)
+        ]
+        done = 0
+        try:
+            while done < len(tasks):
+                kind, payload = await queue.get()
+                if kind == "done":
+                    done += 1
+                elif kind == "error":
+                    raise payload
+                else:
+                    yield payload
+        finally:
+            for t in tasks:
+                t.cancel()
+
     async def chat_stream(
         self, request: ChatCompletionRequest, ctx: Context, timer: Optional[TokenTimer] = None
     ) -> AsyncIterator[Annotated]:
@@ -62,34 +138,34 @@ class ModelExecution:
         for ann in self.preprocessor.requested_annotations(pre, prompt):
             yield ann
         gen = ChatDeltaGenerator(request.model)
-        yield Annotated.from_data(gen.role_chunk().model_dump(exclude_none=True))
-        decoder = self.backend.decoder(pre.stop, pre.eos_token_ids)
-        completion_tokens = 0
-        finish: Optional[FinishReason] = None
-        async for out in self.engine_fn(pre, ctx):
-            step = decoder.step(out)
-            completion_tokens += step.tokens_emitted or (
-                1 if out.text is not None else 0
+        choices = self._fanout(pre)
+        for i in range(len(choices)):
+            yield Annotated.from_data(
+                gen.role_chunk(i).model_dump(exclude_none=True)
             )
-            if step.text:
-                if timer:
-                    timer.on_token(max(step.tokens_emitted, 1))
-                yield Annotated.from_data(
-                    gen.text_chunk(step.text).model_dump(exclude_none=True)
-                )
-            if step.finish_reason is not None:
-                finish = step.finish_reason
-                break
+        counters = {"completion": 0}
+        try:
+            async for chunk in self._merged_choices(
+                choices,
+                ctx,
+                timer,
+                lambda step, i: gen.text_chunk(
+                    step.text, index=i, logprobs=step.logprobs
+                ),
+                lambda reason, i: gen.finish_chunk(reason, index=i),
+                counters,
+            ):
+                yield Annotated.from_data(chunk.model_dump(exclude_none=True))
+        except Exception as e:  # noqa: BLE001
+            yield Annotated.from_error(f"engine error: {e}")
+            return
         if ctx.is_killed():
             return
-        yield Annotated.from_data(
-            gen.finish_chunk(finish or FinishReason.STOP).model_dump(exclude_none=True)
-        )
         if request.stream_options and request.stream_options.get("include_usage"):
             yield Annotated.from_data(
-                gen.usage_chunk(len(pre.token_ids), completion_tokens).model_dump(
-                    exclude_none=True
-                )
+                gen.usage_chunk(
+                    len(pre.token_ids), counters["completion"]
+                ).model_dump(exclude_none=True)
             )
 
     async def completion_stream(
@@ -98,28 +174,28 @@ class ModelExecution:
         pre, prompt = self.preprocessor.preprocess_completion(request)
         pre.extra["echo_text"] = prompt
         gen = CompletionDeltaGenerator(request.model)
-        decoder = self.backend.decoder(pre.stop, pre.eos_token_ids)
-        finish: Optional[FinishReason] = None
+        choices = self._fanout(pre)
         if request.echo and prompt:
-            yield Annotated.from_data(
-                gen.text_chunk(prompt).model_dump(exclude_none=True)
-            )
-        async for out in self.engine_fn(pre, ctx):
-            step = decoder.step(out)
-            if step.text:
-                if timer:
-                    timer.on_token(max(step.tokens_emitted, 1))
+            for i in range(len(choices)):
                 yield Annotated.from_data(
-                    gen.text_chunk(step.text).model_dump(exclude_none=True)
+                    gen.text_chunk(prompt, index=i).model_dump(exclude_none=True)
                 )
-            if step.finish_reason is not None:
-                finish = step.finish_reason
-                break
-        if ctx.is_killed():
+        counters = {"completion": 0}
+        try:
+            async for chunk in self._merged_choices(
+                choices,
+                ctx,
+                timer,
+                lambda step, i: gen.text_chunk(
+                    step.text, index=i, logprobs=step.logprobs
+                ),
+                lambda reason, i: gen.finish_chunk(reason, index=i),
+                counters,
+            ):
+                yield Annotated.from_data(chunk.model_dump(exclude_none=True))
+        except Exception as e:  # noqa: BLE001
+            yield Annotated.from_error(f"engine error: {e}")
             return
-        yield Annotated.from_data(
-            gen.finish_chunk(finish or FinishReason.STOP).model_dump(exclude_none=True)
-        )
 
 
 class ModelManager:

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from dynamo_tpu.ops.attention import (
     causal_prefill_attention,
     chunked_prefill_attention,
+    packed_prefill_attention,
     paged_decode_attention,
     write_chunk_kv,
     write_decode_kv,
@@ -360,6 +361,45 @@ def prefill_chunk(
         v_cache = v_cache.at[i].set(vc)
     idx = jnp.clip(valid_len - 1 - chunk_start, 0, C - 1)
     logits = _logits(x[idx][None, :], params, cfg)[0]
+    return logits, k_cache, v_cache
+
+
+def prefill_packed(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [P] int32 — several prompts packed back-to-back
+    positions: jax.Array,  # [P] int32 — restart at 0 per segment
+    segment_ids: jax.Array,  # [P] int32; -1 marks padding lanes
+    slot_indices: jax.Array,  # [P] int32 flat cache slots per token
+    k_cache: jax.Array,  # [L, Hkv, num_blocks, block_size, D]
+    v_cache: jax.Array,
+    last_idx: jax.Array,  # [N] int32 — index of each prompt's last token
+    *,
+    mesh=None,  # for MoE dispatch-path selection in _mlp
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched prefill: N short prompts packed into ONE [P] program.
+
+    The engine admits waiting prompts up to a token budget per iteration
+    and prefills them together (the reference's engines batch prefill
+    tokens across requests — vLLM behavior its mocker models,
+    mocker/scheduler.rs:28-43). Per-token flat slots route each segment's
+    K/V into its own blocks (write_decode_kv generalizes to P tokens);
+    attention is causal-within-segment. Returns (per-segment last-token
+    logits [N, V], caches). Unused last_idx lanes read token 0 — callers
+    ignore those rows.
+    """
+    P = tokens.shape[0]
+    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    for i, layer in enumerate(params["layers"]):
+        q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
+        kc, vc = write_decode_kv(k_cache[i], v_cache[i], k, v, slot_indices)
+        attn = packed_prefill_attention(q, k, v, segment_ids)
+        x = x + linear(attn.reshape(P, cfg.q_dim), layer["wo"])
+        x = _mlp(x, layer, cfg, mesh)
+        k_cache = k_cache.at[i].set(kc)
+        v_cache = v_cache.at[i].set(vc)
+    logits = _logits(x[last_idx], params, cfg)
     return logits, k_cache, v_cache
 
 

@@ -117,6 +117,43 @@ def causal_prefill_attention(
     return out.reshape(P, Hq, D).astype(q.dtype)
 
 
+def packed_prefill_attention(
+    q: jax.Array,  # [P, Hq, D] — several prompts packed back-to-back
+    k: jax.Array,  # [P, Hkv, D]
+    v: jax.Array,  # [P, Hkv, D]
+    segment_ids: jax.Array,  # [P] int32; -1 marks padding lanes
+) -> jax.Array:
+    """Causal attention over a PACKED buffer of independent prompts.
+
+    The batched-prefill program (vLLM packs prefill tokens across requests
+    up to a token budget — mocker/scheduler.rs:28-43 models that behavior):
+    token j is visible to token i iff j <= i AND both belong to the same
+    segment. One static-[P] program serves any mix of short prompts; MXU
+    utilization comes from the packed row count instead of a batch dim.
+    Padding lanes (segment -1) only attend each other and are never read.
+
+    XLA implementation (fully GSPMD-partitionable over heads); the pallas
+    prefill kernel path stays per-sequence — packing targets the many-small
+    -prompts regime where the [P, P] score tile is cheap anyway.
+    """
+    P, Hq, D = q.shape
+    Hkv = k.shape[1]
+    G = Hq // Hkv
+    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    qr = q.reshape(P, Hkv, G, D)
+    scores = jnp.einsum(
+        "qhgd,khd->hgqk", qr.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    pos = jnp.arange(P)
+    causal = pos[None, :] <= pos[:, None]  # [q, k]
+    same_seg = segment_ids[None, :] == segment_ids[:, None]
+    mask = (causal & same_seg)[None, None, :, :]
+    scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hgqk,khd->qhgd", weights, v.astype(jnp.float32))
+    return out.reshape(P, Hq, D).astype(q.dtype)
+
+
 def paged_decode_attention(
     q: jax.Array,  # [B, Hq, D] — one new token per sequence
     k_cache: jax.Array,  # [Hkv, num_blocks, block_size, D] (this layer)

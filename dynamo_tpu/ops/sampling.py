@@ -1,10 +1,23 @@
-"""On-device batched token sampling: greedy / temperature / top-k / top-p.
+"""On-device batched token sampling: greedy / temperature / top-k / top-p,
+frequency/presence/repetition penalties, per-sequence RNG streams, and
+logprobs.
 
 Fully vectorized over the batch with per-sequence parameters so one jitted
 sample call serves a mixed batch (greedy and sampled requests together).
+Role-equivalent of the sampling-parameter surface the reference validates in
+lib/llm/src/protocols/openai/validate.rs:95-125 and forwards to its engines
+— here the sampler IS the engine's, so the parameters are implemented, not
+just forwarded.
+
+TPU notes: everything is [B, V]-vectorized (no per-sequence Python), the
+penalty histogram is built with one scatter-add per step, and per-sequence
+RNG uses raw threefry key data ([B, 2] uint32 rows: (stream_id, counter)) so
+hosts can construct keys with numpy — no device dispatch per key.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -12,17 +25,121 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def sample_tokens(
-    logits: jax.Array,  # [B, V] float32
-    rng: jax.Array,
-    temperature: jax.Array,  # [B] f32; <=0 means greedy
-    top_p: jax.Array,  # [B] f32 in (0, 1]; 1.0 disables
-    top_k: jax.Array,  # [B] int32; 0 disables
+def apply_penalties(
+    logits: jax.Array,  # [B, V] f32
+    hist: jax.Array,  # [B, L] int32 token history (prompt + generated)
+    hist_len: jax.Array,  # [B] int32 total valid tokens in hist
+    prompt_len: jax.Array,  # [B] int32 prompt prefix length within hist
+    frequency_penalty: jax.Array,  # [B] f32; 0 disables
+    presence_penalty: jax.Array,  # [B] f32; 0 disables
+    repetition_penalty: jax.Array,  # [B] f32; 1 disables
 ) -> jax.Array:
-    """Returns sampled token ids [B] int32."""
-    B, V = logits.shape
-    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    """vLLM-semantics penalties:
 
+    * frequency/presence apply over GENERATED tokens only:
+      ``logits -= freq * count(v) + pres * [count(v) > 0]``
+    * repetition (HF-style) applies over prompt+generated seen tokens:
+      positive logits divided by rp, negative multiplied by rp.
+    """
+    B, V = logits.shape
+    L = hist.shape[1]
+    idx = jnp.arange(L)[None, :]
+    valid = idx < hist_len[:, None]  # [B, L]
+    is_out = valid & (idx >= prompt_len[:, None])
+    rows = jnp.arange(B)[:, None]
+    safe_hist = jnp.clip(hist, 0, V - 1)
+    out_counts = jnp.zeros((B, V), jnp.float32).at[rows, safe_hist].add(
+        is_out.astype(jnp.float32)
+    )
+    seen = jnp.zeros((B, V), jnp.float32).at[rows, safe_hist].max(
+        valid.astype(jnp.float32)
+    )
+    logits = (
+        logits
+        - frequency_penalty[:, None] * out_counts
+        - presence_penalty[:, None] * (out_counts > 0)
+    )
+    rp = repetition_penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / rp, logits * rp)
+    return jnp.where(seen > 0, penalized, logits)
+
+
+def apply_repetition_penalty_from_prompt(
+    logits: jax.Array,  # [V] or [B, V]
+    prompt: jax.Array,  # [T] int32 (padded; positions >= valid_len ignored)
+    valid_len: jax.Array,  # scalar int32
+    repetition_penalty: jax.Array,  # scalar f32; 1 disables
+) -> jax.Array:
+    """Prompt-only repetition penalty for the prefill-sampled first token
+    (frequency/presence are zero by definition at the first token)."""
+    squeeze = logits.ndim == 1
+    if squeeze:
+        logits = logits[None, :]
+    V = logits.shape[-1]
+    valid = jnp.arange(prompt.shape[0]) < valid_len
+    seen = jnp.zeros((V,), jnp.float32).at[jnp.clip(prompt, 0, V - 1)].max(
+        valid.astype(jnp.float32)
+    )
+    rp = repetition_penalty
+    penalized = jnp.where(logits > 0, logits / rp, logits * rp)
+    out = jnp.where(seen[None, :] > 0, penalized, logits)
+    return out[0] if squeeze else out
+
+
+def apply_repetition_penalty_packed(
+    logits: jax.Array,  # [N, V] per-segment last-token logits
+    tokens: jax.Array,  # [P] int32 packed prompt tokens
+    segment_ids: jax.Array,  # [P] int32; -1 marks padding
+    repetition_penalty: jax.Array,  # [N] f32; 1 disables
+) -> jax.Array:
+    """Per-segment prompt repetition penalty for the packed-prefill first
+    token: each segment's seen-set is scattered from its own tokens."""
+    N, V = logits.shape
+    valid = (segment_ids >= 0).astype(jnp.float32)
+    rows = jnp.clip(segment_ids, 0, N - 1)
+    seen = jnp.zeros((N, V), jnp.float32).at[rows, jnp.clip(tokens, 0, V - 1)].max(
+        valid
+    )
+    rp = repetition_penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / rp, logits * rp)
+    return jnp.where(seen > 0, penalized, logits)
+
+
+MAX_EOS_IDS = 4  # eos-id slots carried into the jitted programs
+
+
+def mask_eos_logits(
+    logits: jax.Array,  # [B, V] or [V]
+    eos_ids: jax.Array,  # [B, K] or [K] int32; -1 pads unused slots
+    suppress: jax.Array,  # [B] or scalar bool — min_tokens not reached
+) -> jax.Array:
+    """min_tokens support, done the vLLM way: while a sequence has not
+    generated its minimum, its EOS logits are masked to -inf so EOS cannot
+    be sampled at all (appending a suppressed EOS to the stream would still
+    stop the HTTP-layer decoder — the mask keeps every layer consistent)."""
+    squeeze = logits.ndim == 1
+    if squeeze:
+        logits = logits[None]
+        eos_ids = eos_ids[None]
+        suppress = jnp.asarray(suppress).reshape(1)
+    B, V = logits.shape
+    rows = jnp.arange(B)[:, None]
+    valid = eos_ids >= 0
+    is_eos = jnp.zeros((B, V), bool).at[
+        rows, jnp.clip(eos_ids, 0, V - 1)
+    ].max(valid)
+    out = jnp.where(is_eos & suppress[:, None], NEG_INF, logits)
+    return out[0] if squeeze else out
+
+
+def _filtered_logits(
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    top_k: jax.Array,
+) -> jax.Array:
+    """Temperature-scale then mask to the top-k / nucleus support."""
+    B, V = logits.shape
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
 
@@ -43,7 +160,69 @@ def sample_tokens(
     thresh = jnp.min(
         jnp.where(keep_sorted, sorted_desc2, jnp.inf), axis=-1, keepdims=True
     )
-    scaled = jnp.where(scaled < thresh, NEG_INF, scaled)
+    return jnp.where(scaled < thresh, NEG_INF, scaled)
 
-    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+
+def sample_tokens(
+    logits: jax.Array,  # [B, V] float32
+    rng: jax.Array,
+    temperature: jax.Array,  # [B] f32; <=0 means greedy
+    top_p: jax.Array,  # [B] f32 in (0, 1]; 1.0 disables
+    top_k: jax.Array,  # [B] int32; 0 disables
+    keys: Optional[jax.Array] = None,  # [B, 2] uint32 raw threefry key data
+) -> jax.Array:
+    """Returns sampled token ids [B] int32.
+
+    `rng` seeds the whole batch; when `keys` is given, each row samples from
+    its own threefry stream (per-request `seed` support) and `rng` is
+    ignored for the draw.
+    """
+    greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = _filtered_logits(logits, temperature, top_p, top_k)
+    if keys is not None:
+        sampled = jax.vmap(
+            lambda kd, lg: jax.random.categorical(
+                jax.random.wrap_key_data(kd.astype(jnp.uint32)), lg
+            )
+        )(keys, scaled).astype(jnp.int32)
+    else:
+        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy_ids, sampled)
+
+
+def sample_tokens_full(
+    logits: jax.Array,  # [B, V] float32
+    rng: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    top_k: jax.Array,
+    keys: Optional[jax.Array] = None,
+    num_top: int = 20,  # the OpenAI top_logprobs ceiling
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """sample_tokens + logprob surface.
+
+    Returns (tokens [B] i32, chosen_logprob [B] f32,
+    top_ids [B, num_top] i32, top_logprobs [B, num_top] f32). Logprobs are
+    of the model's raw distribution (pre temperature/top-k/top-p), matching
+    the OpenAI `logprobs` contract.
+    """
+    tokens = sample_tokens(logits, rng, temperature, top_p, top_k, keys=keys)
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen = jnp.take_along_axis(logz, tokens[:, None].astype(jnp.int32), axis=-1)[
+        :, 0
+    ]
+    top_lps, top_ids = jax.lax.top_k(logz, num_top)
+    return tokens, chosen, top_ids.astype(jnp.int32), top_lps
+
+
+def make_key_data(stream_id: int, counter: int):
+    """Host-side raw threefry key row for sample_tokens(keys=...): a
+    (stream, counter) pair IS a valid independent threefry stream — no
+    device work to build one. numpy only (callable from the engine's host
+    loop and from follower replay)."""
+    import numpy as np
+
+    return np.array(
+        [np.uint32(stream_id & 0xFFFFFFFF), np.uint32(counter & 0xFFFFFFFF)],
+        np.uint32,
+    )

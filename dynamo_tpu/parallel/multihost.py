@@ -45,6 +45,7 @@ OP_PREFILL = 2
 OP_CHUNK = 3
 OP_EXTRACT = 4
 OP_INJECT = 5
+OP_PACKED = 6
 OP_STOP = 0
 
 
@@ -206,57 +207,131 @@ class SpmdModelRunner:
 
     # -- intercepted calls (must match follower_loop's dispatch table) --
 
-    def prefill(self, token_ids, block_ids, temperature, top_p, top_k):
+    def prefill(self, token_ids, block_ids, temperature, top_p, top_k,
+                rep_pen=1.0, key_data=None, eos_ids=None, eos_suppress=False):
         t = np.asarray(token_ids, np.int32)
         b = np.asarray(block_ids, np.int32)
+        # materialize the RNG row HERE so leader and followers run the
+        # sampled draw from the identical stream
+        if key_data is None:
+            key_data = self._runner._next_key_data()
+        if eos_ids is None:
+            eos_ids = np.full(_EOS_K, -1, np.int32)
         self._channel.send(
             OP_PREFILL,
-            [len(t), len(b)],
-            (t, b, np.float32(temperature), np.float32(top_p), np.int32(top_k)),
+            [len(t), len(b), 1 if eos_suppress else 0],
+            (t, b, np.float32(temperature), np.float32(top_p),
+             np.int32(top_k), np.float32(rep_pen),
+             np.asarray(key_data, np.uint32),
+             np.asarray(eos_ids, np.int32)),
         )
-        return self._runner._fetch(
+        return self._fetch_sample(
             self._runner.prefill(
-                list(token_ids), list(block_ids), temperature, top_p, top_k
+                list(token_ids), list(block_ids), temperature, top_p, top_k,
+                rep_pen=float(rep_pen), key_data=np.asarray(key_data),
+                eos_ids=np.asarray(eos_ids), eos_suppress=bool(eos_suppress),
             )
         )
 
     def prefill_chunk(
         self, token_chunk, chunk_start, total_len, block_ids, temperature,
-        top_p, top_k,
+        top_p, top_k, rep_pen=1.0, key_data=None, eos_ids=None,
+        eos_suppress=False,
     ):
         t = np.asarray(token_chunk, np.int32)
         b = np.asarray(block_ids, np.int32)
+        if key_data is None:
+            key_data = self._runner._next_key_data()
+        if eos_ids is None:
+            eos_ids = np.full(_EOS_K, -1, np.int32)
         self._channel.send(
             OP_CHUNK,
-            [len(t), len(b), int(chunk_start), int(total_len)],
-            (t, b, np.float32(temperature), np.float32(top_p), np.int32(top_k)),
+            [len(t), len(b), int(chunk_start), int(total_len),
+             1 if eos_suppress else 0],
+            (t, b, np.float32(temperature), np.float32(top_p),
+             np.int32(top_k), np.float32(rep_pen),
+             np.asarray(key_data, np.uint32),
+             np.asarray(eos_ids, np.int32)),
         )
-        return self._runner._fetch(
+        return self._fetch_sample(
             self._runner.prefill_chunk(
                 list(token_chunk), int(chunk_start), int(total_len),
                 list(block_ids), temperature, top_p, top_k,
+                rep_pen=float(rep_pen), key_data=np.asarray(key_data),
+                eos_ids=np.asarray(eos_ids), eos_suppress=bool(eos_suppress),
             )
         )
 
     def decode(self, tokens, positions, block_tables, slot_indices, temps,
-               top_ps, top_ks):
+               top_ps, top_ks, keys=None, penalties=None):
+        B = tokens.shape[0]
+        if keys is None:
+            # same default derivation the inner runner would use, but built
+            # here so the broadcast carries the authoritative rows
+            self._runner._step_counter += 1
+            keys = np.stack(
+                [
+                    np.full(B, self._runner._rng_seed & 0xFFFFFFFF, np.uint32),
+                    (np.arange(B, dtype=np.uint32)
+                     + np.uint32((self._runner._step_counter * B) & 0xFFFFFFFF)),
+                ],
+                axis=1,
+            )
+        payload = [
+            np.asarray(tokens, np.int32),
+            np.asarray(positions, np.int32),
+            np.asarray(block_tables, np.int32),
+            np.asarray(slot_indices, np.int32),
+            np.asarray(temps, np.float32),
+            np.asarray(top_ps, np.float32),
+            np.asarray(top_ks, np.int32),
+            np.asarray(keys, np.uint32),
+        ]
+        if penalties is not None:
+            payload.extend(np.asarray(p) for p in penalties)
         self._channel.send(
             OP_DECODE,
-            [tokens.shape[0], block_tables.shape[1]],
-            (
-                np.asarray(tokens, np.int32),
-                np.asarray(positions, np.int32),
-                np.asarray(block_tables, np.int32),
-                np.asarray(slot_indices, np.int32),
-                np.asarray(temps, np.float32),
-                np.asarray(top_ps, np.float32),
-                np.asarray(top_ks, np.int32),
-            ),
+            [B, block_tables.shape[1], 1 if penalties is not None else 0],
+            tuple(payload),
         )
-        return self._runner._fetch(
+        return self._fetch_sample(
             self._runner.decode(
                 tokens, positions, block_tables, slot_indices, temps,
-                top_ps, top_ks,
+                top_ps, top_ks, keys=keys, penalties=penalties,
+            )
+        )
+
+    def _fetch_sample(self, out: tuple):
+        return tuple(self._runner._fetch(x) for x in out)
+
+    def prefill_packed_arrays(
+        self, tokens, positions, segment_ids, slot_indices, last_idx,
+        temps, top_ps, top_ks, rep_pens, keys, eos_ids=None,
+        eos_suppress=None,
+    ):
+        N = len(last_idx)
+        if eos_ids is None:
+            eos_ids = np.full((N, _EOS_K), -1, np.int32)
+        if eos_suppress is None:
+            eos_suppress = np.zeros(N, bool)
+        payload = (
+            np.asarray(tokens, np.int32), np.asarray(positions, np.int32),
+            np.asarray(segment_ids, np.int32),
+            np.asarray(slot_indices, np.int32),
+            np.asarray(last_idx, np.int32), np.asarray(temps, np.float32),
+            np.asarray(top_ps, np.float32), np.asarray(top_ks, np.int32),
+            np.asarray(rep_pens, np.float32), np.asarray(keys, np.uint32),
+            np.asarray(eos_ids, np.int32),
+            np.asarray(eos_suppress, bool),
+        )
+        self._channel.send(
+            OP_PACKED, [len(payload[0]), len(payload[4])], payload
+        )
+        return self._fetch_sample(
+            self._runner.prefill_packed_arrays(
+                tokens, positions, segment_ids, slot_indices, last_idx,
+                temps, top_ps, top_ks, rep_pens, keys, eos_ids=eos_ids,
+                eos_suppress=eos_suppress,
             )
         )
 
@@ -305,6 +380,7 @@ class FollowerHandle:
 
 
 _DT = {0: np.float16, 1: np.float32, 2: np.uint16}  # 2 = bf16-as-bits
+_EOS_K = 4  # == ops.sampling.MAX_EOS_IDS (kept literal: followers import-light)
 
 
 def follower_loop(runner, channel: SpmdStepChannel) -> None:
@@ -320,44 +396,81 @@ def follower_loop(runner, channel: SpmdStepChannel) -> None:
         if op == OP_STOP:
             return
         if op == OP_DECODE:
-            B, nb = int(h[1]), int(h[2])
-            (tok, pos, bt, slot, te, tp_, tk) = channel.recv_payload(
-                (
-                    np.zeros(B, np.int32), np.zeros(B, np.int32),
-                    np.zeros((B, nb), np.int32), np.zeros(B, np.int32),
-                    np.zeros(B, np.float32), np.zeros(B, np.float32),
-                    np.zeros(B, np.int32),
+            B, nb, has_pen = int(h[1]), int(h[2]), int(h[3])
+            template = [
+                np.zeros(B, np.int32), np.zeros(B, np.int32),
+                np.zeros((B, nb), np.int32), np.zeros(B, np.int32),
+                np.zeros(B, np.float32), np.zeros(B, np.float32),
+                np.zeros(B, np.int32), np.zeros((B, 2), np.uint32),
+            ]
+            if has_pen:
+                Lh = runner.max_model_len
+                template.extend(
+                    [
+                        np.zeros((B, Lh), np.int32), np.zeros(B, np.int32),
+                        np.zeros(B, np.int32), np.zeros(B, np.float32),
+                        np.zeros(B, np.float32), np.ones(B, np.float32),
+                        np.full((B, _EOS_K), -1, np.int32),
+                        np.zeros(B, bool),
+                    ]
                 )
+            got = channel.recv_payload(tuple(template))
+            (tok, pos, bt, slot, te, tp_, tk, keys) = got[:8]
+            penalties = (
+                tuple(np.asarray(p) for p in got[8:]) if has_pen else None
             )
             runner.decode(
                 np.asarray(tok), np.asarray(pos), np.asarray(bt),
                 np.asarray(slot), np.asarray(te), np.asarray(tp_),
-                np.asarray(tk),
+                np.asarray(tk), keys=np.asarray(keys), penalties=penalties,
             )
         elif op == OP_PREFILL:
-            T, nb = int(h[1]), int(h[2])
-            (t, b, te, tp_, tk) = channel.recv_payload(
+            T, nb, sup = int(h[1]), int(h[2]), int(h[3])
+            (t, b, te, tp_, tk, rp, kd, er) = channel.recv_payload(
                 (
                     np.zeros(T, np.int32), np.zeros(nb, np.int32),
                     np.float32(0), np.float32(0), np.int32(0),
+                    np.float32(1), np.zeros(2, np.uint32),
+                    np.full(_EOS_K, -1, np.int32),
                 )
             )
             runner.prefill(
                 np.asarray(t).tolist(), np.asarray(b).tolist(),
                 float(te), float(tp_), int(tk),
+                rep_pen=float(rp), key_data=np.asarray(kd),
+                eos_ids=np.asarray(er), eos_suppress=bool(sup),
             )
         elif op == OP_CHUNK:
-            T, nb, start, total = int(h[1]), int(h[2]), int(h[3]), int(h[4])
-            (t, b, te, tp_, tk) = channel.recv_payload(
+            T, nb, start, total, sup = (
+                int(h[1]), int(h[2]), int(h[3]), int(h[4]), int(h[5])
+            )
+            (t, b, te, tp_, tk, rp, kd, er) = channel.recv_payload(
                 (
                     np.zeros(T, np.int32), np.zeros(nb, np.int32),
                     np.float32(0), np.float32(0), np.int32(0),
+                    np.float32(1), np.zeros(2, np.uint32),
+                    np.full(_EOS_K, -1, np.int32),
                 )
             )
             runner.prefill_chunk(
                 np.asarray(t).tolist(), start, total,
                 np.asarray(b).tolist(), float(te), float(tp_), int(tk),
+                rep_pen=float(rp), key_data=np.asarray(kd),
+                eos_ids=np.asarray(er), eos_suppress=bool(sup),
             )
+        elif op == OP_PACKED:
+            P, N = int(h[1]), int(h[2])
+            got = channel.recv_payload(
+                (
+                    np.zeros(P, np.int32), np.zeros(P, np.int32),
+                    np.zeros(P, np.int32), np.zeros(P, np.int32),
+                    np.zeros(N, np.int32), np.zeros(N, np.float32),
+                    np.zeros(N, np.float32), np.zeros(N, np.int32),
+                    np.ones(N, np.float32), np.zeros((N, 2), np.uint32),
+                    np.full((N, _EOS_K), -1, np.int32), np.zeros(N, bool),
+                )
+            )
+            runner.prefill_packed_arrays(*(np.asarray(a) for a in got))
         elif op == OP_EXTRACT:
             n = int(h[1])
             (b,) = channel.recv_payload((np.zeros(n, np.int32),))

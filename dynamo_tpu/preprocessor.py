@@ -79,15 +79,28 @@ class OpenAIPreprocessor:
         max_tokens: Optional[int],
     ) -> PreprocessedRequest:
         ext = request.ext
+        # logprobs: chat uses (logprobs: bool, top_logprobs: int); legacy
+        # completions uses (logprobs: int = top-N). Normalize both.
+        lp = request.logprobs
+        want_logprobs = lp is not None and lp is not False
+        if request.top_logprobs is not None:
+            num_top = request.top_logprobs
+        elif isinstance(lp, int) and not isinstance(lp, bool):
+            num_top = lp
+        else:
+            num_top = 0
         sampling = SamplingOptions(
             temperature=request.temperature,
             top_p=request.top_p,
             top_k=request.top_k,
             frequency_penalty=request.frequency_penalty,
             presence_penalty=request.presence_penalty,
+            repetition_penalty=request.repetition_penalty,
             seed=request.seed,
             n=request.n,
             greedy=bool(ext and ext.greedy),
+            logprobs=want_logprobs,
+            top_logprobs=num_top,
         )
         budget = self.mdc.context_length - len(token_ids)
         if max_tokens is None:
@@ -95,6 +108,7 @@ class OpenAIPreprocessor:
         stop = StopConditions(
             max_tokens=max_tokens,
             stop=request.stop_list(),
+            min_tokens=request.min_tokens,
             ignore_eos=bool(ext and ext.ignore_eos),
         )
         return PreprocessedRequest(
@@ -139,12 +153,23 @@ class ChatDeltaGenerator:
             ],
         )
 
-    def text_chunk(self, text: str, index: int = 0) -> ChatCompletionChunk:
+    def text_chunk(
+        self,
+        text: str,
+        index: int = 0,
+        logprobs: Optional[list[dict]] = None,
+    ) -> ChatCompletionChunk:
         return ChatCompletionChunk(
             id=self.id,
             model=self.model,
             created=self.created,
-            choices=[StreamChoice(index=index, delta=ChoiceDelta(content=text))],
+            choices=[
+                StreamChoice(
+                    index=index,
+                    delta=ChoiceDelta(content=text),
+                    logprobs={"content": logprobs} if logprobs else None,
+                )
+            ],
         )
 
     def finish_chunk(
@@ -178,13 +203,45 @@ class CompletionDeltaGenerator:
         self.id = request_id or gen_request_id("cmpl")
         self.model = model
         self.created = int(time.time())
+        # running character offset per choice index — the legacy logprobs
+        # contract is four PARALLEL arrays, so text_offset must track
+        # tokens 1:1 across streamed chunks
+        self._char_off: dict[int, int] = {}
 
-    def text_chunk(self, text: str, index: int = 0) -> CompletionResponse:
+    def text_chunk(
+        self,
+        text: str,
+        index: int = 0,
+        logprobs: Optional[list[dict]] = None,
+    ) -> CompletionResponse:
+        lp = None
+        if logprobs:
+            # legacy completions logprobs shape
+            offsets = []
+            off = self._char_off.get(index, 0)
+            for e in logprobs:
+                offsets.append(off)
+                off += len(e["token"])
+            self._char_off[index] = off
+            lp = {
+                "tokens": [e["token"] for e in logprobs],
+                "token_logprobs": [e["logprob"] for e in logprobs],
+                "top_logprobs": [
+                    {
+                        t["token"]: t["logprob"]
+                        for t in e.get("top_logprobs", [])
+                    }
+                    for e in logprobs
+                ],
+                "text_offset": offsets,
+            }
         return CompletionResponse(
             id=self.id,
             model=self.model,
             created=self.created,
-            choices=[CompletionChoice(index=index, text=text)],
+            choices=[
+                CompletionChoice(index=index, text=text, logprobs=lp)
+            ],
         )
 
     def finish_chunk(

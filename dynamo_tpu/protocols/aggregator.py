@@ -36,7 +36,10 @@ class ChatDeltaAggregator:
         for c in chunk.choices:
             slot = self._choices.setdefault(
                 c.index,
-                {"role": None, "content": [], "finish_reason": None, "tool_calls": []},
+                {
+                    "role": None, "content": [], "finish_reason": None,
+                    "tool_calls": [], "logprobs": [],
+                },
             )
             if c.delta.role:
                 slot["role"] = c.delta.role
@@ -44,6 +47,8 @@ class ChatDeltaAggregator:
                 slot["content"].append(c.delta.content)
             if c.delta.tool_calls:
                 slot["tool_calls"].extend(c.delta.tool_calls)
+            if c.logprobs and c.logprobs.get("content"):
+                slot["logprobs"].extend(c.logprobs["content"])
             if c.finish_reason:
                 slot["finish_reason"] = c.finish_reason
 
@@ -57,6 +62,9 @@ class ChatDeltaAggregator:
                     tool_calls=slot["tool_calls"] or None,
                 ),
                 finish_reason=slot["finish_reason"],
+                logprobs={"content": slot["logprobs"]}
+                if slot["logprobs"]
+                else None,
             )
             for i, slot in sorted(self._choices.items())
         ]
@@ -89,10 +97,20 @@ class CompletionAggregator:
             self.usage = chunk.usage
         for c in chunk.choices:
             slot = self._choices.setdefault(
-                c.index, {"text": [], "finish_reason": None}
+                c.index, {"text": [], "finish_reason": None, "logprobs": None}
             )
             if c.text:
                 slot["text"].append(c.text)
+            if c.logprobs:
+                lp = slot["logprobs"] or {
+                    "tokens": [], "token_logprobs": [],
+                    "top_logprobs": [], "text_offset": [],
+                }
+                for key in (
+                    "tokens", "token_logprobs", "top_logprobs", "text_offset"
+                ):
+                    lp[key].extend(c.logprobs.get(key, []))
+                slot["logprobs"] = lp
             if c.finish_reason:
                 slot["finish_reason"] = c.finish_reason
 
@@ -105,6 +123,7 @@ class CompletionAggregator:
                     index=i,
                     text="".join(slot["text"]),
                     finish_reason=slot["finish_reason"],
+                    logprobs=slot["logprobs"],
                 )
                 for i, slot in sorted(self._choices.items())
             ],

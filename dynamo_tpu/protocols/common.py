@@ -43,6 +43,9 @@ class SamplingOptions:
     seed: Optional[int] = None
     n: int = 1
     greedy: bool = False
+    # logprob surface (openai `logprobs`/`top_logprobs`)
+    logprobs: bool = False
+    top_logprobs: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return {k: v for k, v in asdict(self).items() if v is not None}
@@ -123,6 +126,10 @@ class LLMEngineOutput:
     cum_log_probs: Optional[float] = None
     finish_reason: Optional[FinishReason] = None
     index: int = 0  # choice index for n>1
+    # per-token logprob of each id in token_ids (when requested)
+    log_probs: Optional[list[float]] = None
+    # per-token top-K alternatives: [[(token_id, logprob), ...], ...]
+    top_logprobs: Optional[list[list[list[float]]]] = None
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {"token_ids": self.token_ids, "index": self.index}
@@ -132,6 +139,10 @@ class LLMEngineOutput:
             out["cum_log_probs"] = self.cum_log_probs
         if self.finish_reason is not None:
             out["finish_reason"] = self.finish_reason.value
+        if self.log_probs is not None:
+            out["log_probs"] = self.log_probs
+        if self.top_logprobs is not None:
+            out["top_logprobs"] = self.top_logprobs
         return out
 
     @classmethod
@@ -143,6 +154,8 @@ class LLMEngineOutput:
             cum_log_probs=d.get("cum_log_probs"),
             finish_reason=FinishReason(fr) if fr else None,
             index=d.get("index", 0),
+            log_probs=d.get("log_probs"),
+            top_logprobs=d.get("top_logprobs"),
         )
 
     @classmethod

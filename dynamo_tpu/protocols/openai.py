@@ -53,12 +53,15 @@ class _CommonSampling(BaseModel):
     top_k: Optional[int] = Field(default=None, ge=0)
     frequency_penalty: Optional[float] = Field(default=None, ge=-2.0, le=2.0)
     presence_penalty: Optional[float] = Field(default=None, ge=-2.0, le=2.0)
+    repetition_penalty: Optional[float] = Field(default=None, gt=0.0, le=2.0)
+    min_tokens: Optional[int] = Field(default=None, ge=0)
     seed: Optional[int] = None
     n: int = Field(default=1, ge=1, le=16)
     stream: bool = False
     stream_options: Optional[dict[str, Any]] = None
     stop: Optional[Union[str, list[str]]] = None
     logprobs: Optional[Union[bool, int]] = None
+    top_logprobs: Optional[int] = Field(default=None, ge=0, le=20)
     user: Optional[str] = None
     ext: Optional[Ext] = None
 

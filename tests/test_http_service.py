@@ -118,6 +118,56 @@ async def test_http_static_echo_chat_stream_and_aggregate():
         await drt.close()
 
 
+async def test_http_n_gt_1_choices():
+    """n=2 fans out to two engine streams and two indexed choices
+    (service _fanout; ref openai.rs n handling)."""
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        mdc = make_test_mdc("echo-n")
+        config = EngineConfig.static_(EchoEngineCore(), mdc)
+        service = await run_http(drt, config, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as session:
+            payload = {
+                "model": "echo-n",
+                "messages": [{"role": "user", "content": "hello world"}],
+                "stream": False,
+                "n": 2,
+                "max_tokens": 8,
+            }
+            async with session.post(
+                f"{base}/v1/chat/completions", json=payload
+            ) as resp:
+                assert resp.status == 200
+                agg = await resp.json()
+            assert len(agg["choices"]) == 2
+            assert sorted(c["index"] for c in agg["choices"]) == [0, 1]
+            for c in agg["choices"]:
+                assert "hello" in c["message"]["content"]
+            # streaming: chunks carry both indices
+            payload["stream"] = True
+            async with session.post(
+                f"{base}/v1/chat/completions", json=payload
+            ) as resp:
+                events = await _collect_sse(resp)
+            seen = {
+                c["choices"][0]["index"]
+                for c in (ev.json() for ev in events[:-1])
+                if c and c.get("choices")
+            }
+            assert seen == {0, 1}
+            # out-of-range n -> 400 (pydantic le=16)
+            async with session.post(
+                f"{base}/v1/chat/completions", json={**payload, "n": 99}
+            ) as resp:
+                assert resp.status == 400
+    finally:
+        if service:
+            await service.close()
+        await drt.close()
+
+
 async def test_http_dynamic_discovery_e2e():
     """Worker registers a model via register_llm; the frontend's ModelWatcher
     discovers it and serves OpenAI requests routed over the fabric."""
