@@ -1,0 +1,168 @@
+"""Prefix-structured synthetic trace generator.
+
+Role-equivalent of the reference's benchmarks/data_generator/synthesizer.py:
+real serving traffic shares long prompt prefixes (system prompts, few-shot
+scaffolds, multi-turn history), and that structure is exactly what KV-aware
+routing exploits. This generator produces token-space request traces with
+controllable prefix sharing:
+
+  * K distinct prefixes, lengths ~ lognormal, rounded to whole KV blocks
+    (sharing only pays in whole blocks);
+  * requests pick a prefix by a Zipf popularity law and append a unique
+    suffix (lognormal length);
+  * Poisson arrivals at a configurable rate;
+  * OSL ~ lognormal.
+
+Library surface (synthesize_trace / save_jsonl / load_jsonl / trace_stats)
+plus a CLI that writes JSONL and prints a stats line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class TraceRequest:
+    arrival_ms: float
+    token_ids: list[int]
+    osl: int
+    prefix_id: int
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceRequest":
+        return cls(
+            arrival_ms=float(d["arrival_ms"]),
+            token_ids=list(d["token_ids"]),
+            osl=int(d["osl"]),
+            prefix_id=int(d["prefix_id"]),
+        )
+
+
+def synthesize_trace(
+    num_requests: int = 100,
+    *,
+    num_prefixes: int = 8,
+    prefix_len_mean: int = 256,
+    suffix_len_mean: int = 48,
+    osl_mean: int = 64,
+    rate_rps: float = 8.0,
+    zipf_a: float = 1.4,
+    vocab: int = 50000,
+    block_size: int = 16,
+    seed: int = 0,
+) -> list[TraceRequest]:
+    rng = np.random.default_rng(seed)
+
+    def logn(mean: float, sigma: float, size: int) -> np.ndarray:
+        # lognormal parameterized by its MEAN (not mu)
+        mu = np.log(mean) - sigma * sigma / 2
+        return rng.lognormal(mu, sigma, size)
+
+    # prefix pool: whole-block lengths (sharing pays only in whole blocks)
+    plens = np.maximum(
+        block_size,
+        (logn(prefix_len_mean, 0.4, num_prefixes) // block_size).astype(int)
+        * block_size,
+    )
+    prefixes = [
+        rng.integers(1, vocab, size=int(n)).tolist() for n in plens
+    ]
+    # popularity: zipf ranks over the pool (rank 0 hottest)
+    ranks = (rng.zipf(zipf_a, num_requests) - 1) % num_prefixes
+    arrivals = np.cumsum(rng.exponential(1000.0 / rate_rps, num_requests))
+    slens = np.maximum(1, logn(suffix_len_mean, 0.6, num_requests)).astype(int)
+    osls = np.maximum(4, logn(osl_mean, 0.6, num_requests)).astype(int)
+    trace = []
+    for i in range(num_requests):
+        pid = int(ranks[i])
+        suffix = rng.integers(1, vocab, size=int(slens[i])).tolist()
+        trace.append(
+            TraceRequest(
+                arrival_ms=float(arrivals[i]),
+                token_ids=prefixes[pid] + suffix,
+                osl=int(osls[i]),
+                prefix_id=pid,
+            )
+        )
+    return trace
+
+
+def save_jsonl(trace: list[TraceRequest], path: str) -> None:
+    with open(path, "w") as f:
+        for r in trace:
+            f.write(json.dumps(r.to_dict()) + "\n")
+
+
+def load_jsonl(path: str) -> list[TraceRequest]:
+    with open(path) as f:
+        return [TraceRequest.from_dict(json.loads(line)) for line in f if line.strip()]
+
+
+def trace_stats(trace: list[TraceRequest], block_size: int = 16) -> dict:
+    """Sharing/shape statistics (the prefix_share number is what predicts
+    KV-routing gains: the fraction of prompt tokens that are re-served)."""
+    isls = [len(r.token_ids) for r in trace]
+    seen_prefix: set[int] = set()
+    shared_tokens = 0
+    total_tokens = 0
+    by_prefix: dict[int, int] = {}
+    for r in trace:
+        total_tokens += len(r.token_ids)
+        by_prefix[r.prefix_id] = by_prefix.get(r.prefix_id, 0) + 1
+        if r.prefix_id in seen_prefix:
+            # a later request re-uses the whole prefix
+            first = next(t for t in trace if t.prefix_id == r.prefix_id)
+            common = 0
+            for a, b in zip(first.token_ids, r.token_ids):
+                if a != b:
+                    break
+                common += 1
+            shared_tokens += (common // block_size) * block_size
+        seen_prefix.add(r.prefix_id)
+    return {
+        "requests": len(trace),
+        "mean_isl": float(np.mean(isls)),
+        "mean_osl": float(np.mean([r.osl for r in trace])),
+        "prefix_share": shared_tokens / max(1, total_tokens),
+        "hot_prefix_fraction": max(by_prefix.values()) / len(trace),
+        "duration_s": trace[-1].arrival_ms / 1000.0 if trace else 0.0,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--requests", type=int, default=1000)
+    ap.add_argument("--prefixes", type=int, default=16)
+    ap.add_argument("--prefix-len", type=int, default=512)
+    ap.add_argument("--suffix-len", type=int, default=64)
+    ap.add_argument("--osl", type=int, default=128)
+    ap.add_argument("--rate", type=float, default=8.0)
+    ap.add_argument("--zipf", type=float, default=1.4)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    trace = synthesize_trace(
+        args.requests,
+        num_prefixes=args.prefixes,
+        prefix_len_mean=args.prefix_len,
+        suffix_len_mean=args.suffix_len,
+        osl_mean=args.osl,
+        rate_rps=args.rate,
+        zipf_a=args.zipf,
+        seed=args.seed,
+    )
+    save_jsonl(trace, args.out)
+    print(json.dumps({"out": args.out, **trace_stats(trace)}))
+
+
+if __name__ == "__main__":
+    main()

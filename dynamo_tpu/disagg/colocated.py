@@ -1,0 +1,89 @@
+"""Colocated (same-process, same-slice) disaggregated prefill/decode with a
+DEVICE-NATIVE KV data plane.
+
+The reference's disagg data plane is GPUDirect RDMA via NIXL
+(docs/architecture/disagg_serving.md:76-118, block_manager/storage/nixl.rs).
+The TPU-native equivalent when prefill and decode share a slice/pod is NOT a
+wire at all: one process drives a prefill engine on one device subset and a
+decode engine on another, and KV blocks move mesh-to-mesh with
+`jax.device_put` under the destination sharding — pure ICI, zero host hop,
+zero serialization. `disagg/transfer.py`'s msgpack/TCP path remains the
+general cross-process / cross-slice (DCN) fallback; deployments pick by
+topology (same process+slice -> ColocatedPrefillClient, else
+RemotePrefillClient).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from dynamo_tpu.disagg.protocols import RemotePrefillRequest
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.disagg.colocated")
+
+
+@dataclass
+class DevicePrefillResponse:
+    """Prefill result whose KV payload is DEVICE arrays (prefill mesh);
+    shape [L, Hkv, padded_blocks, bs, D] with `num_blocks` meaningful."""
+
+    request_id: str
+    first_token: int
+    k_dev: Any = None
+    v_dev: Any = None
+    num_blocks: int = 0  # valid blocks within the padded device arrays
+    first_block: int = 0
+    error: Optional[str] = None
+    first_logprob: Optional[float] = None
+    first_top: Optional[list] = None
+    # payload=None keeps this duck-compatible with RemotePrefillResponse
+    # consumers that check `resp.payload`
+    payload: None = None
+
+
+class ColocatedPrefillClient:
+    """Drop-in for RemotePrefillClient when the prefill engine lives in
+    this process: same `prefill(...)` surface, device-array payloads."""
+
+    def __init__(self, prefill_engine: Any, block_size: int = 16) -> None:
+        self.engine = prefill_engine
+        self.block_size = block_size
+
+    async def start(self) -> None:  # interface parity
+        return None
+
+    async def close(self) -> None:
+        return None
+
+    async def prefill(
+        self,
+        token_ids: list[int],
+        *,
+        temperature: float = 0.0,
+        top_p: float = 1.0,
+        top_k: int = 0,
+        cached_blocks: int = 0,
+        rep_pen: float = 1.0,
+        key_data=None,
+        eos_ids=None,
+        eos_suppress: bool = False,
+    ) -> DevicePrefillResponse:
+        req = RemotePrefillRequest(
+            request_id=uuid.uuid4().hex,
+            token_ids=list(token_ids),
+            reply_subject="(colocated)",
+            temperature=temperature,
+            top_p=top_p,
+            top_k=top_k,
+            cached_blocks=cached_blocks,
+            block_size=self.block_size,
+            rep_pen=rep_pen,
+            key_data=[int(x) for x in key_data] if key_data is not None else None,
+            eos_ids=[int(x) for x in eos_ids] if eos_ids is not None else None,
+            eos_suppress=bool(eos_suppress),
+        )
+        return await self.engine.prefill_only_device(req)

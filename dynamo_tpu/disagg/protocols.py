@@ -79,6 +79,13 @@ class RemotePrefillRequest:
     # worker skips recomputing these leading blocks
     cached_blocks: int = 0
     block_size: int = 16
+    # first-token sampling identity (same semantics as the local path:
+    # the prefill worker must draw from the REQUESTER'S stream, apply its
+    # repetition penalty, and honor min_tokens EOS masking)
+    rep_pen: float = 1.0
+    key_data: Optional[list[int]] = None  # [2] uint32 threefry row
+    eos_ids: Optional[list[int]] = None
+    eos_suppress: bool = False
     # opaque routing/annotation extras
     extra: dict[str, Any] = field(default_factory=dict)
 

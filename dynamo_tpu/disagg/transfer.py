@@ -118,6 +118,10 @@ class RemotePrefillClient:
         top_p: float = 1.0,
         top_k: int = 0,
         cached_blocks: int = 0,
+        rep_pen: float = 1.0,
+        key_data=None,
+        eos_ids=None,
+        eos_suppress: bool = False,
         extra: Optional[dict[str, Any]] = None,
     ) -> RemotePrefillResponse:
         """Enqueue a remote prefill and await its response."""
@@ -133,6 +137,10 @@ class RemotePrefillClient:
             top_k=top_k,
             cached_blocks=cached_blocks,
             block_size=self.block_size,
+            rep_pen=rep_pen,
+            key_data=[int(x) for x in key_data] if key_data is not None else None,
+            eos_ids=[int(x) for x in eos_ids] if eos_ids is not None else None,
+            eos_suppress=bool(eos_suppress),
             extra=extra or {},
         )
         try:

@@ -672,6 +672,10 @@ class JaxEngine:
                 top_p=seq.top_p,
                 top_k=seq.top_k,
                 cached_blocks=cached,
+                rep_pen=seq.rep_pen,
+                key_data=self._key_row(seq),
+                eos_ids=seq.eos_row,
+                eos_suppress=seq.needs_eos_suppress,
             )
         except asyncio.CancelledError:
             if self._closed:
@@ -728,6 +732,23 @@ class JaxEngine:
         from dynamo_tpu.disagg.transfer import from_wire_array
 
         if resp is not None and resp.error is None:
+            if getattr(resp, "k_dev", None) is not None:
+                # device-native payload (colocated P/D): blocks move
+                # mesh-to-mesh via device_put inside inject_blocks_device —
+                # no host hop, no msgpack
+                ids = seq.block_ids[
+                    resp.first_block : resp.first_block + resp.num_blocks
+                ]
+                if ids:
+                    async with self._device_lock:
+                        await loop.run_in_executor(
+                            None,
+                            self.runner.inject_blocks_device,
+                            ids,
+                            resp.k_dev,
+                            resp.v_dev,
+                        )
+                return (resp.first_token, resp.first_logprob, resp.first_top)
             if resp.payload is not None:
                 # payload may be absent when every shippable block was a
                 # prefix hit already sitting in this worker's cache
@@ -805,6 +826,18 @@ class JaxEngine:
                             req.temperature,
                             req.top_p,
                             req.top_k,
+                            rep_pen=getattr(req, "rep_pen", 1.0),
+                            key_data=(
+                                np.asarray(req.key_data, np.uint32)
+                                if getattr(req, "key_data", None) is not None
+                                else None
+                            ),
+                            eos_ids=(
+                                np.asarray(req.eos_ids, np.int32)
+                                if getattr(req, "eos_ids", None) is not None
+                                else None
+                            ),
+                            eos_suppress=getattr(req, "eos_suppress", False),
                         )
                     ),
                 )
@@ -824,6 +857,75 @@ class JaxEngine:
                 request_id=req.request_id,
                 first_token=int(tok_arr),
                 payload=payload,
+                first_block=req.cached_blocks,
+                first_logprob=float(lp_arr),
+                first_top=[
+                    [int(t), float(l)] for t, l in zip(tids_arr, tlps_arr)
+                ],
+            )
+        finally:
+            self.allocator.free(block_ids)
+
+    async def prefill_only_device(self, req: Any) -> Any:
+        """Colocated prefill-worker role: like prefill_only but the KV
+        payload stays ON DEVICE (disagg/colocated.py). The caller's decode
+        engine lands the blocks with inject_blocks_device — same process,
+        mesh-to-mesh, zero host copies."""
+        from dynamo_tpu.disagg.colocated import DevicePrefillResponse
+
+        loop = asyncio.get_running_loop()
+        bs = self.config.block_size
+        T = len(req.token_ids)
+        if T > self.config.max_model_len:
+            return DevicePrefillResponse(
+                request_id=req.request_id,
+                first_token=-1,
+                error=f"prompt {T} exceeds max_model_len",
+            )
+        need = (T + bs - 1) // bs
+        block_ids = self.allocator.alloc(need)
+        try:
+            async with self._device_lock:
+                sample = await loop.run_in_executor(
+                    None,
+                    lambda: tuple(
+                        np.asarray(x)
+                        for x in self.runner.prefill(
+                            list(req.token_ids),
+                            block_ids,
+                            req.temperature,
+                            req.top_p,
+                            req.top_k,
+                            rep_pen=getattr(req, "rep_pen", 1.0),
+                            key_data=(
+                                np.asarray(req.key_data, np.uint32)
+                                if getattr(req, "key_data", None) is not None
+                                else None
+                            ),
+                            eos_ids=(
+                                np.asarray(req.eos_ids, np.int32)
+                                if getattr(req, "eos_ids", None) is not None
+                                else None
+                            ),
+                            eos_suppress=getattr(req, "eos_suppress", False),
+                        )
+                    ),
+                )
+                tok_arr, lp_arr, tids_arr, tlps_arr = sample
+                ship = block_ids[req.cached_blocks :]
+                k_dev = v_dev = None
+                n_ship = 0
+                if ship:
+                    k_dev, v_dev, n_ship = await loop.run_in_executor(
+                        None, self.runner.extract_blocks_device, ship
+                    )
+            self.stats.generated_tokens += 1
+            return DevicePrefillResponse(
+                request_id=req.request_id,
+                first_token=int(tok_arr),
+                k_dev=k_dev,
+                v_dev=v_dev,
+                num_blocks=n_ship,
                 first_block=req.cached_blocks,
                 first_logprob=float(lp_arr),
                 first_top=[

@@ -30,6 +30,13 @@ async def build_jax_engine(
     kv_block_size: int = 16,
     context_length: Optional[int] = None,
     tensor_parallel_size: int = 1,
+    # dp here is mesh plumbing (multi-host bring-up spans dp x tp): params
+    # and the cache replicate over dp and in-engine compute is identical
+    # per dp group. SERVING data parallelism is fleet-level — multiple
+    # engine replicas behind the router — same as the reference's dp story;
+    # batch-sharded in-engine dp is what __graft_entry__.dryrun_multichip
+    # exercises at the SPMD level.
+    data_parallel_size: int = 1,
     context_parallel_size: int = 1,
     expert_parallel_size: int = 1,
     max_batch: int = 8,
@@ -76,6 +83,7 @@ async def build_jax_engine(
         )
     if (
         tensor_parallel_size > 1
+        or data_parallel_size > 1
         or context_parallel_size > 1
         or expert_parallel_size > 1
         or is_multihost
@@ -89,6 +97,7 @@ async def build_jax_engine(
 
         mesh = build_mesh(
             tp=tensor_parallel_size,
+            dp=data_parallel_size,
             sp=context_parallel_size,
             ep=expert_parallel_size,
         )
@@ -123,7 +132,9 @@ async def build_jax_engine(
 
         channel = SpmdStepChannel(is_leader=multinode.is_leader)
         if not multinode.is_leader:
-            return FollowerHandle(runner, channel), mdc
+            # fabric handle => serve_async supervises leader liveness and
+            # raises LeaderLostError instead of wedging in a collective
+            return FollowerHandle(runner, channel, fabric=fabric), mdc
         runner = SpmdModelRunner(runner, channel)
     engine = JaxEngine(
         runner,

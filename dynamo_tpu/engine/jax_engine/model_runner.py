@@ -624,6 +624,69 @@ class ModelRunner:
         )
         return self._fetch(k)[:, :, :n], self._fetch(v)[:, :, :n]
 
+    def extract_blocks_device(
+        self, block_ids: list[int]
+    ) -> tuple[jax.Array, jax.Array, int]:
+        """Gather dense KV blocks WITHOUT fetching to host: returns
+        (k, v, n) device arrays [L, Hkv, padded, bs, D] where the first `n`
+        block lanes are valid. The device-native disagg path — colocated
+        decode engines consume these via inject_blocks_device and the
+        blocks never leave HBM (the reference's GPUDirect-RDMA role,
+        docs/architecture/disagg_serving.md:76-118)."""
+        n = len(block_ids)
+        padded = self._pad_block_count(n)
+        ids = np.zeros(padded, np.int32)
+        ids[:n] = block_ids
+        k, v = self._extract_jit(
+            self.k_cache, self.v_cache, self._to_dev(ids)
+        )
+        return k, v, n
+
+    def inject_blocks_device(
+        self,
+        block_ids: list[int],
+        k_dev: jax.Array,
+        v_dev: jax.Array,
+    ) -> None:
+        """Scatter DEVICE KV blocks (from a colocated prefill engine's
+        mesh) into this cache. `jax.device_put` moves the buffers onto this
+        runner's devices/sharding first — on a shared TPU slice that is an
+        ICI copy, no host round-trip, no serialization. Padding lanes
+        target null block 0."""
+        n = len(block_ids)
+        padded = self._pad_block_count(n)
+        ids = np.zeros(padded, np.int32)
+        ids[:n] = block_ids
+        if k_dev.shape[2] != padded:
+            if k_dev.shape[2] > padded:
+                k_dev = k_dev[:, :, :padded]
+                v_dev = v_dev[:, :, :padded]
+            else:
+                pad = padded - k_dev.shape[2]
+                shape = k_dev.shape[:2] + (pad,) + k_dev.shape[3:]
+                zpad = jnp.zeros(shape, k_dev.dtype)
+                k_dev = jnp.concatenate([k_dev, zpad], axis=2)
+                v_dev = jnp.concatenate([v_dev, zpad], axis=2)
+        # land the buffers on THIS runner's devices (mesh-to-mesh move);
+        # replicated here — the pinned inject out_sharding reshards into
+        # the paged cache's layout
+        target = (
+            self._repl
+            if self._repl is not None
+            else (
+                jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec()
+                )
+                if self.mesh is not None
+                else self.k_cache.devices().pop()
+            )
+        )
+        k_dev = jax.device_put(k_dev, target)
+        v_dev = jax.device_put(v_dev, target)
+        self.k_cache, self.v_cache = self._inject_jit(
+            self.k_cache, self.v_cache, self._to_dev(ids), k_dev, v_dev
+        )
+
     def inject_blocks(
         self, block_ids: list[int], k_blocks: np.ndarray, v_blocks: np.ndarray
     ) -> None:

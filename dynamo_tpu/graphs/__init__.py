@@ -1,0 +1,10 @@
+"""Deployable serve-graphs (reference examples/llm/graphs/*): declarative
+service topologies launched by `python -m dynamo_tpu.serve <module>`.
+
+  * `dynamo_tpu.graphs.agg`    — frontend + N aggregated workers
+  * `dynamo_tpu.graphs.disagg` — frontend + decode fleet + prefill fleet
+
+Engine selection is env-driven (`DYN_GRAPH_ENGINE`): `echo` (protocol-level
+testing, default for agg), `tiny-jax` (real engine at test scale, default
+for disagg), or `jax` with `DYN_MODEL_PATH` pointing at an HF dir.
+"""

@@ -1,0 +1,46 @@
+"""Aggregated serving graph: OpenAI frontend + N identical workers.
+
+Launch:  python -m dynamo_tpu.serve dynamo_tpu.graphs.agg
+Mirrors the reference's examples/llm/graphs/agg.py (Frontend -> Processor
+-> Worker chain; our frontend folds the processor role in, as the
+reference's Rust frontend does)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from dynamo_tpu.sdk import depends, service
+
+
+@service(name="Worker", replicas=2)
+class Worker:
+    async def serve(self, runtime) -> None:
+        from dynamo_tpu.entrypoint.inputs import EngineConfig, run_endpoint
+        from dynamo_tpu.graphs.common import build_engine_from_env
+
+        engine, mdc = await build_engine_from_env()
+        config = EngineConfig.static_(engine, mdc)
+        await run_endpoint(
+            runtime, config,
+            os.environ.get("DYN_ENDPOINT", "dynamo.backend.generate"),
+        )
+
+
+@service(name="Frontend")
+class Frontend:
+    workers = depends(Worker)
+
+    async def serve(self, runtime) -> None:
+        from dynamo_tpu.entrypoint.inputs import EngineConfig, run_http
+        from dynamo_tpu.pipeline.router import RouterMode
+
+        config = EngineConfig.dynamic(
+            RouterMode(os.environ.get("DYN_ROUTER_MODE", "round_robin"))
+        )
+        await run_http(
+            runtime, config,
+            host=os.environ.get("DYN_HTTP_HOST", "0.0.0.0"),
+            port=int(os.environ.get("DYN_HTTP_PORT", "8080")),
+        )
+        await asyncio.Event().wait()  # serve until the supervisor stops us

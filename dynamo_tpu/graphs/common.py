@@ -1,0 +1,84 @@
+"""Shared engine/model-card builders for the serve graphs."""
+
+from __future__ import annotations
+
+import os
+
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.tokenizer import TokenizerWrapper
+
+
+def word_level_mdc(name: str, vocab_words: int = 61) -> ModelDeploymentCard:
+    """Self-contained word-level model card: <unk>/<s>/</s> plus w0..wN —
+    enough vocabulary that a tiny random model's sampled ids always decode
+    (no files on disk; mirrors run.py's build_test_mdc but sized to the
+    tiny model's vocab)."""
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {"<unk>": 0, "<s>": 1, "</s>": 2}
+    for i in range(vocab_words):
+        vocab[f"w{i}"] = 3 + i
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    return ModelDeploymentCard.from_tokenizer(
+        name, TokenizerWrapper(tok, eos_token_ids=[2])
+    )
+
+
+def model_name() -> str:
+    return os.environ.get("DYN_MODEL_NAME", "graph-model")
+
+
+async def build_engine_from_env():
+    """(engine, mdc) per DYN_GRAPH_ENGINE: echo | tiny-jax | jax."""
+    kind = os.environ.get("DYN_GRAPH_ENGINE", "echo")
+    if kind == "echo":
+        from dynamo_tpu.engine.echo import EchoEngineCore
+
+        return EchoEngineCore(), word_level_mdc(model_name())
+    if kind == "tiny-jax":
+        # build off the event loop: jax init + cache allocation block for
+        # seconds, which would starve the fabric lease keepalive
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        engine = await loop.run_in_executor(None, build_tiny_jax_engine)
+        return engine, word_level_mdc(model_name())
+    if kind == "jax":
+        from dynamo_tpu.engine.jax_engine.factory import build_jax_engine
+
+        path = os.environ.get("DYN_MODEL_PATH")
+        if not path:
+            raise SystemExit("DYN_GRAPH_ENGINE=jax requires DYN_MODEL_PATH")
+        return await build_jax_engine(
+            path,
+            name=model_name(),
+            tensor_parallel_size=int(os.environ.get("DYN_TP", "1")),
+            max_batch=int(os.environ.get("DYN_MAX_BATCH", "8")),
+        )
+    raise SystemExit(f"unknown DYN_GRAPH_ENGINE={kind!r}")
+
+
+def build_tiny_jax_engine(**overrides):
+    """Real JaxEngine at test scale on CPU: tiny llama, deterministic
+    params (seed 0) so every worker in the graph holds identical weights —
+    a requirement for disagg KV transfer between processes."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+    from dynamo_tpu.models import llama as L
+
+    cfg = L.LlamaConfig.tiny(vocab_size=64)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    kw = dict(num_blocks=128, block_size=4, max_batch=4, max_model_len=128)
+    kw.update(overrides)
+    runner = ModelRunner(cfg, params, **kw)
+    return JaxEngine(
+        runner,
+        JaxEngineConfig(
+            max_batch=kw["max_batch"], block_size=kw["block_size"],
+            num_blocks=kw["num_blocks"], max_model_len=kw["max_model_len"],
+        ),
+    )

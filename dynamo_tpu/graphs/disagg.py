@@ -1,0 +1,101 @@
+"""Disaggregated serving graph: frontend + decode fleet + prefill fleet.
+
+Launch:  python -m dynamo_tpu.serve dynamo_tpu.graphs.disagg
+Mirrors the reference's examples/llm/graphs/disagg.py: decode workers ship
+long prompts to a fabric work queue; prefill workers dequeue, compute KV,
+and stream the blocks back; unacked work is redelivered if a prefill worker
+dies (docs/architecture/disagg_serving.md). Engine defaults to `tiny-jax`
+— a real engine at test scale with deterministic weights shared by every
+process."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from dynamo_tpu.sdk import depends, service
+
+
+def _ns() -> str:
+    return os.environ.get("DYN_NAMESPACE", "dynamo")
+
+
+def _engine_kind() -> str:
+    return os.environ.get("DYN_GRAPH_ENGINE", "tiny-jax")
+
+
+async def _build_engine():
+    os.environ.setdefault("DYN_GRAPH_ENGINE", "tiny-jax")
+    from dynamo_tpu.graphs.common import build_engine_from_env
+
+    return await build_engine_from_env()
+
+
+@service(name="PrefillWorker", replicas=1)
+class PrefillWorker:
+    async def serve(self, runtime) -> None:
+        from dynamo_tpu.disagg.transfer import PrefillWorkerService
+
+        engine, _mdc = await _build_engine()
+        svc = PrefillWorkerService(runtime.fabric, _ns(), engine)
+        await svc.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await svc.close()
+
+
+@service(name="DecodeWorker", replicas=1)
+class DecodeWorker:
+    prefill = depends(PrefillWorker)
+
+    async def serve(self, runtime) -> None:
+        from dynamo_tpu.disagg.router import DisaggConfig, DisaggregatedRouter
+        from dynamo_tpu.disagg.transfer import RemotePrefillClient
+        from dynamo_tpu.entrypoint.inputs import EngineConfig, run_endpoint
+
+        engine, mdc = await _build_engine()
+        client = RemotePrefillClient(
+            runtime.fabric, _ns(),
+            block_size=engine.config.block_size,
+            timeout=float(os.environ.get("DYN_PREFILL_TIMEOUT_S", "30")),
+        )
+        await client.start()
+        router = DisaggregatedRouter(
+            runtime.fabric, _ns(),
+            DisaggConfig(
+                max_local_prefill_length=int(
+                    os.environ.get("DYN_MAX_LOCAL_PREFILL", "8")
+                ),
+                max_prefill_queue_size=int(
+                    os.environ.get("DYN_MAX_PREFILL_QUEUE", "100")
+                ),
+            ),
+        )
+        await router.start_watching()
+        engine.disagg_router = router
+        engine.remote_prefill_client = client
+        config = EngineConfig.static_(engine, mdc)
+        await run_endpoint(
+            runtime, config,
+            os.environ.get("DYN_ENDPOINT", "dynamo.backend.generate"),
+        )
+
+
+@service(name="Frontend")
+class Frontend:
+    decode = depends(DecodeWorker)
+
+    async def serve(self, runtime) -> None:
+        from dynamo_tpu.entrypoint.inputs import EngineConfig, run_http
+        from dynamo_tpu.pipeline.router import RouterMode
+
+        config = EngineConfig.dynamic(
+            RouterMode(os.environ.get("DYN_ROUTER_MODE", "round_robin"))
+        )
+        await run_http(
+            runtime, config,
+            host=os.environ.get("DYN_HTTP_HOST", "0.0.0.0"),
+            port=int(os.environ.get("DYN_HTTP_PORT", "8080")),
+        )
+        await asyncio.Event().wait()

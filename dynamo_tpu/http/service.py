@@ -177,6 +177,7 @@ class ModelExecution:
         choices = self._fanout(pre)
         if request.echo and prompt:
             for i in range(len(choices)):
+                gen.note_echo(prompt, index=i)
                 yield Annotated.from_data(
                     gen.text_chunk(prompt, index=i).model_dump(exclude_none=True)
                 )

@@ -208,6 +208,11 @@ class CompletionDeltaGenerator:
         # tokens 1:1 across streamed chunks
         self._char_off: dict[int, int] = {}
 
+    def note_echo(self, prompt: str, index: int = 0) -> None:
+        """echo=true prepends the prompt to the returned text; legacy
+        text_offset indexes into the FULL text, so offsets start after it."""
+        self._char_off[index] = self._char_off.get(index, 0) + len(prompt)
+
     def text_chunk(
         self,
         text: str,

@@ -1,0 +1,54 @@
+"""Child-process entry for one service of a serve graph.
+
+    python -m dynamo_tpu.sdk.runner pkg.graphmodule:ClassName
+
+Builds a fabric-connected DistributedRuntime from the environment
+(DYN_FABRIC_ADDR et al.), instantiates the @service class, and awaits its
+``serve(runtime)`` forever. SIGTERM cancels cleanly so the supervisor's
+graceful stop doesn't need SIGKILL. Role-equivalent of the worker entry the
+reference's circus watchers exec (serving.py:152)."""
+
+from __future__ import annotations
+
+import asyncio
+import importlib
+import signal
+import sys
+
+
+async def _amain(target: str) -> None:
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    mod_name, _, cls_name = target.partition(":")
+    cls = getattr(importlib.import_module(mod_name), cls_name)
+    runtime = await DistributedRuntime.from_settings()
+    svc = cls()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    serve_task = asyncio.create_task(svc.serve(runtime))
+    stop_task = asyncio.create_task(stop.wait())
+    done, _ = await asyncio.wait(
+        {serve_task, stop_task}, return_when=asyncio.FIRST_COMPLETED
+    )
+    if serve_task in done:
+        # propagate a crashed serve() as a nonzero exit for the supervisor
+        serve_task.result()
+    else:
+        serve_task.cancel()
+        try:
+            await serve_task
+        except (asyncio.CancelledError, Exception):  # noqa: BLE001
+            pass
+    await runtime.close()
+
+
+def main() -> None:
+    if len(sys.argv) != 2 or ":" not in sys.argv[1]:
+        raise SystemExit("usage: python -m dynamo_tpu.sdk.runner module:Class")
+    asyncio.run(_amain(sys.argv[1]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,224 @@
+"""Process supervision: ManagedProcess (spawn/monitor/restart one child)
+and Supervisor (a fleet of them).
+
+Role-equivalent of the reference's serving/circus arbiter
+(deploy/sdk/src/dynamo/sdk/cli/serving.py:152 `_create_watcher`) and of its
+test harness's ManagedProcess (tests/utils/managed_process.py:69) — one
+implementation serves both production serve-graphs and the kill-based
+fault-tolerance suite (tests/fault_tolerance/test_runner.py:100-152).
+
+Crash-restart discipline: a child that exits while not stopped restarts
+after an exponential backoff, up to `max_restarts` within `restart_window_s`
+(the budget refills as crashes age out). Discovery-side cleanup is the
+fabric lease's job — a killed worker's instances vanish when its lease
+expires; the supervisor's job is only to put a fresh process back.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import sys
+import time
+from typing import Callable, Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.sdk.supervisor")
+
+
+class ManagedProcess:
+    def __init__(
+        self,
+        args: list[str],
+        *,
+        name: str,
+        env: Optional[dict[str, str]] = None,
+        restart: bool = True,
+        max_restarts: int = 5,
+        restart_window_s: float = 60.0,
+        backoff_s: float = 0.5,
+        on_exit: Optional[Callable[[int], None]] = None,
+        forward_output: bool = True,
+    ) -> None:
+        self.args = args
+        self.name = name
+        self.env = {**os.environ, **(env or {})}
+        self.restart = restart
+        self.max_restarts = max_restarts
+        self.restart_window_s = restart_window_s
+        self.backoff_s = backoff_s
+        self.on_exit = on_exit
+        self.forward_output = forward_output
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self.restarts = 0
+        self._crash_times: list[float] = []
+        self._stopping = False
+        self._monitor_task: Optional[asyncio.Task] = None
+        self._started = asyncio.Event()
+
+    # ------------------------------------------------------------ control
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc else None
+
+    @property
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.returncode is None
+
+    async def start(self) -> None:
+        await self._spawn()
+        self._monitor_task = asyncio.get_running_loop().create_task(
+            self._monitor()
+        )
+
+    async def _spawn(self) -> None:
+        out = None if self.forward_output else asyncio.subprocess.DEVNULL
+        self.proc = await asyncio.create_subprocess_exec(
+            *self.args, env=self.env, stdout=out, stderr=out
+        )
+        self._started.set()
+        logger.info("[%s] started pid %d", self.name, self.proc.pid)
+
+    async def _monitor(self) -> None:
+        while True:
+            assert self.proc is not None
+            rc = await self.proc.wait()
+            if self.on_exit is not None:
+                try:
+                    self.on_exit(rc)
+                except Exception:  # noqa: BLE001 — callback is advisory
+                    logger.exception("[%s] on_exit callback failed", self.name)
+            if self._stopping:
+                return
+            if not self.restart:
+                logger.info("[%s] exited rc=%d (no restart)", self.name, rc)
+                return
+            now = time.monotonic()
+            self._crash_times = [
+                t for t in self._crash_times
+                if now - t < self.restart_window_s
+            ]
+            self._crash_times.append(now)
+            if len(self._crash_times) > self.max_restarts:
+                logger.error(
+                    "[%s] crashed %d times in %.0fs — giving up",
+                    self.name, len(self._crash_times), self.restart_window_s,
+                )
+                return
+            delay = self.backoff_s * (2 ** (len(self._crash_times) - 1))
+            logger.warning(
+                "[%s] exited rc=%d — restarting in %.1fs (%d/%d)",
+                self.name, rc, delay, len(self._crash_times),
+                self.max_restarts,
+            )
+            await asyncio.sleep(delay)
+            if self._stopping:
+                return
+            self.restarts += 1
+            await self._spawn()
+
+    async def stop(self, timeout: float = 5.0) -> None:
+        """Graceful stop: SIGTERM, wait, SIGKILL."""
+        self._stopping = True
+        if self.proc is not None and self.proc.returncode is None:
+            try:
+                self.proc.terminate()
+            except ProcessLookupError:
+                pass
+            try:
+                await asyncio.wait_for(self.proc.wait(), timeout)
+            except asyncio.TimeoutError:
+                logger.warning("[%s] SIGKILL after %.0fs", self.name, timeout)
+                try:
+                    self.proc.kill()
+                except ProcessLookupError:
+                    pass
+                await self.proc.wait()
+        if self._monitor_task is not None:
+            with_suppress = self._monitor_task
+            with_suppress.cancel()
+            try:
+                await with_suppress
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+
+    def kill(self) -> None:
+        """SIGKILL without marking stopped — the monitor restarts it.
+        This is the fault-injection hook the FT tests use."""
+        if self.proc is not None and self.proc.returncode is None:
+            try:
+                os.kill(self.proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+    async def wait_restarted(
+        self, prev_restarts: int, timeout: float = 30.0
+    ) -> None:
+        """Block until a restart beyond `prev_restarts` has spawned."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.restarts > prev_restarts and self.running:
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError(f"{self.name} did not restart within {timeout}s")
+
+
+class Supervisor:
+    """A named fleet of ManagedProcesses started/stopped together."""
+
+    def __init__(self) -> None:
+        self.procs: dict[str, ManagedProcess] = {}
+
+    def add(self, proc: ManagedProcess) -> ManagedProcess:
+        if proc.name in self.procs:
+            raise ValueError(f"duplicate process name {proc.name!r}")
+        self.procs[proc.name] = proc
+        return proc
+
+    def add_python(
+        self, name: str, module: str, *argv: str,
+        env: Optional[dict[str, str]] = None, **kw,
+    ) -> ManagedProcess:
+        # children must resolve dynamo_tpu no matter the parent's cwd
+        import dynamo_tpu
+
+        repo_root = os.path.dirname(os.path.dirname(dynamo_tpu.__file__))
+        child_env = dict(env or {})
+        existing = child_env.get("PYTHONPATH") or os.environ.get("PYTHONPATH")
+        child_env["PYTHONPATH"] = (
+            repo_root + (os.pathsep + existing if existing else "")
+        )
+        return self.add(
+            ManagedProcess(
+                [sys.executable, "-m", module, *argv],
+                name=name, env=child_env, **kw,
+            )
+        )
+
+    async def start_all(self) -> None:
+        for p in self.procs.values():
+            if p.proc is None:
+                await p.start()
+
+    async def stop_all(self, timeout: float = 5.0) -> None:
+        """Stop services first (concurrently), control-plane processes
+        (`stop_last=True`, e.g. the fabric server) afterwards — otherwise
+        workers block their graceful deregistration on a dead fabric and
+        eat the SIGKILL timeout."""
+        first = [
+            p for p in self.procs.values()
+            if not getattr(p, "stop_last", False)
+        ]
+        last = [p for p in self.procs.values() if getattr(p, "stop_last", False)]
+        await asyncio.gather(
+            *(p.stop(timeout) for p in first), return_exceptions=True
+        )
+        await asyncio.gather(
+            *(p.stop(timeout) for p in last), return_exceptions=True
+        )
+
+    def __getitem__(self, name: str) -> ManagedProcess:
+        return self.procs[name]

@@ -1,0 +1,133 @@
+"""`python -m dynamo_tpu.serve <graph>` — launch a serve graph supervised.
+
+Role-equivalent of the reference's `dynamo serve graphs.disagg:Frontend`
+(deploy/sdk/src/dynamo/sdk/cli/serving.py:152): one command starts the
+fabric control plane (unless DYN_FABRIC_ADDR points at one), then every
+@service of the graph as supervised OS processes — dependencies first,
+crash ⇒ restart with backoff, SIGINT/SIGTERM ⇒ graceful teardown.
+
+    python -m dynamo_tpu.serve dynamo_tpu.graphs.agg \
+        --env DYN_HTTP_PORT=8080 --replicas Worker=4
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import socket
+from typing import Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.sdk import Supervisor, load_graph
+
+logger = get_logger("dynamo_tpu.serve")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _wait_port(host: str, port: int, timeout: float = 10.0) -> None:
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        try:
+            _, w = await asyncio.open_connection(host, port)
+            w.close()
+            await w.wait_closed()
+            return
+        except OSError:
+            await asyncio.sleep(0.1)
+    raise TimeoutError(f"fabric server not reachable on {host}:{port}")
+
+
+async def serve_graph(
+    graph_module: str,
+    *,
+    extra_env: Optional[dict[str, str]] = None,
+    replica_overrides: Optional[dict[str, int]] = None,
+    fabric_addr: Optional[str] = None,
+) -> Supervisor:
+    """Start the graph; returns the running Supervisor (also the FT-test
+    entry point — tests kill members and assert recovery)."""
+    if not graph_module.startswith("dynamo_tpu.") and "." not in graph_module:
+        graph_module = f"dynamo_tpu.graphs.{graph_module}"
+    sup = Supervisor()
+    addr = fabric_addr or os.environ.get("DYN_FABRIC_ADDR")
+    if not addr:
+        port = _free_port()
+        fabric_proc = sup.add_python(
+            "fabric", "dynamo_tpu.fabric.server", "--port", str(port),
+            max_restarts=10,
+        )
+        fabric_proc.stop_last = True  # services deregister before it dies
+        addr = f"127.0.0.1:{port}"
+    specs = load_graph(graph_module)
+    logger.info(
+        "graph %s: %s (fabric %s)",
+        graph_module, [s.name for s in specs], addr,
+    )
+    await sup.start_all()  # fabric first, so children can connect
+    host, _, port_s = addr.partition(":")
+    await _wait_port(host, int(port_s))
+    for spec in specs:
+        n = (replica_overrides or {}).get(spec.name, spec.replicas)
+        for r in range(n):
+            sup.add_python(
+                f"{spec.name}-{r}",
+                "dynamo_tpu.sdk.runner",
+                spec.target,
+                env={
+                    "DYN_FABRIC_ADDR": addr,
+                    **spec.env,
+                    **(extra_env or {}),
+                },
+            )
+    await sup.start_all()
+    return sup
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    parser = argparse.ArgumentParser(prog="dynamo_tpu.serve")
+    parser.add_argument("graph", help="graph module (e.g. dynamo_tpu.graphs.agg)")
+    parser.add_argument(
+        "--env", action="append", default=[], metavar="KEY=VAL",
+        help="extra env for every service process",
+    )
+    parser.add_argument(
+        "--replicas", action="append", default=[], metavar="NAME=N",
+        help="override a service's replica count",
+    )
+    parser.add_argument("--fabric-addr", default=None)
+    args = parser.parse_args(argv)
+    extra_env = dict(kv.split("=", 1) for kv in args.env)
+    replicas = {
+        k: int(v) for k, v in (kv.split("=", 1) for kv in args.replicas)
+    }
+
+    async def amain() -> None:
+        sup = await serve_graph(
+            args.graph,
+            extra_env=extra_env,
+            replica_overrides=replicas,
+            fabric_addr=args.fabric_addr,
+        )
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        logger.info("stopping graph")
+        await sup.stop_all()
+
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,12 +1,16 @@
-"""Per-rank worker for the multi-host bring-up test (test_multihost.py).
+"""Per-rank worker for the multi-host bring-up tests (test_multihost.py).
 
-Usage: python multihost_worker.py <rank> <num_nodes> <model_dir>
+Usage: python multihost_worker.py <rank> <num_nodes> <model_dir> [tp] [dp] [mode]
 Env: DYN_FABRIC_ADDR must point at a running fabric server.
 
-Rank 0 builds the engine (leader), serves two greedy requests over a
-tp=<num_nodes> mesh spanning every process, prints the generated tokens as
-one JSON line, and stops the followers. Other ranks replay the leader's
-device calls via the SPMD step channel until told to stop.
+Modes:
+  serve (default): rank 0 builds the engine (leader), serves two greedy
+    requests over a tp x dp mesh spanning every process, prints the
+    generated tokens as one JSON line, and stops the followers. Other
+    ranks replay the leader's device calls until told to stop.
+  leader-hang: rank 0 rendezvouses then SLEEPS forever (short lease with
+    keepalive). The test SIGKILLs it; followers must detect the expired
+    leader lease and exit with rc=3 printing LEADER LOST — not hang.
 """
 
 import asyncio
@@ -21,15 +25,30 @@ jax.config.update("jax_platforms", "cpu")  # axon sitecustomize override
 RANK = int(sys.argv[1])
 NODES = int(sys.argv[2])
 MODEL_DIR = sys.argv[3]
+TP = int(sys.argv[4]) if len(sys.argv) > 4 else NODES
+DP = int(sys.argv[5]) if len(sys.argv) > 5 else 1
+MODE = sys.argv[6] if len(sys.argv) > 6 else "serve"
 
 
 async def main() -> None:
     from dynamo_tpu.engine.jax_engine.factory import build_jax_engine
     from dynamo_tpu.fabric.client import FabricClient
-    from dynamo_tpu.parallel.multihost import MultiNodeConfig
+    from dynamo_tpu.parallel.multihost import LeaderLostError, MultiNodeConfig
 
     fabric = await FabricClient.connect(os.environ["DYN_FABRIC_ADDR"])
-    lease = await fabric.lease_grant(60.0)
+    ttl = float(os.environ.get("DYN_TEST_LEASE_TTL", "60"))
+    lease = await fabric.lease_grant(ttl)
+
+    # CONTRACT: the bring-up lease anchors the barrier data key that
+    # followers use as the leader-liveness signal — it must stay alive for
+    # the engine's whole lifetime, on every rank (a follower's expired
+    # barrier check-in is equally fatal to re-rendezvous).
+    async def keepalive() -> None:
+        while True:
+            await asyncio.sleep(max(0.5, ttl / 3))
+            await fabric.lease_keepalive(lease)
+
+    keepalive_task = asyncio.get_running_loop().create_task(keepalive())
     cfg = MultiNodeConfig(num_nodes=NODES, node_rank=RANK)
     engine_or_handle, _mdc = await build_jax_engine(
         MODEL_DIR,
@@ -37,15 +56,28 @@ async def main() -> None:
         kv_block_size=4,
         max_batch=4,
         num_blocks=64,
-        tensor_parallel_size=NODES,  # one chip per host in this test
+        tensor_parallel_size=TP,
+        data_parallel_size=DP,
         multinode=cfg,
         fabric=fabric,
         lease_id=lease,
     )
     if RANK != 0:
-        await engine_or_handle.serve_async()
+        handle = engine_or_handle
+        handle.idle_grace_s = float(os.environ.get("DYN_TEST_IDLE_GRACE", "10"))
+        try:
+            await handle.serve_async()
+        except LeaderLostError as e:
+            print(f"LEADER LOST: {e}", flush=True)
+            await fabric.close()
+            os._exit(3)
         print("FOLLOWER DONE", flush=True)
         await fabric.close()
+        return
+
+    if MODE == "leader-hang":
+        print("LEADER HANGING", flush=True)
+        await asyncio.sleep(600)  # the test kills us long before this
         return
 
     from dynamo_tpu.pipeline.context import Context
@@ -73,6 +105,7 @@ async def main() -> None:
     await engine.close()
     engine.runner.stop_followers()
     print("TOKENS " + json.dumps([t1, t2]), flush=True)
+    keepalive_task.cancel()
     await fabric.close()
 
 
