@@ -1,0 +1,202 @@
+"""G4-lite: cross-worker KV block fetch over the fabric.
+
+Role-equivalent of the reference's remote block tier
+(lib/llm/src/block_manager.rs:121-148, SerializedNixlBlockSet): a worker
+that misses a prefix locally can discover WHICH peer's host tier holds it
+and pull the blocks, instead of recomputing the prefill. Here:
+
+  * `PeerBlockService` — each worker publishes its block-hash inventory to
+    the fabric kv (bound to its lease, so a dead worker's advert vanishes)
+    and serves pull requests on a `kvbm.pull` endpoint;
+  * `PeerBlockClient` — prefix lookup over the adverts, pull from the best
+    peer, land into the LOCAL block manager (G4 -> G2), after which the
+    normal onboarding path injects into device blocks (G2 -> G1).
+
+Transfers ride the runtime's TCP response plane as raw bf16-as-u16 bytes —
+the DCN path; same-slice workers should colocate (disagg/colocated.py)
+instead.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+import msgpack
+import numpy as np
+
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.block_manager.peer")
+
+_ADVERT_PREFIX = "kvbm/adverts"
+
+
+def _advert_key(namespace: str, instance_id: int) -> str:
+    return f"{_ADVERT_PREFIX}/{namespace}/{instance_id}"
+
+
+class PeerBlockService:
+    """Serve this worker's cached blocks to peers + advertise the set."""
+
+    def __init__(
+        self,
+        drt: Any,
+        namespace: str,
+        manager: Any,  # TieredBlockManager
+        publish_interval_s: float = 1.0,
+    ) -> None:
+        self.drt = drt
+        self.namespace = namespace
+        self.manager = manager
+        self.publish_interval_s = publish_interval_s
+        self.endpoint = (
+            drt.namespace(namespace).component("kvbm").endpoint("pull")
+        )
+        self._service = None
+        self._publish_task: Optional[asyncio.Task] = None
+        self._last_advert: Optional[bytes] = None
+
+    @property
+    def instance_id(self) -> int:
+        assert self._service is not None
+        return self._service.instance_id
+
+    async def start(self) -> None:
+        self._service = await self.endpoint.serve_endpoint(self._handler)
+        self._publish_task = asyncio.get_running_loop().create_task(
+            self._publish_loop()
+        )
+
+    async def close(self) -> None:
+        if self._publish_task is not None:
+            self._publish_task.cancel()
+            try:
+                await self._publish_task
+            except asyncio.CancelledError:
+                pass
+        if self._service is not None:
+            await self._service.stop()
+        await self.drt.fabric.kv_delete(
+            _advert_key(self.namespace, self.instance_id)
+        )
+
+    def _inventory(self) -> list[int]:
+        m = self.manager
+        with m._lock:
+            return list(m._host.keys()) + list(m._disk.keys())
+
+    async def _publish_loop(self) -> None:
+        while True:
+            try:
+                advert = msgpack.packb(self._inventory())
+                if advert != self._last_advert:
+                    await self.drt.fabric.kv_put(
+                        _advert_key(self.namespace, self.instance_id),
+                        advert,
+                        lease_id=self.drt.primary_lease,
+                    )
+                    self._last_advert = advert
+            except Exception:  # noqa: BLE001 — advertising is best-effort
+                logger.exception("block advert publish failed")
+            await asyncio.sleep(self.publish_interval_s)
+
+    async def _handler(self, request: dict, ctx: Context):
+        hashes = [int(h) for h in request.get("hashes", [])]
+        found = [h for h in hashes if h in self.manager]
+        if not found:
+            yield {"hashes": [], "k": b"", "v": b"", "shape": [], "dtype": ""}
+            return
+        loop = asyncio.get_running_loop()
+        k, v = await loop.run_in_executor(
+            None, self.manager.load_blocks, found
+        )
+        yield {
+            "hashes": found,
+            "k": k.tobytes(),
+            "v": v.tobytes(),
+            "shape": list(k.shape),
+            "dtype": str(k.dtype.name),
+        }
+
+
+class PeerBlockClient:
+    """Pull missing prefix blocks from whichever peer holds them."""
+
+    def __init__(self, drt: Any, namespace: str, manager: Any) -> None:
+        self.drt = drt
+        self.namespace = namespace
+        self.manager = manager
+        self.endpoint = (
+            drt.namespace(namespace).component("kvbm").endpoint("pull")
+        )
+        self._client = None
+        self.own_instance_id: Optional[int] = None  # skip self-pulls
+        self.fetched_blocks = 0
+
+    async def _ensure_client(self):
+        if self._client is None:
+            self._client = await self.endpoint.client()
+        return self._client
+
+    async def lookup(self, seq_hashes: list[int]) -> tuple[Optional[int], int]:
+        """(best peer instance, longest advertised prefix length)."""
+        adverts = await self.drt.fabric.kv_get_prefix(
+            f"{_ADVERT_PREFIX}/{self.namespace}/"
+        )
+        best, best_n = None, 0
+        for key, raw in adverts.items():
+            iid = int(key.rsplit("/", 1)[1])
+            if iid == self.own_instance_id:
+                continue
+            try:
+                held = set(msgpack.unpackb(raw))
+            except Exception:  # noqa: BLE001 — skip malformed advert
+                continue
+            n = 0
+            for h in seq_hashes:
+                if h in held:
+                    n += 1
+                else:
+                    break
+            if n > best_n:
+                best, best_n = iid, n
+        return best, best_n
+
+    async def fetch_remote_prefix(self, seq_hashes: list[int]) -> int:
+        """Pull the longest remotely-held prefix into the LOCAL manager;
+        returns the number of blocks landed (0 on miss/failure)."""
+        missing_from = self.manager.lookup_prefix(seq_hashes)
+        want = seq_hashes[missing_from:]
+        if not want:
+            return 0
+        peer, n = await self.lookup(seq_hashes)
+        if peer is None or n <= missing_from:
+            return 0
+        pull = seq_hashes[missing_from:n]
+        try:
+            client = await self._ensure_client()
+            stream = await client.direct(
+                {"hashes": pull}, peer, Context()
+            )
+            reply = None
+            async for item in stream:
+                reply = item
+            data = reply.data if hasattr(reply, "data") else reply
+            if not data or not data.get("hashes"):
+                return 0
+            k = np.frombuffer(data["k"], dtype=np.dtype(data["dtype"]))
+            v = np.frombuffer(data["v"], dtype=np.dtype(data["dtype"]))
+            shape = tuple(data["shape"])
+            k = k.reshape(shape)
+            v = v.reshape(shape)
+            loop = asyncio.get_running_loop()
+            stored = await loop.run_in_executor(
+                None, self.manager.store_blocks, list(data["hashes"]), k, v
+            )
+            self.fetched_blocks += stored
+            return stored
+        except Exception as e:  # noqa: BLE001 — fall back to recompute
+            logger.warning("peer block fetch failed (%s); recomputing", e)
+            return 0

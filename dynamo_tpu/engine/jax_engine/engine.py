@@ -140,6 +140,7 @@ class JaxEngine:
         disagg_router: Optional[Any] = None,
         remote_prefill_client: Optional[Any] = None,
         block_manager: Optional[Any] = None,
+        peer_block_client: Optional[Any] = None,
     ) -> None:
         self.runner = runner
         self.config = config or JaxEngineConfig(
@@ -173,6 +174,9 @@ class JaxEngine:
         # are copied to the host/disk tiers keyed by sequence hash and
         # onboarded on later prefix hits.
         self.block_manager = block_manager
+        # G4-lite (block_manager/peer.py): pull a missing prefix from a
+        # peer worker's host tier instead of recomputing it
+        self.peer_block_client = peer_block_client
         self._remote_tasks: set[asyncio.Task] = set()
         # Landed remote prefills / failures, processed by the engine loop so
         # _append_token (which can preempt and reallocate blocks) never runs
@@ -488,6 +492,18 @@ class JaxEngine:
                 seq.cached_prefix_blocks = self.block_manager.lookup_prefix(
                     seq.prefix_hashes
                 )
+                if (
+                    self.peer_block_client is not None
+                    and seq.cached_prefix_blocks < len(seq.prefix_hashes)
+                ):
+                    # G4-lite: a peer may hold the rest of the prefix
+                    fetched = await self.peer_block_client.fetch_remote_prefix(
+                        seq.prefix_hashes
+                    )
+                    if fetched:
+                        seq.cached_prefix_blocks = (
+                            self.block_manager.lookup_prefix(seq.prefix_hashes)
+                        )
                 hit_len = seq.cached_prefix_blocks * self.config.block_size
             use_remote = False
             if (
@@ -515,6 +531,16 @@ class JaxEngine:
                 # in-flight decode batch never stalls more than one chunk
                 seq.prefilling = True
                 seq.prefill_pos = 0
+                if self.block_manager is not None and seq.cached_prefix_blocks:
+                    # local prefix onboarding (G2/G3/G4 -> G1): inject the
+                    # cached leading blocks and start chunking after them;
+                    # the final chunk always keeps >= 1 token so the first
+                    # sample comes from real logits
+                    onboarded = await self._onboard_prefix(seq, loop)
+                    if onboarded:
+                        bs = self.config.block_size
+                        skip = min(onboarded, (len(replay) - 1) // bs)
+                        seq.prefill_pos = skip * bs
                 self._prefilling.append(seq)
                 continue
             if can_pack:
@@ -865,6 +891,15 @@ class JaxEngine:
             )
         finally:
             self.allocator.free(block_ids)
+
+    async def embed(self, token_ids: list[int]):
+        """Pooled embedding for /v1/embeddings; serialized with the engine
+        loop's device calls (embedding traffic shares the chip)."""
+        loop = asyncio.get_running_loop()
+        async with self._device_lock:
+            return await loop.run_in_executor(
+                None, self.runner.embed, list(token_ids)
+            )
 
     async def prefill_only_device(self, req: Any) -> Any:
         """Colocated prefill-worker role: like prefill_only but the KV

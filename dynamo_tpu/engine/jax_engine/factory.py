@@ -63,18 +63,30 @@ async def build_jax_engine(
         from dynamo_tpu.parallel.multihost import rendezvous_and_initialize
 
         await rendezvous_and_initialize(multinode, fabric, lease_id)
-    config = LlamaConfig.from_model_dir(model_path)
+    from dynamo_tpu.hub import resolve_model
+
+    model_path = resolve_model(model_path)
+    if quantize is None:
+        quantize = os.environ.get("DYN_JAX_QUANTIZE_INT8", "0") in ("1", "true")
+    gguf_file = None
+    if model_path.endswith(".gguf"):
+        # GGUF weights+config (lib/llm/src/gguf/ equivalent); tokenizer
+        # must sit next to the file (tokenizer.json in the same dir)
+        from dynamo_tpu.gguf import GgufFile, params_from_gguf
+
+        gguf_file = GgufFile(model_path)
+        config, params = params_from_gguf(gguf_file)
+    else:
+        config = LlamaConfig.from_model_dir(model_path)
+        params = load_or_init_params(
+            model_path, config, quantize=quantize, seed=rng_seed
+        )
     max_len = min(
         context_length or config.max_position_embeddings,
         config.max_position_embeddings,
     )
-    if quantize is None:
-        quantize = os.environ.get("DYN_JAX_QUANTIZE_INT8", "0") in ("1", "true")
     mesh = None
     kv_sharding = None
-    params = load_or_init_params(
-        model_path, config, quantize=quantize, seed=rng_seed
-    )
     if num_blocks is None:
         num_blocks = default_num_blocks(
             config, max_len, max_batch,
@@ -117,12 +129,21 @@ async def build_jax_engine(
         kv_sharding=kv_sharding,
         global_arrays=is_multihost,
     )
-    mdc = ModelDeploymentCard.from_model_dir(
-        model_path,
-        name or os.path.basename(os.path.normpath(model_path)),
-        kv_block_size=kv_block_size,
-        context_length=max_len,
-    )
+    if gguf_file is not None:
+        gguf_file.close()
+        mdc = ModelDeploymentCard.from_model_dir(
+            os.path.dirname(os.path.abspath(model_path)),
+            name or os.path.basename(model_path).removesuffix(".gguf"),
+            kv_block_size=kv_block_size,
+            context_length=max_len,
+        )
+    else:
+        mdc = ModelDeploymentCard.from_model_dir(
+            model_path,
+            name or os.path.basename(os.path.normpath(model_path)),
+            kv_block_size=kv_block_size,
+            context_length=max_len,
+        )
     if is_multihost:
         from dynamo_tpu.parallel.multihost import (
             FollowerHandle,

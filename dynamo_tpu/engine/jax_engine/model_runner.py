@@ -527,6 +527,30 @@ class ModelRunner:
         )
         return out
 
+    def embed(self, token_ids: list[int]) -> np.ndarray:
+        """Pooled sequence embedding (llama.embed_pooled), bucket-padded;
+        the jit is created lazily so serving-only deployments never compile
+        it."""
+        if not hasattr(self, "_embed_jit"):
+            cfg = self.config
+            self._embed_jit = jax.jit(
+                lambda p, t, v: llama.embed_pooled(p, cfg, t, v)
+            )
+        T = len(token_ids)
+        bucket = self.pick_bucket(T)
+        tokens = np.zeros(bucket, np.int32)
+        tokens[:T] = token_ids
+        out = self._embed_jit(
+            {"embed": self.params["embed"],
+             "layers": self.params["layers"],
+             "final_norm": self.params["final_norm"],
+             **({"lm_head": self.params["lm_head"]}
+                if "lm_head" in self.params else {})},
+            self._to_dev(tokens),
+            self._to_dev(np.int32(T)),
+        )
+        return self._fetch(out)
+
     def pack_prefill(self, seqs: list[tuple]) -> dict[str, np.ndarray]:
         """Pure host-side packing for the batched-prefill program.
 

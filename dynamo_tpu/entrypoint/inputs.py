@@ -94,7 +94,12 @@ async def run_http(
     if config.is_static:
         assert config.mdc is not None
         manager.add_model(
-            config.mdc.name, ModelExecution(config.mdc, config.local_engine_fn())
+            config.mdc.name,
+            ModelExecution(
+                config.mdc,
+                config.local_engine_fn(),
+                embed_fn=getattr(config.engine, "embed", None),
+            ),
         )
     else:
         watcher = ModelWatcher(
@@ -308,7 +313,13 @@ async def _resolve_execution(
 ) -> tuple[ModelExecution, str]:
     if config.is_static:
         assert config.mdc is not None
-        return ModelExecution(config.mdc, config.local_engine_fn()), config.mdc.name
+        embed_fn = getattr(config.engine, "embed", None)
+        return (
+            ModelExecution(
+                config.mdc, config.local_engine_fn(), embed_fn=embed_fn
+            ),
+            config.mdc.name,
+        )
     # dynamic: wait for a discovered model
     manager = ModelManager()
     watcher = ModelWatcher(

@@ -1,0 +1,246 @@
+"""GGUF model file reader (pure numpy + mmap).
+
+Role-equivalent of lib/llm/src/gguf/ (the reference parses GGUF for
+metadata/tokenizer/weights so `--model-path model.gguf` works end-to-end).
+This reader covers the format surface the llama family needs:
+
+  * full metadata KV section (all GGUF value types incl. nested arrays);
+  * tensor directory (name, shape, dtype, offset) with lazy mmap views;
+  * dtypes F32/F16/BF16 natively and Q8_0 via dequantization;
+  * `config_from_gguf` mapping llama.* metadata keys to LlamaConfig and
+    `params_from_gguf` mapping ggml tensor names (token_embd, blk.N.*,
+    output, ...) onto this repo's param tree, transposed to the [in, out]
+    einsum orientation the model code uses.
+
+Spec: https://github.com/ggml-org/ggml/blob/master/docs/gguf.md (public).
+"""
+
+from __future__ import annotations
+
+import mmap
+import struct
+from dataclasses import dataclass
+from typing import Any, BinaryIO, Optional
+
+import numpy as np
+
+GGUF_MAGIC = 0x46554747  # "GGUF" little-endian
+
+# metadata value types
+_T_U8, _T_I8, _T_U16, _T_I16, _T_U32, _T_I32 = 0, 1, 2, 3, 4, 5
+_T_F32, _T_BOOL, _T_STRING, _T_ARRAY, _T_U64, _T_I64, _T_F64 = (
+    6, 7, 8, 9, 10, 11, 12,
+)
+
+_SCALAR_FMT = {
+    _T_U8: "<B", _T_I8: "<b", _T_U16: "<H", _T_I16: "<h",
+    _T_U32: "<I", _T_I32: "<i", _T_F32: "<f", _T_U64: "<Q",
+    _T_I64: "<q", _T_F64: "<d",
+}
+
+# ggml tensor dtypes (subset)
+GGML_F32, GGML_F16, GGML_Q8_0, GGML_BF16 = 0, 1, 8, 30
+_GGML_NAMES = {GGML_F32: "F32", GGML_F16: "F16", GGML_Q8_0: "Q8_0",
+               GGML_BF16: "BF16"}
+
+
+@dataclass
+class GgufTensor:
+    name: str
+    shape: tuple[int, ...]  # logical (numpy, row-major) shape
+    ggml_type: int
+    offset: int  # relative to the data section
+
+    @property
+    def type_name(self) -> str:
+        return _GGML_NAMES.get(self.ggml_type, f"unknown({self.ggml_type})")
+
+
+def _read(f: BinaryIO, fmt: str):
+    size = struct.calcsize(fmt)
+    return struct.unpack(fmt, f.read(size))[0]
+
+
+def _read_string(f: BinaryIO) -> str:
+    n = _read(f, "<Q")
+    return f.read(n).decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int) -> Any:
+    if vtype == _T_STRING:
+        return _read_string(f)
+    if vtype == _T_BOOL:
+        return bool(_read(f, "<B"))
+    if vtype == _T_ARRAY:
+        etype = _read(f, "<I")
+        n = _read(f, "<Q")
+        return [_read_value(f, etype) for _ in range(n)]
+    fmt = _SCALAR_FMT.get(vtype)
+    if fmt is None:
+        raise ValueError(f"unknown gguf value type {vtype}")
+    return _read(f, fmt)
+
+
+class GgufFile:
+    """Parsed GGUF container with lazy tensor access."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self.metadata: dict[str, Any] = {}
+        self.tensors: dict[str, GgufTensor] = {}
+        with open(path, "rb") as f:
+            magic = _read(f, "<I")
+            if magic != GGUF_MAGIC:
+                raise ValueError(f"{path}: not a GGUF file (magic {magic:#x})")
+            self.version = _read(f, "<I")
+            if self.version < 2:
+                raise ValueError(f"gguf v{self.version} unsupported (need >=2)")
+            n_tensors = _read(f, "<Q")
+            n_kv = _read(f, "<Q")
+            for _ in range(n_kv):
+                key = _read_string(f)
+                vtype = _read(f, "<I")
+                self.metadata[key] = _read_value(f, vtype)
+            for _ in range(n_tensors):
+                name = _read_string(f)
+                ndim = _read(f, "<I")
+                dims = [
+                    _read(f, "<Q") for _ in range(ndim)
+                ]  # ggml order: fastest-varying first
+                ggml_type = _read(f, "<I")
+                offset = _read(f, "<Q")
+                self.tensors[name] = GgufTensor(
+                    name=name,
+                    shape=tuple(reversed(dims)),  # numpy row-major
+                    ggml_type=ggml_type,
+                    offset=offset,
+                )
+            align = int(self.metadata.get("general.alignment", 32))
+            pos = f.tell()
+            self.data_offset = (pos + align - 1) // align * align
+        self._mm: Optional[mmap.mmap] = None
+        self._file: Optional[BinaryIO] = None
+
+    # ---------------------------------------------------------- tensors
+
+    def _map(self) -> mmap.mmap:
+        if self._mm is None:
+            self._file = open(self.path, "rb")
+            self._mm = mmap.mmap(
+                self._file.fileno(), 0, access=mmap.ACCESS_READ
+            )
+        return self._mm
+
+    def tensor(self, name: str) -> np.ndarray:
+        """Materialize one tensor as numpy (dequantized if needed)."""
+        import ml_dtypes
+
+        t = self.tensors[name]
+        mm = self._map()
+        start = self.data_offset + t.offset
+        numel = int(np.prod(t.shape))
+        if t.ggml_type == GGML_F32:
+            raw = np.frombuffer(mm, np.float32, numel, start)
+            return raw.reshape(t.shape)
+        if t.ggml_type == GGML_F16:
+            raw = np.frombuffer(mm, np.float16, numel, start)
+            return raw.reshape(t.shape)
+        if t.ggml_type == GGML_BF16:
+            raw = np.frombuffer(mm, np.uint16, numel, start)
+            return raw.view(ml_dtypes.bfloat16).reshape(t.shape)
+        if t.ggml_type == GGML_Q8_0:
+            # blocks of 32: f16 scale + 32 int8 values
+            n_blocks = numel // 32
+            rec = np.dtype([("d", "<f2"), ("q", "i1", (32,))])
+            raw = np.frombuffer(mm, rec, n_blocks, start)
+            vals = raw["q"].astype(np.float32) * raw["d"].astype(np.float32)[
+                :, None
+            ]
+            return vals.reshape(t.shape).astype(np.float32)
+        raise NotImplementedError(
+            f"tensor {name}: ggml type {t.type_name} not supported"
+        )
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+
+# --------------------------------------------------------------- mapping
+
+
+def config_from_gguf(g: GgufFile):
+    """llama.* metadata -> LlamaConfig."""
+    from dynamo_tpu.models.llama import LlamaConfig
+
+    md = g.metadata
+    arch = md.get("general.architecture", "llama")
+
+    def key(suffix, default=None):
+        return md.get(f"{arch}.{suffix}", default)
+
+    n_heads = int(key("attention.head_count", 32))
+    hidden = int(key("embedding_length", 4096))
+    n_vocab = md.get("llama.vocab_size") or (
+        len(md.get("tokenizer.ggml.tokens", [])) or 32000
+    )
+    return LlamaConfig(
+        vocab_size=int(n_vocab),
+        hidden_size=hidden,
+        intermediate_size=int(key("feed_forward_length", 4 * hidden)),
+        num_layers=int(key("block_count", 32)),
+        num_heads=n_heads,
+        num_kv_heads=int(key("attention.head_count_kv", n_heads)),
+        head_dim=int(key("attention.key_length", hidden // n_heads)),
+        rope_theta=float(key("rope.freq_base", 10000.0)),
+        rms_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
+        max_position_embeddings=int(key("context_length", 8192)),
+    )
+
+
+# ggml name -> (our key, needs_transpose). Projection matrices are stored
+# [out, in] in ggml; our einsums are x @ W with W [in, out].
+_LAYER_MAP = {
+    "attn_norm.weight": ("attn_norm", False),
+    "attn_q.weight": ("wq", True),
+    "attn_k.weight": ("wk", True),
+    "attn_v.weight": ("wv", True),
+    "attn_output.weight": ("wo", True),
+    "ffn_norm.weight": ("mlp_norm", False),
+    "ffn_gate.weight": ("wg", True),
+    "ffn_up.weight": ("wu", True),
+    "ffn_down.weight": ("wd", True),
+}
+
+
+def params_from_gguf(g: GgufFile, cfg=None, dtype=None):
+    """Materialize this repo's llama param tree from a GGUF file."""
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    cfg = cfg or config_from_gguf(g)
+    dtype = dtype or ml_dtypes.bfloat16
+
+    def get(name, transpose=False):
+        a = g.tensor(name)
+        if transpose:
+            a = a.T
+        return jnp.asarray(np.ascontiguousarray(a).astype(dtype))
+
+    params: dict[str, Any] = {
+        "embed": get("token_embd.weight"),
+        "final_norm": get("output_norm.weight"),
+        "layers": [],
+    }
+    if "output.weight" in g.tensors:
+        params["lm_head"] = get("output.weight", transpose=True)
+    for i in range(cfg.num_layers):
+        layer = {}
+        for suffix, (ours, tr) in _LAYER_MAP.items():
+            layer[ours] = get(f"blk.{i}.{suffix}", transpose=tr)
+        params["layers"].append(layer)
+    return cfg, params

@@ -48,9 +48,17 @@ EngineFn = Callable[[PreprocessedRequest, Context], AsyncIterator[LLMEngineOutpu
 class ModelExecution:
     """Per-model chain: preprocess -> engine -> detokenize -> OpenAI chunks."""
 
-    def __init__(self, mdc: ModelDeploymentCard, engine_fn: EngineFn) -> None:
+    def __init__(
+        self,
+        mdc: ModelDeploymentCard,
+        engine_fn: EngineFn,
+        embed_fn: Optional[Callable] = None,
+    ) -> None:
         self.mdc = mdc
         self.engine_fn = engine_fn
+        # async (token_ids) -> pooled embedding vector, when the engine
+        # supports it (ref http/service/openai.rs:222 /v1/embeddings)
+        self.embed_fn = embed_fn
         self.preprocessor = OpenAIPreprocessor(mdc)
         self.backend = Backend(self.preprocessor.tokenizer)
 
@@ -97,16 +105,14 @@ class ModelExecution:
                     if step.text or step.logprobs:
                         if timer:
                             timer.on_token(max(step.tokens_emitted, 1))
-                        queue.put_nowait(
-                            ("chunk", emit_chunk(step, i))
-                        )
+                        for chunk in emit_chunk(step, i):
+                            queue.put_nowait(("chunk", chunk))
                     if step.finish_reason is not None:
                         finish = step.finish_reason
                         break
                 if not ctx.is_killed():
-                    queue.put_nowait(
-                        ("chunk", emit_finish(finish or FinishReason.STOP, i))
-                    )
+                    for chunk in emit_finish(finish or FinishReason.STOP, i):
+                        queue.put_nowait(("chunk", chunk))
             except Exception as e:  # noqa: BLE001 — surface as SSE error
                 queue.put_nowait(("error", e))
             finally:
@@ -144,15 +150,51 @@ class ModelExecution:
                 gen.role_chunk(i).model_dump(exclude_none=True)
             )
         counters = {"completion": 0}
+        # tool calling: when the request declares tools, buffer each
+        # choice's text and parse at end-of-stream — a successful parse
+        # becomes tool_calls deltas + finish_reason "tool_calls"; anything
+        # else is released as ordinary text (ref preprocessor/tools.rs:371)
+        buffer_tools = bool(request.tools)
+        buffers: dict[int, list] = {}
+
+        def emit_chat(step, i):
+            if buffer_tools:
+                slot = buffers.setdefault(i, [[], []])
+                if step.text:
+                    slot[0].append(step.text)
+                if step.logprobs:
+                    slot[1].extend(step.logprobs)
+                return []
+            return [gen.text_chunk(step.text, index=i, logprobs=step.logprobs)]
+
+        def finish_chat(reason, i):
+            if not buffer_tools:
+                return [gen.finish_chunk(reason, index=i)]
+            from dynamo_tpu.tool_calling import parse_tool_calls
+
+            texts, lps = buffers.get(i, [[], []])
+            text = "".join(texts)
+            calls = parse_tool_calls(text) if text else None
+            if calls:
+                return [
+                    gen.tool_calls_chunk(
+                        [c.to_openai(j) for j, c in enumerate(calls)], index=i
+                    ),
+                    gen.finish_chunk(reason, index=i, literal="tool_calls"),
+                ]
+            out = []
+            if text or lps:
+                out.append(gen.text_chunk(text, index=i, logprobs=lps or None))
+            out.append(gen.finish_chunk(reason, index=i))
+            return out
+
         try:
             async for chunk in self._merged_choices(
                 choices,
                 ctx,
                 timer,
-                lambda step, i: gen.text_chunk(
-                    step.text, index=i, logprobs=step.logprobs
-                ),
-                lambda reason, i: gen.finish_chunk(reason, index=i),
+                emit_chat,
+                finish_chat,
                 counters,
             ):
                 yield Annotated.from_data(chunk.model_dump(exclude_none=True))
@@ -187,10 +229,10 @@ class ModelExecution:
                 choices,
                 ctx,
                 timer,
-                lambda step, i: gen.text_chunk(
-                    step.text, index=i, logprobs=step.logprobs
-                ),
-                lambda reason, i: gen.finish_chunk(reason, index=i),
+                lambda step, i: [
+                    gen.text_chunk(step.text, index=i, logprobs=step.logprobs)
+                ],
+                lambda reason, i: [gen.finish_chunk(reason, index=i)],
                 counters,
             ):
                 yield Annotated.from_data(chunk.model_dump(exclude_none=True))
@@ -253,6 +295,7 @@ class HttpService:
             [
                 web.post("/v1/chat/completions", self._chat),
                 web.post("/v1/completions", self._completions),
+                web.post("/v1/embeddings", self._embeddings),
                 web.get("/v1/models", self._models),
                 web.get("/health", self._health),
                 web.get("/live", self._health),
@@ -374,6 +417,59 @@ class HttpService:
                 if item.data is not None:
                     agg.add(CompletionResponse.model_validate(item.data))
             return web.json_response(agg.finish().model_dump(exclude_none=True))
+
+    async def _embeddings(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.protocols.openai import EmbeddingRequest
+
+        try:
+            body = await request.json()
+            emb_req = EmbeddingRequest.model_validate(body)
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, f"invalid request: {e}")
+        execution = self.manager.get(emb_req.model)
+        if execution is None:
+            return self._error(
+                404, f"model {emb_req.model!r} not found", "not_found_error"
+            )
+        if execution.embed_fn is None:
+            return self._error(
+                501, "this model does not serve embeddings", "not_implemented"
+            )
+        inputs = emb_req.input
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        elif inputs and isinstance(inputs[0], int):
+            inputs = [inputs]
+        tokenizer = execution.preprocessor.tokenizer
+        data = []
+        prompt_tokens = 0
+        with self.metrics.track(emb_req.model, "embeddings"):
+            for i, item in enumerate(inputs):
+                token_ids = (
+                    list(item)
+                    if isinstance(item, list)
+                    else tokenizer.encode(str(item)).ids
+                )
+                prompt_tokens += len(token_ids)
+                vec = await execution.embed_fn(token_ids)
+                data.append(
+                    {
+                        "object": "embedding",
+                        "index": i,
+                        "embedding": [float(x) for x in vec],
+                    }
+                )
+        return web.json_response(
+            {
+                "object": "list",
+                "data": data,
+                "model": emb_req.model,
+                "usage": {
+                    "prompt_tokens": prompt_tokens,
+                    "total_tokens": prompt_tokens,
+                },
+            }
+        )
 
     async def _models(self, request: web.Request) -> web.Response:
         listing = ModelList(

@@ -455,6 +455,30 @@ def prefill_context_parallel(
     return logits, jnp.stack(k_all), jnp.stack(v_all)
 
 
+def embed_pooled(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [P] int32, padded
+    valid_len: jax.Array,  # scalar int32
+) -> jax.Array:
+    """Pooled sequence embedding: full forward pass (no cache), final-norm
+    hidden states mean-pooled over valid tokens. The /v1/embeddings path
+    (ref http/service/openai.rs:222) — cacheless because embedding traffic
+    never decodes."""
+    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    P = tokens.shape[0]
+    positions = jnp.arange(P, dtype=jnp.int32)
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    for layer in params["layers"]:
+        q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
+        attn = causal_prefill_attention(q, k, v, valid_len, impl=cfg.attn_impl)
+        x = x + linear(attn.reshape(P, cfg.q_dim), layer["wo"])
+        x = _mlp(x, layer, cfg)
+    h = rms_norm(x, params["final_norm"], cfg.rms_eps).astype(jnp.float32)
+    mask = (positions < valid_len)[:, None].astype(jnp.float32)
+    return (h * mask).sum(axis=0) / jnp.maximum(valid_len, 1)
+
+
 def decode(
     params: dict,
     cfg: LlamaConfig,

@@ -1,0 +1,346 @@
+"""Pipeline parallelism (pp mesh axis): layer-partitioned llama forward
+with ppermute stage handoff.
+
+Role-equivalent of the reference's --pipeline-parallel-size pass-through
+(launch/dynamo-run/src/main.rs:39 — it hands PP to vLLM/TRT-LLM; here the
+engine is ours, so PP is implemented in the model math). TPU-first shape:
+
+  * per-layer params are STACKED ([L, ...] leading axis) and sharded over
+    the mesh's "pp" axis — each stage holds L/pp layers and scans them
+    with `lax.scan` (one compiled body, no per-layer unrolling);
+  * the paged KV cache's layer axis is sharded over pp the same way, so
+    each stage reads/writes only its own layers' pages — PP divides cache
+    HBM exactly like it divides weight HBM;
+  * activations move stage-to-stage with `lax.ppermute` over ICI inside a
+    fill/drain microbatch rotation: with M microbatches the schedule runs
+    M + pp - 1 ticks, every stage computing every tick once the pipe is
+    full (the classic GPipe inference schedule, SPMD-formulated so all
+    stages run ONE program).
+
+Scope (documented de-scope, SURVEY §2.7): dense bf16/fp32 llama layers.
+Quantized int8 layer stacks and MoE expert layers are rejected at
+stack-time — quantized serving under PP needs per-stage scale plumbing and
+MoE wants ep over the same devices instead; both are follow-on work, and
+PP's reason-to-exist (fitting a model that TP alone cannot) applies to the
+dense giants first.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.ops.attention import NEG_INF
+from dynamo_tpu.ops.basics import apply_rope, rms_norm, rope_freqs, swiglu
+
+
+def stack_layer_params(params: dict) -> dict:
+    """[{wq, wk, ...}] x L -> {"wq": [L, ...], ...} for pp sharding.
+
+    Dense bf16 layers only (see module docstring for the de-scope)."""
+    layers = params["layers"]
+    if any(isinstance(v, dict) for v in layers[0].values()):
+        raise NotImplementedError(
+            "pipeline parallelism requires dense unquantized layers "
+            "(int8 layer stacks need per-stage scale plumbing)"
+        )
+    if "router" in layers[0]:
+        raise NotImplementedError(
+            "pipeline parallelism over MoE layers is not supported — use "
+            "expert parallelism (ep) for Mixtral-family models"
+        )
+    stacked = {
+        k: jnp.stack([lyr[k] for lyr in layers]) for k in layers[0]
+    }
+    return {
+        "embed": params["embed"],
+        "layers": stacked,
+        "final_norm": params["final_norm"],
+        **({"lm_head": params["lm_head"]} if "lm_head" in params else {}),
+    }
+
+
+def shard_stacked_pp(
+    mesh: Mesh, stacked: dict
+) -> tuple[dict, NamedSharding]:
+    """Place stacked params: layer axis over pp (non-layer params
+    replicated). Returns (params, kv_cache_sharding) where the cache's
+    LAYER axis is pp-sharded."""
+    pp_first = NamedSharding(mesh, P("pp"))
+    repl = NamedSharding(mesh, P())
+    out = {
+        "embed": jax.device_put(stacked["embed"], repl),
+        "final_norm": jax.device_put(stacked["final_norm"], repl),
+        "layers": {
+            k: jax.device_put(v, pp_first)
+            for k, v in stacked["layers"].items()
+        },
+    }
+    if "lm_head" in stacked:
+        out["lm_head"] = jax.device_put(stacked["lm_head"], repl)
+    kv_sharding = NamedSharding(mesh, P("pp"))  # [L, Hkv, nb, bs, D]
+    return out, kv_sharding
+
+
+# ------------------------------------------------------------ stage math
+
+
+def _scan_layers(cfg, layers, x, positions, attend, write_kv, k_cache, v_cache):
+    """Apply this stage's local layer stack with lax.scan.
+
+    `attend(q, k, v, kc, vc)` and `write_kv(kc, vc, k, v)` close over the
+    attention style (prefill in-buffer vs paged decode); kc/vc are one
+    LOCAL layer's cache slices, scanned along axis 0."""
+    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    T = x.shape[0]
+
+    def body(x, per_layer):
+        lyr, kc, vc = per_layer
+        h = rms_norm(x, lyr["attn_norm"], cfg.rms_eps)
+        q = jnp.matmul(h, lyr["wq"].astype(h.dtype)).reshape(
+            T, cfg.num_heads, cfg.head_dim
+        )
+        k = jnp.matmul(h, lyr["wk"].astype(h.dtype)).reshape(
+            T, cfg.num_kv_heads, cfg.head_dim
+        )
+        v = jnp.matmul(h, lyr["wv"].astype(h.dtype)).reshape(
+            T, cfg.num_kv_heads, cfg.head_dim
+        )
+        q = apply_rope(q, positions, inv_freqs)
+        k = apply_rope(k, positions, inv_freqs)
+        kc, vc = write_kv(kc, vc, k, v)
+        attn = attend(q, kc, vc, k, v)
+        x = x + jnp.matmul(
+            attn.reshape(T, cfg.q_dim), lyr["wo"].astype(h.dtype)
+        )
+        h2 = rms_norm(x, lyr["mlp_norm"], cfg.rms_eps)
+        gate = jnp.matmul(h2, lyr["wg"].astype(h2.dtype))
+        up = jnp.matmul(h2, lyr["wu"].astype(h2.dtype))
+        x = x + jnp.matmul(
+            swiglu(gate, up), lyr["wd"].astype(h2.dtype)
+        )
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = jax.lax.scan(
+        body, x, (layers, k_cache, v_cache)
+    )
+    return x, k_cache, v_cache
+
+
+def prefill_pp(
+    params: dict,  # stacked + pp-sharded (shard_stacked_pp)
+    cfg,
+    mesh: Mesh,
+    tokens: jax.Array,  # [Pl] int32, padded
+    valid_len: jax.Array,  # scalar int32
+    k_cache: jax.Array,  # [L, Hkv, nb, bs, D], layer axis pp-sharded
+    v_cache: jax.Array,
+    block_table: jax.Array,  # [Pl // bs] int32
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Single-prompt prefill through the pipeline: the activation visits
+    stage 0..pp-1 in order via ppermute (one microbatch — prefill is a
+    latency path; decode_pp below overlaps microbatches). Every stage
+    writes its own layers' KV pages. Returns (last-token logits [V],
+    caches)."""
+    pp = mesh.shape["pp"]
+    Pl = tokens.shape[0]
+    positions = jnp.arange(Pl, dtype=jnp.int32)
+    causal = positions[None, :] <= positions[:, None]
+    in_seq = positions[None, :] < valid_len
+    mask = causal & in_seq
+
+    def attend(q, kc, vc, k, v):
+        # in-buffer causal attention (prompt K/V just computed)
+        Hq, D = q.shape[1], q.shape[2]
+        Hkv = k.shape[1]
+        G = Hq // Hkv
+        scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+        qr = q.reshape(Pl, Hkv, G, D)
+        scores = jnp.einsum(
+            "qhgd,khd->hgqk", qr.astype(jnp.float32), k.astype(jnp.float32)
+        ) * scale
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        w = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("hgqk,khd->qhgd", w, v.astype(jnp.float32))
+        return out.reshape(Pl, Hq, D).astype(q.dtype)
+
+    def write_kv(kc, vc, k, v):
+        from dynamo_tpu.ops.attention import write_prefill_kv
+
+        return write_prefill_kv(kc, vc, k, v, block_table)
+
+    def stage_fn(layers, embed, final_norm, lm_head, k_cache, v_cache):
+        stage = jax.lax.axis_index("pp")
+        x0 = embed[tokens].astype(embed.dtype)
+        x = x0
+
+        def tick(t, carry):
+            x, k_cache, v_cache = carry
+            y, kc2, vc2 = _scan_layers(
+                cfg, layers, x, positions, attend, write_kv, k_cache, v_cache
+            )
+            active = stage == t  # stage s works at tick s (one microbatch)
+            x = jnp.where(active, y, x)
+            k_cache = jnp.where(active, kc2, k_cache)
+            v_cache = jnp.where(active, vc2, v_cache)
+            # hand the activation to the next stage
+            x = jax.lax.ppermute(
+                x, "pp", [(i, (i + 1) % pp) for i in range(pp)]
+            )
+            return (x, k_cache, v_cache)
+
+        x, k_cache, v_cache = jax.lax.fori_loop(
+            0, pp, tick, (x, k_cache, v_cache)
+        )
+        # after pp ticks the fully-processed activation has rotated back to
+        # stage 0; other stages hold pipeline residue — zero them and psum
+        # so the logits output is genuinely replicated
+        h = rms_norm(x, final_norm, cfg.rms_eps)
+        last = h[valid_len - 1]
+        logits = jnp.matmul(
+            last.astype(jnp.float32), lm_head.astype(jnp.float32)
+        )
+        logits = jnp.where(stage == 0, logits, 0.0)
+        logits = jax.lax.psum(logits, "pp")
+        return logits, k_cache, v_cache
+
+    pp_spec = P("pp")
+    fn = shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(pp_spec, P(), P(), P(), pp_spec, pp_spec),
+        out_specs=(P(), pp_spec, pp_spec),
+        check_rep=False,
+    )
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = params["embed"].T
+    return fn(
+        params["layers"], params["embed"], params["final_norm"], lm_head,
+        k_cache, v_cache,
+    )
+
+
+def decode_pp(
+    params: dict,
+    cfg,
+    mesh: Mesh,
+    tokens: jax.Array,  # [B] int32
+    positions: jax.Array,  # [B] int32
+    k_cache: jax.Array,  # [L, Hkv, nb, bs, D], layer axis pp-sharded
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32
+    slot_indices: jax.Array,  # [B] int32
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Batched decode through the pipeline with the fill/drain microbatch
+    rotation: B must divide by pp; microbatch m enters stage 0 at tick m,
+    exits stage pp-1 at tick m+pp-1 — every stage busy in the steady
+    state. Returns (logits [B, V], caches)."""
+    from dynamo_tpu.ops.attention import write_decode_kv
+
+    pp = mesh.shape["pp"]
+    B = tokens.shape[0]
+    assert B % pp == 0, f"decode batch {B} must divide by pp={pp}"
+    Mb = B // pp  # microbatch size
+    n_ticks = 2 * pp - 1
+
+    def attend_factory(bt, pos1, slots):
+        def attend(q, kc, vc, k, v):
+            Hq, D = q.shape[1], q.shape[2]
+            Hkv, _, bs, _ = kc.shape
+            G = Hq // Hkv
+            S = bt.shape[1] * bs
+            scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+            kw = kc[:, bt].reshape(Hkv, Mb, S, D)
+            vw = vc[:, bt].reshape(Hkv, Mb, S, D)
+            qr = q.reshape(Mb, Hkv, G, D)
+            scores = jnp.einsum(
+                "bhgd,hbsd->bhgs", qr.astype(jnp.float32),
+                kw.astype(jnp.float32),
+            ) * scale
+            m = (jnp.arange(S)[None, :] < (pos1)[:, None])[:, None, None, :]
+            scores = jnp.where(m, scores, NEG_INF)
+            w = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhgs,hbsd->bhgd", w, vw.astype(jnp.float32))
+            return out.reshape(Mb, Hq, D).astype(q.dtype)
+
+        def write_kv(kc, vc, k, v):
+            return write_decode_kv(kc, vc, k, v, slots)
+
+        return attend, write_kv
+
+    def stage_fn(layers, embed, final_norm, lm_head, k_cache, v_cache):
+        stage = jax.lax.axis_index("pp")
+        D = embed.shape[1]
+        buf = jnp.zeros((Mb, D), embed.dtype)  # activation in flight
+        meta = jnp.zeros((Mb, 3), jnp.int32)  # (seq index in B, unused...)
+        out = jnp.zeros((B, cfg.vocab_size), jnp.float32)
+
+        def tick(t, carry):
+            buf, meta, out, k_cache, v_cache = carry
+            m_in = t  # microbatch entering stage 0 this tick
+            # stage 0 loads its incoming microbatch (if one remains)
+            load = (stage == 0) & (m_in < pp)
+            mb_idx = jnp.clip(m_in, 0, pp - 1)
+            in_tokens = jax.lax.dynamic_slice(tokens, (mb_idx * Mb,), (Mb,))
+            x_in = embed[in_tokens].astype(embed.dtype)
+            idx_in = mb_idx * Mb + jnp.arange(Mb, dtype=jnp.int32)
+            buf = jnp.where(load, x_in, buf)
+            meta = jnp.where(
+                load, jnp.stack([idx_in] * 3, axis=1), meta
+            )
+            # every stage processes what it holds; validity by schedule
+            my_mb = t - stage  # microbatch this stage holds this tick
+            active = (my_mb >= 0) & (my_mb < pp)
+            seq_idx = meta[:, 0]
+            pos_mb = positions[seq_idx]
+            bt_mb = block_tables[seq_idx]
+            slots_mb = slot_indices[seq_idx]
+            attend, write_kv = attend_factory(bt_mb, pos_mb + 1, slots_mb)
+            y, kc2, vc2 = _scan_layers(
+                cfg, layers, buf, pos_mb, attend, write_kv, k_cache, v_cache
+            )
+            buf = jnp.where(active, y, buf)
+            k_cache = jnp.where(active, kc2, k_cache)
+            v_cache = jnp.where(active, vc2, v_cache)
+            # last stage emits logits for its finished microbatch
+            emit = active & (stage == pp - 1)
+            h = rms_norm(buf, final_norm, cfg.rms_eps)
+            logits_mb = jnp.matmul(
+                h.astype(jnp.float32), lm_head.astype(jnp.float32)
+            )
+            upd = jnp.zeros_like(out).at[seq_idx].set(logits_mb)
+            out = jnp.where(emit, out + upd, out)
+            # rotate activations + metadata forward one stage
+            perm = [(i, (i + 1) % pp) for i in range(pp)]
+            buf = jax.lax.ppermute(buf, "pp", perm)
+            meta = jax.lax.ppermute(meta, "pp", perm)
+            return (buf, meta, out, k_cache, v_cache)
+
+        buf, meta, out, k_cache, v_cache = jax.lax.fori_loop(
+            0, n_ticks, tick, (buf, meta, out, k_cache, v_cache)
+        )
+        # logits live on the last stage only; psum replicates (zeros
+        # elsewhere make it a broadcast, not a reduction error)
+        out = jax.lax.psum(out, "pp")
+        return out, k_cache, v_cache
+
+    pp_spec = P("pp")
+    fn = shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(pp_spec, P(), P(), P(), pp_spec, pp_spec),
+        out_specs=(P(), pp_spec, pp_spec),
+        check_rep=False,
+    )
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = params["embed"].T
+    return fn(
+        params["layers"], params["embed"], params["final_norm"], lm_head,
+        k_cache, v_cache,
+    )

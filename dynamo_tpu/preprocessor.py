@@ -172,15 +172,36 @@ class ChatDeltaGenerator:
             ],
         )
 
+    def tool_calls_chunk(
+        self, calls: list[dict], index: int = 0
+    ) -> ChatCompletionChunk:
+        """Structured tool-call deltas lifted from generated text
+        (tool_calling.parse_tool_calls; ref preprocessor/tools.rs:371)."""
+        return ChatCompletionChunk(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[
+                StreamChoice(index=index, delta=ChoiceDelta(tool_calls=calls))
+            ],
+        )
+
     def finish_chunk(
-        self, reason: FinishReason, index: int = 0
+        self,
+        reason: FinishReason,
+        index: int = 0,
+        literal: Optional[str] = None,
     ) -> ChatCompletionChunk:
         return ChatCompletionChunk(
             id=self.id,
             model=self.model,
             created=self.created,
             choices=[
-                StreamChoice(index=index, delta=ChoiceDelta(), finish_reason=reason.as_openai())
+                StreamChoice(
+                    index=index,
+                    delta=ChoiceDelta(),
+                    finish_reason=literal or reason.as_openai(),
+                )
             ],
         )
 

@@ -1,0 +1,128 @@
+"""`python -m dynamo_tpu.router` — standalone KV-aware router service.
+
+Role-equivalent of the reference's standalone router bin
+(components/router/src/main.rs:97): one process owns the KV-overlap index
+(worker cache events -> radix tree -> cost-based selection) and serves
+routing decisions on a fabric endpoint, so N stateless frontends share ONE
+routing brain instead of each running its own partial view.
+
+Endpoint: `<namespace>.router.find_best`
+  request : {"token_ids": [...]}                (or {"tokens": ...})
+  response: {"worker_id": int, "overlap_blocks": int}
+Frontends then `client.direct(request, worker_id, ctx)` to the chosen
+worker and report completion with {"op": "free", "request_id": ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import signal
+from typing import Any, Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.router")
+
+
+class StandaloneRouter:
+    """Hosts a KvRouter and serves find_best decisions over the fabric."""
+
+    def __init__(
+        self,
+        drt: Any,
+        namespace: str = "dynamo",
+        component: str = "backend",
+        endpoint: str = "generate",
+        block_size: int = 16,
+        kv_config: Optional[Any] = None,
+    ) -> None:
+        self.drt = drt
+        self.namespace = namespace
+        self.component = drt.namespace(namespace).component(component)
+        self.worker_endpoint = self.component.endpoint(endpoint)
+        self.block_size = block_size
+        self.kv_config = kv_config
+        self.router = None
+        self._service = None
+
+    async def start(self) -> None:
+        from dynamo_tpu.kv_router.router import KvRouter
+
+        client = await self.worker_endpoint.client()
+        self.router = KvRouter(
+            self.component,
+            client,
+            block_size=self.block_size,
+            config=self.kv_config,
+        )
+        await self.router.start()
+        serve_ep = (
+            self.drt.namespace(self.namespace)
+            .component("router")
+            .endpoint("find_best")
+        )
+        self._service = await serve_ep.serve_endpoint(self._handler)
+        logger.info(
+            "standalone router serving %s.router.find_best for %s",
+            self.namespace, self.worker_endpoint.id,
+        )
+
+    async def _handler(self, request: dict, ctx):
+        if request.get("op") == "free":
+            self.router.free(str(request.get("request_id", "")))
+            yield {"ok": True}
+            return
+        tokens = request.get("token_ids") or request.get("tokens") or []
+        request_id = str(request.get("request_id", ""))
+        worker_id, overlap = await self.router.find_best_match(
+            list(tokens), request_id=request_id or None
+        )
+        yield {"worker_id": worker_id, "overlap_blocks": overlap}
+
+    async def close(self) -> None:
+        if self._service is not None:
+            await self._service.stop()
+        if self.router is not None:
+            await self.router.close()
+
+
+async def _amain(args) -> None:
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.from_settings()
+    router = StandaloneRouter(
+        drt,
+        namespace=args.namespace,
+        component=args.component,
+        endpoint=args.endpoint,
+        block_size=args.block_size,
+        kv_config=KvRouterConfig(
+            overlap_score_weight=args.kv_overlap_score_weight,
+            router_temperature=args.router_temperature,
+        ),
+    )
+    await router.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await router.close()
+    await drt.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="dynamo_tpu.router", description=__doc__)
+    ap.add_argument("--namespace", default="dynamo")
+    ap.add_argument("--component", default="backend")
+    ap.add_argument("--endpoint", default="generate")
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
+    ap.add_argument("--router-temperature", type=float, default=0.0)
+    asyncio.run(_amain(ap.parse_args()))
+
+
+if __name__ == "__main__":
+    main()

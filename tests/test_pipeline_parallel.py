@@ -1,0 +1,149 @@
+"""Pipeline parallelism (pp axis): layer-partitioned prefill/decode must
+match the single-device reference exactly (CPU 8-device mesh; round-2
+VERDICT item #9 — implement pp with collective_permute between stages)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import llama as L
+from dynamo_tpu.parallel.mesh import build_mesh
+from dynamo_tpu.parallel.pipeline import (
+    decode_pp,
+    prefill_pp,
+    shard_stacked_pp,
+    stack_layer_params,
+)
+
+BS = 4
+
+
+def setup(pp=2, num_layers=4):
+    cfg = L.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_layers=num_layers, num_heads=4, num_kv_heads=2, head_dim=8,
+        rope_theta=10000.0, max_position_embeddings=64,
+    )
+    params = L.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    mesh = build_mesh(pp=pp)
+    stacked, kv_sharding = shard_stacked_pp(mesh, stack_layer_params(params))
+    return cfg, params, stacked, mesh, kv_sharding
+
+
+def caches(cfg, nb=16, sharding=None):
+    shape = (cfg.num_layers, cfg.num_kv_heads, nb, BS, cfg.head_dim)
+    k = jnp.zeros(shape, jnp.float32)
+    v = jnp.zeros(shape, jnp.float32)
+    if sharding is not None:
+        k = jax.device_put(k, sharding)
+        v = jax.device_put(v, sharding)
+    return k, v
+
+
+def test_stack_rejects_quantized_and_moe():
+    cfg = L.LlamaConfig.tiny(vocab_size=64)
+    qparams = L.init_params(cfg, jax.random.PRNGKey(0), quantize=True)
+    with pytest.raises(NotImplementedError):
+        stack_layer_params(qparams)
+    from dynamo_tpu.models import mixtral
+
+    mcfg = mixtral.tiny_moe(num_experts=4)
+    mparams = mixtral.init_params(mcfg, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError):
+        stack_layer_params(mparams)
+
+
+def test_prefill_pp_matches_reference():
+    cfg, params, stacked, mesh, kv_sharding = setup(pp=2)
+    prompt = list(range(2, 13))  # 11 tokens
+    Pl = 12  # padded to whole blocks
+    tokens = jnp.asarray(np.pad(np.array(prompt, np.int32), (0, Pl - len(prompt))))
+    table = jnp.array([1, 2, 3], jnp.int32)
+
+    k_ref, v_ref = caches(cfg)
+    logits_ref, k_ref, v_ref = L.prefill(
+        params, cfg, tokens, jnp.int32(len(prompt)), k_ref, v_ref, table
+    )
+
+    k_pp, v_pp = caches(cfg, sharding=kv_sharding)
+    logits_pp, k_pp, v_pp = prefill_pp(
+        stacked, cfg, mesh, tokens, jnp.int32(len(prompt)), k_pp, v_pp, table
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_pp), np.asarray(logits_ref), rtol=2e-3, atol=2e-3
+    )
+    # every stage wrote ITS layers' pages: full caches must match
+    np.testing.assert_allclose(
+        np.asarray(k_pp), np.asarray(k_ref), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_decode_pp_matches_reference():
+    cfg, params, stacked, mesh, kv_sharding = setup(pp=2)
+    B = 4  # 2 microbatches of 2
+    prompt = list(range(2, 10))  # 8 tokens = 2 blocks
+    Pl = 8
+    tokens = jnp.asarray(np.array(prompt, np.int32))
+
+    # prefill both caches identically (reference path + pp path)
+    k_ref, v_ref = caches(cfg)
+    _, k_ref, v_ref = L.prefill(
+        params, cfg, tokens, jnp.int32(Pl), k_ref, v_ref,
+        jnp.array([1, 2], jnp.int32),
+    )
+    k_pp, v_pp = caches(cfg, sharding=kv_sharding)
+    _, k_pp, v_pp = prefill_pp(
+        stacked, cfg, mesh, tokens, jnp.int32(Pl), k_pp, v_pp,
+        jnp.array([1, 2], jnp.int32),
+    )
+
+    # one decode step for a batch of 4 sequences all reading that context
+    toks_b = jnp.array([5, 9, 11, 3], jnp.int32)
+    pos_b = jnp.full((B,), Pl, jnp.int32)
+    bt = jnp.tile(jnp.array([1, 2, 3], jnp.int32), (B, 1))
+    # distinct write slots per sequence (block 3)
+    slots = jnp.array([3 * BS + 0, 3 * BS + 1, 3 * BS + 2, 3 * BS + 3], jnp.int32)
+
+    logits_ref, k_ref2, _ = L.decode(
+        params, cfg, toks_b, pos_b, k_ref, v_ref, bt, slots
+    )
+    logits_pp, k_pp2, _ = decode_pp(
+        stacked, cfg, mesh, toks_b, pos_b, k_pp, v_pp, bt, slots
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_pp), np.asarray(logits_ref), rtol=2e-3, atol=2e-3
+    )
+    np.testing.assert_allclose(
+        np.asarray(k_pp2), np.asarray(k_ref2), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_decode_pp_four_stages():
+    cfg, params, stacked, mesh, kv_sharding = setup(pp=4, num_layers=4)
+    B = 4  # microbatch size 1
+    prompt = list(range(2, 10))
+    tokens = jnp.asarray(np.array(prompt, np.int32))
+    k_ref, v_ref = caches(cfg)
+    _, k_ref, v_ref = L.prefill(
+        params, cfg, tokens, jnp.int32(8), k_ref, v_ref,
+        jnp.array([1, 2], jnp.int32),
+    )
+    k_pp, v_pp = caches(cfg, sharding=kv_sharding)
+    _, k_pp, v_pp = prefill_pp(
+        stacked, cfg, mesh, tokens, jnp.int32(8), k_pp, v_pp,
+        jnp.array([1, 2], jnp.int32),
+    )
+    toks_b = jnp.array([5, 9, 11, 3], jnp.int32)
+    pos_b = jnp.full((B,), 8, jnp.int32)
+    bt = jnp.tile(jnp.array([1, 2, 3], jnp.int32), (B, 1))
+    slots = jnp.array([12, 13, 14, 15], jnp.int32)
+    logits_ref, _, _ = L.decode(
+        params, cfg, toks_b, pos_b, k_ref, v_ref, bt, slots
+    )
+    logits_pp, _, _ = decode_pp(
+        stacked, cfg, mesh, toks_b, pos_b, k_pp, v_pp, bt, slots
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_pp), np.asarray(logits_ref), rtol=2e-3, atol=2e-3
+    )

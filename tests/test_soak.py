@@ -1,0 +1,146 @@
+"""Engine soak/stress: randomized concurrent workload against the
+continuous-batching loop under block pressure (round-2 VERDICT weak #7;
+ref lib/runtime/tests/soak.rs). Preemption, chunked + packed prefill
+interleaving, offload, cancellation mid-stream, and mixed sampling all run
+together; afterwards every invariant must hold and the engine must still
+serve deterministically."""
+
+import asyncio
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.block_manager.layout import LayoutConfig
+from dynamo_tpu.block_manager.manager import TieredBlockManager
+from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+from dynamo_tpu.models import llama as L
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+BS = 4
+
+
+def make_engine(num_blocks=48, with_manager=False):
+    cfg = L.LlamaConfig.tiny(vocab_size=64)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    runner = ModelRunner(
+        cfg, params, num_blocks=num_blocks, block_size=BS, max_batch=4,
+        max_model_len=96,
+    )
+    manager = None
+    if with_manager:
+        layout = LayoutConfig(
+            num_layers=cfg.num_layers, page_size=BS,
+            num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+            dtype="bfloat16",
+        )
+        manager = TieredBlockManager(layout, host_blocks=32)
+    return JaxEngine(
+        runner,
+        JaxEngineConfig(
+            max_batch=4, block_size=BS, num_blocks=num_blocks,
+            max_model_len=96, watermark_blocks=2,
+        ),
+        block_manager=manager,
+    )
+
+
+async def test_engine_soak_random_ops():
+    rng = random.Random(1234)
+    # SMALL cache: 47 usable blocks for 4 slots of up to 24 blocks each —
+    # preemption and admission backpressure are guaranteed to fire
+    engine = make_engine(num_blocks=48, with_manager=True)
+    stats = {"done": 0, "cancelled": 0, "errors": 0}
+
+    async def one(i: int) -> None:
+        n = rng.randint(3, 60)
+        prompt = [rng.randint(1, 63) for _ in range(n)]
+        sampling = rng.choice(
+            [
+                SamplingOptions(greedy=True),
+                SamplingOptions(temperature=1.0, seed=i),
+                SamplingOptions(temperature=0.8, top_k=8, logprobs=True,
+                                top_logprobs=2),
+                SamplingOptions(greedy=True, frequency_penalty=1.0),
+            ]
+        )
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            sampling=sampling,
+            stop=StopConditions(
+                max_tokens=rng.randint(2, 20), ignore_eos=True
+            ),
+        )
+        ctx = Context()
+        cancel_after = rng.random() < 0.2 and rng.randint(1, 4)
+        got = 0
+        reason = None
+        try:
+            async for out in engine.generate(req, ctx):
+                got += len(out.token_ids)
+                if out.finish_reason is not None:
+                    reason = out.finish_reason
+                if cancel_after and got >= cancel_after:
+                    ctx.kill()
+                    break
+                if rng.random() < 0.05:
+                    await asyncio.sleep(0.001)  # slow consumer
+        except Exception:  # noqa: BLE001
+            stats["errors"] += 1
+            return
+        if cancel_after:
+            stats["cancelled"] += 1
+        elif reason in (FinishReason.LENGTH, FinishReason.EOS):
+            stats["done"] += 1
+        else:
+            stats["errors"] += 1
+
+    sem = asyncio.Semaphore(8)
+
+    async def gated(i):
+        async with sem:
+            await one(i)
+
+    await asyncio.gather(*(gated(i) for i in range(80)))
+    # engine must drain: give offload tasks a moment, then check invariants
+    for _ in range(100):
+        if (
+            engine.allocator.free_count == engine.config.num_blocks - 1
+            and all(s is None for s in engine.slots)
+        ):
+            break
+        await asyncio.sleep(0.05)
+    assert stats["errors"] == 0, stats
+    assert stats["done"] > 30, stats
+    assert all(s is None for s in engine.slots)
+    assert not engine.waiting and not engine._prefilling
+    assert engine.allocator.free_count == engine.config.num_blocks - 1, (
+        "leaked KV blocks after soak"
+    )
+    # the engine still serves, and deterministically
+    async def greedy(e, prompt):
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(greedy=True),
+            stop=StopConditions(max_tokens=6, ignore_eos=True),
+        )
+        toks = []
+        async for out in e.generate(req, Context()):
+            toks.extend(out.token_ids)
+        return toks
+
+    probe = [5, 9, 17, 23]
+    after = await greedy(engine, probe)
+    fresh = make_engine(num_blocks=48)
+    want = await greedy(fresh, probe)
+    assert after == want, "soak corrupted engine state"
+    await engine.close()
+    await fresh.close()

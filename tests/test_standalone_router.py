@@ -1,0 +1,97 @@
+"""Standalone router service (python -m dynamo_tpu.router; ref
+components/router/src/main.rs:97): one shared routing brain served over
+the fabric, queried like any endpoint."""
+
+import asyncio
+
+from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.kv_router.publisher import KvEventPublisher
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.router import StandaloneRouter
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+BS = 16
+
+
+async def test_standalone_router_serves_decisions():
+    drt = await DistributedRuntime.detached()
+    try:
+        component = drt.namespace("sr").component("backend")
+        ep = component.endpoint("generate")
+        services, engines = [], []
+        for _ in range(2):
+            eng = MockEngine(
+                MockEngineArgs(num_blocks=256, block_size=BS, speedup_ratio=1000.0)
+            )
+
+            async def handler(request, context, _eng=eng):
+                req = PreprocessedRequest.from_dict(request)
+                async for out in _eng.generate(req, context):
+                    yield out.to_dict()
+
+            svc = await ep.serve_endpoint(handler)
+            pub = KvEventPublisher(component, svc.instance_id)
+            eng.cache.on_stored = pub.on_blocks_stored
+            eng.cache.on_removed = pub.on_blocks_removed
+            services.append(svc)
+            engines.append(eng)
+
+        router = StandaloneRouter(
+            drt, namespace="sr", component="backend", endpoint="generate",
+            block_size=BS,
+        )
+        await router.start()
+
+        # a FRONTEND process would discover the router endpoint and call it
+        finder = await (
+            drt.namespace("sr").component("router").endpoint("find_best")
+        ).client()
+        await finder.wait_for_instances(2.0)
+        worker_client = await ep.client()
+
+        prefix = list(range(4 * BS))
+
+        async def ask(tokens, rid=""):
+            stream = await finder.direct(
+                {"token_ids": tokens, "request_id": rid},
+                finder.instance_ids()[0], Context(),
+            )
+            async for item in stream:
+                data = item.data if hasattr(item, "data") else item
+                return data
+
+        # warm worker 0 with the prefix via a direct request
+        warm_id = services[0].instance_id
+        req = PreprocessedRequest(
+            token_ids=prefix,
+            sampling=SamplingOptions(greedy=True),
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+        )
+        stream = await worker_client.direct(req.to_dict(), warm_id, Context())
+        async for _ in stream:
+            pass
+        await asyncio.sleep(0.2)  # events propagate to the router's indexer
+
+        decision = await ask(prefix + [999, 998])
+        assert decision["worker_id"] == warm_id
+        assert decision["overlap_blocks"] >= 4
+        # free op round-trips
+        freed = await ask_free(finder)
+        assert freed["ok"] is True
+
+        await router.close()
+    finally:
+        await drt.close()
+
+
+async def ask_free(finder):
+    stream = await finder.direct(
+        {"op": "free", "request_id": "x"}, finder.instance_ids()[0], Context()
+    )
+    async for item in stream:
+        return item.data if hasattr(item, "data") else item
