@@ -96,12 +96,18 @@ def _metrics_from_state(partial: bool) -> dict:
     if tok_s_chip and STATE["model"] and STATE["model"] != "tiny":
         peak = tpu_peak_flops(STATE["device_kind"])
         mfu = tok_s_chip * 2 * LLAMA3_8B_PARAMS / peak
+    # vs_baseline is only meaningful for the headline model on real TPU;
+    # tiny / cpu-fallback numbers must never masquerade as the metric of
+    # record (VERDICT r3 weak #8).
+    headline = (
+        STATE["model"] == "llama3-8b-int8" and STATE["device"] == "tpu"
+    )
     out = {
         "metric": "output_tok_s_per_chip",
         "value": round(tok_s_chip, 2) if tok_s_chip else None,
         "unit": "tok/s/chip",
         "vs_baseline": round(tok_s_chip / H100_REFERENCE_TOK_S, 4)
-        if tok_s_chip
+        if (tok_s_chip and headline)
         else None,
         "p50_ttft_ms": round(p50_ttft_ms, 1) if p50_ttft_ms else None,
         "total_output_tokens": tokens,
@@ -302,6 +308,8 @@ def compile_phase(engine) -> None:
 
     Scratch writes target the null block 0 (a designated garbage sink), so
     warmup never corrupts real sequences."""
+    from dynamo_tpu.engine.jax_engine.model_runner import MAX_EOS_IDS
+
     runner = engine.runner
     chunk = runner.prefill_chunk_tokens
     short = runner.prefill_buckets[0]
@@ -321,7 +329,8 @@ def compile_phase(engine) -> None:
             runner.prefill_packed_arrays(
                 **runner.pack_prefill(
                     [(list(range(1, 9)), [0], 0.0, 1.0, 0, 1.0,
-                      np.zeros(2, np.uint32))]
+                      np.zeros(2, np.uint32),
+                      np.full(MAX_EOS_IDS, -1, np.int32), False)]
                 )
             )[0]
         ),

@@ -188,6 +188,9 @@ class MockEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self.generated_tokens = 0
+        # cumulative UNCACHED prompt tokens actually prefilled; the routing
+        # tests compare this (deterministic) rather than wall-clock TTFT
+        self.prefilled_tokens = 0
 
     # Hook properties matching JaxEngine's surface so worker hosting can
     # attach a KvEventPublisher uniformly (entrypoint/inputs.py).
@@ -290,6 +293,7 @@ class MockEngine:
             self.active.append(seq)
             n_prefill = max(0, len(seq.request.token_ids)
                             - cached * self.args.block_size)
+            self.prefilled_tokens += n_prefill
             cost += (
                 self.args.prefill_linear_s * n_prefill
                 + self.args.prefill_quadratic_s * n_prefill * n_prefill

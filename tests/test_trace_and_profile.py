@@ -104,9 +104,10 @@ async def _serve_trace(trace, pick_worker):
             await one(i, r)
 
     await asyncio.gather(*(gated(i, r) for i, r in enumerate(trace)))
+    prefilled = sum(e.prefilled_tokens for e in engines)
     for e in engines:
         await e.close()
-    return float(np.mean(ttfts))
+    return float(np.mean(ttfts)), prefilled
 
 
 async def test_kv_affinity_routing_beats_round_robin():
@@ -132,10 +133,15 @@ async def test_kv_affinity_routing_beats_round_robin():
         # tested at the component level in test_kv_router e2e)
         return engines[hash(tuple(tokens[:BS])) % len(engines)]
 
-    rr = await _serve_trace(trace, round_robin)
-    kv = await _serve_trace(trace, prefix_affinity)
-    # affinity halves cold prefills on 2 workers; demand a real margin
-    assert kv < rr * 0.8, f"kv={kv*1e3:.1f}ms rr={rr*1e3:.1f}ms"
+    rr_ttft, rr_tokens = await _serve_trace(trace, round_robin)
+    kv_ttft, kv_tokens = await _serve_trace(trace, prefix_affinity)
+    # affinity halves cold prefills on 2 workers. Compare UNCACHED prefill
+    # tokens (deterministic sim counter) — wall-clock TTFT flakes under CI
+    # load because the mock's sleeps are real-time scaled.
+    assert kv_tokens < rr_tokens * 0.8, (
+        f"kv={kv_tokens} rr={rr_tokens} tokens "
+        f"(ttft kv={kv_ttft*1e3:.1f}ms rr={rr_ttft*1e3:.1f}ms)"
+    )
 
 
 async def test_kv_router_picks_affinity_on_trace():
