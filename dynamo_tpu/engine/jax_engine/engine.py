@@ -114,6 +114,7 @@ class _Sequence(SequenceState):
         self.eos_row = np.full(MAX_EOS_IDS, -1, np.int32)
         for j, t in enumerate(sorted(self.eos)[:MAX_EOS_IDS]):
             self.eos_row[j] = t
+        self.eos_drops = 0  # suppressed-EOS resamples past the device mask
 
     @property
     def needs_eos_suppress(self) -> bool:
@@ -166,6 +167,8 @@ class JaxEngine:
         )
         self.on_blocks_stored = on_blocks_stored
         self.on_blocks_removed = on_blocks_removed
+        # fired by clear_kv_blocks so routers drop this worker's radix state
+        self.on_cache_cleared: Optional[Callable[[], None]] = None
         # Disaggregation (SURVEY §7.6): when both are set, long prompts are
         # shipped to the prefill fleet instead of running locally.
         self.disagg_router = disagg_router
@@ -257,6 +260,33 @@ class JaxEngine:
             seq.out.put_nowait(LLMEngineOutput.final(FinishReason.CANCELLED))
         for seq in list(self._admit_order):
             self._finish(seq, FinishReason.CANCELLED)
+
+    async def clear_kv_blocks(self) -> dict:
+        """Flush reusable KV state: the tiered offload cache (G2 host + G3
+        disk) and the router-visible hash bookkeeping. In-flight sequences
+        keep their G1 device blocks — only *reusable* state is dropped
+        (ref http/service/clear_kv_blocks.rs semantics: reset prefix reuse
+        without killing live requests)."""
+        tier_blocks = 0
+        if self.block_manager is not None:
+            s = self.block_manager.stats
+            tier_blocks = s.host_blocks_used + s.disk_blocks_used
+            self.block_manager.clear()
+        self._hash_refs.clear()
+        for seq in self._admit_order:
+            # stored events already published for these sequences are about
+            # to be wiped by the Cleared event; re-emitting on the next
+            # block boundary re-registers live prefixes with the router
+            seq.emitted_hashes = 0
+        if self.on_cache_cleared is not None:
+            self.on_cache_cleared()
+        return {
+            "status": "cleared",
+            "offload_blocks_dropped": tier_blocks,
+            "active_sequences_kept": sum(
+                1 for s in self.slots if s is not None
+            ),
+        }
 
     # ------------------------------------------------------------- events
 
@@ -386,7 +416,14 @@ class JaxEngine:
             seq.seed if seq.seed is not None
             else self._seed_base + seq.seq_id
         )
-        return make_key_data(stream, seq.num_generated)
+        # eos_drops rides the high counter bits so a dropped overflow-EOS
+        # redraw uses a FRESH key (num_generated doesn't advance on a drop;
+        # without this the redraw would deterministically re-sample the
+        # same suppressed token). Generation counters stay < max_model_len
+        # << 2^16, so the ranges can't collide.
+        return make_key_data(
+            stream, seq.num_generated + (seq.eos_drops << 16)
+        )
 
     def _preempt_youngest(self, exclude: _Sequence) -> bool:
         for victim in reversed(self._admit_order):
@@ -482,6 +519,15 @@ class JaxEngine:
                 break
             self.waiting.pop(0)
             admitted = True
+            # multimodal sequences (vision embeddings in extra["mm"]):
+            # token-hash prefix reuse would collide across DIFFERENT images
+            # whose placeholder tokens are identical, so they skip the
+            # block-manager/peer lookup, disagg shipping, chunking and
+            # packing, and run the dedicated mm prefill program.
+            mm = seq.request.extra.get("mm")
+            if mm is not None:
+                await self._run_mm_prefill(loop, seq, mm)
+                continue
             hit_len = 0
             if self.block_manager is not None:
                 seq.pending_chain = TokenBlockSequence(
@@ -589,6 +635,39 @@ class JaxEngine:
                 total += len(s.token_ids)
             await self._run_packed_prefill(loop, group)
         return admitted
+
+    async def _run_mm_prefill(self, loop, seq: _Sequence, mm: dict) -> None:
+        """Single-sequence multimodal prefill: vision embeddings spliced
+        over the expanded placeholder span (runner.prefill_mm). No hash
+        chain is built — the chain keys on token ids only, and two prompts
+        with different images share identical placeholder tokens, so
+        emitting Stored events would poison prefix routing."""
+        embeds = mm["embeds"]
+        if not hasattr(embeds, "devices"):  # host payload (wire path)
+            embeds = np.asarray(embeds, np.float32)
+        start = int(mm["start"])
+        key_row = self._key_row(seq)
+        async with self._device_lock:
+            sample = await loop.run_in_executor(
+                None,
+                lambda: tuple(
+                    np.asarray(x)
+                    for x in self.runner.prefill_mm(
+                        list(seq.token_ids),
+                        seq.block_ids,
+                        embeds,
+                        start,
+                        seq.temperature,
+                        seq.top_p,
+                        seq.top_k,
+                        rep_pen=seq.rep_pen,
+                        key_data=key_row,
+                        eos_ids=seq.eos_row,
+                        eos_suppress=seq.needs_eos_suppress,
+                    )
+                ),
+            )
+        self._append_sample(seq, sample)
 
     async def _run_packed_prefill(
         self, loop, group: list[_Sequence]
@@ -992,9 +1071,21 @@ class JaxEngine:
             self._top_ks[i] = seq.top_k
             self._keys[i] = self._key_row(seq)
         penalties = None
-        if any(
-            seq.has_penalties or seq.needs_eos_suppress for seq in active
-        ):
+        eos_mask = None
+        any_pen = any(seq.has_penalties for seq in active)
+        any_eos = any(seq.needs_eos_suppress for seq in active)
+        if any_eos and not any_pen:
+            # min_tokens-only batch: EOS masking needs no token history —
+            # skip the [B, L] upload the penalty program pays every step
+            from dynamo_tpu.ops.sampling import MAX_EOS_IDS
+
+            eos_ids = np.full((B, MAX_EOS_IDS), -1, np.int32)
+            eos_sup = np.zeros(B, bool)
+            for seq in active:
+                eos_ids[seq.slot] = seq.eos_row
+                eos_sup[seq.slot] = seq.needs_eos_suppress
+            eos_mask = (eos_ids, eos_sup)
+        elif any_pen:
             # full-history penalties ride a separate (lazily compiled)
             # program; the plain path never pays the [B, L] input
             L = self.config.max_model_len
@@ -1037,6 +1128,7 @@ class JaxEngine:
                         self._top_ks,
                         keys=self._keys,
                         penalties=penalties,
+                        eos_mask=eos_mask,
                     )
                 ),
             )
@@ -1073,8 +1165,21 @@ class JaxEngine:
         if seq.ctx.is_stopped():
             self._finish(seq, FinishReason.CANCELLED)
             return
-        if token in seq.eos and seq.num_generated >= seq.min_tokens:
-            self._finish(seq, FinishReason.EOS)  # eos token stays hidden
+        if token in seq.eos:
+            if seq.num_generated >= seq.min_tokens:
+                self._finish(seq, FinishReason.EOS)  # eos token stays hidden
+                return
+            # min_tokens unmet but an EOS got sampled anyway: the device
+            # mask covers only the first MAX_EOS_IDS sorted stop ids, so an
+            # overflow id can slip through. Appending would leak the special
+            # token into the stream AND stop the HTTP-layer decoder early —
+            # drop it and resample next step (_key_row folds eos_drops into
+            # the counter, so the redraw uses a fresh key). A greedy
+            # sequence can still argmax the same id; after a few drops
+            # finish anyway.
+            seq.eos_drops += 1
+            if seq.eos_drops > 4:
+                self._finish(seq, FinishReason.EOS)
             return
         seq.token_ids.append(token)
         if seq.hash_seq is not None:

@@ -113,6 +113,7 @@ class ModelRunner:
         self.cp_min_tokens = cp_min_tokens
         self._rng_seed = rng_seed
         self._step_counter = 0
+        self._key_offset = 0  # monotonic decode-key counter (never reused)
         self.prefill_buckets = sorted(
             prefill_buckets or default_prefill_buckets(block_size, max_model_len)
         )
@@ -217,6 +218,17 @@ class ModelRunner:
             donate_argnums=(1, 2),  # k_cache, v_cache
             **jit_kwargs,
         )
+        # eos-mask-only variant (min_tokens set, no penalties): masks EOS
+        # logits without the [B, max_model_len] history upload the penalty
+        # program pays on every step.
+        self._decode_eos_fn = jax.jit(
+            functools.partial(
+                self._decode_eos_impl, self.config,
+                self.mesh, self._attn_head_axis,
+            ),
+            donate_argnums=(1, 2),  # k_cache, v_cache
+            **jit_kwargs,
+        )
         # packed batched prefill: N short prompts in ONE [P] program
         # (segment-masked attention); admission batches prompts up to this
         # token budget per engine iteration. Shares the chunk budget so the
@@ -296,6 +308,24 @@ class ModelRunner:
     ):
         logits, k_cache, v_cache = llama.prefill(
             params, cfg, tokens, valid_len, k_cache, v_cache, block_table,
+            mesh=attn_mesh, attn_head_axis=attn_head_axis,
+        )
+        out = ModelRunner._sample_one(
+            logits, tokens, valid_len, key_data, temp, top_p, top_k, rep_pen,
+            eos_ids, eos_suppress,
+        )
+        return out, k_cache, v_cache
+
+    @staticmethod
+    def _prefill_mm_impl(
+        cfg, attn_mesh, attn_head_axis,
+        params, k_cache, v_cache, tokens, valid_len, block_table,
+        mm_embeds, mm_start,
+        key_data, temp, top_p, top_k, rep_pen, eos_ids, eos_suppress,
+    ):
+        logits, k_cache, v_cache = llama.prefill_mm(
+            params, cfg, tokens, valid_len, k_cache, v_cache, block_table,
+            mm_embeds, mm_start,
             mesh=attn_mesh, attn_head_axis=attn_head_axis,
         )
         out = ModelRunner._sample_one(
@@ -393,6 +423,21 @@ class ModelRunner:
         out = sample_tokens_full(logits, None, temps, top_ps, top_ks, keys=keys)
         return out, k_cache, v_cache
 
+    @staticmethod
+    def _decode_eos_impl(
+        cfg, attn_mesh, attn_head_axis,
+        params, k_cache, v_cache, tokens, positions, block_tables,
+        slot_indices, keys, temps, top_ps, top_ks, eos_ids, eos_suppress,
+    ):
+        logits, k_cache, v_cache = llama.decode(
+            params, cfg, tokens, positions, k_cache, v_cache,
+            block_tables, slot_indices,
+            mesh=attn_mesh, attn_head_axis=attn_head_axis,
+        )
+        logits = mask_eos_logits(logits, eos_ids, eos_suppress)
+        out = sample_tokens_full(logits, None, temps, top_ps, top_ks, keys=keys)
+        return out, k_cache, v_cache
+
     def _next_key_data(self) -> np.ndarray:
         """Default per-call RNG stream: raw threefry key data built on the
         host with numpy (ops/sampling.make_key_data). Multi-controller:
@@ -402,6 +447,28 @@ class ModelRunner:
 
         self._step_counter += 1
         return make_key_data(self._rng_seed, self._step_counter)
+
+    # Decode defaults draw from a distinct threefry stream id so (stream,
+    # counter) rows can never collide with prefill's (_rng_seed, step) rows,
+    # and the counter advances by B per step (monotonic offset) so rows
+    # never repeat when the batch size varies across steps.
+    _DECODE_STREAM_SALT = 0x9E3779B9
+
+    def _next_decode_keys(self, B: int) -> np.ndarray:
+        keys = np.stack(
+            [
+                np.full(
+                    B,
+                    (self._rng_seed ^ self._DECODE_STREAM_SALT) & 0xFFFFFFFF,
+                    np.uint32,
+                ),
+                (np.arange(B, dtype=np.uint32)
+                 + np.uint32(self._key_offset & 0xFFFFFFFF)),
+            ],
+            axis=1,
+        )
+        self._key_offset += B
+        return keys
 
     def _to_dev(self, a) -> jax.Array:
         """Commit a host input: local array normally; fully-replicated
@@ -472,6 +539,66 @@ class ModelRunner:
             self.params, self.k_cache, self.v_cache,
             self._to_dev(tokens), self._to_dev(np.int32(T)),
             self._to_dev(table), self._to_dev(key_data),
+            self._to_dev(np.float32(temperature)),
+            self._to_dev(np.float32(top_p)), self._to_dev(np.int32(top_k)),
+            self._to_dev(np.float32(rep_pen)),
+            self._to_dev(np.asarray(eos_ids, np.int32)),
+            self._to_dev(np.bool_(eos_suppress)),
+        )
+        return out
+
+    def prefill_mm(
+        self,
+        token_ids: list[int],  # image placeholders already expanded
+        block_ids: list[int],
+        mm_embeds: np.ndarray,  # [M, hidden] vision embeddings
+        mm_start: int,  # first expanded-placeholder index
+        temperature: float,
+        top_p: float,
+        top_k: int,
+        rep_pen: float = 1.0,
+        key_data: Optional[np.ndarray] = None,
+        eos_ids: Optional[np.ndarray] = None,
+        eos_suppress: bool = False,
+    ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Multimodal prefill (vision embeddings spliced over placeholder
+        positions — reference prefill_worker.py:249-258). Jitted lazily so
+        text-only deployments never compile it; one program per (bucket,
+        num_patches) pair."""
+        if not hasattr(self, "_prefill_mm_jit"):
+            self._prefill_mm_jit = jax.jit(
+                functools.partial(
+                    self._prefill_mm_impl, self.config,
+                    self.mesh, self._attn_head_axis,
+                ),
+                donate_argnums=(1, 2),  # k_cache, v_cache
+            )
+        T = len(token_ids)
+        bucket = self.pick_bucket(T)
+        tokens = np.zeros(bucket, np.int32)
+        tokens[:T] = token_ids
+        nb = bucket // self.block_size
+        table = np.zeros(nb, np.int32)
+        used = (T + self.block_size - 1) // self.block_size
+        table[:used] = block_ids[:used]
+        if key_data is None:
+            key_data = self._next_key_data()
+        if eos_ids is None:
+            eos_ids = np.full(MAX_EOS_IDS, -1, np.int32)
+        # device-path embeddings (already jax arrays, e.g. handed over via
+        # transfer_embeds_device) stay on device; host payloads upload here
+        mm_dev = (
+            mm_embeds
+            if isinstance(mm_embeds, jax.Array)
+            else self._to_dev(np.asarray(mm_embeds, np.float32))
+        )
+        out, self.k_cache, self.v_cache = self._prefill_mm_jit(
+            self.params, self.k_cache, self.v_cache,
+            self._to_dev(tokens), self._to_dev(np.int32(T)),
+            self._to_dev(table),
+            mm_dev,
+            self._to_dev(np.int32(mm_start)),
+            self._to_dev(key_data),
             self._to_dev(np.float32(temperature)),
             self._to_dev(np.float32(top_p)), self._to_dev(np.int32(top_k)),
             self._to_dev(np.float32(rep_pen)),
@@ -754,20 +881,15 @@ class ModelRunner:
         # the lazily-compiled penalty program (ref validate.rs:95-125 — the
         # options are implemented here, not accepted-and-dropped; the eos
         # mask implements min_tokens)
+        eos_mask: Optional[tuple] = None,
+        # eos_mask = (eos_ids [B, MAX_EOS_IDS] i32, eos_suppress [B] bool):
+        # min_tokens without penalties — masks EOS on device but skips the
+        # [B, L] history transfer. Ignored when penalties is given.
     ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
         """One batched decode step. Returns (tokens, logprobs, top_ids,
         top_logprobs) device arrays, each batch-major."""
         if keys is None:
-            self._step_counter += 1
-            B = tokens.shape[0]
-            keys = np.stack(
-                [
-                    np.full(B, self._rng_seed & 0xFFFFFFFF, np.uint32),
-                    (np.arange(B, dtype=np.uint32)
-                     + np.uint32((self._step_counter * B) & 0xFFFFFFFF)),
-                ],
-                axis=1,
-            )
+            keys = self._next_decode_keys(tokens.shape[0])
         args = [
             self.params, self.k_cache, self.v_cache,
             self._to_dev(tokens), self._to_dev(positions),
@@ -778,6 +900,9 @@ class ModelRunner:
         if penalties is not None:
             args.extend(self._to_dev(p) for p in penalties)
             out, self.k_cache, self.v_cache = self._decode_pen_fn(*args)
+        elif eos_mask is not None:
+            args.extend(self._to_dev(p) for p in eos_mask)
+            out, self.k_cache, self.v_cache = self._decode_eos_fn(*args)
         else:
             out, self.k_cache, self.v_cache = self._decode_fn(*args)
         return out

@@ -53,6 +53,8 @@ OP_CHUNK = 3
 OP_EXTRACT = 4
 OP_INJECT = 5
 OP_PACKED = 6
+OP_EMBED = 7
+OP_MM_PREFILL = 8
 OP_STOP = 0
 
 
@@ -270,20 +272,12 @@ class SpmdModelRunner:
         )
 
     def decode(self, tokens, positions, block_tables, slot_indices, temps,
-               top_ps, top_ks, keys=None, penalties=None):
+               top_ps, top_ks, keys=None, penalties=None, eos_mask=None):
         B = tokens.shape[0]
         if keys is None:
             # same default derivation the inner runner would use, but built
             # here so the broadcast carries the authoritative rows
-            self._runner._step_counter += 1
-            keys = np.stack(
-                [
-                    np.full(B, self._runner._rng_seed & 0xFFFFFFFF, np.uint32),
-                    (np.arange(B, dtype=np.uint32)
-                     + np.uint32((self._runner._step_counter * B) & 0xFFFFFFFF)),
-                ],
-                axis=1,
-            )
+            keys = self._runner._next_decode_keys(B)
         payload = [
             np.asarray(tokens, np.int32),
             np.asarray(positions, np.int32),
@@ -294,17 +288,22 @@ class SpmdModelRunner:
             np.asarray(top_ks, np.int32),
             np.asarray(keys, np.uint32),
         ]
+        # variant flag: 0 slim, 1 full penalties, 2 eos-mask only
+        variant = 1 if penalties is not None else (
+            2 if eos_mask is not None else 0
+        )
         if penalties is not None:
             payload.extend(np.asarray(p) for p in penalties)
+        elif eos_mask is not None:
+            payload.extend(np.asarray(p) for p in eos_mask)
         self._channel.send(
-            OP_DECODE,
-            [B, block_tables.shape[1], 1 if penalties is not None else 0],
-            tuple(payload),
+            OP_DECODE, [B, block_tables.shape[1], variant], tuple(payload)
         )
         return self._fetch_sample(
             self._runner.decode(
                 tokens, positions, block_tables, slot_indices, temps,
                 top_ps, top_ks, keys=keys, penalties=penalties,
+                eos_mask=eos_mask,
             )
         )
 
@@ -365,6 +364,49 @@ class SpmdModelRunner:
             OP_INJECT, [len(b), k.shape[2], dt_code], (b, k, v)
         )
         return self._runner.inject_blocks(list(block_ids), k_blocks, v_blocks)
+
+    def prefill_mm(self, token_ids, block_ids, mm_embeds, mm_start,
+                   temperature, top_p, top_k, rep_pen=1.0, key_data=None,
+                   eos_ids=None, eos_suppress=False):
+        # multimodal prefill is a collective program like prefill; without
+        # this broadcast the leader would launch it alone and wedge the
+        # slice. Embeddings ride the broadcast as host f32 (the device
+        # path is a same-process optimization; multi-controller replicates
+        # host inputs by construction).
+        t = np.asarray(token_ids, np.int32)
+        b = np.asarray(block_ids, np.int32)
+        emb = np.asarray(mm_embeds, np.float32)
+        if key_data is None:
+            key_data = self._runner._next_key_data()
+        if eos_ids is None:
+            eos_ids = np.full(_EOS_K, -1, np.int32)
+        self._channel.send(
+            OP_MM_PREFILL,
+            [len(t), len(b), emb.shape[0], emb.shape[1],
+             int(mm_start), 1 if eos_suppress else 0],
+            (t, b, emb, np.float32(temperature), np.float32(top_p),
+             np.int32(top_k), np.float32(rep_pen),
+             np.asarray(key_data, np.uint32),
+             np.asarray(eos_ids, np.int32)),
+        )
+        return self._fetch_sample(
+            self._runner.prefill_mm(
+                list(token_ids), list(block_ids), emb, int(mm_start),
+                temperature, top_p, top_k, rep_pen=float(rep_pen),
+                key_data=np.asarray(key_data),
+                eos_ids=np.asarray(eos_ids),
+                eos_suppress=bool(eos_suppress),
+            )
+        )
+
+    def embed(self, token_ids):
+        # /v1/embeddings launches a collective program (llama.embed_pooled
+        # over the global mesh); without this broadcast the leader would run
+        # it alone and wedge the slice — the same hazard class as
+        # extract_blocks_device below.
+        t = np.asarray(token_ids, np.int32)
+        self._channel.send(OP_EMBED, [len(t)], (t,))
+        return self._runner.embed(np.asarray(t).tolist())
 
     def extract_blocks_device(self, block_ids):
         raise NotImplementedError(
@@ -490,14 +532,14 @@ def follower_loop(runner, channel: SpmdStepChannel, progress_cb=None) -> None:
         if op == OP_STOP:
             return
         if op == OP_DECODE:
-            B, nb, has_pen = int(h[1]), int(h[2]), int(h[3])
+            B, nb, variant = int(h[1]), int(h[2]), int(h[3])
             template = [
                 np.zeros(B, np.int32), np.zeros(B, np.int32),
                 np.zeros((B, nb), np.int32), np.zeros(B, np.int32),
                 np.zeros(B, np.float32), np.zeros(B, np.float32),
                 np.zeros(B, np.int32), np.zeros((B, 2), np.uint32),
             ]
-            if has_pen:
+            if variant == 1:  # full penalties
                 Lh = runner.max_model_len
                 template.extend(
                     [
@@ -508,15 +550,22 @@ def follower_loop(runner, channel: SpmdStepChannel, progress_cb=None) -> None:
                         np.zeros(B, bool),
                     ]
                 )
+            elif variant == 2:  # eos-mask only
+                template.extend(
+                    [
+                        np.full((B, _EOS_K), -1, np.int32),
+                        np.zeros(B, bool),
+                    ]
+                )
             got = channel.recv_payload(tuple(template))
             (tok, pos, bt, slot, te, tp_, tk, keys) = got[:8]
-            penalties = (
-                tuple(np.asarray(p) for p in got[8:]) if has_pen else None
-            )
+            extra = tuple(np.asarray(p) for p in got[8:])
             runner.decode(
                 np.asarray(tok), np.asarray(pos), np.asarray(bt),
                 np.asarray(slot), np.asarray(te), np.asarray(tp_),
-                np.asarray(tk), keys=np.asarray(keys), penalties=penalties,
+                np.asarray(tk), keys=np.asarray(keys),
+                penalties=extra if variant == 1 else None,
+                eos_mask=extra if variant == 2 else None,
             )
         elif op == OP_PREFILL:
             T, nb, sup = int(h[1]), int(h[2]), int(h[3])
@@ -565,6 +614,30 @@ def follower_loop(runner, channel: SpmdStepChannel, progress_cb=None) -> None:
                 )
             )
             runner.prefill_packed_arrays(*(np.asarray(a) for a in got))
+        elif op == OP_MM_PREFILL:
+            T, nb, M, H, start, sup = (
+                int(h[1]), int(h[2]), int(h[3]), int(h[4]), int(h[5]),
+                int(h[6]),
+            )
+            (t, b, emb, te, tp_, tk, rp, kd, er) = channel.recv_payload(
+                (
+                    np.zeros(T, np.int32), np.zeros(nb, np.int32),
+                    np.zeros((M, H), np.float32),
+                    np.float32(0), np.float32(0), np.int32(0),
+                    np.float32(1), np.zeros(2, np.uint32),
+                    np.full(_EOS_K, -1, np.int32),
+                )
+            )
+            runner.prefill_mm(
+                np.asarray(t).tolist(), np.asarray(b).tolist(),
+                np.asarray(emb), start, float(te), float(tp_), int(tk),
+                rep_pen=float(rp), key_data=np.asarray(kd),
+                eos_ids=np.asarray(er), eos_suppress=bool(sup),
+            )
+        elif op == OP_EMBED:
+            T = int(h[1])
+            (t,) = channel.recv_payload((np.zeros(T, np.int32),))
+            runner.embed(np.asarray(t).tolist())
         elif op == OP_EXTRACT:
             n = int(h[1])
             (b,) = channel.recv_payload((np.zeros(n, np.int32),))
