@@ -177,7 +177,37 @@ class ModelWatcher:
             )
         else:
             router = PushRouter(client, self.router_mode)
-        execution = ModelExecution(mdc, RemoteEngine(router))
+        # admin fan-out: POST /clear_kv_blocks on the frontend round-trips
+        # every worker's clear_kv_blocks endpoint (ref clear_kv_blocks.rs:88)
+        clear_endpoint = endpoint.component.endpoint("clear_kv_blocks")
+        clear_client_box: dict[str, Any] = {}
+
+        async def clear_fn() -> list[dict]:
+            client_c = clear_client_box.get("c")
+            if client_c is None:
+                client_c = await clear_endpoint.client()
+                clear_client_box["c"] = client_c
+            results = []
+            for iid in client_c.instance_ids():
+                stream = None
+                try:
+                    stream = await client_c.direct({}, iid)
+                    async for item in stream:
+                        if item.data is not None:
+                            results.append(
+                                {"instance": iid, **dict(item.data)}
+                            )
+                            break
+                except Exception as e:  # noqa: BLE001
+                    results.append({"instance": iid, "error": str(e)})
+                finally:
+                    if stream is not None:
+                        await stream.close()
+            return results
+
+        execution = ModelExecution(
+            mdc, RemoteEngine(router), clear_fn=clear_fn
+        )
         self.manager.add_model(entry.name, execution, ref=key)
         self._key_to_model[key] = entry.name
         logger.info("watcher wired model %s via %s", entry.name, entry.endpoint)

@@ -30,6 +30,19 @@ from dynamo_tpu.runtime.protocols import EndpointId
 logger = get_logger("dynamo_tpu.entrypoint")
 
 
+def _local_clear_fn(engine: Any) -> Optional[Any]:
+    """Adapt a local engine's clear_kv_blocks() (one dict) to the
+    ModelExecution.clear_fn contract (list of per-worker dicts)."""
+    inner = getattr(engine, "clear_kv_blocks", None)
+    if inner is None:
+        return None
+
+    async def clear_fn() -> list[dict]:
+        return [{"instance": "local", **await inner()}]
+
+    return clear_fn
+
+
 @dataclass
 class EngineConfig:
     """Either dynamic (discovered workers) or a static local engine."""
@@ -38,6 +51,7 @@ class EngineConfig:
     mdc: Optional[ModelDeploymentCard] = None
     router_mode: RouterMode = RouterMode.ROUND_ROBIN
     kv_router_config: Optional[Any] = None  # KvRouterConfig when mode=KV
+    request_template: Optional[Any] = None  # request_template.RequestTemplate
 
     @classmethod
     def dynamic(
@@ -90,15 +104,20 @@ async def run_http(
     port: int = 8080,
 ) -> HttpService:
     manager = ModelManager()
-    service = HttpService(manager, host=host, port=port)
+    service = HttpService(
+        manager, host=host, port=port, template=config.request_template
+    )
     if config.is_static:
         assert config.mdc is not None
+        if getattr(config.engine, "supports_images", False):
+            config.mdc.extra["supports_images"] = True
         manager.add_model(
             config.mdc.name,
             ModelExecution(
                 config.mdc,
                 config.local_engine_fn(),
                 embed_fn=getattr(config.engine, "embed", None),
+                clear_fn=_local_clear_fn(config.engine),
             ),
         )
     else:
@@ -252,6 +271,8 @@ async def run_endpoint(
         async for out in engine.generate(pre, ctx):
             yield out.to_dict()
 
+    if getattr(engine, "supports_images", False):
+        config.mdc.extra["supports_images"] = True
     service = await endpoint.serve_endpoint(handler)
     await register_llm(drt, endpoint, config.mdc)
 
@@ -271,6 +292,20 @@ async def run_endpoint(
     if hasattr(engine, "on_blocks_stored"):
         engine.on_blocks_stored = kv_pub.on_blocks_stored
         engine.on_blocks_removed = kv_pub.on_blocks_removed
+    if hasattr(engine, "on_cache_cleared"):
+        engine.on_cache_cleared = kv_pub.publish_cleared
+
+    # admin control plane: the frontend's POST /clear_kv_blocks fans out to
+    # this per-worker endpoint (ref http/service/clear_kv_blocks.rs:23)
+    clear_service = None
+    if hasattr(engine, "clear_kv_blocks"):
+
+        async def clear_handler(request: dict, ctx: Context):
+            yield await engine.clear_kv_blocks()
+
+        clear_service = await endpoint.component.endpoint(
+            "clear_kv_blocks"
+        ).serve_endpoint(clear_handler)
 
     metrics_pub = WorkerMetricsPublisher(
         endpoint.component, endpoint.id, service.instance_id
@@ -303,6 +338,8 @@ async def run_endpoint(
         await service.wait()
     finally:
         await metrics_pub.stop()
+        if clear_service is not None:
+            await clear_service.stop(drain=False)
 
 
 # ----------------------------------------------------------------- util
@@ -313,10 +350,16 @@ async def _resolve_execution(
 ) -> tuple[ModelExecution, str]:
     if config.is_static:
         assert config.mdc is not None
+        if getattr(config.engine, "supports_images", False):
+            config.mdc.extra["supports_images"] = True
         embed_fn = getattr(config.engine, "embed", None)
+        clear_fn = _local_clear_fn(config.engine)
         return (
             ModelExecution(
-                config.mdc, config.local_engine_fn(), embed_fn=embed_fn
+                config.mdc,
+                config.local_engine_fn(),
+                embed_fn=embed_fn,
+                clear_fn=clear_fn,
             ),
             config.mdc.name,
         )

@@ -53,14 +53,25 @@ class ModelExecution:
         mdc: ModelDeploymentCard,
         engine_fn: EngineFn,
         embed_fn: Optional[Callable] = None,
+        clear_fn: Optional[Callable] = None,
     ) -> None:
         self.mdc = mdc
         self.engine_fn = engine_fn
         # async (token_ids) -> pooled embedding vector, when the engine
         # supports it (ref http/service/openai.rs:222 /v1/embeddings)
         self.embed_fn = embed_fn
+        # async () -> list of per-worker result dicts; flushes worker KV
+        # caches (ref http/service/clear_kv_blocks.rs:40)
+        self.clear_fn = clear_fn
         self.preprocessor = OpenAIPreprocessor(mdc)
         self.backend = Backend(self.preprocessor.tokenizer)
+
+    @property
+    def supports_images(self) -> bool:
+        """True when the backing worker understands image content parts
+        (set by MultimodalEngine deployments via the model card — the flag
+        must ride discovery so remote frontends see it too)."""
+        return bool(self.mdc.extra.get("supports_images"))
 
     @staticmethod
     def _fanout(pre: PreprocessedRequest) -> list[PreprocessedRequest]:
@@ -285,17 +296,21 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8080,
         metrics: Optional[ServiceMetrics] = None,
+        template: Optional[Any] = None,  # request_template.RequestTemplate
     ) -> None:
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
         self.metrics = metrics or ServiceMetrics()
+        self.template = template
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.add_routes(
             [
                 web.post("/v1/chat/completions", self._chat),
                 web.post("/v1/completions", self._completions),
                 web.post("/v1/embeddings", self._embeddings),
+                web.post("/v1/responses", self._responses),
+                web.post("/clear_kv_blocks", self._clear_kv_blocks),
                 web.get("/v1/models", self._models),
                 web.get("/health", self._health),
                 web.get("/live", self._health),
@@ -374,12 +389,26 @@ class HttpService:
     async def _chat(self, request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
+            if self.template is not None:
+                body = self.template.apply_chat(body)
             chat_req = ChatCompletionRequest.model_validate(body)
         except Exception as e:  # noqa: BLE001
             return self._error(400, f"invalid request: {e}")
         execution = self.manager.get(chat_req.model)
         if execution is None:
             return self._error(404, f"model {chat_req.model!r} not found", "not_found_error")
+        has_images = any(
+            isinstance(m.content, list)
+            and any(p.get("type") == "image_url" for p in m.content)
+            for m in chat_req.messages
+        )
+        if has_images and not execution.supports_images:
+            # fail loudly instead of silently answering text-only (the
+            # preprocessor strips image parts for the template either way)
+            return self._error(
+                501, "this model does not accept image input",
+                "not_implemented",
+            )
         ctx = Context()
         timer = TokenTimer(self.metrics, chat_req.model)
         with self.metrics.track(chat_req.model, "chat_completions"):
@@ -398,6 +427,8 @@ class HttpService:
     async def _completions(self, request: web.Request) -> web.StreamResponse:
         try:
             body = await request.json()
+            if self.template is not None:
+                body = self.template.apply_completion(body)
             comp_req = CompletionRequest.model_validate(body)
         except Exception as e:  # noqa: BLE001
             return self._error(400, f"invalid request: {e}")
@@ -469,6 +500,133 @@ class HttpService:
                     "total_tokens": prompt_tokens,
                 },
             }
+        )
+
+    async def _responses(self, request: web.Request) -> web.Response:
+        """OpenAI Responses API, unary (ref http/service/openai.rs:443 —
+        the reference also serves it unary-only). A responses body is
+        converted to a chat request (responses.rs:152-191 TryFrom), run
+        through the chat chain, and the aggregate is reshaped into a
+        Response object (responses.rs:198-253)."""
+        import time
+        import uuid
+
+        try:
+            body = await request.json()
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, f"invalid request: {e}")
+        if not isinstance(body, dict):
+            return self._error(400, "request body must be a JSON object")
+        if self.template is not None:
+            body = self.template.apply_responses(body)
+        inp = body.get("input")
+        if not isinstance(inp, str):
+            # ref validate_response_input_is_text_only: items input is 501
+            return self._error(
+                501, "only text input is supported", "not_implemented"
+            )
+        for field in ("tools", "tool_choice", "previous_response_id"):
+            if body.get(field):
+                return self._error(
+                    501, f"`{field}` is not supported", "not_implemented"
+                )
+        chat_body = {
+            "model": body.get("model", ""),
+            "messages": [{"role": "user", "content": inp}],
+            "stream": False,
+        }
+        for src, dst in (
+            ("temperature", "temperature"),
+            ("top_p", "top_p"),
+            ("max_output_tokens", "max_completion_tokens"),
+        ):
+            if body.get(src) is not None:
+                chat_body[dst] = body[src]
+        if body.get("top_logprobs") is not None:
+            chat_body["logprobs"] = True
+            chat_body["top_logprobs"] = min(int(body["top_logprobs"]), 20)
+        try:
+            chat_req = ChatCompletionRequest.model_validate(chat_body)
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, f"invalid request: {e}")
+        execution = self.manager.get(chat_req.model)
+        if execution is None:
+            return self._error(
+                404, f"model {chat_req.model!r} not found", "not_found_error"
+            )
+        ctx = Context()
+        timer = TokenTimer(self.metrics, chat_req.model)
+        with self.metrics.track(chat_req.model, "responses"):
+            agg = ChatDeltaAggregator()
+            async for item in execution.chat_stream(chat_req, ctx, timer):
+                if item.is_error():
+                    return self._error(
+                        500, item.error_message() or "engine error",
+                        "internal_error",
+                    )
+                if item.data is not None:
+                    agg.add(ChatCompletionChunk.model_validate(item.data))
+            chat_resp = agg.finish()
+        content = ""
+        if chat_resp.choices:
+            content = chat_resp.choices[0].message.content or ""
+        return web.json_response(
+            {
+                "id": f"resp_{uuid.uuid4().hex}",
+                "object": "response",
+                "created_at": int(time.time()),
+                "model": chat_req.model,
+                "status": "completed",
+                "output": [
+                    {
+                        "type": "message",
+                        "id": f"msg_{uuid.uuid4().hex}",
+                        "role": "assistant",
+                        "status": "completed",
+                        "content": [
+                            {
+                                "type": "output_text",
+                                "text": content,
+                                "annotations": [],
+                            }
+                        ],
+                    }
+                ],
+            }
+        )
+
+    async def _clear_kv_blocks(self, request: web.Request) -> web.Response:
+        """Admin route: flush every worker's reusable KV cache state (ref
+        http/service/clear_kv_blocks.rs:40-110 — per-worker-group results
+        under cleared/failed lists)."""
+        models = self.manager.list_models()
+        if not models:
+            return web.json_response(
+                {"message": "No active worker groups found"}
+            )
+        cleared, failed = [], []
+        for name in models:
+            execution = self.manager.get(name)
+            if execution is None or execution.clear_fn is None:
+                failed.append(
+                    {
+                        "name": name,
+                        "status": "worker group doesn't support "
+                        "clear_kv_blocks",
+                    }
+                )
+                continue
+            try:
+                results = await execution.clear_fn()
+                cleared.append(
+                    {"name": name, "status": "cleared", "workers": results}
+                )
+            except Exception as e:  # noqa: BLE001
+                failed.append(
+                    {"name": name, "status": "error", "error": str(e)}
+                )
+        return web.json_response(
+            {"cleared_worker_groups": cleared, "failed_worker_groups": failed}
         )
 
     async def _models(self, request: web.Request) -> web.Response:

@@ -72,6 +72,11 @@ def parse_args(argv: Optional[list[str]] = None) -> argparse.Namespace:
         "--no-kv-events", action="store_true",
         help="KV router: use TTL-based ApproxKvIndexer instead of events",
     )
+    parser.add_argument(
+        "--request-template", default=None,
+        help="JSON file with default model/temperature/max_completion_tokens "
+        "applied to requests that omit them (ref request_template.rs)",
+    )
     args = parser.parse_args(argv)
     args.in_opt = "http"
     args.out_opt = "echo_full"
@@ -151,6 +156,10 @@ async def amain(args: argparse.Namespace) -> None:
             config = EngineConfig.static_(engine, mdc)
         else:
             raise SystemExit(f"unknown out={args.out_opt}")
+        if args.request_template:
+            from dynamo_tpu.request_template import RequestTemplate
+
+            config.request_template = RequestTemplate.load(args.request_template)
         if args.in_opt == "http":
             from dynamo_tpu.entrypoint.inputs import serve_http_forever
 

@@ -1,0 +1,216 @@
+"""Protocol parity additions (round 4): /v1/responses, /clear_kv_blocks,
+request template.
+
+(reference lib/llm/src/protocols/openai/responses.rs,
+http/service/clear_kv_blocks.rs, request_template.rs)"""
+
+import json
+
+import aiohttp
+
+from dynamo_tpu.engine.echo import EchoEngineCore
+from dynamo_tpu.entrypoint.inputs import EngineConfig, run_http
+from dynamo_tpu.request_template import RequestTemplate
+
+from tests.util import make_test_mdc
+
+
+async def _serve_echo(drt, template=None):
+    mdc = make_test_mdc("echo-8b")
+    config = EngineConfig.static_(EchoEngineCore(), mdc)
+    config.request_template = template
+    service = await run_http(drt, config, host="127.0.0.1", port=0)
+    return service, f"http://127.0.0.1:{service.port}"
+
+
+async def test_responses_api_unary():
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        service, base = await _serve_echo(drt)
+        async with aiohttp.ClientSession() as session:
+            payload = {
+                "model": "echo-8b",
+                "input": "hello quick world",
+                "max_output_tokens": 16,
+            }
+            async with session.post(
+                f"{base}/v1/responses", json=payload
+            ) as resp:
+                assert resp.status == 200
+                data = await resp.json()
+            assert data["object"] == "response"
+            assert data["status"] == "completed"
+            assert data["id"].startswith("resp_")
+            msg = data["output"][0]
+            assert msg["type"] == "message"
+            assert msg["role"] == "assistant"
+            text = msg["content"][0]["text"]
+            # echo engine echoes the prompt back
+            for word in ("hello", "quick", "world"):
+                assert word in text
+            # items input -> 501 (ref validate_response_input_is_text_only)
+            async with session.post(
+                f"{base}/v1/responses",
+                json={"model": "echo-8b", "input": [{"role": "user"}]},
+            ) as resp:
+                assert resp.status == 501
+            # unknown model -> 404
+            async with session.post(
+                f"{base}/v1/responses",
+                json={"model": "nope", "input": "hi"},
+            ) as resp:
+                assert resp.status == 404
+    finally:
+        if service:
+            await service.close()
+        await drt.close()
+
+
+async def test_request_template_fills_defaults(tmp_path):
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    tpl_file = tmp_path / "template.json"
+    tpl_file.write_text(
+        json.dumps(
+            {
+                "model": "echo-8b",
+                "temperature": 0.7,
+                "max_completion_tokens": 5,
+            }
+        )
+    )
+    template = RequestTemplate.load(str(tpl_file))
+    assert template.model == "echo-8b"
+    assert template.max_completion_tokens == 5
+
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        service, base = await _serve_echo(drt, template=template)
+        async with aiohttp.ClientSession() as session:
+            # no model, no max_tokens: template supplies both; the echo
+            # engine would otherwise emit its default token budget
+            payload = {
+                "messages": [
+                    {"role": "user", "content": "a b c d e f g h i j k l"}
+                ],
+            }
+            async with session.post(
+                f"{base}/v1/chat/completions", json=payload
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+                data = await resp.json()
+            assert data["model"] == "echo-8b"
+            # max_completion_tokens=5 capped the echo (the prompt alone is
+            # 12+ tokens; without the template cap the echo would return
+            # far more than 5)
+            content = data["choices"][0]["message"]["content"] or ""
+            assert 0 < len(content.split()) <= 5
+            # responses route gets the same defaults
+            async with session.post(
+                f"{base}/v1/responses", json={"input": "x y z"}
+            ) as resp:
+                assert resp.status == 200
+                assert (await resp.json())["model"] == "echo-8b"
+    finally:
+        if service:
+            await service.close()
+        await drt.close()
+
+
+async def test_clear_kv_blocks_local_engine():
+    """POST /clear_kv_blocks flushes the static engine's offload tiers and
+    publishes a Cleared event."""
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.block_manager.layout import LayoutConfig
+    from dynamo_tpu.block_manager.manager import TieredBlockManager
+    from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+    from dynamo_tpu.models import llama as L
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.http.service import HttpService, ModelExecution, ModelManager
+
+    cfg = L.LlamaConfig.tiny(vocab_size=64)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    runner = ModelRunner(
+        cfg, params, num_blocks=32, block_size=8, max_batch=2,
+        max_model_len=128,
+    )
+    layout = LayoutConfig(
+        num_layers=cfg.num_layers, page_size=8,
+        num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+        dtype="float32",
+    )
+    bm = TieredBlockManager(layout, host_blocks=8)
+    engine = JaxEngine(
+        runner,
+        JaxEngineConfig(
+            max_batch=2, block_size=8, num_blocks=32, max_model_len=128
+        ),
+        block_manager=bm,
+    )
+    cleared_events = []
+    engine.on_cache_cleared = lambda: cleared_events.append(1)
+
+    # seed the host tier with one block so clear has something to drop
+    kb = np.zeros(
+        (cfg.num_layers, cfg.num_kv_heads, 1, 8, cfg.head_dim), np.float32
+    )
+    bm.store_blocks([12345], kb, kb)
+    assert bm.stats.host_blocks_used == 1
+
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        manager = ModelManager()
+        mdc = make_test_mdc("tiny")
+        from dynamo_tpu.entrypoint.inputs import _local_clear_fn
+
+        manager.add_model(
+            "tiny",
+            ModelExecution(
+                mdc, engine.generate, clear_fn=_local_clear_fn(engine)
+            ),
+        )
+        service = HttpService(manager, host="127.0.0.1", port=0)
+        await service.start()
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{base}/clear_kv_blocks") as resp:
+                assert resp.status == 200
+                data = await resp.json()
+        assert data["cleared_worker_groups"], data
+        workers = data["cleared_worker_groups"][0]
+        assert workers["status"] == "cleared"
+        assert bm.stats.host_blocks_used == 0
+        assert cleared_events  # router-facing Cleared was published
+    finally:
+        if service:
+            await service.close()
+        await engine.close()
+        await drt.close()
+
+
+async def test_clear_kv_blocks_no_support():
+    """Models without a clear_fn land in failed_worker_groups."""
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        service, base = await _serve_echo(drt)
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"{base}/clear_kv_blocks") as resp:
+                assert resp.status == 200
+                data = await resp.json()
+        assert data["failed_worker_groups"]
+        assert not data["cleared_worker_groups"]
+    finally:
+        if service:
+            await service.close()
+        await drt.close()
