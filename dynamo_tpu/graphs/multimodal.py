@@ -1,0 +1,90 @@
+"""Multimodal E/P/D serving graph: frontend + encode worker + LLM worker.
+
+Launch:  python -m dynamo_tpu.serve dynamo_tpu.graphs.multimodal
+Mirrors the reference's examples/multimodal/graphs/agg.py topology
+(Frontend -> Processor -> [EncodeWorker, VllmWorker]): a dedicated encode
+worker owns the vision tower; LLM workers request embeddings over the
+fabric wire (the DCN path — same process+slice deployments can instead
+construct MultimodalEngine with the EncodeWorker directly for the ICI
+device path, see tests/test_multimodal.py)."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+from dynamo_tpu.sdk import depends, service
+
+
+def _encode_endpoint() -> str:
+    return os.environ.get("DYN_ENCODE_ENDPOINT", "dynamo.encoder.encode")
+
+
+@service(name="EncodeWorker", replicas=1)
+class EncodeWorkerService:
+    async def serve(self, runtime) -> None:
+        def build():
+            # jax backend init + param RNG block for seconds on first use;
+            # off the event loop so the lease keepalive isn't starved
+            # (same reason graphs/common.build_engine_from_env uses an
+            # executor for the tiny-jax engine)
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            from dynamo_tpu.multimodal.encode_worker import EncodeWorker
+            from dynamo_tpu.multimodal.vision import ViTConfig, init_vit_params
+
+            # out_dim must equal the language model's hidden size (tiny=64)
+            cfg = ViTConfig(
+                out_dim=int(os.environ.get("DYN_MM_OUT_DIM", "64"))
+            )
+            params = init_vit_params(cfg, jax.random.PRNGKey(7))
+            return EncodeWorker(params, cfg)
+
+        worker = await asyncio.get_running_loop().run_in_executor(None, build)
+        svc = await worker.serve(runtime, _encode_endpoint())
+        try:
+            await svc.wait()
+        finally:
+            await svc.stop(drain=False)
+
+
+@service(name="Worker", replicas=1)
+class Worker:
+    encoder = depends(EncodeWorkerService)
+
+    async def serve(self, runtime) -> None:
+        from dynamo_tpu.entrypoint.inputs import EngineConfig, run_endpoint
+        from dynamo_tpu.graphs.common import build_engine_from_env
+        from dynamo_tpu.multimodal.encode_worker import EncodeClient
+        from dynamo_tpu.multimodal.worker import MultimodalEngine
+
+        os.environ.setdefault("DYN_GRAPH_ENGINE", "tiny-jax")
+        engine, mdc = await build_engine_from_env()
+        mm_engine = MultimodalEngine(
+            engine,
+            EncodeClient(runtime, _encode_endpoint()),
+            placeholder_id=int(os.environ.get("DYN_MM_PLACEHOLDER", "0")),
+            num_patches=int(os.environ.get("DYN_MM_PATCHES", "16")),
+        )
+        config = EngineConfig.static_(mm_engine, mdc)
+        await run_endpoint(
+            runtime, config,
+            os.environ.get("DYN_ENDPOINT", "dynamo.backend.generate"),
+        )
+
+
+@service(name="Frontend")
+class Frontend:
+    workers = depends(Worker)
+
+    async def serve(self, runtime) -> None:
+        from dynamo_tpu.entrypoint.inputs import EngineConfig, run_http
+
+        config = EngineConfig.dynamic()
+        await run_http(
+            runtime, config,
+            host=os.environ.get("DYN_HTTP_HOST", "0.0.0.0"),
+            port=int(os.environ.get("DYN_HTTP_PORT", "8080")),
+        )
+        await asyncio.Event().wait()

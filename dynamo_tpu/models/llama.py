@@ -308,9 +308,57 @@ def prefill(
     attn_head_axis=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process a prompt; returns (last_token_logits [V], k_cache, v_cache)."""
-    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-    positions = jnp.arange(tokens.shape[0], dtype=jnp.int32)
     x = params["embed"][tokens].astype(params["embed"].dtype)
+    return _prefill_from_embeds(
+        params, cfg, x, valid_len, k_cache, v_cache, block_table,
+        mesh=mesh, attn_head_axis=attn_head_axis,
+    )
+
+
+def prefill_mm(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [P] int32, image placeholders pre-expanded
+    valid_len: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_table: jax.Array,
+    mm_embeds: jax.Array,  # [M, hidden] vision-projector output
+    mm_start: jax.Array,  # scalar int32; embeds overwrite [start, start+M)
+    *,
+    mesh=None,
+    attn_head_axis=None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Multimodal prefill: token embeddings with the vision tower's patch
+    embeddings spliced over the expanded image-placeholder span — the
+    splice the reference does in vLLM's prompt_embeds path
+    (examples/multimodal/components/prefill_worker.py:249-258). One static
+    [M, hidden] dynamic-update-slice keeps this a single compiled program
+    regardless of where the image sits in the prompt."""
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = jax.lax.dynamic_update_slice(
+        x, mm_embeds.astype(x.dtype), (mm_start, jnp.int32(0))
+    )
+    return _prefill_from_embeds(
+        params, cfg, x, valid_len, k_cache, v_cache, block_table,
+        mesh=mesh, attn_head_axis=attn_head_axis,
+    )
+
+
+def _prefill_from_embeds(
+    params: dict,
+    cfg: LlamaConfig,
+    x: jax.Array,  # [P, hidden]
+    valid_len: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    block_table: jax.Array,
+    *,
+    mesh=None,
+    attn_head_axis=None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    positions = jnp.arange(x.shape[0], dtype=jnp.int32)
     for i, layer in enumerate(params["layers"]):
         x, kc, vc = _attn_prefill(
             x, layer, cfg, inv_freqs, positions, valid_len,

@@ -1,0 +1,30 @@
+"""Multimodal E/P/D disaggregation: vision encode -> embedding handoff ->
+prefill -> decode.
+
+Role-equivalent of the reference's multimodal example stack
+(examples/multimodal/components/{encode_worker,prefill_worker,
+decode_worker,processor}.py + connect/__init__.py NIXL transfer), built
+TPU-first:
+
+- the vision tower is a jitted JAX ViT (`vision.py`) whose patchify is one
+  big matmul on the MXU, not a conv loop;
+- embedding handoff rides either the colocated device path (`device_put`
+  under the destination mesh — the ICI analogue of the reference's NIXL
+  RDMA write, encode_worker.py:205-210) or the fabric wire
+  (`to_wire_array` codec, the DCN analogue);
+- prompt splicing happens inside the prefill program: image placeholder
+  tokens are overwritten with vision embeddings post-lookup, keeping one
+  static-shape jit (`llama.prefill(..., mm_embeds, mm_mask)`).
+"""
+
+from dynamo_tpu.multimodal.processor import (  # noqa: F401
+    IMAGE_PLACEHOLDER,
+    expand_image_prompt,
+    load_image_array,
+    preprocess_pixels,
+)
+from dynamo_tpu.multimodal.vision import (  # noqa: F401
+    ViTConfig,
+    encode_pixels,
+    init_vit_params,
+)

@@ -1,0 +1,155 @@
+"""Encode worker: vision tower as a standalone disaggregated service.
+
+Role-equivalent of examples/multimodal/components/encode_worker.py: a
+dedicated worker owns the vision model; prefill workers request embeddings
+for an image source and receive them over one of two data planes:
+
+- WIRE (cross-process / cross-slice, DCN): embeddings ride the fabric as
+  a wire-coded array (disagg/transfer.to_wire_array), the analogue of the
+  reference's NIXL write into the prefill worker's pre-allocated buffer
+  (encode_worker.py:205-210, connect/__init__.py:397-617).
+- DEVICE (same process + slice, ICI): the jitted encoder's output stays a
+  device array and is re-committed under the destination engine's mesh
+  with `jax.device_put` — no host hop, mirroring disagg/colocated.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Optional
+
+import jax
+import numpy as np
+
+from dynamo_tpu.disagg.transfer import from_wire_array, to_wire_array
+from dynamo_tpu.multimodal.processor import load_image_array, preprocess_pixels
+from dynamo_tpu.multimodal.vision import ViTConfig, encode_pixels
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.multimodal.encode")
+
+_IMAGE_CACHE_MAX = 8
+
+
+class EncodeWorker:
+    """Owns ViT params; serves `encode` over the fabric and a same-process
+    device path."""
+
+    def __init__(self, params: dict, cfg: ViTConfig) -> None:
+        self.params = params
+        self.cfg = cfg
+        self._encode_jit = jax.jit(
+            lambda p, px: encode_pixels(p, cfg, px)
+        )
+        # small decoded-image LRU, like the reference's CACHE_SIZE_MAXIMUM
+        # url cache (encode_worker.py:51,127-135)
+        self._cache: dict[str, np.ndarray] = {}
+
+    # ------------------------------------------------------------ compute
+
+    def _pixels(self, image_url: str) -> np.ndarray:
+        cached = self._cache.get(image_url)
+        if cached is not None:
+            return cached
+        img = load_image_array(image_url)
+        px = preprocess_pixels(img, self.cfg.image_size)
+        if len(self._cache) >= _IMAGE_CACHE_MAX:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[image_url] = px
+        return px
+
+    def encode_device(self, image_url: str) -> jax.Array:
+        """Device path: returns [num_patches, out_dim] as a DEVICE array."""
+        px = self._pixels(image_url)
+        return self._encode_jit(self.params, px[None])[0]
+
+    def encode_numpy(self, image_url: str) -> np.ndarray:
+        return np.asarray(self.encode_device(image_url))
+
+    # ------------------------------------------------------------- serve
+
+    async def handler(
+        self, request: dict, ctx: Context
+    ) -> AsyncIterator[dict]:
+        """Fabric endpoint handler: {image_url} -> wire-coded embeddings."""
+        try:
+            emb = self.encode_numpy(request["image_url"])
+            wire = to_wire_array(emb)
+            yield {
+                "shape": list(emb.shape),
+                "dtype": str(emb.dtype),
+                "data": wire.tobytes(),
+                "wire_dtype": str(wire.dtype),
+            }
+        except Exception as e:  # noqa: BLE001 — surface to the caller
+            logger.exception("encode failed")
+            yield {"error": f"{type(e).__name__}: {e}"}
+
+    async def serve(self, drt: Any, endpoint_str: str) -> Any:
+        from dynamo_tpu.runtime.protocols import EndpointId
+
+        eid = EndpointId.parse(endpoint_str, drt.config.namespace)
+        endpoint = (
+            drt.namespace(eid.namespace)
+            .component(eid.component)
+            .endpoint(eid.name)
+        )
+        return await endpoint.serve_endpoint(self.handler)
+
+
+def decode_embeddings(resp: dict) -> np.ndarray:
+    """Inverse of EncodeWorker.handler's wire coding."""
+    if resp.get("error"):
+        raise RuntimeError(f"encode worker error: {resp['error']}")
+    wire = np.frombuffer(
+        resp["data"], dtype=np.dtype(resp["wire_dtype"])
+    ).reshape(resp["shape"])
+    return from_wire_array(wire, resp["dtype"])
+
+
+class EncodeClient:
+    """Prefill-side client for a remote encode worker (wire path)."""
+
+    def __init__(self, drt: Any, endpoint_str: str) -> None:
+        from dynamo_tpu.runtime.protocols import EndpointId
+
+        eid = EndpointId.parse(endpoint_str, drt.config.namespace)
+        self._endpoint = (
+            drt.namespace(eid.namespace)
+            .component(eid.component)
+            .endpoint(eid.name)
+        )
+        self._client: Optional[Any] = None
+
+    async def encode(self, image_url: str) -> np.ndarray:
+        if self._client is None:
+            self._client = await self._endpoint.client()
+            await self._client.wait_for_instances()
+        stream = await self._client.round_robin({"image_url": image_url})
+        try:
+            async for item in stream:
+                if item.is_error():
+                    raise RuntimeError(item.error_message())
+                if item.data is not None:
+                    return decode_embeddings(dict(item.data))
+        finally:
+            await stream.close()
+        raise RuntimeError("encode worker returned no data")
+
+    async def close(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+            self._client = None
+
+
+def transfer_embeds_device(embeds: jax.Array, dest_runner: Any) -> jax.Array:
+    """ICI handoff: re-commit encoder-mesh embeddings under the destination
+    engine's sharding (replicated — every TP shard reads the full splice).
+    Same-process analogue of the NIXL RDMA write; see disagg/colocated.py
+    for the KV-block equivalent."""
+    mesh = getattr(dest_runner, "mesh", None)
+    if mesh is None:
+        return jax.device_put(embeds)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return jax.device_put(embeds, NamedSharding(mesh, PartitionSpec()))

@@ -1,0 +1,94 @@
+"""Image loading + prompt expansion for multimodal requests.
+
+Role-equivalent of the reference's processor + encode-worker image path
+(examples/multimodal/components/processor.py and
+encode_worker.py:79-145 `load_image`): accepts `data:` base64 URLs and
+local `file://` paths (this environment has no egress, so http(s) sources
+are rejected with a clear error rather than half-supported), decodes with
+PIL, resizes to the vision tower's square input, and normalizes to
+[-1, 1] float32.
+
+Prompt expansion mirrors vLLM's placeholder convention: ONE image
+placeholder token in the tokenized prompt is expanded to `num_patches`
+copies, and the expansion positions become the mm mask the prefill
+program uses to overwrite token embeddings with vision embeddings."""
+
+from __future__ import annotations
+
+import base64
+import io
+from urllib.parse import urlparse
+
+import numpy as np
+
+IMAGE_PLACEHOLDER = "<image>"
+
+
+def load_image_array(image_url: str) -> np.ndarray:
+    """Decode an image source to an RGB uint8 array [H, W, 3]."""
+    parsed = urlparse(image_url)
+    if parsed.scheme == "data":
+        # data:image/png;base64,<payload>  (encode_worker.py:90-103)
+        if not parsed.path.startswith("image/"):
+            raise ValueError("data URL must carry an image media type")
+        media, _, payload = parsed.path.partition(",")
+        if ";base64" not in media:
+            raise ValueError("data URL must be base64 encoded")
+        raw = base64.b64decode(payload)
+    elif parsed.scheme == "file" or not parsed.scheme:
+        path = parsed.path if parsed.scheme else image_url
+        with open(path, "rb") as f:
+            raw = f.read()
+    elif parsed.scheme in ("http", "https"):
+        raise ValueError(
+            "http(s) image sources are not reachable from this deployment; "
+            "inline the image as a data: URL"
+        )
+    else:
+        raise ValueError(f"unsupported image source scheme {parsed.scheme!r}")
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(raw)).convert("RGB")
+    return np.asarray(img, dtype=np.uint8)
+
+
+def preprocess_pixels(img: np.ndarray, image_size: int) -> np.ndarray:
+    """uint8 [H, W, 3] -> float32 [S, S, 3] in [-1, 1], bilinear resize.
+
+    Pure numpy (deterministic across hosts — every process in a
+    multi-controller slice must derive identical pixels)."""
+    H, W, _ = img.shape
+    S = image_size
+    ys = np.linspace(0, H - 1, S)
+    xs = np.linspace(0, W - 1, S)
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    y1 = np.minimum(y0 + 1, H - 1)
+    x1 = np.minimum(x0 + 1, W - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    img_f = img.astype(np.float32)
+    top = img_f[y0][:, x0] * (1 - wx) + img_f[y0][:, x1] * wx
+    bot = img_f[y1][:, x0] * (1 - wx) + img_f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return (out / 127.5 - 1.0).astype(np.float32)
+
+
+def expand_image_prompt(
+    token_ids: list[int], placeholder_id: int, num_patches: int
+) -> tuple[list[int], int]:
+    """Expand the FIRST placeholder token to `num_patches` copies.
+
+    Returns (expanded_ids, mm_start) where mm_start is the index of the
+    first expanded position (-1 when no placeholder present). The prefill
+    program overwrites embeddings at [mm_start, mm_start + num_patches)."""
+    try:
+        i = token_ids.index(placeholder_id)
+    except ValueError:
+        return list(token_ids), -1
+    expanded = (
+        list(token_ids[:i])
+        + [placeholder_id] * num_patches
+        + list(token_ids[i + 1 :])
+    )
+    return expanded, i

@@ -1,0 +1,119 @@
+"""Tiny ViT vision tower + multimodal projector, pure JAX.
+
+Role-equivalent of the reference encode worker's
+`vision_model.get_multimodal_embeddings(...)` call
+(examples/multimodal/components/encode_worker.py:188-196, which wraps
+vLLM's LLaVA vision tower + projector). TPU-first shape choices:
+
+- patchify is a single [B*N, p*p*3] @ [p*p*3, hidden] matmul (MXU tile),
+  not an image conv;
+- the encoder is a pre-LN transformer over a STATIC [B, N, hidden] grid —
+  no dynamic shapes, one compile per batch bucket;
+- the projector maps hidden -> llm_hidden so the output splices directly
+  into the language model's embedding stream (prefill_worker.py:252-258
+  does the same splice on the vLLM side).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.ops.basics import rms_norm
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 64
+    patch_size: int = 16
+    hidden_size: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    out_dim: int = 128  # = the language model's hidden_size
+    eps: float = 1e-5
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+
+def init_vit_params(cfg: ViTConfig, key: jax.Array) -> dict:
+    ks = jax.random.split(key, 4 + cfg.num_layers)
+    s = 0.02
+    params = {
+        "patch_proj": jax.random.normal(
+            ks[0], (cfg.patch_dim, cfg.hidden_size), jnp.float32) * s,
+        "pos_embed": jax.random.normal(
+            ks[1], (cfg.num_patches, cfg.hidden_size), jnp.float32) * s,
+        "final_norm": jnp.ones(cfg.hidden_size, jnp.float32),
+        # two-layer GELU projector, like LLaVA's mm_projector
+        "proj_w1": jax.random.normal(
+            ks[2], (cfg.hidden_size, cfg.out_dim), jnp.float32) * s,
+        "proj_w2": jax.random.normal(
+            ks[3], (cfg.out_dim, cfg.out_dim), jnp.float32) * s,
+        "layers": [],
+    }
+    H = cfg.hidden_size
+    for i in range(cfg.num_layers):
+        lk = jax.random.split(ks[4 + i], 6)
+        params["layers"].append(
+            {
+                "ln1": jnp.ones(H, jnp.float32),
+                "ln2": jnp.ones(H, jnp.float32),
+                "qkv": jax.random.normal(lk[0], (H, 3 * H), jnp.float32) * s,
+                "attn_out": jax.random.normal(lk[1], (H, H), jnp.float32) * s,
+                "mlp_in": jax.random.normal(
+                    lk[2], (H, cfg.mlp_ratio * H), jnp.float32) * s,
+                "mlp_out": jax.random.normal(
+                    lk[3], (cfg.mlp_ratio * H, H), jnp.float32) * s,
+            }
+        )
+    return params
+
+
+def _block(x: jax.Array, layer: dict, cfg: ViTConfig) -> jax.Array:
+    """One pre-LN encoder block; full (non-causal) attention over patches."""
+    B, N, H = x.shape
+    h = rms_norm(x, layer["ln1"], cfg.eps)
+    qkv = h @ layer["qkv"]  # [B, N, 3H]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    Dh = H // cfg.num_heads
+    q = q.reshape(B, N, cfg.num_heads, Dh)
+    k = k.reshape(B, N, cfg.num_heads, Dh)
+    v = v.reshape(B, N, cfg.num_heads, Dh)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(float(Dh))
+    attn = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(B, N, H)
+    x = x + o @ layer["attn_out"]
+    h = rms_norm(x, layer["ln2"], cfg.eps)
+    x = x + jax.nn.gelu(h @ layer["mlp_in"]) @ layer["mlp_out"]
+    return x
+
+
+def encode_pixels(
+    params: dict, cfg: ViTConfig, pixels: jax.Array  # [B, S, S, 3] f32
+) -> jax.Array:
+    """Vision tower + projector: pixels -> [B, num_patches, out_dim].
+
+    The output rows are per-patch embeddings in the LANGUAGE model's
+    hidden space, ready to overwrite image-placeholder token positions
+    (the splice the reference prefill worker does at
+    prefill_worker.py:249-258)."""
+    B = pixels.shape[0]
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    # [B, g, p, g, p, 3] -> [B, g*g, p*p*3]: one reshape, one matmul
+    patches = pixels.reshape(B, g, p, g, p, 3).transpose(0, 1, 3, 2, 4, 5)
+    patches = patches.reshape(B, g * g, cfg.patch_dim)
+    x = patches @ params["patch_proj"] + params["pos_embed"][None]
+    for layer in params["layers"]:
+        x = _block(x, layer, cfg)
+    x = rms_norm(x, params["final_norm"], cfg.eps)
+    return jax.nn.gelu(x @ params["proj_w1"]) @ params["proj_w2"]

@@ -1,0 +1,136 @@
+"""Multimodal serving wrapper: image URLs -> vision embeddings -> engine.
+
+Role-equivalent of the reference's multimodal prefill/decode worker pair
+(examples/multimodal/components/{prefill_worker,decode_worker}.py): the
+language engine stays unchanged; this wrapper resolves the image sources
+the preprocessor lifted into `extra["mm_images"]`, obtains embeddings from
+the encode worker (device path when colocated, wire path when remote),
+expands the prompt with placeholder tokens, and forwards to the inner
+engine whose mm prefill splices the embeddings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, AsyncIterator, Optional
+
+import numpy as np
+
+from dynamo_tpu.multimodal.encode_worker import (
+    EncodeClient,
+    EncodeWorker,
+    transfer_embeds_device,
+)
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.multimodal.worker")
+
+
+class MultimodalEngine:
+    """AsyncEngine decorator adding image understanding to a JaxEngine.
+
+    encoder: an EncodeWorker (same-process: embeddings ride ICI via
+    device_put — the colocated path) or an EncodeClient (remote encode
+    worker: embeddings ride the fabric wire). Image tokens are prepended
+    ([img]*N + prompt), the single-image convention of LLaVA-style models
+    whose template puts <image> first."""
+
+    def __init__(
+        self,
+        inner: Any,
+        encoder: Any,  # EncodeWorker | EncodeClient
+        placeholder_id: int = 0,
+        num_patches: Optional[int] = None,
+    ) -> None:
+        self.inner = inner
+        self.encoder = encoder
+        self.placeholder_id = placeholder_id
+        if num_patches is None:
+            cfg = getattr(encoder, "cfg", None)
+            num_patches = cfg.num_patches if cfg is not None else 16
+        self.num_patches = num_patches
+
+    # advertises image support to the serving layer (http 501 otherwise)
+    supports_images = True
+
+    def __getattr__(self, name: str) -> Any:  # stats/close/etc delegate
+        return getattr(self.inner, name)
+
+    # KV-event hooks must reach the INNER engine: run_endpoint assigns
+    # `engine.on_blocks_stored = publisher...` on whatever it's handed, and
+    # a plain setattr here would shadow the wrapper while the inner engine
+    # (which fires the events) kept None — silently unplugging prefix
+    # routing for mm workers.
+    @property
+    def on_blocks_stored(self):
+        return self.inner.on_blocks_stored
+
+    @on_blocks_stored.setter
+    def on_blocks_stored(self, fn) -> None:
+        self.inner.on_blocks_stored = fn
+
+    @property
+    def on_blocks_removed(self):
+        return self.inner.on_blocks_removed
+
+    @on_blocks_removed.setter
+    def on_blocks_removed(self, fn) -> None:
+        self.inner.on_blocks_removed = fn
+
+    @property
+    def on_cache_cleared(self):
+        return self.inner.on_cache_cleared
+
+    @on_cache_cleared.setter
+    def on_cache_cleared(self, fn) -> None:
+        self.inner.on_cache_cleared = fn
+
+    async def _resolve_embeds(self, image_url: str) -> Any:
+        if isinstance(self.encoder, EncodeWorker):
+            # colocated: stay on device, re-commit under the engine's mesh
+            emb = self.encoder.encode_device(image_url)
+            runner = getattr(self.inner, "runner", None)
+            return (
+                transfer_embeds_device(emb, runner)
+                if runner is not None
+                else np.asarray(emb)
+            )
+        if isinstance(self.encoder, EncodeClient):
+            return await self.encoder.encode(image_url)
+        raise TypeError(f"unsupported encoder {type(self.encoder)!r}")
+
+    async def generate(
+        self, request: PreprocessedRequest, context: Context
+    ) -> AsyncIterator[LLMEngineOutput]:
+        urls = request.extra.get("mm_images")
+        if urls:
+            if len(urls) > 1:
+                logger.warning(
+                    "multi-image request: using first of %d images "
+                    "(parity with the reference's single-image TODO, "
+                    "encode_worker.py:192)", len(urls),
+                )
+            try:
+                embeds = await self._resolve_embeds(urls[0])
+            except Exception:  # noqa: BLE001
+                logger.exception("image encode failed")
+                yield LLMEngineOutput.final(FinishReason.ERROR)
+                return
+            ids = (
+                [self.placeholder_id] * self.num_patches
+                + list(request.token_ids)
+            )
+            extra = dict(request.extra)
+            extra.pop("mm_images", None)
+            extra["mm"] = {"embeds": embeds, "start": 0}
+            request = dataclasses.replace(
+                request, token_ids=ids, extra=extra
+            )
+        async for out in self.inner.generate(request, context):
+            yield out

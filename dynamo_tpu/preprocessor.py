@@ -50,13 +50,34 @@ class OpenAIPreprocessor:
     def preprocess_chat(
         self, request: ChatCompletionRequest
     ) -> tuple[PreprocessedRequest, str]:
+        # multimodal content parts: image_url parts are lifted OUT of the
+        # template (rendered as text-only) and carried in extra; the mm
+        # worker (multimodal/worker.py) turns them into vision embeddings
+        # + expanded placeholder tokens (ref multimodal processor.py)
+        messages = []
+        image_urls: list[str] = []
+        for m in request.messages:
+            d = m.model_dump(exclude_none=True)
+            if isinstance(d.get("content"), list):
+                for part in d["content"]:
+                    if part.get("type") == "image_url":
+                        url = part.get("image_url")
+                        if isinstance(url, dict):
+                            url = url.get("url")
+                        if url:
+                            image_urls.append(url)
+                d["content"] = m.text_content()
+            messages.append(d)
         prompt = self.template.render(
-            [m.model_dump(exclude_none=True) for m in request.messages],
+            messages,
             add_generation_prompt=True,
             tools=request.tools,
         )
         enc = self.tokenizer.encode(prompt)
-        return self._build(request, enc.ids, request.output_limit()), prompt
+        pre = self._build(request, enc.ids, request.output_limit())
+        if image_urls:
+            pre.extra["mm_images"] = image_urls
+        return pre, prompt
 
     def preprocess_completion(
         self, request: CompletionRequest
