@@ -40,6 +40,12 @@ def main() -> None:
         help="run on the TPU backend (default: force CPU — probing the "
         "backend first would block on an unavailable tunnel)",
     )
+    parser.add_argument(
+        "--reshard", default=None, metavar="PTP,DTP",
+        help="asymmetric-TP mode, e.g. '1,2' or '2,4': source cache on a "
+        "tp=PTP mesh, dest on a DISTINCT tp=DTP mesh — measures the "
+        "cross-mesh reshard copy (the reference's block_copy.cu case)",
+    )
     args = parser.parse_args()
 
     import jax
@@ -72,15 +78,46 @@ def main() -> None:
         block_size = 16
     else:
         cfg = L.LlamaConfig.tiny(vocab_size=256)
+        if args.reshard:  # tp=4 dest needs >= 4 kv heads to shard
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, num_kv_heads=4)
         params = L.init_params(cfg, jax.random.PRNGKey(0))
         block_size = 16
 
     nb = args.blocks + 8
-    mk = lambda: ModelRunner(  # noqa: E731
-        cfg, params, num_blocks=nb, block_size=block_size,
-        max_batch=4, max_model_len=args.blocks * block_size,
-    )
-    src, dst = mk(), mk()
+
+    def mk(devices=None, tp=1):
+        mesh = kv_sharding = None
+        p = params
+        if devices is not None:
+            from dynamo_tpu.parallel.mesh import build_mesh
+            from dynamo_tpu.parallel.sharding import shard_llama
+
+            mesh = build_mesh(tp=tp, devices=devices)
+            p, kv_sharding = shard_llama(mesh, cfg, params)
+        return ModelRunner(
+            cfg, p, num_blocks=nb, block_size=block_size,
+            max_batch=4, max_model_len=args.blocks * block_size,
+            mesh=mesh, kv_sharding=kv_sharding,
+        )
+
+    reshard = None
+    if args.reshard:
+        p_tp, d_tp = (int(x) for x in args.reshard.split(","))
+        devs = jax.devices()
+        need = p_tp + d_tp
+        if len(devs) < need:
+            raise SystemExit(
+                f"--reshard {args.reshard} needs {need} devices, "
+                f"have {len(devs)} (CPU: XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8)"
+            )
+        src = mk(devices=devs[:p_tp], tp=p_tp)
+        dst = mk(devices=devs[p_tp : p_tp + d_tp], tp=d_tp)
+        reshard = (p_tp, d_tp)
+    else:
+        src, dst = mk(), mk()
     ids = list(range(1, args.blocks + 1))
     block_bytes = (
         2 * cfg.num_layers * cfg.num_kv_heads * args.blocks * block_size
@@ -135,6 +172,9 @@ def main() -> None:
                 "wire_gbps": round(block_bytes / wire_s / 1e9, 3),
                 "payload_mib": round(block_bytes / 2**20, 2),
                 "blocks": args.blocks,
+                "reshard": (
+                    f"tp{reshard[0]}->tp{reshard[1]}" if reshard else None
+                ),
                 "device": str(jax.devices()[0].platform),
                 "model": "llama3-8b" if args.big else ("medium" if args.medium else "tiny"),
             }

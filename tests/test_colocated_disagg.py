@@ -29,8 +29,12 @@ from dynamo_tpu.protocols.common import (
 BLOCK = 4
 
 
-def make_engine(mesh=None, devices=None, tp=1, **kw):
+def make_engine(mesh=None, devices=None, tp=1, kv_heads=None, **kw):
+    import dataclasses
+
     cfg = L.LlamaConfig.tiny(vocab_size=64)
+    if kv_heads:  # tp=4 needs >= 4 kv heads to shard
+        cfg = dataclasses.replace(cfg, num_kv_heads=kv_heads)
     params = L.init_params(cfg, jax.random.PRNGKey(0))
     kv_sharding = None
     if devices is not None:
@@ -113,6 +117,55 @@ async def test_colocated_mesh_to_mesh_distinct_devices():
     assert {d for d in prefill_engine.runner.k_cache.devices()} == set(devs[0:2])
     await decode_engine.close()
     await prefill_engine.close()
+
+
+async def _assert_asymmetric_matches_local(
+    p_devs, p_tp, d_devs, d_tp, ns, kv_heads=None
+):
+    """P(tp=p_tp) -> D(tp=d_tp) on DISTINCT device sets: KV blocks cross
+    meshes with a real reshard (different head partitioning), the case
+    block_copy.cu exists for in the reference (its canonical benchmark
+    shape is 4x P(TP1) + 1x D(TP4), examples/llm/benchmarks/README.md:77).
+    device_put under the destination sharding must produce bit-identical
+    decode vs serving locally."""
+    prefill_engine = make_engine(devices=p_devs, tp=p_tp, kv_heads=kv_heads)
+    decode_engine = make_engine(devices=d_devs, tp=d_tp, kv_heads=kv_heads)
+    router = DisaggregatedRouter(
+        FabricClient.in_process(), ns,
+        DisaggConfig(max_local_prefill_length=4, max_prefill_queue_size=100),
+    )
+    router._queue_depth_cache = 0
+    decode_engine.disagg_router = router
+    decode_engine.remote_prefill_client = ColocatedPrefillClient(
+        prefill_engine, block_size=BLOCK
+    )
+    prompts = [list(range(2, 2 + n)) for n in (9, 17)]
+    refs = [
+        await collect_tokens(make_engine(kv_heads=kv_heads), p)
+        for p in prompts
+    ]
+    outs = [await collect_tokens(decode_engine, p) for p in prompts]
+    assert outs == refs
+    assert {d for d in decode_engine.runner.k_cache.devices()} == set(d_devs)
+    assert {d for d in prefill_engine.runner.k_cache.devices()} == set(p_devs)
+    await decode_engine.close()
+    await prefill_engine.close()
+
+
+async def test_colocated_asymmetric_tp1_to_tp2():
+    devs = jax.devices()
+    assert len(devs) >= 3
+    await _assert_asymmetric_matches_local(
+        devs[0:1], 1, devs[1:3], 2, "asym12"
+    )
+
+
+async def test_colocated_asymmetric_tp2_to_tp4():
+    devs = jax.devices()
+    assert len(devs) >= 8
+    await _assert_asymmetric_matches_local(
+        devs[0:2], 2, devs[4:8], 4, "asym24", kv_heads=4
+    )
 
 
 async def test_device_path_skips_wire_codec(monkeypatch):
