@@ -6,7 +6,9 @@ This reader covers the format surface the llama family needs:
 
   * full metadata KV section (all GGUF value types incl. nested arrays);
   * tensor directory (name, shape, dtype, offset) with lazy mmap views;
-  * dtypes F32/F16/BF16 natively and Q8_0 via dequantization;
+  * dtypes F32/F16/BF16 natively; Q4_0/Q4_1/Q5_0/Q5_1/Q8_0 and the
+    K-quants Q4_K/Q5_K/Q6_K (what real published GGUFs like Q4_K_M
+    actually contain) via vectorized dequantization;
   * `config_from_gguf` mapping llama.* metadata keys to LlamaConfig and
     `params_from_gguf` mapping ggml tensor names (token_embd, blk.N.*,
     output, ...) onto this repo's param tree, transposed to the [in, out]
@@ -39,9 +41,22 @@ _SCALAR_FMT = {
 }
 
 # ggml tensor dtypes (subset)
-GGML_F32, GGML_F16, GGML_Q8_0, GGML_BF16 = 0, 1, 8, 30
-_GGML_NAMES = {GGML_F32: "F32", GGML_F16: "F16", GGML_Q8_0: "Q8_0",
-               GGML_BF16: "BF16"}
+GGML_F32, GGML_F16, GGML_BF16 = 0, 1, 30
+GGML_Q4_0, GGML_Q4_1, GGML_Q5_0, GGML_Q5_1, GGML_Q8_0 = 2, 3, 6, 7, 8
+GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 12, 13, 14
+_GGML_NAMES = {GGML_F32: "F32", GGML_F16: "F16", GGML_BF16: "BF16",
+               GGML_Q4_0: "Q4_0", GGML_Q4_1: "Q4_1", GGML_Q5_0: "Q5_0",
+               GGML_Q5_1: "Q5_1", GGML_Q8_0: "Q8_0", GGML_Q4_K: "Q4_K",
+               GGML_Q5_K: "Q5_K", GGML_Q6_K: "Q6_K"}
+
+QK_K = 256  # K-quant super-block size
+
+# bytes per block, elements per block — for tensor size validation
+GGML_BLOCK = {
+    GGML_Q4_0: (18, 32), GGML_Q4_1: (20, 32), GGML_Q5_0: (22, 32),
+    GGML_Q5_1: (24, 32), GGML_Q8_0: (34, 32),
+    GGML_Q4_K: (144, QK_K), GGML_Q5_K: (176, QK_K), GGML_Q6_K: (210, QK_K),
+}
 
 
 @dataclass
@@ -148,15 +163,18 @@ class GgufFile:
         if t.ggml_type == GGML_BF16:
             raw = np.frombuffer(mm, np.uint16, numel, start)
             return raw.view(ml_dtypes.bfloat16).reshape(t.shape)
-        if t.ggml_type == GGML_Q8_0:
-            # blocks of 32: f16 scale + 32 int8 values
-            n_blocks = numel // 32
-            rec = np.dtype([("d", "<f2"), ("q", "i1", (32,))])
-            raw = np.frombuffer(mm, rec, n_blocks, start)
-            vals = raw["q"].astype(np.float32) * raw["d"].astype(np.float32)[
-                :, None
-            ]
-            return vals.reshape(t.shape).astype(np.float32)
+        deq = _DEQUANT.get(t.ggml_type)
+        if deq is not None:
+            _, elems = GGML_BLOCK[t.ggml_type]
+            # ggml blocks never span rows: the fastest-varying dim must be
+            # block-aligned, not just the total element count.
+            if t.shape and t.shape[-1] % elems:
+                raise ValueError(
+                    f"tensor {name}: row length {t.shape[-1]} not divisible "
+                    f"by {t.type_name} block size {elems}"
+                )
+            vals = deq(mm, numel // elems, start)
+            return vals.reshape(t.shape).astype(np.float32, copy=False)
         raise NotImplementedError(
             f"tensor {name}: ggml type {t.type_name} not supported"
         )
@@ -168,6 +186,136 @@ class GgufFile:
         if self._file is not None:
             self._file.close()
             self._file = None
+
+
+# ---------------------------------------------------- block dequantization
+#
+# Vectorized numpy ports of the ggml block formats (spec: ggml quants.c,
+# reference reader: lib/llm/src/gguf/).  Every function takes the mmap, a
+# block count, and a byte offset and returns float32 [n_blocks, elems].
+
+def _deq_q4_0(mm, n, start):
+    rec = np.dtype([("d", "<f2"), ("qs", "u1", (16,))])
+    raw = np.frombuffer(mm, rec, n, start)
+    d = raw["d"].astype(np.float32)[:, None]
+    lo = (raw["qs"] & 0x0F).astype(np.int8) - 8
+    hi = (raw["qs"] >> 4).astype(np.int8) - 8
+    return d * np.concatenate([lo, hi], axis=1).astype(np.float32)
+
+
+def _deq_q4_1(mm, n, start):
+    rec = np.dtype([("d", "<f2"), ("m", "<f2"), ("qs", "u1", (16,))])
+    raw = np.frombuffer(mm, rec, n, start)
+    d = raw["d"].astype(np.float32)[:, None]
+    m = raw["m"].astype(np.float32)[:, None]
+    q = np.concatenate([raw["qs"] & 0x0F, raw["qs"] >> 4], axis=1)
+    return d * q.astype(np.float32) + m
+
+
+def _deq_q5_0(mm, n, start):
+    rec = np.dtype([("d", "<f2"), ("qh", "<u4"), ("qs", "u1", (16,))])
+    raw = np.frombuffer(mm, rec, n, start)
+    d = raw["d"].astype(np.float32)[:, None]
+    j = np.arange(16)
+    xh0 = ((raw["qh"][:, None] >> j) << 4) & 0x10
+    xh1 = (raw["qh"][:, None] >> (j + 12)) & 0x10
+    lo = ((raw["qs"] & 0x0F) | xh0).astype(np.int16) - 16
+    hi = ((raw["qs"] >> 4) | xh1).astype(np.int16) - 16
+    return d * np.concatenate([lo, hi], axis=1).astype(np.float32)
+
+
+def _deq_q5_1(mm, n, start):
+    rec = np.dtype(
+        [("d", "<f2"), ("m", "<f2"), ("qh", "<u4"), ("qs", "u1", (16,))]
+    )
+    raw = np.frombuffer(mm, rec, n, start)
+    d = raw["d"].astype(np.float32)[:, None]
+    m = raw["m"].astype(np.float32)[:, None]
+    j = np.arange(16)
+    xh0 = ((raw["qh"][:, None] >> j) << 4) & 0x10
+    xh1 = (raw["qh"][:, None] >> (j + 12)) & 0x10
+    lo = (raw["qs"] & 0x0F) | xh0
+    hi = (raw["qs"] >> 4) | xh1
+    return d * np.concatenate([lo, hi], axis=1).astype(np.float32) + m
+
+
+def _deq_q8_0(mm, n, start):
+    rec = np.dtype([("d", "<f2"), ("q", "i1", (32,))])
+    raw = np.frombuffer(mm, rec, n, start)
+    return raw["q"].astype(np.float32) * raw["d"].astype(np.float32)[:, None]
+
+
+def _unpack_scale_min_k4(s):
+    """6-bit packed (scale, min) pairs for 8 sub-blocks; s is [n, 12] u8."""
+    sc = np.empty(s.shape[:-1] + (8,), np.uint8)
+    mn = np.empty_like(sc)
+    sc[:, :4] = s[:, :4] & 63
+    mn[:, :4] = s[:, 4:8] & 63
+    sc[:, 4:] = (s[:, 8:12] & 0x0F) | ((s[:, 0:4] >> 6) << 4)
+    mn[:, 4:] = (s[:, 8:12] >> 4) | ((s[:, 4:8] >> 6) << 4)
+    return sc.astype(np.float32), mn.astype(np.float32)
+
+
+def _deq_q4_k(mm, n, start):
+    rec = np.dtype([("d", "<f2"), ("dmin", "<f2"),
+                    ("scales", "u1", (12,)), ("qs", "u1", (128,))])
+    raw = np.frombuffer(mm, rec, n, start)
+    d = raw["d"].astype(np.float32)
+    dmin = raw["dmin"].astype(np.float32)
+    sc, mn = _unpack_scale_min_k4(raw["scales"])
+    qs = raw["qs"].reshape(n, 4, 32)
+    # chunk j yields sub-blocks 2j (low nibbles) then 2j+1 (high nibbles)
+    q = np.stack([qs & 0x0F, qs >> 4], axis=2).reshape(n, 8, 32)
+    vals = (d[:, None, None] * sc[:, :, None] * q.astype(np.float32)
+            - dmin[:, None, None] * mn[:, :, None])
+    return vals.reshape(n, QK_K)
+
+
+def _deq_q5_k(mm, n, start):
+    rec = np.dtype([("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)),
+                    ("qh", "u1", (32,)), ("qs", "u1", (128,))])
+    raw = np.frombuffer(mm, rec, n, start)
+    d = raw["d"].astype(np.float32)
+    dmin = raw["dmin"].astype(np.float32)
+    sc, mn = _unpack_scale_min_k4(raw["scales"])
+    qs = raw["qs"].reshape(n, 4, 32)
+    qh = raw["qh"][:, None, :]
+    jj = np.arange(4)[None, :, None]
+    # 5th bit of sub-block 2j lives at qh bit 2j, of 2j+1 at bit 2j+1
+    lo = (qs & 0x0F) + (((qh >> (2 * jj)) & 1) << 4)
+    hi = (qs >> 4) + (((qh >> (2 * jj + 1)) & 1) << 4)
+    q = np.stack([lo, hi], axis=2).reshape(n, 8, 32)
+    vals = (d[:, None, None] * sc[:, :, None] * q.astype(np.float32)
+            - dmin[:, None, None] * mn[:, :, None])
+    return vals.reshape(n, QK_K)
+
+
+def _deq_q6_k(mm, n, start):
+    rec = np.dtype([("ql", "u1", (128,)), ("qh", "u1", (64,)),
+                    ("scales", "i1", (16,)), ("d", "<f2")])
+    raw = np.frombuffer(mm, rec, n, start)
+    d = raw["d"].astype(np.float32)
+    ql = raw["ql"].reshape(n, 2, 2, 32)   # [n, half, lo32/hi32-bytes, 32]
+    qh = raw["qh"].reshape(n, 2, 32)
+    sc = raw["scales"].reshape(n, 2, 8).astype(np.float32)
+    ql_a, ql_b = ql[:, :, 0], ql[:, :, 1]
+    q = np.stack([
+        (ql_a & 0x0F) | (((qh >> 0) & 3) << 4),
+        (ql_b & 0x0F) | (((qh >> 2) & 3) << 4),
+        (ql_a >> 4) | (((qh >> 4) & 3) << 4),
+        (ql_b >> 4) | (((qh >> 6) & 3) << 4),
+    ], axis=2).astype(np.int16) - 32        # [n, 2, 4, 32]
+    # output y[l + 32k] scales with scales[l//16 + 2k] within each half
+    sidx = (np.arange(32) // 16)[None, :] + 2 * np.arange(4)[:, None]
+    vals = d[:, None, None, None] * sc[:, :, sidx] * q.astype(np.float32)
+    return vals.reshape(n, QK_K)
+
+
+_DEQUANT = {
+    GGML_Q4_0: _deq_q4_0, GGML_Q4_1: _deq_q4_1, GGML_Q5_0: _deq_q5_0,
+    GGML_Q5_1: _deq_q5_1, GGML_Q8_0: _deq_q8_0, GGML_Q4_K: _deq_q4_k,
+    GGML_Q5_K: _deq_q5_k, GGML_Q6_K: _deq_q6_k,
+}
 
 
 # --------------------------------------------------------------- mapping
