@@ -291,6 +291,8 @@ def build_engine(tiny: bool, max_batch: int):
         prefill_buckets=buckets,
         prefill_chunk_tokens=chunk,
     )
+    from dynamo_tpu.engine.jax_engine.factory import default_decode_horizon
+
     engine = JaxEngine(
         runner,
         JaxEngineConfig(
@@ -298,6 +300,7 @@ def build_engine(tiny: bool, max_batch: int):
             block_size=block_size,
             num_blocks=num_blocks,
             max_model_len=max_len,
+            decode_horizon=default_decode_horizon(),
         ),
     )
     return engine, cfg, max_len
@@ -358,6 +361,29 @@ def compile_phase(engine) -> None:
             )[0]
         ),
     )
+    H = engine.config.decode_horizon
+    if H > 1:
+        from dynamo_tpu.engine.jax_engine.model_runner import MAX_EOS_IDS as EK
+
+        timed(
+            f"decode_multi@H{H}B{B}",
+            lambda: np.asarray(
+                runner.decode_multi(
+                    H,
+                    np.zeros(B, np.int32),
+                    np.zeros(B, np.int32),
+                    np.zeros((B, runner.max_blocks_per_seq), np.int32),
+                    np.zeros(B, np.float32),
+                    np.ones(B, np.float32),
+                    np.zeros(B, np.int32),
+                    np.zeros((B, 2), np.uint32),
+                    np.zeros(B, bool),
+                    np.ones(B, np.int32),
+                    np.zeros(B, np.int32),
+                    np.full((B, EK), -1, np.int32),
+                )
+            ),
+        )
 
 
 def sharegpt_workload(n: int, vocab: int, max_len: int, seed: int = 0):

@@ -1,0 +1,144 @@
+"""Decode-step latency breakdown on the live device.
+
+Separates the three costs that add up to serving throughput:
+  1. pure device compute (device-resident inputs, block_until_ready)
+  2. full ModelRunner.decode serving call (host inputs + fetch)
+  3. host->device transfer RTT alone
+
+Under the axon tunnel the delta between (1) and (2) is tunnel RTT; on a
+real TPU host it's PCIe/DMA. Prints one JSON line per measurement.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def bench_it(fn, warmup=3, iters=20):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true")
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--prefill", type=int, default=512)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as graft
+    from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}", file=sys.stderr)
+
+    cfg, params = graft._flagship_setup(tiny=args.tiny)
+    B = args.batch
+    runner = ModelRunner(
+        cfg, params,
+        num_blocks=max(256, B * 64), block_size=16, max_batch=B,
+        max_model_len=4096, rng_seed=0,
+    )
+
+    results = {}
+
+    # ---- 3. raw host->device RTT for the per-step input set
+    tokens = np.zeros((B,), np.int32)
+    positions = np.full((B,), 100, np.int32)
+    bt = np.tile(np.arange(runner.max_blocks_per_seq, dtype=np.int32), (B, 1))
+    slots = np.arange(B, dtype=np.int32) * 16 + 5
+    temps = np.ones((B,), np.float32)
+    top_ps = np.ones((B,), np.float32)
+    top_ks = np.zeros((B,), np.int32)
+    keys = runner._next_decode_keys(B)
+
+    def put_all():
+        arrs = [
+            jax.device_put(a)
+            for a in (tokens, positions, bt, slots, temps, top_ps, top_ks, keys)
+        ]
+        for a in arrs:
+            a.block_until_ready()
+
+    results["h2d_8arrays_ms"] = bench_it(put_all) * 1e3
+
+    one = np.zeros((4,), np.int32)
+
+    def put_one():
+        jax.device_put(one).block_until_ready()
+
+    results["h2d_1array_ms"] = bench_it(put_one) * 1e3
+
+    scalar_dev = jax.device_put(np.zeros((4,), np.int32))
+
+    def fetch_one():
+        np.asarray(scalar_dev)
+
+    results["d2h_1array_ms"] = bench_it(fetch_one) * 1e3
+
+    # ---- 2. serving-path decode (host numpy in, fetch out)
+    def serving_step():
+        out = runner.decode(tokens, positions, bt, slots, temps, top_ps, top_ks)
+        return tuple(np.asarray(o) for o in out)
+
+    serving_s = bench_it(serving_step, warmup=4, iters=15)
+    results["decode_serving_ms"] = serving_s * 1e3
+
+    # ---- 1. pure compute: device-resident inputs, reuse jitted fn
+    d = lambda a: jax.device_put(a)  # noqa: E731
+    dev_args = [
+        runner.params, runner.k_cache, runner.v_cache,
+        d(tokens), d(positions), d(bt), d(slots), d(keys),
+        d(temps), d(top_ps), d(top_ks),
+    ]
+
+    def compute_step():
+        out, k2, v2 = runner._decode_fn(*dev_args)
+        # donation invalidates the cache refs; rebind for the next call
+        dev_args[1], dev_args[2] = k2, v2
+        out[0].block_until_ready()
+
+    compute_s = bench_it(compute_step, warmup=4, iters=15)
+    results["decode_compute_ms"] = compute_s * 1e3
+    # donation consumed the runner's cache refs; hand back the live ones
+    runner.k_cache, runner.v_cache = dev_args[1], dev_args[2]
+
+    # ---- prefill
+    ptoks = np.random.randint(0, 1000, (args.prefill,), dtype=np.int32)
+
+    def prefill_step():
+        r = runner.prefill(
+            [int(t) for t in ptoks],
+            block_ids=list(range(args.prefill // 16)),
+            temperature=0.0, top_p=1.0, top_k=0,
+        )
+        np.asarray(r[0])
+        return r
+
+    results["prefill_serving_ms"] = bench_it(prefill_step, warmup=2, iters=5) * 1e3
+
+    results["batch"] = B
+    results["tok_s_at_B_compute"] = B / compute_s
+    results["tok_s_at_B_serving"] = B / serving_s
+    results["device"] = str(dev)
+    print(json.dumps({k: (round(v, 3) if isinstance(v, float) else v)
+                      for k, v in results.items()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,13 @@ class JaxEngineConfig:
     max_model_len: int = 2048
     watermark_blocks: int = 8  # admission reserve
     rng_seed: int = 0
+    # decode horizon: H chained decode steps per device dispatch (ONE
+    # host<->device round trip per H tokens — the measured round trip is
+    # ~65 ms under the TPU tunnel, so per-token fetches cap throughput at
+    # ~15 steps/s regardless of compute). 1 = classic per-token stepping.
+    # Batches with penalties, or with min_tokens + more stop ids than the
+    # device mask carries, fall back to single-step for that iteration.
+    decode_horizon: int = 1
 
 
 @dataclass
@@ -243,6 +250,25 @@ class JaxEngine:
             self._loop_task = asyncio.get_running_loop().create_task(
                 self._engine_loop()
             )
+            self._loop_task.add_done_callback(self._on_loop_done)
+
+    def _on_loop_done(self, task: asyncio.Task) -> None:
+        """If the engine loop dies (e.g. a compile error on the first real
+        batch), every parked generate() consumer would otherwise wait on
+        its queue forever. Fail them all loudly instead."""
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is None or self._closed:
+            return
+        logger.error("engine loop crashed: %r — failing all sequences", exc)
+        for seq in list(self.waiting):
+            self.waiting.remove(seq)
+            seq.out.put_nowait(LLMEngineOutput.final(FinishReason.ERROR))
+        # _finish frees the slot + KV blocks too: a restarted loop must not
+        # keep decoding zombie lanes that no consumer is reading
+        for seq in list(self._admit_order):
+            self._finish(seq, FinishReason.ERROR)
 
     async def close(self) -> None:
         self._closed = True
@@ -1049,8 +1075,65 @@ class JaxEngine:
         finally:
             self.allocator.free(block_ids)
 
+    def _horizon_for(self, active: list[_Sequence]) -> int:
+        """Pick this iteration's decode horizon. 1 = single-step path."""
+        H = self.config.decode_horizon
+        if H <= 1 or not hasattr(self.runner, "decode_multi"):
+            return 1
+        if any(s.has_penalties for s in active):
+            return 1  # penalties need the [B, L] history program
+        # overflow-EOS redraws (_append_token's eos_drops path) can't happen
+        # mid-horizon: gate batches where the device mask can't hold the
+        # full stop set of a min_tokens sequence
+        from dynamo_tpu.ops.sampling import MAX_EOS_IDS
+
+        if any(
+            s.needs_eos_suppress and len(s.eos) > MAX_EOS_IDS for s in active
+        ):
+            return 1
+        # no lane can emit more than its remaining budget; don't burn
+        # frozen all-lane steps when everyone is nearly done
+        max_rem = max(
+            min(
+                s.max_new - s.num_generated,
+                self.config.max_model_len - len(s.token_ids),
+            )
+            for s in active
+        )
+        H = max(1, min(H, max_rem))
+        if H == 1:
+            return 1
+        # preallocate KV blocks to cover every horizon write — capped at
+        # each lane's OWN remaining budget (a lane one token from its limit
+        # must not grow past max_blocks_per_seq). On pressure, fall back to
+        # single-step (its just-in-time alloc can preempt).
+        bs = self.config.block_size
+        for seq in active:
+            lane_steps = min(
+                H,
+                max(
+                    1,
+                    min(
+                        seq.max_new - seq.num_generated,
+                        self.config.max_model_len - len(seq.token_ids),
+                    ),
+                ),
+            )
+            last_write = (seq.pos - 1) + (lane_steps - 1)
+            need = last_write // bs + 1 - len(seq.block_ids)
+            if need > 0:
+                try:
+                    seq.block_ids.extend(self.allocator.alloc(need))
+                except OutOfBlocks:
+                    return 1
+        return H
+
     async def _decode_phase(self, loop, active: list[_Sequence]) -> None:
         B = self.config.max_batch
+        H = self._horizon_for(active)
+        if H > 1:
+            await self._decode_multi_phase(loop, active, H)
+            return
         self._block_tables.fill(0)
         self._positions.fill(0)
         self._slot_indices.fill(0)  # null block slot 0
@@ -1141,6 +1224,80 @@ class JaxEngine:
                 seq, int(toks[i]), lp=float(lps[i]),
                 top_ids=tids[i], top_lps=tlps[i],
             )
+
+    async def _decode_multi_phase(
+        self, loop, active: list[_Sequence], H: int
+    ) -> None:
+        """Horizon decode: H device-chained steps, one packed fetch.
+
+        The device freezes a lane at EOS / its remaining-token budget and
+        emits -1 for frozen steps; the host replays the packed [H, B, .]
+        samples through the exact same _append_token flow as single-step,
+        so streaming, stop handling, block growth (preallocated here) and
+        finish reasons are identical — just H tokens per round trip."""
+        from dynamo_tpu.ops.sampling import MAX_EOS_IDS
+
+        B = self.config.max_batch
+        bs = self.config.block_size
+        self._block_tables.fill(0)
+        self._positions.fill(0)
+        self._temps.fill(0.0)
+        self._top_ps.fill(1.0)
+        self._top_ks.fill(0)
+        act = np.zeros(B, bool)
+        limit_rem = np.ones(B, np.int32)
+        min_rem = np.zeros(B, np.int32)
+        eos_ids = np.full((B, MAX_EOS_IDS), -1, np.int32)
+        for seq in active:
+            i = seq.slot
+            pos = seq.pos - 1
+            self._tokens[i] = seq.token_ids[-1]
+            self._positions[i] = pos
+            nb = len(seq.block_ids)
+            self._block_tables[i, :nb] = seq.block_ids
+            self._temps[i] = seq.temperature
+            self._top_ps[i] = seq.top_p
+            self._top_ks[i] = seq.top_k
+            self._keys[i] = self._key_row(seq)
+            act[i] = True
+            limit_rem[i] = max(
+                1,
+                min(
+                    seq.max_new - seq.num_generated,
+                    self.config.max_model_len - len(seq.token_ids),
+                ),
+            )
+            min_rem[i] = max(0, seq.min_tokens - seq.num_generated)
+            eos_ids[i] = seq.eos_row
+        async with self._device_lock:
+            packed = await loop.run_in_executor(
+                None,
+                lambda: np.asarray(
+                    self.runner.decode_multi(
+                        H,
+                        self._tokens, self._positions, self._block_tables,
+                        self._temps, self._top_ps, self._top_ks,
+                        self._keys, act, limit_rem, min_rem, eos_ids,
+                    )
+                ),
+            )
+        K = (packed.shape[-1] - 2) // 2
+        for h in range(H):
+            step = packed[h]
+            for seq in active:
+                if seq.slot is None:
+                    continue  # finished earlier in this horizon
+                i = seq.slot
+                tok = int(step[i, 0])
+                if tok < 0:
+                    continue  # lane was frozen on device
+                self._append_token(
+                    seq,
+                    tok,
+                    lp=float(step[i, 1]),
+                    top_ids=step[i, 2:2 + K].astype(np.int32),
+                    top_lps=step[i, 2 + K:],
+                )
 
     def _append_sample(
         self, seq: _Sequence, sample: tuple[np.ndarray, ...]

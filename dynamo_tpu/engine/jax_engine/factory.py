@@ -165,9 +165,20 @@ async def build_jax_engine(
             num_blocks=num_blocks,
             max_model_len=max_len,
             rng_seed=rng_seed,
+            decode_horizon=default_decode_horizon(),
         ),
     )
     return engine, mdc
+
+
+def default_decode_horizon() -> int:
+    """Horizon decode default: DYN_DECODE_HORIZON env override, else 8 on
+    TPU (amortizes the per-step host round trip), 1 elsewhere (CPU tests
+    exercise the single-step path unless they opt in)."""
+    override = os.environ.get("DYN_DECODE_HORIZON")
+    if override:
+        return max(1, int(override))
+    return 8 if jax.default_backend() == "tpu" else 1
 
 
 def hbm_budget_bytes() -> int:

@@ -132,35 +132,45 @@ def mask_eos_logits(
     return out[0] if squeeze else out
 
 
-def _filtered_logits(
-    logits: jax.Array,
-    temperature: jax.Array,
+# Candidate-pool width for top-k/top-p filtering. Two full [B, V] sorts
+# per step (tens of ms at 128k vocab) are replaced by one lax.top_k(C)
+# pass over a descending candidate pool. Rows with NO restriction
+# (top_k<=0 and top_p>=1) bypass the pool entirely — they draw a full
+# categorical over the temperature-scaled vocab, so the default sampling
+# distribution stays exact at any temperature. Restricted rows are exact
+# whenever their support fits the pool (always true for vocab <= C and
+# any top_k <= C; a nucleus is truncated to the pool only if its mass
+# extends past the top 256 temperature-scaled candidates — ~1e-4 mass on
+# real models near temp 1); top_k > C clamps to C.
+SAMPLE_CANDIDATES = 256
+
+
+def _filtered_candidates(
+    scaled: jax.Array,  # [B, V] temperature-scaled logits
     top_p: jax.Array,
     top_k: jax.Array,
-) -> jax.Array:
-    """Temperature-scale then mask to the top-k / nucleus support."""
-    B, V = logits.shape
-    temp = jnp.maximum(temperature, 1e-6)[:, None]
-    scaled = logits / temp
+) -> tuple[jax.Array, jax.Array]:
+    """Mask the candidate pool to the top-k / nucleus support.
 
-    # top-k: mask everything below the k-th largest
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] descending
-    k = jnp.clip(jnp.where(top_k <= 0, V, top_k), 1, V)
-    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
-    scaled = jnp.where(scaled < kth, NEG_INF, scaled)
+    Returns (vals [B, C] descending filtered logits, idx [B, C] vocab ids):
+    a compact candidate representation — sample over C, map back via idx.
+    """
+    B, V = scaled.shape
+    C = min(SAMPLE_CANDIDATES, V)
+    vals, idx = jax.lax.top_k(scaled, C)  # [B, C] descending
 
-    # top-p (nucleus): keep the smallest prefix of the sorted distribution
-    # with cumulative probability >= top_p
-    sorted_desc2 = jnp.sort(scaled, axis=-1)[:, ::-1]
-    probs_sorted = jax.nn.softmax(sorted_desc2, axis=-1)
-    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
-    # keep tokens while cumulative prob of STRICTLY better tokens < top_p
-    keep_sorted = (cumprobs - probs_sorted) < top_p[:, None]
-    # threshold = smallest logit still kept
-    thresh = jnp.min(
-        jnp.where(keep_sorted, sorted_desc2, jnp.inf), axis=-1, keepdims=True
-    )
-    return jnp.where(scaled < thresh, NEG_INF, scaled)
+    # top-k: candidates are sorted, so the mask is positional
+    k = jnp.clip(jnp.where(top_k <= 0, C, jnp.minimum(top_k, C)), 1, C)
+    pos = jnp.arange(C)[None, :]
+    vals = jnp.where(pos >= k[:, None], NEG_INF, vals)
+
+    # top-p (nucleus): keep the smallest prefix of the candidate
+    # distribution with cumulative probability >= top_p
+    probs = jax.nn.softmax(vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p[:, None]
+    vals = jnp.where(keep, vals, NEG_INF)
+    return vals, idx
 
 
 def sample_tokens(
@@ -176,17 +186,35 @@ def sample_tokens(
     `rng` seeds the whole batch; when `keys` is given, each row samples from
     its own threefry stream (per-request `seed` support) and `rng` is
     ignored for the draw.
+
+    Unrestricted rows (top_k<=0, top_p>=1) draw over the full vocab —
+    exact at any temperature. Restricted rows draw from the top
+    SAMPLE_CANDIDATES pool (exact for top_k <= pool; a wider nucleus
+    truncates to the pool).
     """
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = _filtered_logits(logits, temperature, top_p, top_k)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+    vals, idx = _filtered_candidates(scaled, top_p, top_k)
+    unrestricted = (top_k <= 0) & (top_p >= 1.0)  # [B]
     if keys is not None:
-        sampled = jax.vmap(
-            lambda kd, lg: jax.random.categorical(
-                jax.random.wrap_key_data(kd.astype(jnp.uint32)), lg
+        def draw(kd, pool_lg, full_lg):
+            k = jax.random.wrap_key_data(kd.astype(jnp.uint32))
+            return (
+                jax.random.categorical(k, pool_lg),
+                jax.random.categorical(k, full_lg),
             )
-        )(keys, scaled).astype(jnp.int32)
+
+        choice, full_choice = jax.vmap(draw)(keys, vals, scaled)
     else:
-        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+        choice = jax.random.categorical(rng, vals, axis=-1)
+        full_choice = jax.random.categorical(rng, scaled, axis=-1)
+    pool_sampled = jnp.take_along_axis(
+        idx, choice[:, None].astype(jnp.int32), axis=-1
+    )[:, 0]
+    sampled = jnp.where(
+        unrestricted, full_choice.astype(jnp.int32), pool_sampled.astype(jnp.int32)
+    )
     return jnp.where(temperature <= 0.0, greedy_ids, sampled)
 
 

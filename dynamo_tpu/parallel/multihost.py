@@ -55,6 +55,7 @@ OP_INJECT = 5
 OP_PACKED = 6
 OP_EMBED = 7
 OP_MM_PREFILL = 8
+OP_DECODE_MULTI = 9
 OP_STOP = 0
 
 
@@ -305,6 +306,33 @@ class SpmdModelRunner:
                 top_ps, top_ks, keys=keys, penalties=penalties,
                 eos_mask=eos_mask,
             )
+        )
+
+    def decode_multi(self, H, tokens, positions, block_tables, temps,
+                     top_ps, top_ks, keys, active, limit_remaining,
+                     min_remaining, eos_ids):
+        # horizon decode is a collective program: broadcast the full input
+        # set so followers launch the identical H-step scan (without this
+        # the leader would wedge the slice — same hazard as embed/extract)
+        payload = (
+            np.asarray(tokens, np.int32),
+            np.asarray(positions, np.int32),
+            np.asarray(block_tables, np.int32),
+            np.asarray(temps, np.float32),
+            np.asarray(top_ps, np.float32),
+            np.asarray(top_ks, np.int32),
+            np.asarray(keys, np.uint32),
+            np.asarray(active, bool),
+            np.asarray(limit_remaining, np.int32),
+            np.asarray(min_remaining, np.int32),
+            np.asarray(eos_ids, np.int32),
+        )
+        B = payload[0].shape[0]
+        self._channel.send(
+            OP_DECODE_MULTI, [int(H), B, block_tables.shape[1]], payload
+        )
+        return self._runner.decode_multi(
+            int(H), *payload
         )
 
     def _fetch_sample(self, out: tuple):
@@ -634,6 +662,20 @@ def follower_loop(runner, channel: SpmdStepChannel, progress_cb=None) -> None:
                 rep_pen=float(rp), key_data=np.asarray(kd),
                 eos_ids=np.asarray(er), eos_suppress=bool(sup),
             )
+        elif op == OP_DECODE_MULTI:
+            Hn, B, nb = int(h[1]), int(h[2]), int(h[3])
+            got = channel.recv_payload(
+                (
+                    np.zeros(B, np.int32), np.zeros(B, np.int32),
+                    np.zeros((B, nb), np.int32),
+                    np.zeros(B, np.float32), np.zeros(B, np.float32),
+                    np.zeros(B, np.int32), np.zeros((B, 2), np.uint32),
+                    np.zeros(B, bool), np.zeros(B, np.int32),
+                    np.zeros(B, np.int32),
+                    np.full((B, _EOS_K), -1, np.int32),
+                )
+            )
+            runner.decode_multi(Hn, *(np.asarray(a) for a in got))
         elif op == OP_EMBED:
             T = int(h[1])
             (t,) = channel.recv_payload((np.zeros(T, np.int32),))

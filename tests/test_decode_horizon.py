@@ -1,0 +1,194 @@
+"""Horizon decode (H chained device steps per dispatch) tests.
+
+The multi-step program must be observationally identical to single-step
+decoding: same greedy tokens, same seeded samples (the device advances the
+per-sequence threefry counter exactly as the host's per-token _key_row
+would), same finish reasons, same min_tokens enforcement — just H tokens
+per host round trip. (engine.py _decode_multi_phase / model_runner.py
+_decode_multi_impl; motivated by the measured ~65 ms per-step fetch RTT.)
+"""
+
+import numpy as np
+
+import jax
+
+from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+from dynamo_tpu.models import llama as L
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+
+def make_engine(decode_horizon, num_blocks=64, max_batch=4, block_size=4,
+                max_len=64):
+    cfg = L.LlamaConfig.tiny(vocab_size=64)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    runner = ModelRunner(
+        cfg, params,
+        num_blocks=num_blocks, block_size=block_size,
+        max_batch=max_batch, max_model_len=max_len,
+    )
+    return JaxEngine(
+        runner,
+        JaxEngineConfig(
+            max_batch=max_batch, block_size=block_size,
+            num_blocks=num_blocks, max_model_len=max_len,
+            watermark_blocks=2, decode_horizon=decode_horizon,
+        ),
+    )
+
+
+async def collect(engine, request):
+    toks, reason = [], None
+    async for out in engine.generate(request, Context()):
+        toks.extend(out.token_ids)
+        if out.finish_reason:
+            reason = out.finish_reason
+    return toks, reason
+
+
+def greedy_request(prompt, max_tokens, **stop_kw):
+    return PreprocessedRequest(
+        token_ids=prompt,
+        sampling=SamplingOptions(greedy=True),
+        stop=StopConditions(max_tokens=max_tokens, **stop_kw),
+    )
+
+
+async def test_horizon_matches_single_step_greedy():
+    prompts = [[5, 9, 17, 23], [2, 40, 41], [60, 3, 3, 3, 8, 1]]
+    outs = {}
+    for H in (1, 4):
+        engine = make_engine(H)
+        outs[H] = [
+            await collect(engine, greedy_request(p, 11)) for p in prompts
+        ]
+        await engine.close()
+    assert outs[1] == outs[4]
+    for toks, reason in outs[4]:
+        assert len(toks) == 11 and reason is FinishReason.LENGTH
+
+
+async def test_horizon_matches_single_step_seeded_sampling():
+    prompt = [7, 12, 30]
+    outs = {}
+    for H in (1, 3):
+        engine = make_engine(H)
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(temperature=0.9, top_p=0.95, seed=1234),
+            stop=StopConditions(max_tokens=10, ignore_eos=True),
+        )
+        outs[H] = await collect(engine, req)
+        await engine.close()
+    assert outs[1] == outs[3]
+
+
+async def test_horizon_respects_max_tokens_not_divisible_by_h():
+    engine = make_engine(4)
+    toks, reason = await collect(engine, greedy_request([5, 6, 7], 7))
+    await engine.close()
+    assert len(toks) == 7
+    assert reason is FinishReason.LENGTH
+
+
+async def test_horizon_min_tokens_suppresses_eos():
+    # pin EOS to whatever greedy emits first so suppression must kick in
+    probe = make_engine(1)
+    first, _ = await collect(probe, greedy_request([4, 4, 4], 1))
+    await probe.close()
+    eos = first[0]
+    engine = make_engine(4)
+    req = PreprocessedRequest(
+        token_ids=[4, 4, 4],
+        sampling=SamplingOptions(greedy=True),
+        stop=StopConditions(max_tokens=12, min_tokens=6),
+        eos_token_ids=[eos],
+    )
+    toks, reason = await collect(engine, req)
+    await engine.close()
+    assert len(toks) >= 6
+
+
+async def test_horizon_eos_finish_mid_horizon():
+    # make EOS the greedy continuation a few steps in: run single-step to
+    # find the 3rd greedy token, then declare it EOS and expect EOS finish
+    # with exactly 2 streamed tokens (EOS itself stays hidden)
+    probe = make_engine(1)
+    toks1, _ = await collect(probe, greedy_request([9, 9, 21], 8))
+    await probe.close()
+    eos = toks1[2]
+    if toks1[0] == eos or toks1[1] == eos:
+        # degenerate greedy loop; EOS would fire earlier — still a valid
+        # mid-horizon stop, adjust expectation
+        expect = toks1.index(eos)
+    else:
+        expect = 2
+    engine = make_engine(4)
+    req = PreprocessedRequest(
+        token_ids=[9, 9, 21],
+        sampling=SamplingOptions(greedy=True),
+        stop=StopConditions(max_tokens=8),
+        eos_token_ids=[eos],
+    )
+    toks, reason = await collect(engine, req)
+    await engine.close()
+    assert reason is FinishReason.EOS
+    assert toks == toks1[:expect]
+
+
+async def test_horizon_crosses_block_boundaries():
+    # block_size=4 and 13 generated tokens forces several just-in-time
+    # block extensions; the preallocation in _horizon_for must cover them
+    engine = make_engine(4, block_size=4, max_len=64)
+    toks, reason = await collect(engine, greedy_request([11, 13], 13))
+    await engine.close()
+    assert len(toks) == 13
+
+
+async def test_horizon_lane_near_model_len_with_fresh_lane():
+    # a lane one block from max_model_len batched with a fresh lane: block
+    # preallocation must cap at the lane's own remaining budget, not the
+    # global H, or block_ids overruns max_blocks_per_seq and the
+    # block-table row assignment crashes the engine loop
+    import asyncio
+
+    engine = make_engine(8, max_len=16, block_size=4, num_blocks=64)
+    near = greedy_request([1] * 13, 8)   # only 3 tokens fit before max_len
+    fresh = greedy_request([2, 3], 8)
+    (ta, ra), (tb, rb) = await asyncio.gather(
+        collect(engine, near), collect(engine, fresh)
+    )
+    await engine.close()
+    assert len(ta) == 3 and ra is FinishReason.LENGTH
+    assert len(tb) == 8
+
+
+async def test_horizon_mixed_batch_and_penalty_fallback():
+    # one plain + one penalty request: the batch must fall back to
+    # single-step (penalties need the history program) and still match
+    # the H=1 engine's output for both
+    async def run(H):
+        engine = make_engine(H)
+        import asyncio
+
+        plain = greedy_request([5, 9, 17], 9)
+        pen = PreprocessedRequest(
+            token_ids=[8, 2, 44],
+            sampling=SamplingOptions(
+                greedy=True, repetition_penalty=1.3
+            ),
+            stop=StopConditions(max_tokens=9),
+        )
+        a, b = await asyncio.gather(
+            collect(engine, plain), collect(engine, pen)
+        )
+        await engine.close()
+        return a, b
+
+    assert await run(4) == await run(1)
