@@ -38,9 +38,12 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+    from pathlib import Path
 
-    sys.path.insert(0, "/root/repo")
+    root = str(Path(__file__).resolve().parents[1])
+    jax.config.update("jax_compilation_cache_dir", f"{root}/.jax_cache")
+
+    sys.path.insert(0, root)
     import __graft_entry__ as graft
     from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
 

@@ -100,6 +100,18 @@ class _Sequence(SequenceState):
         )
         self.top_p = s.top_p if s.top_p is not None else 1.0
         self.top_k = s.top_k if s.top_k is not None else 0
+        # the device sampler draws restricted rows from a top-
+        # SAMPLE_CANDIDATES pool; clamp here (with a log) so the behavior
+        # is declared once instead of silently applied on device
+        from dynamo_tpu.ops.sampling import SAMPLE_CANDIDATES
+
+        if self.top_k > SAMPLE_CANDIDATES:
+            logger.warning(
+                "seq %d: top_k=%d clamped to the device sampler's "
+                "candidate pool (%d)",
+                seq_id, self.top_k, SAMPLE_CANDIDATES,
+            )
+            self.top_k = SAMPLE_CANDIDATES
         self.max_new = request.stop.max_tokens or 16
         self.min_tokens = request.stop.min_tokens or 0
         # penalties + per-request RNG stream + logprobs (reference
@@ -266,9 +278,17 @@ class JaxEngine:
             self.waiting.remove(seq)
             seq.out.put_nowait(LLMEngineOutput.final(FinishReason.ERROR))
         # _finish frees the slot + KV blocks too: a restarted loop must not
-        # keep decoding zombie lanes that no consumer is reading
+        # keep decoding zombie lanes that no consumer is reading. Sequences
+        # with an in-flight remote-prefill inject keep their blocks (the
+        # late inject would otherwise land in recycled blocks and corrupt a
+        # new sequence — same hazard _reap_cancelled guards); their killed
+        # context gets them reaped once the inject lands.
         for seq in list(self._admit_order):
-            self._finish(seq, FinishReason.ERROR)
+            if seq.pending_remote:
+                seq.ctx.kill()
+                seq.out.put_nowait(LLMEngineOutput.final(FinishReason.ERROR))
+            else:
+                self._finish(seq, FinishReason.ERROR)
 
     async def close(self) -> None:
         self._closed = True

@@ -53,8 +53,8 @@ def default_prefill_buckets(block_size: int, max_len: int) -> list[int]:
     return buckets
 
 
-def lax_scan_steps(step, init, H: int):
-    """H chained step() calls, statically unrolled.
+def unrolled_steps(step, init, H: int):
+    """H chained step() calls, statically unrolled — NOT a lax.scan.
 
     A lax.scan would compile the body once, but carrying the multi-GB KV
     caches through a scan makes XLA double-buffer them (the r04 bench OOMed
@@ -454,11 +454,12 @@ class ModelRunner:
         min_remaining,    # [B] i32 — steps during which EOS stays masked
         eos_ids,          # [B, MAX_EOS_IDS] i32, -1 pads
     ):
-        """H chained decode steps in ONE program (lax.scan): each step's
-        sampled token feeds the next step on device, so the host pays one
-        dispatch + one fetch per H tokens instead of per token. Under the
-        bench's measured ~65 ms host<->device round trip this is the
-        difference between 54 and 460 tok/s at B=16.
+        """H chained decode steps in ONE program (statically unrolled; see
+        unrolled_steps for why not lax.scan): each step's sampled token
+        feeds the next step on device, so the host pays one dispatch + one
+        fetch per H tokens instead of per token. Under the bench's measured
+        ~65 ms host<->device round trip this is the difference between 54
+        and 460 tok/s at B=16.
 
         Per-lane freeze semantics: a lane stops advancing (and scatters its
         KV writes into null block 0) once it samples an un-suppressed EOS
@@ -506,7 +507,7 @@ class ModelRunner:
             return (next_tokens, next_positions, k_cache, v_cache, done), packed
 
         init = (tokens, positions, k_cache, v_cache, ~active)
-        (tokens, positions, k_cache, v_cache, _), packed = lax_scan_steps(
+        (tokens, positions, k_cache, v_cache, _), packed = unrolled_steps(
             step, init, H
         )
         return packed, k_cache, v_cache  # packed [H, B, 2+2K]

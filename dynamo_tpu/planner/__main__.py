@@ -46,6 +46,25 @@ def main() -> None:
     ap.add_argument("--min-decode", type=int, default=1)
     ap.add_argument("--max-decode", type=int, default=8)
     ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument(
+        "--connector",
+        choices=("auto", "kube"),
+        default="auto",
+        help="auto: local processes (or virtual in --dry-run); kube: patch "
+        "spec.replicas on the deploy/k8s workloads via the in-cluster API",
+    )
+    ap.add_argument(
+        "--kube-prefill", default="statefulsets/dynamo-prefill",
+        help="<plural>/<name> of the prefill workload (kube connector)",
+    )
+    ap.add_argument(
+        "--kube-decode", default="statefulsets/dynamo-worker",
+        help="<plural>/<name> of the decode workload (kube connector)",
+    )
+    ap.add_argument(
+        "--kube-namespace", default=None,
+        help="k8s namespace (default: the pod's serviceaccount namespace)",
+    )
     args = ap.parse_args()
     dlog.init()
 
@@ -70,7 +89,35 @@ def main() -> None:
                 "no fabric available; kv_usage/queue_depth stay 0"
             )
         sample = FrontendFabricSampler(args.metrics_url, aggregator)
-        if args.dry_run or not (args.prefill_cmd and args.decode_cmd):
+        if args.dry_run:
+            # dry-run ALWAYS wins — never actuate a live cluster from a
+            # preview run, regardless of --connector
+            connector = VirtualConnector()
+        elif args.connector == "kube":
+            from dynamo_tpu.planner.connectors import (
+                KubernetesApi,
+                KubernetesConnector,
+            )
+            from dynamo_tpu.planner.planner_core import DECODE, PREFILL
+
+            def parse_workload(spec: str) -> tuple[str, str]:
+                plural, _, name = spec.partition("/")
+                if not name:
+                    ap.error(
+                        "--kube-prefill/--kube-decode must be "
+                        "<plural>/<name>, e.g. statefulsets/dynamo-worker"
+                    )
+                return (plural, name)
+
+            connector = KubernetesConnector(
+                {
+                    PREFILL: parse_workload(args.kube_prefill),
+                    DECODE: parse_workload(args.kube_decode),
+                },
+                api=KubernetesApi(namespace=args.kube_namespace),
+            )
+            await connector.refresh()
+        elif not (args.prefill_cmd and args.decode_cmd):
             connector = VirtualConnector()
         else:
             connector = LocalProcessConnector(

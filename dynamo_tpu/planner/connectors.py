@@ -1,20 +1,26 @@
 """Scale actuators: turn replica targets into running workers.
 
 Role-equivalent of planner LocalConnector (circus-based) and
-KubernetesConnector (DynamoGraphDeployment CRD patch). Ours:
+KubernetesConnector (components/planner/src/dynamo/planner/kube.py,
+kubernetes_connector.py — DynamoGraphDeployment CRD patch). Ours:
 
   * VirtualConnector — bookkeeping only; planner tests and dry-run mode.
   * LocalProcessConnector — spawns/kills worker subprocesses from a
     command template (the supervisor-backed analogue; the SDK process
     supervisor builds on the same mechanism).
-  * (k8s: deploy/ manifests patch `replicas:` — documented there; the
-    planner emits ScaleDecision objects any operator glue can consume.)
+  * KubernetesConnector — patches `spec.replicas` on the apps/v1
+    Deployments/StatefulSets shipped in deploy/k8s/ straight through the
+    Kubernetes REST API (in-cluster serviceaccount auth; no client lib).
+    The reference scales its operator CRD; we deliberately ship plain
+    workloads (no operator — see deploy/k8s/), so the planner actuates
+    what we actually deploy.
 """
 
 from __future__ import annotations
 
 import asyncio
 import contextlib
+import json
 import os
 import signal
 from typing import Optional, Protocol
@@ -96,3 +102,171 @@ class LocalProcessConnector:
     async def close(self) -> None:
         for component in list(self._procs):
             await self.set_replicas(component, 0)
+
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubernetesApi:
+    """Minimal async Kubernetes REST client for workload scaling.
+
+    In-cluster defaults (service host/port env + serviceaccount token/CA,
+    like the reference's config.load_incluster_config()); every input can
+    be overridden, which is also how tests point it at a faked API server.
+    """
+
+    def __init__(
+        self,
+        base_url: Optional[str] = None,
+        token: Optional[str] = None,
+        namespace: Optional[str] = None,
+        ca_path: Optional[str] = None,
+    ) -> None:
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            base_url = f"https://{host}:{port}"
+        self.base_url = base_url.rstrip("/")
+        # None = read the projected serviceaccount token per request (the
+        # kubelet rotates it ~hourly; a snapshot would 401 after expiry)
+        self._static_token = token
+        if namespace is None:
+            try:
+                with open(f"{_SA_DIR}/namespace") as f:
+                    namespace = f.read().strip()
+            except FileNotFoundError:
+                namespace = "default"
+        self.namespace = namespace
+        self._ssl = None
+        if self.base_url.startswith("https"):
+            import ssl
+
+            ca = ca_path or f"{_SA_DIR}/ca.crt"
+            self._ssl = (
+                ssl.create_default_context(cafile=ca)
+                if os.path.exists(ca)
+                else ssl.create_default_context()
+            )
+        self._session = None
+
+    def _headers(self) -> dict:
+        token = self._static_token
+        if token is None:
+            try:
+                with open(f"{_SA_DIR}/token") as f:
+                    token = f.read().strip()
+            except FileNotFoundError:
+                token = ""
+        h = {"Accept": "application/json"}
+        if token:
+            h["Authorization"] = f"Bearer {token}"
+        return h
+
+    async def _sess(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    def _url(self, plural: str, name: str = "") -> str:
+        path = (
+            f"{self.base_url}/apis/apps/v1/namespaces/{self.namespace}/{plural}"
+        )
+        return f"{path}/{name}" if name else path
+
+    async def get_workload(self, plural: str, name: str) -> Optional[dict]:
+        """GET one Deployment/StatefulSet; None on 404."""
+        s = await self._sess()
+        async with s.get(
+            self._url(plural, name), headers=self._headers(), ssl=self._ssl
+        ) as r:
+            if r.status == 404:
+                return None
+            r.raise_for_status()
+            return await r.json()
+
+    async def patch_replicas(self, plural: str, name: str, n: int) -> None:
+        """Strategic-merge-patch spec.replicas (the reference patches the
+        same field on its CRD, kube.py update_graph_replicas)."""
+        s = await self._sess()
+        headers = dict(
+            self._headers(),
+            **{"Content-Type": "application/strategic-merge-patch+json"},
+        )
+        body = json.dumps({"spec": {"replicas": int(n)}})
+        async with s.patch(
+            self._url(plural, name), data=body, headers=headers, ssl=self._ssl
+        ) as r:
+            r.raise_for_status()
+
+    async def wait_ready(
+        self,
+        plural: str,
+        name: str,
+        replicas: int,
+        timeout_s: float = 600.0,
+        poll_s: float = 2.0,
+    ) -> None:
+        """Poll status.readyReplicas until the target is met (the
+        reference's wait_for_graph_deployment_ready equivalent)."""
+        deadline = asyncio.get_event_loop().time() + timeout_s
+        while True:
+            obj = await self.get_workload(plural, name)
+            ready = (obj or {}).get("status", {}).get("readyReplicas", 0) or 0
+            if obj is not None and ready >= replicas:
+                return
+            if asyncio.get_event_loop().time() >= deadline:
+                raise TimeoutError(
+                    f"{plural}/{name} not ready ({ready}/{replicas}) "
+                    f"after {timeout_s:.0f}s"
+                )
+            await asyncio.sleep(poll_s)
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
+class KubernetesConnector:
+    """Planner Connector that scales k8s workloads.
+
+    mapping: {component: (plural, workload_name)} — e.g.
+    {"prefill": ("statefulsets", "dynamo-prefill"),
+     "decode": ("statefulsets", "dynamo-worker")}.
+    `blocking=True` waits for readiness after scale-up, mirroring the
+    reference connector's blocking add_component.
+    """
+
+    def __init__(
+        self,
+        mapping: dict[str, tuple[str, str]],
+        api: Optional[KubernetesApi] = None,
+        blocking: bool = False,
+    ) -> None:
+        self.api = api or KubernetesApi()
+        self.mapping = mapping
+        self.blocking = blocking
+        self._cache: dict[str, int] = {}
+
+    def replicas(self, component: str) -> int:
+        return self._cache.get(component, 0)
+
+    async def refresh(self) -> None:
+        """Load current spec.replicas for every mapped component."""
+        for comp, (plural, name) in self.mapping.items():
+            obj = await self.api.get_workload(plural, name)
+            if obj is not None:
+                self._cache[comp] = int(obj.get("spec", {}).get("replicas", 0))
+
+    async def set_replicas(self, component: str, n: int) -> None:
+        plural, name = self.mapping[component]
+        prev = self._cache.get(component, 0)
+        await self.api.patch_replicas(plural, name, n)
+        self._cache[component] = n
+        logger.info("scaled %s (%s/%s) -> %d", component, plural, name, n)
+        if self.blocking and n > prev:
+            await self.api.wait_ready(plural, name, n)
+
+    async def close(self) -> None:
+        await self.api.close()
