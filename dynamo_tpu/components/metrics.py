@@ -219,7 +219,7 @@ async def _main() -> None:
         mock = MockWorkerMetrics(ep, instance_id=0)
         await mock.start()
     try:
-        await asyncio.Event().wait()
+        await drt.token.cancelled()  # exits on fabric loss too
     finally:
         if mock:
             await mock.stop()

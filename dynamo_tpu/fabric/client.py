@@ -121,6 +121,7 @@ class FabricClient:
         self._inproc_watches: set[int] = set()
         self._inproc_subs: set[int] = set()
         self._write_lock = asyncio.Lock()
+        self._conn_lost = False
         self.addr: str = ""
 
     # ------------------------------------------------------- construction
@@ -224,6 +225,7 @@ class FabricClient:
                     else:
                         fut.set_exception(RuntimeError(msg[2]))
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            self._conn_lost = True
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("fabric connection lost"))
@@ -235,6 +237,13 @@ class FabricClient:
 
     async def _call(self, op: str, **kwargs: Any) -> Any:
         assert self._writer is not None, "client not connected"
+        # fail fast once the read loop has died: a write into the dead
+        # socket often "succeeds" (kernel buffer), and with no reader the
+        # pending future would hang forever — wedging e.g. the lease
+        # keepalive loop, which must instead see the error and cancel the
+        # runtime (fabric loss is fatal; the supervisor restarts us)
+        if self._conn_lost or (self._read_task and self._read_task.done()):
+            raise ConnectionError("fabric connection lost")
         req_id = next(self._req_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut

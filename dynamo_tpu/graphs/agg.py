@@ -43,4 +43,8 @@ class Frontend:
             host=os.environ.get("DYN_HTTP_HOST", "0.0.0.0"),
             port=int(os.environ.get("DYN_HTTP_PORT", "8080")),
         )
-        await asyncio.Event().wait()  # serve until the supervisor stops us
+        # serve until the supervisor stops us OR the runtime cancels
+        # (fabric loss kills the primary lease -> keepalive cancels the
+        # token; exiting lets the supervisor restart us against the
+        # recovered fabric with fresh discovery state)
+        await runtime.token.cancelled()

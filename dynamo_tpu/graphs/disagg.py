@@ -40,7 +40,7 @@ class PrefillWorker:
         svc = PrefillWorkerService(runtime.fabric, _ns(), engine)
         await svc.start()
         try:
-            await asyncio.Event().wait()
+            await runtime.token.cancelled()  # exits on fabric loss too
         finally:
             await svc.close()
 
@@ -98,4 +98,4 @@ class Frontend:
             host=os.environ.get("DYN_HTTP_HOST", "0.0.0.0"),
             port=int(os.environ.get("DYN_HTTP_PORT", "8080")),
         )
-        await asyncio.Event().wait()
+        await runtime.token.cancelled()  # exits on fabric loss too

@@ -87,4 +87,4 @@ class Frontend:
             host=os.environ.get("DYN_HTTP_HOST", "0.0.0.0"),
             port=int(os.environ.get("DYN_HTTP_PORT", "8080")),
         )
-        await asyncio.Event().wait()
+        await runtime.token.cancelled()  # exits on fabric loss too

@@ -188,6 +188,13 @@ class Planner:
 
     async def step(self) -> ScaleDecision:
         """One observe->decide->actuate cycle (the testable unit)."""
+        # re-read actual replica counts from connectors that can observe
+        # them (k8s: another actor — operator, HPA, kubectl — may have
+        # scaled since our last write; deciding from a stale write-through
+        # cache would silently revert their change)
+        refresh = getattr(self.connector, "refresh", None)
+        if refresh is not None:
+            await refresh()
         m = await self.sample()
         self._rate.observe(m.req_per_s)
         if m.avg_isl:
