@@ -18,6 +18,7 @@ from dynamo_tpu.protocols.common import (
     LLMEngineOutput,
     StopConditions,
 )
+from dynamo_tpu.pipeline.nodes import Operator as PipelineOperator
 from dynamo_tpu.tokenizer import TokenizerWrapper
 
 
@@ -85,6 +86,7 @@ class SequenceDecoder:
             released, hit = self._scan_stop(pieces)
             result.text += released
             self._emitted_tokens += max(len(output.token_ids), 1)
+            result.tokens_emitted += max(len(output.token_ids), 1)
             if hit:
                 self.finished = FinishReason.STOP_SEQUENCE
         else:
@@ -170,3 +172,23 @@ class Backend:
         self, stop: StopConditions, eos_token_ids: list[int]
     ) -> SequenceDecoder:
         return SequenceDecoder(self.tokenizer, stop, eos_token_ids)
+
+
+class DetokenizeOperator(PipelineOperator):
+    """The backend node of the reference's per-model chain
+    (lib/llm/src/backend.rs into_operator; linked at
+    discovery/watcher.rs:205): forward passes the PreprocessedRequest
+    through untouched; backward folds each LLMEngineOutput delta through
+    a per-request SequenceDecoder (incremental detokenize, stop-sequence
+    jail, EOS/length finish), yielding StepResults upstream."""
+
+    def __init__(self, backend: Backend) -> None:
+        self._backend = backend
+
+    async def generate(self, request, ctx, next):
+        decoder = self._backend.decoder(request.stop, request.eos_token_ids)
+        async for out in next.generate(request, ctx):
+            step = decoder.step(out)
+            yield step
+            if step.finish_reason is not None:
+                return

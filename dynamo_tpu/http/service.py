@@ -15,8 +15,9 @@ from typing import Any, AsyncIterator, Callable, Optional
 
 from aiohttp import web
 
-from dynamo_tpu.backend import Backend
+from dynamo_tpu.backend import Backend, DetokenizeOperator
 from dynamo_tpu.http.metrics import ServiceMetrics, TokenTimer
+from dynamo_tpu.pipeline.nodes import ServiceBackend, ServiceFrontend
 from dynamo_tpu.model_card import ModelDeploymentCard
 from dynamo_tpu.pipeline.annotated import Annotated
 from dynamo_tpu.pipeline.context import Context
@@ -56,7 +57,8 @@ class ModelExecution:
         clear_fn: Optional[Callable] = None,
     ) -> None:
         self.mdc = mdc
-        self.engine_fn = engine_fn
+        self.engine_fn = engine_fn  # read through a closure by the pipeline
+        # backend, so swapping it (tests, reconnect) takes effect
         # async (token_ids) -> pooled embedding vector, when the engine
         # supports it (ref http/service/openai.rs:222 /v1/embeddings)
         self.embed_fn = embed_fn
@@ -65,6 +67,20 @@ class ModelExecution:
         self.clear_fn = clear_fn
         self.preprocessor = OpenAIPreprocessor(mdc)
         self.backend = Backend(self.preprocessor.tokenizer)
+        # the per-model token pipeline as a composable node graph
+        # (pipeline/nodes.py; reference watcher.rs:201-236 builds the same
+        # frontend -> backend-operator -> router-backend ring). Chat/
+        # completion-specific chunking stays at this HTTP layer; the chain
+        # below is the protocol-independent token path.
+        self.pipeline = (
+            ServiceFrontend(name=mdc.name)
+            .link(DetokenizeOperator(self.backend))
+            .link(
+                ServiceBackend.from_engine(
+                    lambda req, ctx: self.engine_fn(req, ctx)
+                )
+            )
+        )
 
     @property
     def supports_images(self) -> bool:
@@ -105,14 +121,10 @@ class ModelExecution:
         queue: asyncio.Queue = asyncio.Queue()
 
         async def run_choice(i: int, pre_i: PreprocessedRequest) -> None:
-            decoder = self.backend.decoder(pre_i.stop, pre_i.eos_token_ids)
             finish: Optional[FinishReason] = None
             try:
-                async for out in self.engine_fn(pre_i, ctx):
-                    step = decoder.step(out)
-                    counters["completion"] += step.tokens_emitted or (
-                        1 if out.text is not None else 0
-                    )
+                async for step in self.pipeline.generate(pre_i, ctx):
+                    counters["completion"] += step.tokens_emitted
                     if step.text or step.logprobs:
                         if timer:
                             timer.on_token(max(step.tokens_emitted, 1))

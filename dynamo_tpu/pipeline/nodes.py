@@ -1,0 +1,129 @@
+"""Composable pipeline node graph: Source / Sink / Operator / link().
+
+Role-equivalent of lib/runtime/src/pipeline/nodes.rs (:20-123 traits,
+:190-260 PipelineOperator) and its sources/sinks modules: a service
+pipeline is a chain of nodes where each node acts on the forward/request
+path, the backward/response path, or both.
+
+  * ServiceFrontend — the graph entry: a Source for requests and the Sink
+    that hands the final response stream back to the caller
+    (nodes/sources.rs ServiceFrontend).
+  * Operator — transforms BOTH directions: it receives the upstream
+    request plus the downstream engine, so it can rewrite the request,
+    call downstream, and re-shape the response stream on the way back up
+    (nodes.rs:107-141 Operator::generate(req, next)).
+  * ServiceBackend — the terminal Sink: wraps a plain engine callable
+    `async (request, ctx) -> AsyncIterator` (nodes/sinks.rs
+    ServiceBackend::from_engine).
+
+Rust needs forward_edge()/backward_edge() objects because each direction
+is a separately typed Sink/Source pair; in Python the Operator's generate
+holds both directions in one scope, so `link()` composes operators
+directly — same graph, same vocabulary, no trait plumbing. The egress
+half of a split pipeline (SegmentSink -> network -> SegmentSource) is
+what discovery.RemoteEngine + pipeline.ingress already implement; wrap a
+RemoteEngine in ServiceBackend.from_engine to place it in a graph.
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Callable, Optional
+
+EngineFn = Callable[..., AsyncIterator[Any]]  # async (request, ctx) -> stream
+
+
+class ServiceBackend:
+    """Terminal node: a Sink for requests, the Source of responses."""
+
+    def __init__(self, engine: EngineFn) -> None:
+        self._engine = engine
+
+    @classmethod
+    def from_engine(cls, engine: EngineFn) -> "ServiceBackend":
+        return cls(engine)
+
+    def generate(self, request: Any, ctx: Any) -> AsyncIterator[Any]:
+        return self._engine(request, ctx)
+
+
+class Operator:
+    """A node that may transform the forward request, the backward
+    response stream, or both. Subclasses override `generate` and call
+    `next.generate(...)` for the downstream half (nodes.rs Operator)."""
+
+    async def generate(
+        self, request: Any, ctx: Any, next: "ServiceBackend"
+    ) -> AsyncIterator[Any]:
+        async for item in next.generate(request, ctx):
+            yield item
+
+
+class _LinkedOperator(ServiceBackend):
+    """An Operator bound to its downstream node — itself engine-shaped, so
+    chains compose associatively (nodes.rs PipelineOperator: the operator
+    plus its forward/backward edges collapsed into one engine)."""
+
+    def __init__(self, op: Operator, downstream: ServiceBackend) -> None:
+        self._op = op
+        self._downstream = downstream
+
+    def generate(self, request: Any, ctx: Any) -> AsyncIterator[Any]:
+        return self._op.generate(request, ctx, self._downstream)
+
+
+class ServiceFrontend:
+    """Graph entry point. Build with link() — operators first, terminal
+    ServiceBackend (or bare engine callable) last:
+
+        pipe = (ServiceFrontend(name="chat")
+                .link(PreprocessOp())
+                .link(DetokenizeOp(backend))
+                .link(ServiceBackend.from_engine(router_engine)))
+        async for item in pipe.generate(request, ctx): ...
+
+    Linking order is the forward path; each Operator's generate wraps the
+    response stream on the way back, so the backward path runs the same
+    chain in reverse — exactly the reference's
+    frontend.link(pre.forward_edge()).link(...).link(pre.backward_edge())
+    ring (discovery/watcher.rs:230-236) without the explicit edge objects.
+    """
+
+    def __init__(self, name: str = "pipeline") -> None:
+        self.name = name
+        self._ops: list[Operator] = []
+        self._backend: Optional[ServiceBackend] = None
+        self._composed: Optional[ServiceBackend] = None
+
+    def link(self, node: Any) -> "ServiceFrontend":
+        if self._backend is not None:
+            raise ValueError(
+                f"{self.name}: pipeline already terminated by a backend"
+            )
+        if isinstance(node, Operator):
+            self._ops.append(node)
+        elif isinstance(node, ServiceBackend):
+            self._backend = node
+        elif callable(node):
+            self._backend = ServiceBackend.from_engine(node)
+        else:
+            raise TypeError(f"{self.name}: cannot link {type(node).__name__}")
+        return self
+
+    @property
+    def engine(self) -> ServiceBackend:
+        """The composed engine: operators folded right-to-left onto the
+        terminal backend (memoized — the chain is immutable once a
+        backend is linked, and generate() runs per request)."""
+        if self._composed is None:
+            if self._backend is None:
+                raise ValueError(
+                    f"{self.name}: no terminal ServiceBackend linked"
+                )
+            engine = self._backend
+            for op in reversed(self._ops):
+                engine = _LinkedOperator(op, engine)
+            self._composed = engine
+        return self._composed
+
+    def generate(self, request: Any, ctx: Any) -> AsyncIterator[Any]:
+        return self.engine.generate(request, ctx)

@@ -1,0 +1,111 @@
+"""Pipeline node graph (pipeline/nodes.py): composition + both directions.
+
+Port of the reference's Source/Sink/Operator DAG (nodes.rs:20-141,
+watcher.rs:201-236); these tests prove operators transform the forward
+request AND re-shape the backward response stream, compose in link()
+order, and that the load-bearing production chain (DetokenizeOperator over
+an engine backend, as ModelExecution builds it) emits decoded StepResults.
+"""
+
+from dynamo_tpu.pipeline.nodes import (
+    Operator,
+    ServiceBackend,
+    ServiceFrontend,
+)
+
+
+async def test_operator_transforms_both_directions():
+    log = []
+
+    async def engine(request, ctx):
+        log.append(("engine", request))
+        for tok in request.split():
+            yield tok
+
+    class Shout(Operator):  # forward: upcase request; backward: tag items
+        async def generate(self, request, ctx, next):
+            async for item in next.generate(request.upper(), ctx):
+                yield f"<{item}>"
+
+    pipe = ServiceFrontend(name="t").link(Shout()).link(
+        ServiceBackend.from_engine(engine)
+    )
+    got = [x async for x in pipe.generate("a b c", None)]
+    assert got == ["<A>", "<B>", "<C>"]
+    assert log == [("engine", "A B C")]
+
+
+async def test_operators_compose_in_link_order():
+    async def engine(request, ctx):
+        yield request
+
+    class Add(Operator):
+        def __init__(self, tag):
+            self.tag = tag
+
+        async def generate(self, request, ctx, next):
+            async for item in next.generate(request + f".{self.tag}dn", ctx):
+                yield item + f".{self.tag}up"
+
+    pipe = (
+        ServiceFrontend()
+        .link(Add("A"))
+        .link(Add("B"))
+        .link(ServiceBackend.from_engine(engine))
+    )
+    got = [x async for x in pipe.generate("r", None)]
+    # forward: A then B; backward: B then A (the reference's edge ring)
+    assert got == ["r.Adn.Bdn.Bup.Aup"]
+
+
+async def test_link_validation():
+    import pytest
+
+    front = ServiceFrontend(name="v")
+    with pytest.raises(ValueError):
+        front.engine  # no backend yet
+
+    async def engine(request, ctx):
+        yield request
+
+    front.link(engine)  # bare callables become ServiceBackend
+    with pytest.raises(ValueError):
+        front.link(engine)  # already terminated
+    with pytest.raises(TypeError):
+        ServiceFrontend().link(123)
+
+
+async def test_detokenize_operator_chain_decodes_engine_deltas():
+    """The production chain shape: DetokenizeOperator -> engine backend
+    (http/service.ModelExecution builds exactly this)."""
+    from dynamo_tpu.backend import Backend, DetokenizeOperator
+    from dynamo_tpu.protocols.common import (
+        LLMEngineOutput,
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from tests.util import make_test_tokenizer
+
+    tok = make_test_tokenizer()
+    ids = tok.encode("quick brown fox").ids
+
+    async def engine2(request, ctx):
+        for t in ids:
+            yield LLMEngineOutput(token_ids=[t])
+
+    backend = Backend(tok)
+    pipe = (
+        ServiceFrontend(name="detok")
+        .link(DetokenizeOperator(backend))
+        .link(ServiceBackend.from_engine(engine2))
+    )
+    req = PreprocessedRequest(
+        token_ids=[1],
+        sampling=SamplingOptions(greedy=True),
+        stop=StopConditions(max_tokens=16),
+    )
+    text = "".join(
+        [s.text async for s in pipe.generate(req, None)]
+    )
+    assert "quick" in text and "fox" in text
