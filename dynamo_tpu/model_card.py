@@ -40,9 +40,12 @@ class ModelDeploymentCard:
     eos_token_ids: list[int] = field(default_factory=list)
     # large blobs live in the object store, keyed by slug
     tokenizer_obj: Optional[str] = None
+    # "hf" (tokenizer.json) or "sp" (SentencePiece .model protobuf)
+    tokenizer_kind: str = "hf"
     extra: dict[str, Any] = field(default_factory=dict)
-    # populated locally, never serialized
+    # populated locally, never serialized (hf json text or sp raw bytes)
     _tokenizer_json: Optional[str] = None
+    _tokenizer_sp: Optional[bytes] = None
 
     @property
     def slug(self) -> str:
@@ -79,8 +82,16 @@ class ModelDeploymentCard:
             eos_token=tpl.eos_token,
             eos_token_ids=tok.eos_token_ids,
         )
-        card._tokenizer_json = tok.to_json_str()
+        card._attach_tokenizer(tok)
         return card
+
+    def _attach_tokenizer(self, tok: TokenizerWrapper) -> None:
+        if tok.kind == "sp":
+            self.tokenizer_kind = "sp"
+            self._tokenizer_sp = tok.sp_model_bytes
+        else:
+            self.tokenizer_kind = "hf"
+            self._tokenizer_json = tok.to_json_str()
 
     @classmethod
     def from_tokenizer(
@@ -96,7 +107,7 @@ class ModelDeploymentCard:
             chat_template=chat_template,
             **kwargs,
         )
-        card._tokenizer_json = tokenizer.to_json_str()
+        card._attach_tokenizer(tokenizer)
         return card
 
     # --------------------------------------------------------- serialize
@@ -112,6 +123,7 @@ class ModelDeploymentCard:
             "eos_token": self.eos_token,
             "eos_token_ids": self.eos_token_ids,
             "tokenizer_obj": self.tokenizer_obj,
+            "tokenizer_kind": self.tokenizer_kind,
             "extra": self.extra,
         }
         return json.dumps(d)
@@ -125,7 +137,10 @@ class ModelDeploymentCard:
 
     async def publish(self, fabric: FabricClient) -> None:
         """Upload tokenizer blob + card to the fabric object store."""
-        if self._tokenizer_json is not None:
+        if self.tokenizer_kind == "sp" and self._tokenizer_sp is not None:
+            self.tokenizer_obj = f"{self.slug}/tokenizer.model"
+            await fabric.obj_put(MDC_BUCKET, self.tokenizer_obj, self._tokenizer_sp)
+        elif self._tokenizer_json is not None:
             self.tokenizer_obj = f"{self.slug}/tokenizer.json"
             await fabric.obj_put(
                 MDC_BUCKET, self.tokenizer_obj, self._tokenizer_json.encode()
@@ -143,12 +158,23 @@ class ModelDeploymentCard:
         if card.tokenizer_obj:
             blob = await fabric.obj_get(MDC_BUCKET, card.tokenizer_obj)
             if blob is not None:
-                card._tokenizer_json = blob.decode()
+                if card.tokenizer_kind == "sp":
+                    card._tokenizer_sp = blob
+                else:
+                    card._tokenizer_json = blob.decode()
         return card
 
     # ----------------------------------------------------------- loaders
 
     def load_tokenizer(self) -> TokenizerWrapper:
+        if self.tokenizer_kind == "sp":
+            if self._tokenizer_sp is None:
+                raise RuntimeError(
+                    f"card {self.name}: tokenizer blob not loaded"
+                )
+            return TokenizerWrapper.from_sp_bytes(
+                self._tokenizer_sp, self.eos_token_ids
+            )
         if self._tokenizer_json is None:
             raise RuntimeError(f"card {self.name}: tokenizer blob not loaded")
         return TokenizerWrapper.from_json_str(

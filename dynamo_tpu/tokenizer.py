@@ -67,9 +67,19 @@ class DecodeStream:
 
 
 class TokenizerWrapper:
-    def __init__(self, hf: HfTokenizer, eos_token_ids: Sequence[int] = ()) -> None:
+    """Wraps either an HF `tokenizers` tokenizer or a native SentencePiece
+    model (sp_tokenizer.SentencePieceTokenizer — reference
+    tokenizers/sp.rs); both expose the same encode/decode surface."""
+
+    def __init__(self, hf, eos_token_ids: Sequence[int] = ()) -> None:
         self._hf = hf
         self.eos_token_ids = list(eos_token_ids)
+        # raw .model bytes when SP-backed (published to model cards)
+        self.sp_model_bytes: Optional[bytes] = None
+
+    @property
+    def kind(self) -> str:
+        return "sp" if self.sp_model_bytes is not None else "hf"
 
     # ----------------------------------------------------------- factory
 
@@ -84,11 +94,40 @@ class TokenizerWrapper:
         return cls(HfTokenizer.from_str(data), eos_token_ids)
 
     @classmethod
+    def from_sp_bytes(
+        cls, data: bytes, eos_token_ids: Sequence[int] = ()
+    ) -> "TokenizerWrapper":
+        from dynamo_tpu.sp_tokenizer import (
+            SentencePieceTokenizer,
+            parse_model_proto,
+        )
+
+        sp = SentencePieceTokenizer(parse_model_proto(data))
+        ids = list(eos_token_ids) or (
+            [sp.model.eos_id] if sp.model.eos_id >= 0 else []
+        )
+        tok = cls(sp, ids)
+        tok.sp_model_bytes = data
+        return tok
+
+    @classmethod
     def from_model_dir(cls, model_dir: str) -> "TokenizerWrapper":
+        from dynamo_tpu.sp_tokenizer import sp_model_path
+
         tok_path = os.path.join(model_dir, "tokenizer.json")
-        if not os.path.exists(tok_path):
-            raise FileNotFoundError(f"no tokenizer.json in {model_dir}")
-        hf = HfTokenizer.from_file(tok_path)
+        sp_path = None if os.path.exists(tok_path) else sp_model_path(model_dir)
+        if not os.path.exists(tok_path) and sp_path is None:
+            raise FileNotFoundError(
+                f"no tokenizer.json or tokenizer.model in {model_dir}"
+            )
+        if sp_path is not None:
+            with open(sp_path, "rb") as f:
+                sp_bytes = f.read()
+            base = cls.from_sp_bytes(sp_bytes)
+            hf = base._hf
+        else:
+            hf = HfTokenizer.from_file(tok_path)
+            base = None
         eos_ids: list[int] = []
         cfg_path = os.path.join(model_dir, "config.json")
         if os.path.exists(cfg_path):
@@ -112,6 +151,10 @@ class TokenizerWrapper:
                     tid = hf.token_to_id(eos_tok)
                     if tid is not None:
                         eos_ids = [tid]
+        if base is not None:
+            if eos_ids:
+                base.eos_token_ids = eos_ids
+            return base
         return cls(hf, eos_ids)
 
     # --------------------------------------------------------------- api

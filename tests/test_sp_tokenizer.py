@@ -1,0 +1,153 @@
+"""SentencePiece tokenizer: wire-format parse, unigram/BPE encode, decode,
+TokenizerWrapper + model-card integration (reference tokenizers/sp.rs).
+
+The test writes real ModelProto bytes by hand (protobuf wire format), so
+the parser is validated against the format spec rather than against its
+own writer."""
+
+import struct
+
+from dynamo_tpu.sp_tokenizer import (
+    SentencePieceTokenizer,
+    parse_model_proto,
+)
+from dynamo_tpu.tokenizer import TokenizerWrapper
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out += bytes([b | (0x80 if n else 0)])
+        if not n:
+            return out
+
+
+def _ld(fno: int, payload: bytes) -> bytes:  # length-delimited field
+    return _varint((fno << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _vi(fno: int, val: int) -> bytes:  # varint field
+    return _varint(fno << 3) + _varint(val)
+
+
+def _f32(fno: int, val: float) -> bytes:  # 32-bit field
+    return _varint((fno << 3) | 5) + struct.pack("<f", val)
+
+
+def _piece(text: str, score: float, ptype: int = 1) -> bytes:
+    body = _ld(1, text.encode()) + _f32(2, score) + _vi(3, ptype)
+    return _ld(1, body)
+
+
+def make_model(pieces, model_type=1, add_dummy_prefix=True) -> bytes:
+    blob = b"".join(_piece(*p) for p in pieces)
+    trainer = _vi(3, model_type) + _vi(40, 0) + _vi(41, 1) + _vi(42, 2)
+    norm = _vi(3, 1 if add_dummy_prefix else 0) + _vi(4, 1) + _vi(5, 1)
+    return blob + _ld(2, trainer) + _ld(4, norm)
+
+
+BASE = [
+    ("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3),
+    ("▁", -10.0, 1),
+    ("▁hello", -1.0, 1), ("▁world", -1.5, 1),
+    ("▁hel", -3.0, 1), ("lo", -3.5, 1),
+    ("h", -8.0, 1), ("e", -8.0, 1), ("l", -8.0, 1), ("o", -8.0, 1),
+    ("w", -8.0, 1), ("r", -8.0, 1), ("d", -8.0, 1),
+] + [(f"<0x{b:02X}>", -20.0, 6) for b in range(256)]
+
+
+def test_parse_model_proto():
+    m = parse_model_proto(make_model(BASE))
+    assert m.model_type == 1
+    assert m.add_dummy_prefix and m.escape_whitespaces
+    assert (m.unk_id, m.bos_id, m.eos_id) == (0, 1, 2)
+    assert m.pieces[4].piece == "▁hello"
+    assert abs(m.pieces[4].score + 1.0) < 1e-6
+    assert m.pieces[0].type == 2 and m.pieces[1].type == 3
+
+
+def test_unigram_encode_picks_best_segmentation():
+    sp = SentencePieceTokenizer(parse_model_proto(make_model(BASE)))
+    enc = sp.encode("hello world", add_special_tokens=False)
+    # "▁hello" (-1.0) beats "▁hel"+"lo" (-6.5) and chars
+    assert enc.tokens == ["▁hello", "▁world"]
+    assert sp.decode(enc.ids) == "hello world"
+
+
+def test_encode_adds_bos_and_decode_skips_specials():
+    sp = SentencePieceTokenizer(parse_model_proto(make_model(BASE)))
+    enc = sp.encode("hello")
+    assert enc.ids[0] == 1  # <s>
+    assert sp.decode(enc.ids) == "hello"
+    assert sp.decode(enc.ids, skip_special_tokens=False).startswith("<s>")
+
+
+def test_byte_fallback_roundtrip():
+    sp = SentencePieceTokenizer(parse_model_proto(make_model(BASE)))
+    enc = sp.encode("héllo", add_special_tokens=False)  # é is OOV
+    assert any(t.startswith("<0x") for t in enc.tokens)
+    assert sp.decode(enc.ids) == "héllo"
+
+
+def test_bpe_encode_merges_by_score():
+    pieces = [
+        ("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3),
+        ("▁", -5.0, 1), ("a", -6.0, 1), ("b", -6.0, 1),
+        ("ab", -1.0, 1), ("▁ab", -0.5, 1), ("abab", -2.0, 1),
+    ] + [(f"<0x{b:02X}>", -20.0, 6) for b in range(256)]
+    sp = SentencePieceTokenizer(
+        parse_model_proto(make_model(pieces, model_type=2))
+    )
+    enc = sp.encode("abab", add_special_tokens=False)
+    # merges: a+b -> ab (twice), ▁+ab -> ▁ab; leftover ab stays
+    assert enc.tokens == ["▁ab", "ab"]
+    assert sp.decode(enc.ids) == "abab"
+
+
+def test_negative_trainer_ids_parse_as_disabled():
+    # T5/ALBERT-style .model files set bos_id=-1; protobuf encodes that as
+    # a 64-bit two's-complement varint which must sign-decode, not appear
+    # as 2^64-1 (which would pass `>= 0` and index out of the piece table)
+    blob = b"".join(_piece(*p) for p in BASE)
+    neg1 = (1 << 64) - 1
+    trainer = _vi(3, 1) + _vi(40, 0) + _vi(41, neg1) + _vi(42, 2)
+    norm = _vi(3, 1) + _vi(4, 1) + _vi(5, 1)
+    m = parse_model_proto(blob + _ld(2, trainer) + _ld(4, norm))
+    assert m.bos_id == -1
+    sp = SentencePieceTokenizer(m)
+    enc = sp.encode("hello")  # add_special_tokens honors disabled bos
+    assert enc.ids[0] != neg1
+    assert sp.decode(enc.ids) == "hello"
+
+
+def test_tokenizer_wrapper_from_sp_model_dir(tmp_path):
+    (tmp_path / "tokenizer.model").write_bytes(make_model(BASE))
+    tok = TokenizerWrapper.from_model_dir(str(tmp_path))
+    assert tok.kind == "sp"
+    assert tok.eos_token_ids == [2]
+    enc = tok.encode("hello world", add_special_tokens=False)
+    assert tok.decode(enc.ids) == "hello world"
+    # incremental streaming decode emits the full text
+    stream = tok.decode_stream()
+    text = "".join(stream.step(t) for t in enc.ids)
+    assert text == "hello world"
+
+
+async def test_model_card_publishes_sp_blob(tmp_path):
+    from dynamo_tpu.fabric.client import FabricClient
+    from dynamo_tpu.fabric.state import FabricState
+    from dynamo_tpu.model_card import ModelDeploymentCard
+
+    (tmp_path / "tokenizer.model").write_bytes(make_model(BASE))
+    (tmp_path / "config.json").write_text('{"eos_token_id": 2}')
+    card = ModelDeploymentCard.from_model_dir(str(tmp_path), "sp-model")
+    assert card.tokenizer_kind == "sp"
+    fabric = FabricClient.in_process(FabricState())
+    await card.publish(fabric)
+    got = await ModelDeploymentCard.download(fabric, card.slug)
+    tok = got.load_tokenizer()
+    assert tok.kind == "sp"
+    enc = tok.encode("hello", add_special_tokens=False)
+    assert tok.decode(enc.ids) == "hello"
