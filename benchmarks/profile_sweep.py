@@ -28,6 +28,7 @@ import asyncio
 import json
 import sys
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -72,6 +73,7 @@ async def profile_engine(
     usage_grid: list[float],
     decode_ctx: int = 128,
     decode_osl: int = 32,
+    ctx_grid: Optional[list[int]] = None,  # 2-D surface when >1 point
     time_scale: float = 1.0,
     rng_seed: int = 0,
 ) -> dict:
@@ -86,38 +88,50 @@ async def profile_engine(
         prefill_ttft.append(ttft_model * 1e3)
         prefill_tok_s.append(isl / max(ttft_model, 1e-9))
 
-    decode_itl, decode_tok_s = [], []
-    for usage in usage_grid:
-        want_blocks = usage * total_blocks
-        n_seqs = max(1, int(want_blocks * block_size) // decode_ctx)
-        prompts = [
-            rng.integers(1, 1000, size=decode_ctx).tolist()
-            for _ in range(n_seqs)
-        ]
-        t0 = time.perf_counter()
-        results = await asyncio.gather(
-            *(
-                _one_request(engine, p, max_tokens=decode_osl)
-                for p in prompts
+    # 2-D decode surface over (context_len, kv_usage) — the reference's
+    # perf_interpolation shape; a single-point ctx_grid collapses to the
+    # 1-D profile older planners consume
+    ctx_grid = list(ctx_grid or [decode_ctx])
+    decode_itl = np.zeros((len(ctx_grid), len(usage_grid)))
+    decode_tok_s = np.zeros_like(decode_itl)
+    for ci, ctx in enumerate(ctx_grid):
+        for ui, usage in enumerate(usage_grid):
+            want_blocks = usage * total_blocks
+            n_seqs = max(1, int(want_blocks * block_size) // ctx)
+            prompts = [
+                rng.integers(1, 1000, size=ctx).tolist()
+                for _ in range(n_seqs)
+            ]
+            t0 = time.perf_counter()
+            results = await asyncio.gather(
+                *(
+                    _one_request(engine, p, max_tokens=decode_osl)
+                    for p in prompts
+                )
             )
-        )
-        wall = (time.perf_counter() - t0) * time_scale
-        gaps = [g for _, gs in results for g in gs]
-        itl = (np.mean(gaps) if gaps else 0.0) * time_scale
-        decode_itl.append(itl * 1e3)
-        decode_tok_s.append(n_seqs * decode_osl / max(wall, 1e-9))
+            wall = (time.perf_counter() - t0) * time_scale
+            gaps = [g for _, gs in results for g in gs]
+            itl = (np.mean(gaps) if gaps else 0.0) * time_scale
+            decode_itl[ci, ui] = itl * 1e3
+            decode_tok_s[ci, ui] = n_seqs * decode_osl / max(wall, 1e-9)
 
-    return {
+    out = {
         "prefill_isl": np.asarray(isl_grid, float),
         "prefill_ttft_ms": np.asarray(prefill_ttft),
         "prefill_tok_s": np.asarray(prefill_tok_s),
         "decode_kv_usage": np.asarray(usage_grid, float),
-        "decode_itl_ms": np.asarray(decode_itl),
-        "decode_tok_s": np.asarray(decode_tok_s),
     }
+    if len(ctx_grid) > 1:
+        out["decode_context_len"] = np.asarray(ctx_grid, float)
+        out["decode_itl_ms"] = decode_itl
+        out["decode_tok_s"] = decode_tok_s
+    else:
+        out["decode_itl_ms"] = decode_itl[0]
+        out["decode_tok_s"] = decode_tok_s[0]
+    return out
 
 
-async def profile_mocker(isl_grid, usage_grid, **mock_kw) -> dict:
+async def profile_mocker(isl_grid, usage_grid, ctx_grid=None, **mock_kw) -> dict:
     from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
 
     args = MockEngineArgs(
@@ -134,13 +148,14 @@ async def profile_mocker(isl_grid, usage_grid, **mock_kw) -> dict:
             block_size=args.block_size,
             isl_grid=isl_grid,
             usage_grid=usage_grid,
+            ctx_grid=ctx_grid,
             time_scale=args.speedup_ratio,
         )
     finally:
         await engine.close()
 
 
-async def profile_tiny_jax(isl_grid, usage_grid) -> dict:
+async def profile_tiny_jax(isl_grid, usage_grid, ctx_grid=None) -> dict:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -158,6 +173,7 @@ async def profile_tiny_jax(isl_grid, usage_grid) -> dict:
             usage_grid=usage_grid,
             decode_ctx=32,
             decode_osl=16,
+            ctx_grid=ctx_grid,
         )
     finally:
         await engine.close()
@@ -179,13 +195,21 @@ def main() -> None:
         "--usage-grid", default="0.1,0.25,0.5,0.75,0.9",
         help="comma-separated decode kv_usage points",
     )
+    ap.add_argument(
+        "--ctx-grid", default=None,
+        help="comma-separated decode context lengths; >1 point records "
+        "the 2-D (context, kv_usage) decode surface",
+    )
     args = ap.parse_args()
     isl_grid = [int(x) for x in args.isl_grid.split(",")]
     usage_grid = [float(x) for x in args.usage_grid.split(",")]
+    ctx_grid = (
+        [int(x) for x in args.ctx_grid.split(",")] if args.ctx_grid else None
+    )
     if args.engine == "mocker":
-        prof = asyncio.run(profile_mocker(isl_grid, usage_grid))
+        prof = asyncio.run(profile_mocker(isl_grid, usage_grid, ctx_grid))
     else:
-        prof = asyncio.run(profile_tiny_jax(isl_grid, usage_grid))
+        prof = asyncio.run(profile_tiny_jax(isl_grid, usage_grid, ctx_grid))
     save_npz(args.out, prof)
     print(
         json.dumps(
@@ -193,7 +217,10 @@ def main() -> None:
                 "out": args.out,
                 "engine": args.engine,
                 "prefill_ttft_ms": [round(x, 3) for x in prof["prefill_ttft_ms"]],
-                "decode_itl_ms": [round(x, 3) for x in prof["decode_itl_ms"]],
+                "decode_itl_ms": [
+                    round(float(x), 3)
+                    for x in np.ravel(prof["decode_itl_ms"])
+                ],
             }
         )
     )

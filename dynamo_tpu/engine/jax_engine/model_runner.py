@@ -106,6 +106,23 @@ class ModelRunner:
 
         if attn_impl == "auto":
             attn_impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        # Mosaic tiling constraints, hit on real TPU (r04 verify): the
+        # decode kernel DMAs [block_size, head_dim] page tiles into VMEM,
+        # so head_dim must be lane-aligned (128) and block_size
+        # sublane-aligned (8). Models/configs outside that (head_dim 64,
+        # tiny block sizes) serve through the XLA gather path instead of
+        # failing compile.
+        from dynamo_tpu.ops.attention import _pallas_tileable
+
+        if attn_impl == "pallas" and not _pallas_tileable(
+            config.head_dim, block_size
+        ):
+            logger.warning(
+                "pallas attention needs head_dim%%128==0 and "
+                "block_size%%8==0 (got %d/%d); falling back to xla",
+                config.head_dim, block_size,
+            )
+            attn_impl = "xla"
         self.attn_impl = attn_impl
         # head axis for the shard_map-wrapped pallas path: only set when the
         # mesh actually shards kv heads (tp>1); dp/sp/ep-only meshes keep

@@ -54,6 +54,15 @@ def _prefill_block(P: int) -> Optional[int]:
     return None
 
 
+def _pallas_tileable(head_dim: int, block_size: int = 8) -> bool:
+    """Mosaic VMEM tiling: lane dim (head_dim) must be a multiple of 128,
+    sublane dim (page block_size) a multiple of 8 — compiling outside
+    that fails on real TPU ('Slice shape ... must be aligned to tiling').
+    Interpret mode has no such limits, so CPU tests still cover any
+    shape; production callers (ModelRunner) pre-check too."""
+    return head_dim % 128 == 0 and block_size % 8 == 0
+
+
 def causal_prefill_attention(
     q: jax.Array,  # [P, Hq, D]
     k: jax.Array,  # [P, Hkv, D]
@@ -71,6 +80,8 @@ def causal_prefill_attention(
     and no collective is needed (the wo row-parallel psum happens outside).
     """
     impl = get_attention_impl(impl)
+    if impl == "pallas" and not _pallas_tileable(q.shape[-1]):
+        impl = "xla"
     if impl != "xla":
         bq = _prefill_block(q.shape[0])
         if bq is not None:
@@ -178,6 +189,10 @@ def paged_decode_attention(
     follows is GSPMD-inserted outside this op.
     """
     impl = get_attention_impl(impl)
+    if impl == "pallas" and not _pallas_tileable(
+        q.shape[-1], k_cache.shape[2]
+    ):
+        impl = "xla"
     if impl != "xla":
         from dynamo_tpu.ops.pallas_attention import paged_decode_attention_pallas
 

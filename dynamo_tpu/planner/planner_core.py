@@ -140,15 +140,19 @@ class Planner:
         # --- decode fleet: run each replica at the highest kv_usage that
         # still meets the ITL target; size fleet for predicted token rate
         if self.decode_interp is not None:
+            # decode operating context: prompt plus half the output on
+            # average — feeds the 2-D (kv_usage, context) surface when the
+            # profile has one; None falls back to the profile's midpoint
+            ctx = (isl + osl / 2.0) if (isl or osl) else None
             target_usage = self.decode_interp.max_usage_for_itl(
-                cfg.itl_target_ms / max(self._itl_corr, 1e-6)
+                cfg.itl_target_ms / max(self._itl_corr, 1e-6), ctx
             )
-            base_itl = self.decode_interp.itl(m.kv_usage)
+            base_itl = self.decode_interp.itl(m.kv_usage, ctx)
             if m.itl_ms and base_itl > 0:
                 self._itl_corr = 0.7 * self._itl_corr + 0.3 * (
                     m.itl_ms / base_itl
                 )
-            cap = self.decode_interp.throughput(target_usage)
+            cap = self.decode_interp.throughput(target_usage, ctx)
             demand = rate * osl * cfg.headroom
             n_d = math.ceil(demand / max(cap, 1e-6))
         else:

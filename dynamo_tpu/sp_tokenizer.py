@@ -80,6 +80,7 @@ class SpPiece:
 class SpModel:
     pieces: list[SpPiece] = field(default_factory=list)
     model_type: int = 1  # TrainerSpec.model_type: 1=unigram, 2=bpe
+    normalizer_name: str = "nmt_nfkc"
     add_dummy_prefix: bool = True
     remove_extra_whitespaces: bool = True
     escape_whitespaces: bool = True
@@ -126,6 +127,10 @@ def parse_model_proto(data: bytes) -> SpModel:
                     m.eos_id = tval
         elif fno == 4 and wt == 2:  # NormalizerSpec
             for nf, nwt, nval in _fields(val):
+                if nf == 1 and nwt == 2:
+                    m.normalizer_name = nval.decode(
+                        "utf-8", errors="replace"
+                    )
                 if nwt != 0:
                     continue
                 if nf == 3:
@@ -168,6 +173,12 @@ class SentencePieceTokenizer:
                 self._max_piece_chars = max(
                     self._max_piece_chars, len(p.piece)
                 )
+        # unk/byte fallback score: below any real piece (pure function of
+        # the model — computed once, not per encode on the request path)
+        self._fallback_score = min(
+            (p.score for p in model.pieces if p.type == _NORMAL),
+            default=0.0,
+        ) - 10.0
 
     @classmethod
     def from_file(cls, path: str) -> "SentencePieceTokenizer":
@@ -177,7 +188,18 @@ class SentencePieceTokenizer:
     # -------------------------------------------------------- normalize
 
     def _normalize(self, text: str) -> str:
-        text = unicodedata.normalize("NFKC", text)
+        # honor NormalizerSpec.name: "identity" (llama-family) means no
+        # unicode rewriting at all; nfkc-family normalizers apply NFKC,
+        # and the nmt variants additionally fold control whitespace
+        # (\t \n \r) to plain space before the escape step
+        name = self.model.normalizer_name
+        if name != "identity":
+            if name.startswith("nmt"):
+                text = text.translate(
+                    {0x9: " ", 0xA: " ", 0xD: " "}
+                )
+            if "nfkc" in name or name == "":
+                text = unicodedata.normalize("NFKC", text)
         if self.model.remove_extra_whitespaces:
             # collapse runs of spaces and trim ends, as SP's normalizer does
             text = " ".join(s for s in text.split(" ") if s)
@@ -221,11 +243,7 @@ class SentencePieceTokenizer:
         best = [NEG] * (n + 1)
         back: list[Optional[tuple[int, list[int]]]] = [None] * (n + 1)
         best[0] = 0.0
-        # unk/byte fallback cost: below any real piece so it's a last resort
-        fallback_score = min(
-            (p.score for p in self.model.pieces if p.type == _NORMAL),
-            default=0.0,
-        ) - 10.0
+        fallback_score = self._fallback_score
         for i in range(n):
             if best[i] == NEG:
                 continue

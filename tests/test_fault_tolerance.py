@@ -13,6 +13,10 @@ import json
 import aiohttp
 import pytest
 
+# kill-based FT over real process graphs: excluded from the default suite (-m 'not slow') to keep
+# it under the CI budget; CI runs the slow tier separately
+pytestmark = pytest.mark.slow
+
 from dynamo_tpu.serve import _free_port, serve_graph
 
 # fast discovery-removal + fast echo so kills land mid-stream

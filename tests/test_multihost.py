@@ -17,6 +17,10 @@ import jax
 import numpy as np
 import pytest
 
+# 2-process SPMD bring-up: excluded from the default suite (-m 'not slow') to keep
+# it under the CI budget; CI runs the slow tier separately
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -127,3 +127,41 @@ def test_decode_under_jit():
     np.testing.assert_allclose(
         np.asarray(fn(q, kc, vc, bt, cl)), np.asarray(ref), atol=1e-5, rtol=1e-5
     )
+
+
+def test_untileable_shapes_fall_back_to_xla():
+    """head_dim 64 / block_size 4 can't satisfy Mosaic VMEM tiling on real
+    TPU (r04 verify: 'Slice shape ... must be aligned to tiling'); with
+    impl='pallas' the dispatch must route to the XLA path instead of
+    attempting the kernel. On CPU a non-interpret pallas call would fail
+    outright, so these succeeding proves the fallback fired."""
+    keys = jax.random.split(jax.random.PRNGKey(2), 4)
+    B, hq, hkv, D, bs, nb = 2, 4, 2, 64, 4, 16
+    q = _rand(keys[0], (B, hq, D))
+    kc = _rand(keys[1], (hkv, nb, bs, D))
+    vc = _rand(keys[2], (hkv, nb, bs, D))
+    bt = jnp.tile(jnp.arange(4, dtype=jnp.int32), (B, 1))
+    cl = jnp.array([3, 9], jnp.int32)
+    out = A.paged_decode_attention(q, kc, vc, bt, cl, impl="pallas")
+    ref = A.paged_decode_attention(q, kc, vc, bt, cl, impl="xla")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    p = _rand(keys[3], (32, hq, D))
+    k1 = _rand(keys[1], (32, hkv, D))
+    v1 = _rand(keys[2], (32, hkv, D))
+    o2 = A.causal_prefill_attention(p, k1, v1, jnp.int32(20), impl="pallas")
+    r2 = A.causal_prefill_attention(p, k1, v1, jnp.int32(20), impl="xla")
+    np.testing.assert_allclose(np.asarray(o2), np.asarray(r2), atol=1e-6)
+
+
+def test_runner_untileable_config_downgrades_to_xla():
+    from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+    from dynamo_tpu.models import llama as L
+
+    cfg = L.LlamaConfig.tiny(vocab_size=64)  # head_dim < 128
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    runner = ModelRunner(
+        cfg, params, num_blocks=16, block_size=8, max_batch=2,
+        max_model_len=64, attn_impl="pallas",
+    )
+    assert runner.attn_impl == "xla"

@@ -7,6 +7,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# pp parity sweeps: excluded from the default suite (-m 'not slow') to keep
+# it under the CI budget; CI runs the slow tier separately
+pytestmark = pytest.mark.slow
+
 from dynamo_tpu.models import llama as L
 from dynamo_tpu.parallel.mesh import build_mesh
 from dynamo_tpu.parallel.pipeline import (

@@ -12,6 +12,10 @@ import jax
 import numpy as np
 import pytest
 
+# pressure soak: excluded from the default suite (-m 'not slow') to keep
+# it under the CI budget; CI runs the slow tier separately
+pytestmark = pytest.mark.slow
+
 from dynamo_tpu.block_manager.layout import LayoutConfig
 from dynamo_tpu.block_manager.manager import TieredBlockManager
 from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
