@@ -161,8 +161,9 @@ async def profile_tiny_jax(isl_grid, usage_grid, ctx_grid=None) -> dict:
     jax.config.update("jax_platforms", "cpu")
     from dynamo_tpu.graphs.common import build_tiny_jax_engine
 
+    longest = max(max(isl_grid), max(ctx_grid or [0]))
     engine = build_tiny_jax_engine(
-        num_blocks=256, max_model_len=max(max(isl_grid) + 64, 256)
+        num_blocks=256, max_model_len=max(longest + 64, 256)
     )
     try:
         return await profile_engine(

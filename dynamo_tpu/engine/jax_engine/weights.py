@@ -77,6 +77,12 @@ def load_hf_safetensors(
             "wo": lin(p + "self_attn.o_proj.weight"),
             "mlp_norm": get(p + "post_attention_layernorm.weight"),
         }
+        if config.attn_bias:
+            layer.update(
+                bq=get(p + "self_attn.q_proj.bias"),
+                bk=get(p + "self_attn.k_proj.bias"),
+                bv=get(p + "self_attn.v_proj.bias"),
+            )
         if config.num_experts:
             # Mixtral block_sparse_moe: gate = router; per-expert
             # w1 = gate proj, w3 = up proj, w2 = down proj. Experts stay
@@ -114,6 +120,7 @@ def load_hf_safetensors(
     if tensors:
         logger.debug("unused tensors: %s", sorted(tensors)[:5])
     per_layer = 6 + (1 + 3 * config.num_experts if config.num_experts else 3)
+    per_layer += 3 if config.attn_bias else 0
     mapped = 2 + per_layer * config.num_layers + (
         1 if "lm_head" in params else 0
     )

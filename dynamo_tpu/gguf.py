@@ -333,10 +333,11 @@ def config_from_gguf(g: GgufFile):
 
     n_heads = int(key("attention.head_count", 32))
     hidden = int(key("embedding_length", 4096))
-    n_vocab = md.get("llama.vocab_size") or (
+    n_vocab = md.get(f"{arch}.vocab_size") or (
         len(md.get("tokenizer.ggml.tokens", [])) or 32000
     )
     return LlamaConfig(
+        attn_bias=arch.startswith("qwen2"),
         vocab_size=int(n_vocab),
         hidden_size=hidden,
         intermediate_size=int(key("feed_forward_length", 4 * hidden)),
@@ -390,5 +391,12 @@ def params_from_gguf(g: GgufFile, cfg=None, dtype=None):
         layer = {}
         for suffix, (ours, tr) in _LAYER_MAP.items():
             layer[ours] = get(f"blk.{i}.{suffix}", transpose=tr)
+        # qwen2-family q/k/v biases, when the file ships them
+        for suffix, ours in (
+            ("attn_q.bias", "bq"), ("attn_k.bias", "bk"),
+            ("attn_v.bias", "bv"),
+        ):
+            if f"blk.{i}.{suffix}" in g.tensors:
+                layer[ours] = get(f"blk.{i}.{suffix}")
         params["layers"].append(layer)
     return cfg, params

@@ -49,6 +49,8 @@ class LlamaConfig:
     max_position_embeddings: int = 8192
     tie_word_embeddings: bool = False
     rope_scaling: Optional[dict] = None
+    # Qwen2-family: bias on the q/k/v projections (o/mlp stay bias-free)
+    attn_bias: bool = False
     # attention kernel choice for THIS model instance (None -> process
     # default): lets two runners in one process use different impls
     # without stomping the ops-level global (e.g. a TP-meshed engine on
@@ -66,7 +68,13 @@ class LlamaConfig:
     def from_hf_dict(cls, d: dict[str, Any]) -> "LlamaConfig":
         num_heads = d.get("num_attention_heads", 32)
         hidden = d.get("hidden_size", 4096)
+        # Qwen2/Qwen2.5 are llama-shaped with q/k/v bias; HF marks them by
+        # model_type (qwen2) / architectures (Qwen2ForCausalLM)
+        is_qwen2 = d.get("model_type", "").startswith("qwen2") or any(
+            a.startswith("Qwen2") for a in d.get("architectures") or []
+        )
         return cls(
+            attn_bias=is_qwen2,
             vocab_size=d.get("vocab_size", 32000),
             hidden_size=hidden,
             intermediate_size=d.get("intermediate_size", 4 * hidden),
@@ -153,6 +161,12 @@ def init_params(
             "wo": dense(next(keys), (c.q_dim, c.hidden_size), c.q_dim),
             "mlp_norm": jnp.ones((c.hidden_size,), dtype),
         }
+        if c.attn_bias:
+            layer.update(
+                bq=jnp.zeros((c.q_dim,), dtype),
+                bk=jnp.zeros((c.kv_dim,), dtype),
+                bv=jnp.zeros((c.kv_dim,), dtype),
+            )
         if c.num_experts:
             # Mixtral MoE FFN: router + stacked expert SwiGLU weights
             # (experts kept bf16; expert einsums go through ops/moe.py)
@@ -199,6 +213,7 @@ def param_count(config: LlamaConfig) -> int:
         + c.q_dim * c.hidden_size
         + ffn
         + 2 * c.hidden_size
+        + ((c.q_dim + 2 * c.kv_dim) if c.attn_bias else 0)
     )
     total = c.num_layers * per_layer + 2 * c.vocab_size * c.hidden_size
     return total
@@ -209,12 +224,20 @@ def param_count(config: LlamaConfig) -> int:
 
 def _qkv(x, layer, cfg, inv_freqs, positions):
     """Shared projection head: norm -> q/k/v -> RoPE. One definition so the
-    serial, context-parallel, and decode paths cannot drift."""
+    serial, context-parallel, and decode paths cannot drift. Qwen2-family
+    models carry q/k/v biases (bq/bk/bv)."""
     T = x.shape[0]
     h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = linear(h, layer["wq"]).reshape(T, cfg.num_heads, cfg.head_dim)
-    k = linear(h, layer["wk"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
-    v = linear(h, layer["wv"]).reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    q = linear(h, layer["wq"])
+    k = linear(h, layer["wk"])
+    v = linear(h, layer["wv"])
+    if "bq" in layer:
+        q = q + layer["bq"].astype(q.dtype)
+        k = k + layer["bk"].astype(k.dtype)
+        v = v + layer["bv"].astype(v.dtype)
+    q = q.reshape(T, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
     q = apply_rope(q, positions, inv_freqs)
     k = apply_rope(k, positions, inv_freqs)
     return q, k, v

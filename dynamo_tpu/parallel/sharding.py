@@ -93,6 +93,13 @@ def shard_llama(
             "wo": _shard_linear(mesh, layer["wo"], "tp", None, put),
             "mlp_norm": put(layer["mlp_norm"], repl),
         }
+        if "bq" in layer:
+            # qwen2 q/k/v biases follow their column-parallel outputs
+            placed.update(
+                bq=put(layer["bq"], _ns(mesh, "tp")),
+                bk=put(layer["bk"], _ns(mesh, "tp")),
+                bv=put(layer["bv"], _ns(mesh, "tp")),
+            )
         if "router" in layer:
             # WideEP: experts sharded over ep, each expert's FFN over tp
             # (dsr1-wideep equivalent: dp-attention + deepep-moe flags)

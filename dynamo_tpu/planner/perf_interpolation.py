@@ -66,6 +66,11 @@ class DecodeInterpolator:
         self.kv_usage = np.asarray(kv_usage, float)[order]
         itl_ms = np.asarray(itl_ms, float)
         tok_s = np.asarray(tok_s, float)
+        if itl_ms.ndim == 2 and context_len is None:
+            raise ValueError(
+                "2-D decode_itl_ms requires decode_context_len (the "
+                "context axis); re-save the profile with it"
+            )
         if context_len is not None and itl_ms.ndim == 2:
             corder = np.argsort(context_len)
             self.context_len = np.asarray(context_len, float)[corder]
