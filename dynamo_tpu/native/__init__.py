@@ -1,0 +1,149 @@
+"""Native (C) runtime components, built on demand with the system compiler.
+
+The reference keeps its runtime hot paths native (Rust transports, the
+tokens crate, CUDA block copy); here the compute path is JAX/XLA and the
+one CPU-side per-request hot loop is the KV-block hash chain — so that is
+what goes native first. `blockhash.c` is compiled once into a cached
+shared object next to the source (cc -O3 -shared -fPIC); environments
+without a C compiler, or where the build fails for any reason, silently
+use the pure-Python implementation in dynamo_tpu/tokens.py — digests are
+bit-identical by test (tests/test_native_blockhash.py).
+
+Set DYN_NO_NATIVE=1 to force the Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "blockhash.c")
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _so_path() -> str:
+    """Cache keyed on source CONTENT (mtimes survive neither git clones
+    nor image builds): a changed .c gets a fresh filename, and a stale or
+    foreign-arch artifact can never shadow a rebuild."""
+    with open(_SRC, "rb") as f:
+        digest = hashlib.blake2b(f.read(), digest_size=8).hexdigest()
+    return os.path.join(_DIR, f"_blockhash-{digest}.so")
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if _tried:
+            return _lib
+        _lib = _load_locked()
+        _tried = True
+    return _lib
+
+
+def _load_locked() -> Optional[ctypes.CDLL]:
+    if os.environ.get("DYN_NO_NATIVE"):
+        return None
+    try:
+        so = _so_path()
+        if not os.path.exists(so):
+            # compile to a temp file + atomic rename: concurrent processes
+            # (serve graphs import this in every worker) must never CDLL a
+            # half-written artifact
+            cc = os.environ.get("CC", "cc")
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+            os.close(fd)
+            try:
+                subprocess.run(
+                    [cc, "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
+                    check=True,
+                    capture_output=True,
+                    timeout=60,
+                )
+                os.rename(tmp, so)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        lib = ctypes.CDLL(so)
+        lib.block_hash.restype = ctypes.c_uint64
+        lib.block_hash.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+        ]
+        lib.hash_chain.restype = ctypes.c_size_t
+        lib.hash_chain.argtypes = [
+            ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_size_t, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        return lib
+    except Exception:  # noqa: BLE001 — no compiler/arch issues: pure Python
+        return None
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _tok_buffer(tokens: list[int]):
+    """list[int] -> C u32 buffer via array('I') (a single C-speed copy —
+    per-element ctypes conversion costs more than the hash itself)."""
+    import array
+
+    try:
+        arr = array.array("I", tokens)
+    except (OverflowError, TypeError):
+        return None  # negative / oversized ids: let Python handle them
+    return (ctypes.c_uint32 * len(arr)).from_buffer(arr)
+
+
+def block_hash(parent: int, tokens: list[int], salt: int = 0) -> Optional[int]:
+    """Native single-block hash; None if unavailable or out of bounds."""
+    lib = _load()
+    n = len(tokens)
+    if lib is None or n == 0 or n > 1024 or not 0 <= salt < 1 << 64:
+        # out-of-range salt: defer to the Python path so behavior (a
+        # struct.error) doesn't depend on compiler availability
+        return None
+    buf = _tok_buffer(tokens)
+    if buf is None:
+        return None
+    return int(
+        lib.block_hash(
+            parent & 0xFFFFFFFFFFFFFFFF, salt & 0xFFFFFFFFFFFFFFFF, buf, n
+        )
+    )
+
+
+def hash_chain(
+    tokens: list[int], block_size: int, salt: int = 0
+) -> Optional[list[int]]:
+    """Native full-chain hash; None if unavailable or out of bounds."""
+    lib = _load()
+    n = len(tokens)
+    if (
+        lib is None or block_size <= 0 or block_size > 1024
+        or not 0 <= salt < 1 << 64
+    ):
+        return None
+    nb = n // block_size
+    if nb == 0:
+        return []
+    buf = _tok_buffer(tokens)
+    if buf is None:
+        return None
+    out = (ctypes.c_uint64 * nb)()
+    got = lib.hash_chain(
+        salt & 0xFFFFFFFFFFFFFFFF, buf, n, block_size, out
+    )
+    return list(out[:got])

@@ -23,6 +23,15 @@ DEFAULT_BLOCK_SIZE = 16
 def compute_block_hash(
     parent_hash: int, tokens: list[int], salt: int = 0
 ) -> int:
+    from dynamo_tpu import native
+
+    got = native.block_hash(parent_hash, tokens, salt)
+    if got is not None:
+        return got
+    return _py_block_hash(parent_hash, tokens, salt)
+
+
+def _py_block_hash(parent_hash: int, tokens: list[int], salt: int = 0) -> int:
     h = hashlib.blake2b(digest_size=8)
     h.update(struct.pack("<QQ", parent_hash & 0xFFFFFFFFFFFFFFFF, salt))
     h.update(struct.pack(f"<{len(tokens)}I", *tokens))
@@ -32,11 +41,28 @@ def compute_block_hash(
 def compute_seq_hash_chain(
     tokens: list[int], block_size: int = DEFAULT_BLOCK_SIZE, salt: int = 0
 ) -> list[int]:
-    """Hashes of all COMPLETE blocks of the sequence."""
+    """Hashes of all COMPLETE blocks of the sequence.
+
+    Dispatches to the native C implementation (dynamo_tpu/native —
+    bit-identical digests) when available; router/indexer call this for
+    every scheduled prompt."""
+    from dynamo_tpu import native
+
+    got = native.hash_chain(tokens, block_size, salt)
+    if got is not None:
+        return got
+    return _py_seq_hash_chain(tokens, block_size, salt)
+
+
+def _py_seq_hash_chain(
+    tokens: list[int], block_size: int = DEFAULT_BLOCK_SIZE, salt: int = 0
+) -> list[int]:
     hashes: list[int] = []
     parent = 0
     for start in range(0, len(tokens) - len(tokens) % block_size, block_size):
-        parent = compute_block_hash(parent, tokens[start : start + block_size], salt)
+        parent = _py_block_hash(
+            parent, tokens[start : start + block_size], salt
+        )
         hashes.append(parent)
     return hashes
 

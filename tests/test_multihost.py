@@ -53,6 +53,20 @@ def _tiny_model_dir(tmp_path) -> str:
 
 @pytest.mark.timeout(300)
 def test_two_process_engine_serves(tmp_path):
+    _two_process_engine_serves(tmp_path, {})
+
+
+@pytest.mark.timeout(300)
+def test_two_process_engine_serves_horizon_decode(tmp_path):
+    """Same 2-host serve, but with horizon decode (H=3): the leader
+    broadcasts OP_DECODE_MULTI and the follower must replay the identical
+    H-step collective program — the exact hazard class that wedges a
+    slice when an op isn't broadcast (advisor r3 embed finding). Greedy
+    outputs must still match the single-device reference bit-for-bit."""
+    _two_process_engine_serves(tmp_path, {"DYN_DECODE_HORIZON": "3"})
+
+
+def _two_process_engine_serves(tmp_path, extra_env):
     model_dir = _tiny_model_dir(tmp_path)
     port = _free_port()
     env_base = {
@@ -62,6 +76,7 @@ def test_two_process_engine_serves(tmp_path):
         # one device per process -> the tp=2 mesh MUST span both hosts
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
         "PYTHONPATH": REPO,
+        **extra_env,
     }
     server = subprocess.Popen(
         [sys.executable, "-m", "dynamo_tpu.fabric.server", "--port", str(port)],

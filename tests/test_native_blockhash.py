@@ -1,0 +1,69 @@
+"""Native C block-hash chain vs the pure-Python blake2b reference.
+
+The C implementation (dynamo_tpu/native/blockhash.c) must produce
+BIT-IDENTICAL digests to hashlib.blake2b(digest_size=8) over the same
+message layout — the hash chain is the shared currency between router,
+engine, and block manager, so two implementations disagreeing would
+silently break every prefix-reuse path."""
+
+import random
+
+import pytest
+
+from dynamo_tpu import native
+from dynamo_tpu.tokens import (
+    _py_block_hash,
+    _py_seq_hash_chain,
+    compute_block_hash,
+    compute_seq_hash_chain,
+)
+
+needs_native = pytest.mark.skipif(
+    not native.native_available(), reason="no C compiler available"
+)
+
+
+@needs_native
+def test_single_block_parity():
+    rng = random.Random(0)
+    for _ in range(50):
+        n = rng.randint(1, 64)
+        toks = [rng.randint(0, 2**31 - 1) for _ in range(n)]
+        parent = rng.randint(0, 2**64 - 1)
+        salt = rng.choice([0, 1, rng.randint(0, 2**63)])
+        assert native.block_hash(parent, toks, salt) == _py_block_hash(
+            parent, toks, salt
+        )
+
+
+@needs_native
+def test_chain_parity_all_block_sizes():
+    rng = random.Random(1)
+    for bs in (1, 4, 16, 64, 128):
+        toks = [rng.randint(0, 2**31 - 1) for _ in range(bs * 7 + 3)]
+        assert native.hash_chain(toks, bs) == _py_seq_hash_chain(toks, bs)
+        assert native.hash_chain(toks, bs, salt=99) == _py_seq_hash_chain(
+            toks, bs, salt=99
+        )
+
+
+@needs_native
+def test_long_message_multi_compression_block():
+    # > 128 bytes of message forces the multi-block blake2b path
+    toks = list(range(1024))
+    assert native.hash_chain(toks, 512) == _py_seq_hash_chain(toks, 512)
+
+
+def test_dispatch_is_transparent():
+    # the public functions agree with the pure-Python reference whether or
+    # not the native library loaded
+    toks = list(range(40))
+    assert compute_seq_hash_chain(toks, 16) == _py_seq_hash_chain(toks, 16)
+    assert compute_block_hash(7, toks[:16], 3) == _py_block_hash(7, toks[:16], 3)
+
+
+@needs_native
+def test_out_of_bounds_block_size_falls_back():
+    toks = list(range(4096))
+    # block_size > the C guard (1024) must still work via Python
+    assert compute_seq_hash_chain(toks, 2048) == _py_seq_hash_chain(toks, 2048)
