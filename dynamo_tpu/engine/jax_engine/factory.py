@@ -130,13 +130,13 @@ async def build_jax_engine(
         global_arrays=is_multihost,
     )
     if gguf_file is not None:
-        gguf_file.close()
-        mdc = ModelDeploymentCard.from_model_dir(
-            os.path.dirname(os.path.abspath(model_path)),
-            name or os.path.basename(model_path).removesuffix(".gguf"),
-            kv_block_size=kv_block_size,
-            context_length=max_len,
-        )
+        try:
+            mdc = _gguf_model_card(
+                gguf_file, model_path, name,
+                kv_block_size=kv_block_size, context_length=max_len,
+            )
+        finally:
+            gguf_file.close()  # the mmap must not leak on error paths
     else:
         mdc = ModelDeploymentCard.from_model_dir(
             model_path,
@@ -179,6 +179,52 @@ def default_decode_horizon() -> int:
     if override:
         return max(1, int(override))
     return 8 if jax.default_backend() == "tpu" else 1
+
+
+def _gguf_model_card(
+    gguf_file, model_path: str, name: Optional[str],
+    *, kv_block_size: int, context_length: int,
+) -> ModelDeploymentCard:
+    """Model card for a .gguf deployment: sidecar tokenizer files next to
+    the file win; otherwise the tokenizer embedded in the GGUF metadata
+    serves (tokenizer.ggml.* -> native SentencePiece; reference
+    gguf_tokenizer.rs). The embedded chat template rides along too."""
+    model_dir = os.path.dirname(os.path.abspath(model_path))
+    card_name = name or os.path.basename(model_path).removesuffix(".gguf")
+    try:
+        return ModelDeploymentCard.from_model_dir(
+            model_dir, card_name,
+            kv_block_size=kv_block_size, context_length=context_length,
+        )
+    except FileNotFoundError:
+        pass
+    from dynamo_tpu.gguf import tokenizer_from_gguf
+
+    tok = tokenizer_from_gguf(gguf_file)
+    if tok is None:
+        raise FileNotFoundError(
+            f"{model_path}: no tokenizer.json/tokenizer.model beside the "
+            "file and no tokenizer.ggml metadata inside it"
+        )
+    # bos/eos STRINGS feed chat templates ('{{ bos_token }}' is standard
+    # in published GGUF templates — empty strings would silently drop them)
+    md = gguf_file.metadata
+    tokens = md.get("tokenizer.ggml.tokens") or []
+
+    def tok_str(key: str) -> str:
+        tid = md.get(key)
+        if isinstance(tid, int) and 0 <= tid < len(tokens):
+            return tokens[tid]
+        return ""
+
+    return ModelDeploymentCard.from_tokenizer(
+        card_name, tok,
+        chat_template=md.get("tokenizer.chat_template"),
+        bos_token=tok_str("tokenizer.ggml.bos_token_id"),
+        eos_token=tok_str("tokenizer.ggml.eos_token_id"),
+        kv_block_size=kv_block_size,
+        context_length=context_length,
+    )
 
 
 def hbm_budget_bytes() -> int:

@@ -318,6 +318,64 @@ _DEQUANT = {
 }
 
 
+# ------------------------------------------------------- embedded tokenizer
+
+
+def tokenizer_from_gguf(g: GgufFile):
+    """TokenizerWrapper from the file's own tokenizer.ggml.* metadata.
+
+    Real published GGUFs embed their tokenizer (reference:
+    lib/llm/src/gguf/gguf_tokenizer.rs convert_gguf_to_hf_tokenizer); the
+    llama-family model ("llama"/"replit": SentencePiece pieces + scores)
+    maps 1:1 onto our native SP engine — GGUF token_type uses the same
+    enum as SentencePiece piece types (1 normal, 2 unknown, 3 control,
+    6 byte). Returns None when the metadata carries no tokenizer; raises
+    for tokenizer models we don't support (gpt2 byte-BPE needs merges —
+    ship a tokenizer.json next to the file for those)."""
+    md = g.metadata
+    tokens = md.get("tokenizer.ggml.tokens")
+    if not tokens:
+        return None
+    model_name = md.get("tokenizer.ggml.model", "llama")
+    if model_name not in ("llama", "replit"):
+        raise NotImplementedError(
+            f"GGUF tokenizer model {model_name!r} unsupported — place a "
+            "tokenizer.json next to the .gguf file"
+        )
+    from dynamo_tpu.sp_tokenizer import (
+        SentencePieceTokenizer,
+        SpModel,
+        SpPiece,
+        serialize_model_proto,
+    )
+    from dynamo_tpu.tokenizer import TokenizerWrapper
+
+    scores = md.get("tokenizer.ggml.scores") or [0.0] * len(tokens)
+    types = md.get("tokenizer.ggml.token_type") or [1] * len(tokens)
+    if len(scores) != len(tokens) or len(types) != len(tokens):
+        # zip() would silently truncate the vocab; corrupt files must fail
+        raise ValueError(
+            f"corrupt GGUF tokenizer metadata: {len(tokens)} tokens vs "
+            f"{len(scores)} scores / {len(types)} token types"
+        )
+    model = SpModel(
+        pieces=[
+            SpPiece(t, float(s), int(ty))
+            for t, s, ty in zip(tokens, scores, types)
+        ],
+        model_type=1,  # SP scores -> unigram Viterbi (llama.cpp SPM)
+        unk_id=int(md.get("tokenizer.ggml.unknown_token_id", 0)),
+        bos_id=int(md.get("tokenizer.ggml.bos_token_id", 1)),
+        eos_id=int(md.get("tokenizer.ggml.eos_token_id", 2)),
+        add_dummy_prefix=bool(md.get("tokenizer.ggml.add_space_prefix", True)),
+    )
+    sp = SentencePieceTokenizer(model)
+    eos = [model.eos_id] if model.eos_id >= 0 else []
+    tok = TokenizerWrapper(sp, eos)
+    tok.sp_model_bytes = serialize_model_proto(model)
+    return tok
+
+
 # --------------------------------------------------------------- mapping
 
 

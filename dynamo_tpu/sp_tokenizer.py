@@ -382,6 +382,50 @@ class SentencePieceTokenizer:
         )
 
 
+def serialize_model_proto(model: SpModel) -> bytes:
+    """SpModel -> ModelProto wire bytes (inverse of parse_model_proto).
+
+    Used when a tokenizer is constructed from somewhere other than a
+    .model file (e.g. GGUF tokenizer.ggml metadata) but still needs the
+    canonical byte form — model cards publish exactly these bytes."""
+    import struct
+
+    def varint(n: int) -> bytes:
+        out = b""
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            out += bytes([b | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def ld(fno: int, payload: bytes) -> bytes:
+        return varint((fno << 3) | 2) + varint(len(payload)) + payload
+
+    def vi(fno: int, val: int) -> bytes:
+        if val < 0:
+            val += 1 << 64  # two's-complement (disabled ids are -1)
+        return varint(fno << 3) + varint(val)
+
+    def f32(fno: int, val: float) -> bytes:
+        return varint((fno << 3) | 5) + struct.pack("<f", val)
+
+    blob = b"".join(
+        ld(1, ld(1, p.piece.encode()) + f32(2, p.score) + vi(3, p.type))
+        for p in model.pieces
+    )
+    trainer = (
+        vi(3, model.model_type) + vi(40, model.unk_id)
+        + vi(41, model.bos_id) + vi(42, model.eos_id)
+    )
+    norm = ld(1, model.normalizer_name.encode()) + vi(
+        3, int(model.add_dummy_prefix)
+    ) + vi(4, int(model.remove_extra_whitespaces)) + vi(
+        5, int(model.escape_whitespaces)
+    )
+    return blob + ld(2, trainer) + ld(4, norm)
+
+
 def sp_model_path(model_dir: str) -> Optional[str]:
     for name in ("tokenizer.model", "spiece.model", "sentencepiece.model"):
         p = os.path.join(model_dir, name)

@@ -47,6 +47,10 @@ def _w_kv(f, key, vtype, value):
         for it in items:
             if etype == _T_STRING:
                 _w_string(f, it)
+            elif etype == _T_U32:
+                f.write(struct.pack("<I", it))
+            elif etype == _T_F32:
+                f.write(struct.pack("<f", it))
             else:
                 raise NotImplementedError
     else:
@@ -255,3 +259,75 @@ def test_hub_resolution(tmp_path, monkeypatch):
     monkeypatch.delenv("DYN_ALLOW_DOWNLOAD", raising=False)
     with pytest.raises(FileNotFoundError, match="Pre-stage"):
         resolve_model("org/absent")
+
+
+async def test_factory_serves_from_gguf_embedded_tokenizer(tmp_path):
+    """A GGUF in a bare directory (no tokenizer files) serves using the
+    tokenizer embedded in its own tokenizer.ggml metadata (reference
+    gguf_tokenizer.rs convert_gguf_to_hf_tokenizer), and the resulting
+    model card publishes/downloads that tokenizer intact."""
+    from dynamo_tpu.engine.jax_engine.factory import build_jax_engine
+    from dynamo_tpu.fabric.client import FabricClient
+    from dynamo_tpu.fabric.state import FabricState
+    from dynamo_tpu.model_card import ModelDeploymentCard
+    from tests.test_colocated_disagg import collect_tokens
+
+    cfg = tiny_cfg()
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    bare = tmp_path / "bare"
+    bare.mkdir()
+    path = str(bare / "tiny.gguf")
+    build_gguf_from_params(path, cfg, params)
+
+    # rewrite the file with tokenizer.ggml metadata: SP-style pieces
+    # covering the model vocab (64 ids), with scores and types
+    pieces = ["<unk>", "<s>", "</s>"] + [f"▁w{i}" for i in range(61)]
+    types = [2, 3, 3] + [1] * 61
+    scores = [0.0, 0.0, 0.0] + [-float(i) / 10 for i in range(61)]
+    tensors = {}
+    g1 = GgufFile(path)
+    for name in g1.tensors:
+        # copy: F32 tensors are views into the mmap, which must close
+        tensors[name] = (np.array(g1.tensor(name)), GGML_F32)
+    g1.close()
+    meta = {
+        "general.architecture": (_T_STRING, "llama"),
+        "llama.embedding_length": (_T_U32, cfg.hidden_size),
+        "llama.feed_forward_length": (_T_U32, cfg.intermediate_size),
+        "llama.block_count": (_T_U32, cfg.num_layers),
+        "llama.attention.head_count": (_T_U32, cfg.num_heads),
+        "llama.attention.head_count_kv": (_T_U32, cfg.num_kv_heads),
+        "llama.attention.key_length": (_T_U32, cfg.head_dim),
+        "llama.context_length": (_T_U32, cfg.max_position_embeddings),
+        "llama.vocab_size": (_T_U32, cfg.vocab_size),
+        "llama.rope.freq_base": (_T_F32, cfg.rope_theta),
+        "llama.attention.layer_norm_rms_epsilon": (_T_F32, cfg.rms_eps),
+        "tokenizer.ggml.model": (_T_STRING, "llama"),
+        "tokenizer.ggml.tokens": (_T_ARRAY, (_T_STRING, pieces)),
+        "tokenizer.ggml.scores": (_T_ARRAY, (_T_F32, scores)),
+        "tokenizer.ggml.token_type": (_T_ARRAY, (_T_U32, types)),
+        "tokenizer.ggml.unknown_token_id": (_T_U32, 0),
+        "tokenizer.ggml.bos_token_id": (_T_U32, 1),
+        "tokenizer.ggml.eos_token_id": (_T_U32, 2),
+    }
+    write_gguf(path, meta, tensors)
+
+    engine, mdc = await build_jax_engine(
+        path, kv_block_size=4, max_batch=4, num_blocks=64
+    )
+    assert mdc.tokenizer_kind == "sp"
+    tok = mdc.load_tokenizer()
+    enc = tok.encode("w1 w2", add_special_tokens=False)
+    assert tok.decode(enc.ids) == "w1 w2"
+    toks = await collect_tokens(engine, list(range(2, 10)))
+    assert len(toks) == 8
+    await engine.close()
+
+    # publish/download preserves the embedded tokenizer
+    fabric = FabricClient.in_process(FabricState())
+    await mdc.publish(fabric)
+    got = await ModelDeploymentCard.download(fabric, mdc.slug)
+    tok2 = got.load_tokenizer()
+    assert tok2.encode("w5", add_special_tokens=False).ids == tok.encode(
+        "w5", add_special_tokens=False
+    ).ids
