@@ -644,9 +644,8 @@ class JaxEngine:
             async with self._device_lock:
                 sample = await loop.run_in_executor(
                     None,
-                    lambda: tuple(
-                        np.asarray(x)
-                        for x in self.runner.prefill(
+                    lambda: self.runner.fetch_sample(
+                        self.runner.prefill(
                             replay,
                             seq.block_ids,
                             seq.temperature,
@@ -696,9 +695,8 @@ class JaxEngine:
         async with self._device_lock:
             sample = await loop.run_in_executor(
                 None,
-                lambda: tuple(
-                    np.asarray(x)
-                    for x in self.runner.prefill_mm(
+                lambda: self.runner.fetch_sample(
+                    self.runner.prefill_mm(
                         list(seq.token_ids),
                         seq.block_ids,
                         embeds,
@@ -730,9 +728,8 @@ class JaxEngine:
         async with self._device_lock:
             sample = await loop.run_in_executor(
                 None,
-                lambda: tuple(
-                    np.asarray(x)
-                    for x in self.runner.prefill_packed_arrays(**packed)
+                lambda: self.runner.fetch_sample(
+                    self.runner.prefill_packed_arrays(**packed)
                 ),
             )
         toks, lps, tids, tlps = sample
@@ -763,9 +760,8 @@ class JaxEngine:
         async with self._device_lock:
             sample = await loop.run_in_executor(
                 None,
-                lambda: tuple(
-                    np.asarray(x)
-                    for x in self.runner.prefill_chunk(
+                lambda: self.runner.fetch_sample(
+                    self.runner.prefill_chunk(
                         chunk, start, total, seq.block_ids,
                         seq.temperature, seq.top_p, seq.top_k,
                         rep_pen=seq.rep_pen, key_data=key_row,
@@ -920,9 +916,8 @@ class JaxEngine:
         async with self._device_lock:
             sample = await loop.run_in_executor(
                 None,
-                lambda: tuple(
-                    np.asarray(x)
-                    for x in self.runner.prefill(
+                lambda: self.runner.fetch_sample(
+                    self.runner.prefill(
                         seq.token_ids,
                         seq.block_ids,
                         seq.temperature,
@@ -969,9 +964,8 @@ class JaxEngine:
             async with self._device_lock:
                 sample = await loop.run_in_executor(
                     None,
-                    lambda: tuple(
-                        np.asarray(x)
-                        for x in self.runner.prefill(
+                    lambda: self.runner.fetch_sample(
+                        self.runner.prefill(
                             list(req.token_ids),
                             block_ids,
                             req.temperature,
@@ -1048,9 +1042,8 @@ class JaxEngine:
             async with self._device_lock:
                 sample = await loop.run_in_executor(
                     None,
-                    lambda: tuple(
-                        np.asarray(x)
-                        for x in self.runner.prefill(
+                    lambda: self.runner.fetch_sample(
+                        self.runner.prefill(
                             list(req.token_ids),
                             block_ids,
                             req.temperature,
@@ -1219,9 +1212,8 @@ class JaxEngine:
         async with self._device_lock:
             sample = await loop.run_in_executor(
                 None,
-                lambda: tuple(
-                    np.asarray(x)
-                    for x in self.runner.decode(
+                lambda: self.runner.fetch_sample(
+                    self.runner.decode(
                         self._tokens,
                         self._positions,
                         self._block_tables,

@@ -147,6 +147,7 @@ class ModelRunner:
         self.mesh = mesh
         self.cp_min_tokens = cp_min_tokens
         self._rng_seed = rng_seed
+        self._pack_fetch_jit = None  # lazy: see fetch_sample
         self._step_counter = 0
         self._key_offset = 0  # monotonic decode-key counter (never reused)
         self.prefill_buckets = sorted(
@@ -563,6 +564,40 @@ class ModelRunner:
         logits = mask_eos_logits(logits, eos_ids, eos_suppress)
         out = sample_tokens_full(logits, None, temps, top_ps, top_ks, keys=keys)
         return out, k_cache, v_cache
+
+    def fetch_sample(self, out: tuple) -> tuple[np.ndarray, ...]:
+        """Fetch a (tokens, logprobs, top_ids, top_lps) output tuple with
+        ONE host round trip: the device arrays are packed into a single
+        flat f32 buffer on device (token ids < 2^24 are exact in f32) and
+        split back on the host. Four separate fetches cost ~65 ms EACH
+        under the TPU tunnel — this turns every prefill/packed/chunk call
+        from ~260 ms of fetch overhead into one round trip. Tuples that
+        are already host numpy (multihost SpmdModelRunner pre-fetches)
+        pass through untouched."""
+        if isinstance(out[0], np.ndarray):
+            return tuple(out)
+        if self._pack_fetch_jit is None:
+            self._pack_fetch_jit = jax.jit(
+                lambda *xs: jnp.concatenate(
+                    [jnp.ravel(x).astype(jnp.float32) for x in xs]
+                ),
+                **(
+                    {"out_shardings": self._repl}
+                    if self._repl is not None
+                    else {}
+                ),
+            )
+        flat = np.asarray(self._pack_fetch_jit(*out))
+        outs: list[np.ndarray] = []
+        off = 0
+        for o in out:
+            n = int(np.prod(o.shape)) if o.shape else 1
+            piece = flat[off:off + n].reshape(o.shape)
+            off += n
+            # restore each output's dtype (ids must come back int32, not a
+            # float32 trap for consumers that index/serialize with them)
+            outs.append(np.asarray(piece, dtype=o.dtype))
+        return tuple(outs)
 
     def _next_key_data(self) -> np.ndarray:
         """Default per-call RNG stream: raw threefry key data built on the

@@ -343,7 +343,6 @@ def tokenizer_from_gguf(g: GgufFile):
             "tokenizer.json next to the .gguf file"
         )
     from dynamo_tpu.sp_tokenizer import (
-        SentencePieceTokenizer,
         SpModel,
         SpPiece,
         serialize_model_proto,
@@ -364,16 +363,19 @@ def tokenizer_from_gguf(g: GgufFile):
             for t, s, ty in zip(tokens, scores, types)
         ],
         model_type=1,  # SP scores -> unigram Viterbi (llama.cpp SPM)
+        # llama-family SPM semantics: identity normalizer, whitespace kept
+        # verbatim (newlines ride byte-fallback pieces — folding them to
+        # spaces would tokenize differently than llama.cpp does)
+        normalizer_name="identity",
+        remove_extra_whitespaces=False,
         unk_id=int(md.get("tokenizer.ggml.unknown_token_id", 0)),
         bos_id=int(md.get("tokenizer.ggml.bos_token_id", 1)),
         eos_id=int(md.get("tokenizer.ggml.eos_token_id", 2)),
         add_dummy_prefix=bool(md.get("tokenizer.ggml.add_space_prefix", True)),
     )
-    sp = SentencePieceTokenizer(model)
-    eos = [model.eos_id] if model.eos_id >= 0 else []
-    tok = TokenizerWrapper(sp, eos)
-    tok.sp_model_bytes = serialize_model_proto(model)
-    return tok
+    # round-trip through the canonical byte form so the tokenizer a worker
+    # serves is BY CONSTRUCTION the one the model card publishes
+    return TokenizerWrapper.from_sp_bytes(serialize_model_proto(model))
 
 
 # --------------------------------------------------------------- mapping
