@@ -365,25 +365,34 @@ def compile_phase(engine) -> None:
     if H > 1:
         from dynamo_tpu.engine.jax_engine.model_runner import MAX_EOS_IDS as EK
 
-        timed(
-            f"decode_multi@H{H}B{B}",
-            lambda: np.asarray(
-                runner.decode_multi(
-                    H,
-                    np.zeros(B, np.int32),
-                    np.zeros(B, np.int32),
-                    np.zeros((B, runner.max_blocks_per_seq), np.int32),
-                    np.zeros(B, np.float32),
-                    np.ones(B, np.float32),
-                    np.zeros(B, np.int32),
-                    np.zeros((B, 2), np.uint32),
-                    np.zeros(B, bool),
-                    np.ones(B, np.int32),
-                    np.zeros(B, np.int32),
-                    np.full((B, EK), -1, np.int32),
-                )
-            ),
-        )
+        try:
+            timed(
+                f"decode_multi@H{H}B{B}",
+                lambda: np.asarray(
+                    runner.decode_multi(
+                        H,
+                        np.zeros(B, np.int32),
+                        np.zeros(B, np.int32),
+                        np.zeros((B, runner.max_blocks_per_seq), np.int32),
+                        np.zeros(B, np.float32),
+                        np.ones(B, np.float32),
+                        np.zeros(B, np.int32),
+                        np.zeros((B, 2), np.uint32),
+                        np.zeros(B, bool),
+                        np.ones(B, np.int32),
+                        np.zeros(B, np.int32),
+                        np.full((B, EK), -1, np.int32),
+                    )
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — e.g. HBM OOM at compile
+            # a missing horizon program must not cost the metric of
+            # record: fall back to single-step decode and keep measuring
+            heartbeat(f"decode_multi compile failed ({e!r:.200}); horizon=1")
+            STATE.setdefault("extra_diag", []).append(
+                "decode_multi_fallback_h1"
+            )
+            engine.config.decode_horizon = 1
 
 
 def sharegpt_workload(n: int, vocab: int, max_len: int, seed: int = 0):
