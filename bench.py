@@ -92,16 +92,17 @@ def _metrics_from_state(partial: bool) -> dict:
     )
     ttfts = STATE["ttfts"]
     p50_ttft_ms = statistics.median(ttfts) * 1e3 if ttfts else None
-    mfu = None
-    if tok_s_chip and STATE["model"] and STATE["model"] != "tiny":
-        peak = tpu_peak_flops(STATE["device_kind"])
-        mfu = tok_s_chip * 2 * LLAMA3_8B_PARAMS / peak
-    # vs_baseline is only meaningful for the headline model on real TPU;
-    # tiny / cpu-fallback numbers must never masquerade as the metric of
-    # record (VERDICT r3 weak #8).
+    # vs_baseline and MFU are only meaningful for the headline model on
+    # real TPU; tiny / cpu-fallback numbers must never masquerade as the
+    # metric of record (VERDICT r3 weak #8 — the fallback once reported an
+    # "MFU" computed from 8B FLOPs it never ran, on a CPU).
     headline = (
         STATE["model"] == "llama3-8b-int8" and STATE["device"] == "tpu"
     )
+    mfu = None
+    if tok_s_chip and headline:
+        peak = tpu_peak_flops(STATE["device_kind"])
+        mfu = tok_s_chip * 2 * LLAMA3_8B_PARAMS / peak
     out = {
         "metric": "output_tok_s_per_chip",
         "value": round(tok_s_chip, 2) if tok_s_chip else None,
