@@ -1088,6 +1088,31 @@ class JaxEngine:
         finally:
             self.allocator.free(block_ids)
 
+    def _lane_remaining(self, seq: _Sequence) -> int:
+        """Tokens this lane may still emit (max_new and model-length caps)."""
+        return max(
+            1,
+            min(
+                seq.max_new - seq.num_generated,
+                self.config.max_model_len - len(seq.token_ids),
+            ),
+        )
+
+    def _fill_lane(self, seq: _Sequence) -> int:
+        """Write one active lane's shared per-step inputs into the batch
+        arrays (both decode phases use the identical seven); returns the
+        fed token's position."""
+        i = seq.slot
+        pos = seq.pos - 1  # position of the token being fed
+        self._tokens[i] = seq.token_ids[-1]
+        self._positions[i] = pos
+        self._block_tables[i, : len(seq.block_ids)] = seq.block_ids
+        self._temps[i] = seq.temperature
+        self._top_ps[i] = seq.top_p
+        self._top_ks[i] = seq.top_k
+        self._keys[i] = self._key_row(seq)
+        return pos
+
     def _horizon_for(self, active: list[_Sequence]) -> int:
         """Pick this iteration's decode horizon. 1 = single-step path."""
         H = self.config.decode_horizon
@@ -1106,14 +1131,7 @@ class JaxEngine:
             return 1
         # no lane can emit more than its remaining budget; don't burn
         # frozen all-lane steps when everyone is nearly done
-        max_rem = max(
-            min(
-                s.max_new - s.num_generated,
-                self.config.max_model_len - len(s.token_ids),
-            )
-            for s in active
-        )
-        H = max(1, min(H, max_rem))
+        H = max(1, min(H, max(self._lane_remaining(s) for s in active)))
         if H == 1:
             return 1
         # preallocate KV blocks to cover every horizon write — capped at
@@ -1122,16 +1140,7 @@ class JaxEngine:
         # single-step (its just-in-time alloc can preempt).
         bs = self.config.block_size
         for seq in active:
-            lane_steps = min(
-                H,
-                max(
-                    1,
-                    min(
-                        seq.max_new - seq.num_generated,
-                        self.config.max_model_len - len(seq.token_ids),
-                    ),
-                ),
-            )
+            lane_steps = min(H, self._lane_remaining(seq))
             last_write = (seq.pos - 1) + (lane_steps - 1)
             need = last_write // bs + 1 - len(seq.block_ids)
             if need > 0:
@@ -1155,17 +1164,10 @@ class JaxEngine:
         self._top_ks.fill(0)
         bs = self.config.block_size
         for seq in active:
-            i = seq.slot
-            pos = seq.pos - 1  # position of the token being fed
-            self._tokens[i] = seq.token_ids[-1]
-            self._positions[i] = pos
-            nb = len(seq.block_ids)
-            self._block_tables[i, :nb] = seq.block_ids
-            self._slot_indices[i] = seq.block_ids[pos // bs] * bs + pos % bs
-            self._temps[i] = seq.temperature
-            self._top_ps[i] = seq.top_p
-            self._top_ks[i] = seq.top_k
-            self._keys[i] = self._key_row(seq)
+            pos = self._fill_lane(seq)
+            self._slot_indices[seq.slot] = (
+                seq.block_ids[pos // bs] * bs + pos % bs
+            )
         penalties = None
         eos_mask = None
         any_pen = any(seq.has_penalties for seq in active)
@@ -1250,7 +1252,6 @@ class JaxEngine:
         from dynamo_tpu.ops.sampling import MAX_EOS_IDS
 
         B = self.config.max_batch
-        bs = self.config.block_size
         self._block_tables.fill(0)
         self._positions.fill(0)
         self._temps.fill(0.0)
@@ -1262,23 +1263,9 @@ class JaxEngine:
         eos_ids = np.full((B, MAX_EOS_IDS), -1, np.int32)
         for seq in active:
             i = seq.slot
-            pos = seq.pos - 1
-            self._tokens[i] = seq.token_ids[-1]
-            self._positions[i] = pos
-            nb = len(seq.block_ids)
-            self._block_tables[i, :nb] = seq.block_ids
-            self._temps[i] = seq.temperature
-            self._top_ps[i] = seq.top_p
-            self._top_ks[i] = seq.top_k
-            self._keys[i] = self._key_row(seq)
+            self._fill_lane(seq)
             act[i] = True
-            limit_rem[i] = max(
-                1,
-                min(
-                    seq.max_new - seq.num_generated,
-                    self.config.max_model_len - len(seq.token_ids),
-                ),
-            )
+            limit_rem[i] = self._lane_remaining(seq)
             min_rem[i] = max(0, seq.min_tokens - seq.num_generated)
             eos_ids[i] = seq.eos_row
         async with self._device_lock:
