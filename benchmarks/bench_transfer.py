@@ -78,10 +78,22 @@ def main() -> None:
         block_size = 16
     else:
         cfg = L.LlamaConfig.tiny(vocab_size=256)
-        if args.reshard:  # tp=4 dest needs >= 4 kv heads to shard
+        if args.reshard:
+            # both TP degrees must divide the kv-head count: derive it
+            # from the requested shape instead of capping at 4
             import dataclasses
 
-            cfg = dataclasses.replace(cfg, num_kv_heads=4)
+            import math
+
+            tps = [int(x) for x in args.reshard.split(",")]
+            heads = math.lcm(4, *tps)
+            # every column/row-parallel dim must divide by each TP degree:
+            # derive the whole geometry from the head count
+            cfg = dataclasses.replace(
+                cfg, num_kv_heads=heads, num_heads=heads,
+                hidden_size=heads * 16, intermediate_size=heads * 32,
+                vocab_size=heads * 32,
+            )
         params = L.init_params(cfg, jax.random.PRNGKey(0))
         block_size = 16
 
