@@ -112,6 +112,7 @@ async def test_worker_kill_restart_and_recovery():
         await sup.stop_all()
 
 
+@pytest.mark.timeout(420)  # 3 jax workers compile serially under load
 async def test_prefill_worker_kill_redelivery():
     """Disagg: kill one of two prefill workers while requests are in
     flight; the fabric queue redelivers unacked work and every request
@@ -137,7 +138,7 @@ async def test_prefill_worker_kill_redelivery():
         async with aiohttp.ClientSession() as s:
             # gate on a healthy end-to-end round trip (engine compile done,
             # decode worker stable) before injecting the fault
-            for _ in range(120):
+            for _ in range(240):  # loaded boxes compile slowly
                 r = await _chat(s, base, model, prompt, max_tokens=2)
                 if r.status == 200:
                     break
