@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -20,11 +21,15 @@ import numpy as np
 
 
 def bench_it(fn, warmup=3, iters=20):
-    for _ in range(warmup):
-        fn()
+    """fn(i) is called with a fresh iteration index — USE IT to vary the
+    input content. The axon tunnel memoizes identical (program, inputs)
+    executions, so timing repeated identical calls measures the cache,
+    not the device (it once reported 53 TB/s of "HBM bandwidth")."""
+    for i in range(warmup):
+        fn(i)
     t0 = time.perf_counter()
-    for _ in range(iters):
-        fn()
+    for i in range(iters):
+        fn(warmup + i)
     return (time.perf_counter() - t0) / iters
 
 
@@ -42,6 +47,11 @@ def main():
 
     root = str(Path(__file__).resolve().parents[1])
     jax.config.update("jax_compilation_cache_dir", f"{root}/.jax_cache")
+    # the axon sitecustomize overrides JAX_PLATFORMS at interpreter start;
+    # honor the env (a CPU run must not try to claim a wedged tunnel)
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and jax.config.jax_platforms != want:
+        jax.config.update("jax_platforms", want)
 
     sys.path.insert(0, root)
     import __graft_entry__ as graft
@@ -70,33 +80,35 @@ def main():
     top_ks = np.zeros((B,), np.int32)
     keys = runner._next_decode_keys(B)
 
-    def put_all():
+    def put_all(i):
         arrs = [
-            jax.device_put(a)
-            for a in (tokens, positions, bt, slots, temps, top_ps, top_ks, keys)
-        ]
+            jax.device_put(a + (i % 7))
+            for a in (tokens, positions, slots, temps, top_ps, top_ks)
+        ] + [jax.device_put(bt), jax.device_put(keys + np.uint32(i))]
         for a in arrs:
             a.block_until_ready()
 
     results["h2d_8arrays_ms"] = bench_it(put_all) * 1e3
 
-    one = np.zeros((4,), np.int32)
-
-    def put_one():
-        jax.device_put(one).block_until_ready()
+    def put_one(i):
+        jax.device_put(np.full((4,), i, np.int32)).block_until_ready()
 
     results["h2d_1array_ms"] = bench_it(put_one) * 1e3
 
+    bump = jax.jit(lambda x, c: x + c)
     scalar_dev = jax.device_put(np.zeros((4,), np.int32))
 
-    def fetch_one():
-        np.asarray(scalar_dev)
+    def fetch_one(i):
+        # a fresh RESULT each time: fetching a cached array is free
+        np.asarray(bump(scalar_dev, i))
 
     results["d2h_1array_ms"] = bench_it(fetch_one) * 1e3
 
     # ---- 2. serving-path decode (host numpy in, fetch out)
-    def serving_step():
-        out = runner.decode(tokens, positions, bt, slots, temps, top_ps, top_ks)
+    def serving_step(i):
+        out = runner.decode(
+            tokens + (i % 16), positions, bt, slots, temps, top_ps, top_ks
+        )
         return tuple(np.asarray(o) for o in out)
 
     serving_s = bench_it(serving_step, warmup=4, iters=15)
@@ -110,10 +122,12 @@ def main():
         d(temps), d(top_ps), d(top_ks),
     ]
 
-    def compute_step():
+    def compute_step(i):
         out, k2, v2 = runner._decode_fn(*dev_args)
-        # donation invalidates the cache refs; rebind for the next call
+        # donation invalidates the cache refs; rebind for the next call,
+        # and chain the sampled tokens so inputs differ every iteration
         dev_args[1], dev_args[2] = k2, v2
+        dev_args[3] = out[0]
         out[0].block_until_ready()
 
     compute_s = bench_it(compute_step, warmup=4, iters=15)
@@ -124,9 +138,9 @@ def main():
     # ---- prefill
     ptoks = np.random.randint(0, 1000, (args.prefill,), dtype=np.int32)
 
-    def prefill_step():
+    def prefill_step(i):
         r = runner.prefill(
-            [int(t) for t in ptoks],
+            [int((t + i) % 1000) for t in ptoks],
             block_ids=list(range(args.prefill // 16)),
             temperature=0.0, top_p=1.0, top_k=0,
         )
