@@ -84,7 +84,7 @@ def main():
         arrs = [
             jax.device_put(a + (i % 7))
             for a in (tokens, positions, slots, temps, top_ps, top_ks)
-        ] + [jax.device_put(bt), jax.device_put(keys + np.uint32(i))]
+        ] + [jax.device_put(bt + (i % 7)), jax.device_put(keys + np.uint32(i))]
         for a in arrs:
             a.block_until_ready()
 

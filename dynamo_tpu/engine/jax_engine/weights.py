@@ -62,6 +62,12 @@ def load_hf_safetensors(
         t = tensors.pop(name)
         return jnp.asarray(t).astype(dtype)
 
+    def norm(name: str) -> jax.Array:
+        # Gemma stores RMSNorm weights as w with output (1+w)*x̂ — fold the
+        # +1 here so the forward pass stays family-agnostic
+        w = get(name)
+        return w + 1 if config.norm_plus_one else w
+
     def lin(name: str) -> Any:
         # HF stores [out, in]; we use [in, out]
         return maybe_quantize(get(name).T, quantize)
@@ -70,12 +76,12 @@ def load_hf_safetensors(
     for i in range(config.num_layers):
         p = f"model.layers.{i}."
         layer = {
-            "attn_norm": get(p + "input_layernorm.weight"),
+            "attn_norm": norm(p + "input_layernorm.weight"),
             "wq": lin(p + "self_attn.q_proj.weight"),
             "wk": lin(p + "self_attn.k_proj.weight"),
             "wv": lin(p + "self_attn.v_proj.weight"),
             "wo": lin(p + "self_attn.o_proj.weight"),
-            "mlp_norm": get(p + "post_attention_layernorm.weight"),
+            "mlp_norm": norm(p + "post_attention_layernorm.weight"),
         }
         if config.attn_bias:
             layer.update(
@@ -111,7 +117,7 @@ def load_hf_safetensors(
     params: dict[str, Any] = {
         "embed": get("model.embed_tokens.weight"),
         "layers": layers,
-        "final_norm": get("model.norm.weight"),
+        "final_norm": norm("model.norm.weight"),
     }
     if not config.tie_word_embeddings:
         if "lm_head.weight" in tensors:

@@ -396,8 +396,18 @@ def config_from_gguf(g: GgufFile):
     n_vocab = md.get(f"{arch}.vocab_size") or (
         len(md.get("tokenizer.ggml.tokens", [])) or 32000
     )
+    if arch.startswith("gemma") and arch != "gemma":
+        # gemma2/gemma3/gemma3n/...: soft-caps, local attention — refuse
+        # rather than load as a silently-wrong plain llama
+        raise NotImplementedError(
+            f"GGUF architecture {arch!r} not supported (only gemma v1)"
+        )
     return LlamaConfig(
         attn_bias=arch.startswith("qwen2"),
+        mlp_act="gelu_tanh" if arch == "gemma" else "silu",
+        embed_scale=arch == "gemma",
+        norm_plus_one=arch == "gemma",
+        tie_word_embeddings=arch == "gemma",
         vocab_size=int(n_vocab),
         hidden_size=hidden,
         intermediate_size=int(key("feed_forward_length", 4 * hidden)),
@@ -434,15 +444,17 @@ def params_from_gguf(g: GgufFile, cfg=None, dtype=None):
     cfg = cfg or config_from_gguf(g)
     dtype = dtype or ml_dtypes.bfloat16
 
-    def get(name, transpose=False):
+    def get(name, transpose=False, plus_one=False):
         a = g.tensor(name)
         if transpose:
             a = a.T
+        if plus_one and cfg.norm_plus_one:  # gemma (1+w) RMSNorm weights
+            a = a + 1
         return jnp.asarray(np.ascontiguousarray(a).astype(dtype))
 
     params: dict[str, Any] = {
         "embed": get("token_embd.weight"),
-        "final_norm": get("output_norm.weight"),
+        "final_norm": get("output_norm.weight", plus_one=True),
         "layers": [],
     }
     if "output.weight" in g.tensors:
@@ -450,7 +462,10 @@ def params_from_gguf(g: GgufFile, cfg=None, dtype=None):
     for i in range(cfg.num_layers):
         layer = {}
         for suffix, (ours, tr) in _LAYER_MAP.items():
-            layer[ours] = get(f"blk.{i}.{suffix}", transpose=tr)
+            layer[ours] = get(
+                f"blk.{i}.{suffix}", transpose=tr,
+                plus_one=ours in ("attn_norm", "mlp_norm"),
+            )
         # qwen2-family q/k/v biases, when the file ships them
         for suffix, ours in (
             ("attn_q.bias", "bq"), ("attn_k.bias", "bk"),

@@ -51,6 +51,13 @@ class LlamaConfig:
     rope_scaling: Optional[dict] = None
     # Qwen2-family: bias on the q/k/v projections (o/mlp stay bias-free)
     attn_bias: bool = False
+    # Gemma-family: GeGLU FFN instead of SwiGLU ("gelu_tanh"), embeddings
+    # scaled by sqrt(hidden) at lookup, and (1+w) RMSNorm weights — the
+    # +1 is folded into the stored weights at load time, so the forward
+    # pass stays identical
+    mlp_act: str = "silu"
+    embed_scale: bool = False
+    norm_plus_one: bool = False
     # attention kernel choice for THIS model instance (None -> process
     # default): lets two runners in one process use different impls
     # without stomping the ops-level global (e.g. a TP-meshed engine on
@@ -73,8 +80,23 @@ class LlamaConfig:
         is_qwen2 = d.get("model_type", "").startswith("qwen2") or any(
             a.startswith("Qwen2") for a in d.get("architectures") or []
         )
+        # Gemma (v1): GeGLU + scaled embeddings + (1+w) norms + tied head.
+        # Gemma-2/3 add logit soft-caps and alternating local attention —
+        # refuse those explicitly rather than serve a silently-wrong model.
+        mt = d.get("model_type", "")
+        archs = d.get("architectures") or []
+        if mt in ("gemma2", "gemma3", "gemma3_text") or any(
+            a.startswith(("Gemma2", "Gemma3")) for a in archs
+        ):
+            raise NotImplementedError(
+                "gemma2/gemma3 (soft-caps, local attention) not supported"
+            )
+        is_gemma = mt == "gemma" or any(a.startswith("GemmaFor") for a in archs)
         return cls(
             attn_bias=is_qwen2,
+            mlp_act="gelu_tanh" if is_gemma else "silu",
+            embed_scale=is_gemma,
+            norm_plus_one=is_gemma,
             vocab_size=d.get("vocab_size", 32000),
             hidden_size=hidden,
             intermediate_size=d.get("intermediate_size", 4 * hidden),
@@ -85,7 +107,7 @@ class LlamaConfig:
             rope_theta=d.get("rope_theta", 10000.0),
             rms_eps=d.get("rms_norm_eps", 1e-5),
             max_position_embeddings=d.get("max_position_embeddings", 8192),
-            tie_word_embeddings=d.get("tie_word_embeddings", False),
+            tie_word_embeddings=d.get("tie_word_embeddings", is_gemma),
             rope_scaling=d.get("rope_scaling"),
             num_experts=d.get("num_local_experts", 0),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
@@ -222,6 +244,14 @@ def param_count(config: LlamaConfig) -> int:
 # ----------------------------------------------------------------- forward
 
 
+def _embed(params, cfg, tokens):
+    """Token embedding lookup; Gemma scales by sqrt(hidden) here."""
+    x = params["embed"][tokens].astype(params["embed"].dtype)
+    if cfg.embed_scale:
+        x = x * jnp.sqrt(jnp.float32(cfg.hidden_size)).astype(x.dtype)
+    return x
+
+
 def _qkv(x, layer, cfg, inv_freqs, positions):
     """Shared projection head: norm -> q/k/v -> RoPE. One definition so the
     serial, context-parallel, and decode paths cannot drift. Qwen2-family
@@ -307,7 +337,13 @@ def _mlp(x, layer, cfg, mesh=None):
         return x + y
     gate = linear(h, layer["wg"])
     up = linear(h, layer["wu"])
-    return x + linear(swiglu(gate, up), layer["wd"])
+    if cfg.mlp_act == "gelu_tanh":  # Gemma GeGLU
+        act = jax.nn.gelu(gate.astype(jnp.float32), approximate=True).astype(
+            gate.dtype
+        ) * up
+    else:
+        act = swiglu(gate, up)
+    return x + linear(act, layer["wd"])
 
 
 def _logits(x, params, cfg):
@@ -331,7 +367,7 @@ def prefill(
     attn_head_axis=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Process a prompt; returns (last_token_logits [V], k_cache, v_cache)."""
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = _embed(params, cfg, tokens)
     return _prefill_from_embeds(
         params, cfg, x, valid_len, k_cache, v_cache, block_table,
         mesh=mesh, attn_head_axis=attn_head_axis,
@@ -358,7 +394,7 @@ def prefill_mm(
     (examples/multimodal/components/prefill_worker.py:249-258). One static
     [M, hidden] dynamic-update-slice keeps this a single compiled program
     regardless of where the image sits in the prompt."""
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = _embed(params, cfg, tokens)
     x = jax.lax.dynamic_update_slice(
         x, mm_embeds.astype(x.dtype), (mm_start, jnp.int32(0))
     )
@@ -419,7 +455,7 @@ def prefill_chunk(
     C = tokens.shape[0]
     inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     positions = chunk_start + jnp.arange(C, dtype=jnp.int32)
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = _embed(params, cfg, tokens)
     for i, layer in enumerate(params["layers"]):
         q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
         kc, vc = write_chunk_kv(
@@ -461,7 +497,7 @@ def prefill_packed(
     """
     P = tokens.shape[0]
     inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = _embed(params, cfg, tokens)
     for i, layer in enumerate(params["layers"]):
         q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
         kc, vc = write_decode_kv(k_cache[i], v_cache[i], k, v, slot_indices)
@@ -504,7 +540,7 @@ def prefill_context_parallel(
     P_len = tokens.shape[0]
     inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     positions = jnp.arange(P_len, dtype=jnp.int32)
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = _embed(params, cfg, tokens)
     k_all, v_all = [], []
     for i, layer in enumerate(params["layers"]):
         q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
@@ -539,7 +575,7 @@ def embed_pooled(
     inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     P = tokens.shape[0]
     positions = jnp.arange(P, dtype=jnp.int32)
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = _embed(params, cfg, tokens)
     for layer in params["layers"]:
         q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
         attn = causal_prefill_attention(q, k, v, valid_len, impl=cfg.attn_impl)
@@ -565,7 +601,7 @@ def decode(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for a batch; returns (logits [B, V], caches)."""
     inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
-    x = params["embed"][tokens].astype(params["embed"].dtype)
+    x = _embed(params, cfg, tokens)
     for i, layer in enumerate(params["layers"]):
         x, kc, vc = _attn_decode(
             x, layer, cfg, inv_freqs, positions,

@@ -89,6 +89,18 @@ def shard_stacked_pp(
 # ------------------------------------------------------------ stage math
 
 
+def _check_pp_supported(cfg) -> None:
+    """The pp forward hardcodes the llama/qwen dense path (SwiGLU,
+    unscaled embeddings); family flags it does not implement must refuse
+    loudly instead of serving silently-wrong outputs."""
+    if cfg.mlp_act != "silu" or cfg.embed_scale:
+        raise NotImplementedError(
+            "pipeline parallelism supports the SwiGLU/unscaled-embedding "
+            "families only (llama/qwen2/mixtral-dense); gemma's GeGLU and "
+            "embedding scaling are not plumbed through the pp stages"
+        )
+
+
 def _scan_layers(cfg, layers, x, positions, attend, write_kv, k_cache, v_cache):
     """Apply this stage's local layer stack with lax.scan.
 
@@ -146,6 +158,7 @@ def prefill_pp(
     latency path; decode_pp below overlaps microbatches). Every stage
     writes its own layers' KV pages. Returns (last-token logits [V],
     caches)."""
+    _check_pp_supported(cfg)
     pp = mesh.shape["pp"]
     Pl = tokens.shape[0]
     positions = jnp.arange(Pl, dtype=jnp.int32)
@@ -240,6 +253,7 @@ def decode_pp(
     rotation: B must divide by pp; microbatch m enters stage 0 at tick m,
     exits stage pp-1 at tick m+pp-1 — every stage busy in the steady
     state. Returns (logits [B, V], caches)."""
+    _check_pp_supported(cfg)
     from dynamo_tpu.ops.attention import write_decode_kv
 
     pp = mesh.shape["pp"]
