@@ -106,7 +106,14 @@ class LlamaConfig:
             head_dim=d.get("head_dim", hidden // num_heads),
             rope_theta=d.get("rope_theta", 10000.0),
             rms_eps=d.get("rms_norm_eps", 1e-5),
-            max_position_embeddings=d.get("max_position_embeddings", 8192),
+            # Mistral-family sliding-window attention: full attention is
+            # EXACT for contexts within the window, so serve those and
+            # clamp the model length to the window instead of silently
+            # attending beyond it without the sliding mask
+            max_position_embeddings=min(
+                d.get("max_position_embeddings", 8192),
+                d.get("sliding_window") or (1 << 62),
+            ),
             tie_word_embeddings=d.get("tie_word_embeddings", is_gemma),
             rope_scaling=d.get("rope_scaling"),
             num_experts=d.get("num_local_experts", 0),

@@ -213,3 +213,21 @@ def test_chunked_prefill_ragged_table_no_clamp(tiny_setup):
     k_ref = np.asarray(kc_ref[:, :, used], np.float32).reshape(-1, 12, cfg.head_dim)
     k_new = np.asarray(kc2[:, :, used], np.float32).reshape(-1, 12, cfg.head_dim)
     np.testing.assert_allclose(k_ref[:, :T], k_new[:, :T], atol=1e-2, rtol=1e-2)
+
+
+def test_mistral_sliding_window_clamps_context():
+    """Mistral-family configs declare sliding-window attention; full
+    attention is exact only within the window, so the model length clamps
+    to it rather than silently attending past it without the mask."""
+    cfg = L.LlamaConfig.from_hf_dict(
+        {"model_type": "mistral", "hidden_size": 64,
+         "num_attention_heads": 4, "max_position_embeddings": 32768,
+         "sliding_window": 4096}
+    )
+    assert cfg.max_position_embeddings == 4096
+    # null / absent windows leave the length alone
+    cfg2 = L.LlamaConfig.from_hf_dict(
+        {"model_type": "mistral", "max_position_embeddings": 32768,
+         "sliding_window": None}
+    )
+    assert cfg2.max_position_embeddings == 32768
