@@ -35,18 +35,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ARTIFACT = os.path.join(REPO, "BENCH_TPU_LOCAL.json")
 PROBE_LOG = os.path.join(REPO, "benchmarks", "tpu_probe_log.jsonl")
 
-PROBE_SRC = r"""
-import json, time
-t0 = time.time()
-import jax
-ds = jax.devices()
-print("PROBE" + json.dumps({
-    "platforms": sorted({d.platform for d in ds}),
-    "kinds": sorted({getattr(d, "device_kind", "") for d in ds}),
-    "n": len(ds),
-    "init_s": round(time.time() - t0, 2),
-}))
-"""
+sys.path.insert(0, REPO)
+from benchmarks.tpu_probe import probe_fresh  # noqa: E402
+
+# The knobs run_bench passes to the worker — kept in the banked artifact so
+# bench.py's supervisor can tell whether a banked number is same-config.
+BENCH_CONFIG = {
+    "requests": 48,
+    "concurrency": 32,
+    "max_batch": 16,
+    "measure_s": 150.0,
+}
 
 
 def log_probe(entry: dict) -> None:
@@ -60,34 +59,7 @@ def log_probe(entry: dict) -> None:
 
 def probe(timeout_s: float = 45.0) -> tuple[bool, dict]:
     """Fresh-subprocess jax.devices() probe. True iff a real TPU answered."""
-    t0 = time.monotonic()
-    try:
-        cp = subprocess.run(
-            [sys.executable, "-c", PROBE_SRC],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-            cwd=REPO,
-        )
-    except subprocess.TimeoutExpired:
-        info = {"outcome": "wedged", "probe_s": round(time.monotonic() - t0, 1)}
-        log_probe(info)
-        return False, info
-    info: dict = {
-        "outcome": "error",
-        "rc": cp.returncode,
-        "probe_s": round(time.monotonic() - t0, 1),
-    }
-    for line in cp.stdout.splitlines():
-        if line.startswith("PROBE"):
-            payload = json.loads(line[5:])
-            info.update(payload)
-            info["outcome"] = (
-                "tpu" if "tpu" in payload.get("platforms", []) else "no_tpu"
-            )
-            break
-    else:
-        info["stderr_tail"] = cp.stderr[-300:]
+    info = probe_fresh(timeout_s)
     log_probe(info)
     return info["outcome"] == "tpu", info
 
@@ -100,6 +72,10 @@ def run_bench(budget_s: float) -> dict | None:
         "--worker",
         "--budget-s",
         str(budget_s),
+        "--requests", str(BENCH_CONFIG["requests"]),
+        "--concurrency", str(BENCH_CONFIG["concurrency"]),
+        "--max-batch", str(BENCH_CONFIG["max_batch"]),
+        "--measure-s", str(BENCH_CONFIG["measure_s"]),
     ]
     try:
         cp = subprocess.run(
@@ -124,6 +100,7 @@ def run_bench(budget_s: float) -> dict | None:
 def bank(result: dict) -> None:
     result["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
     result["source"] = "mid_round_tpu_capture"
+    result["config"] = dict(BENCH_CONFIG)
     prev_value = None
     if os.path.exists(ARTIFACT):
         try:
@@ -140,13 +117,14 @@ def bank(result: dict) -> None:
     with open(ARTIFACT, "w") as f:
         json.dump(result, f, indent=1)
         f.write("\n")
-    subprocess.run(
-        ["git", "add", "BENCH_TPU_LOCAL.json"], cwd=REPO, check=False
-    )
+    # --only: commit JUST this artifact, never sweeping up whatever the
+    # developer happens to have staged in the shared working repo
     subprocess.run(
         [
             "git",
             "commit",
+            "--only",
+            "BENCH_TPU_LOCAL.json",
             "-m",
             f"Bank TPU bench capture: {result.get('value')} tok/s/chip",
             "--no-verify",

@@ -1,0 +1,55 @@
+"""Shared fresh-subprocess TPU probe (used by bench.py and tpu_capture.py).
+
+The axon tunnel wedge is *per-process*: `jax.devices()` can block forever
+inside PJRT init in one interpreter while a freshly-started one succeeds.
+So the only reliable probe is a new subprocess with a hard timeout.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+
+PROBE_SRC = (
+    "import json,time;t=time.time();import jax;ds=jax.devices();"
+    "print('PROBE'+json.dumps({'platforms':sorted({d.platform for d in ds}),"
+    "'kinds':sorted({getattr(d,'device_kind','') for d in ds}),"
+    "'n':len(ds),'init_s':round(time.time()-t,2)}))"
+)
+
+
+def probe_fresh(timeout_s: float = 45.0) -> dict:
+    """One fresh-subprocess jax.devices() probe.
+
+    Returns forensics: {"outcome": "tpu"|"no_tpu"|"wedged"|"error", ...}.
+    """
+    t0 = time.monotonic()
+    try:
+        cp = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"outcome": "wedged", "probe_s": round(time.monotonic() - t0, 1)}
+    info: dict = {
+        "outcome": "error",
+        "rc": cp.returncode,
+        "probe_s": round(time.monotonic() - t0, 1),
+    }
+    for line in cp.stdout.splitlines():
+        if line.startswith("PROBE"):
+            try:
+                payload = json.loads(line[5:])
+            except json.JSONDecodeError:
+                break
+            info.update(payload)
+            info["outcome"] = (
+                "tpu" if "tpu" in payload.get("platforms", []) else "no_tpu"
+            )
+            return info
+    info["stderr_tail"] = cp.stderr[-200:]
+    return info

@@ -14,6 +14,7 @@ Set DYN_NO_NATIVE=1 to force the Python path.
 
 from __future__ import annotations
 
+import array
 import ctypes
 import hashlib
 import os
@@ -21,6 +22,12 @@ import subprocess
 import tempfile
 import threading
 from typing import Optional
+
+# Platform constant, hoisted out of the per-hash hot path: on an exotic ABI
+# where C `unsigned int` isn't 32-bit, the C hasher would read a
+# differently-laid-out buffer and silently corrupt KV prefix-reuse routing
+# — force the Python fallback there instead.
+_U32_OK = array.array("I").itemsize == 4
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "blockhash.c")
@@ -98,8 +105,8 @@ def native_available() -> bool:
 def _tok_buffer(tokens: list[int]):
     """list[int] -> C u32 buffer via array('I') (a single C-speed copy —
     per-element ctypes conversion costs more than the hash itself)."""
-    import array
-
+    if not _U32_OK:
+        return None
     try:
         arr = array.array("I", tokens)
     except (OverflowError, TypeError):

@@ -189,14 +189,20 @@ def sample_tokens(
 
     Unrestricted rows (top_k<=0, top_p>=1) draw over the full vocab —
     exact at any temperature. Restricted rows draw from the top
-    SAMPLE_CANDIDATES pool (exact for top_k <= pool; a wider nucleus
-    truncates to the pool).
+    SAMPLE_CANDIDATES pool: exact for top_k <= pool, and a nucleus
+    truncates to the pool with ~1e-4 lost mass near temperature 1. At
+    high temperature the tail past the pool is materially heavier, so
+    rows with an effectively-unrestricting nucleus (top_p >= 0.99,
+    no top_k) and temperature > 1.25 are routed to the full-vocab draw
+    instead — trading the top 1% tail cut (which high temperature makes
+    ill-defined anyway) for no pool truncation.
     """
     greedy_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
     vals, idx = _filtered_candidates(scaled, top_p, top_k)
-    unrestricted = (top_k <= 0) & (top_p >= 1.0)  # [B]
+    wide_nucleus = (top_k <= 0) & (top_p >= 0.99) & (temperature > 1.25)
+    unrestricted = ((top_k <= 0) & (top_p >= 1.0)) | wide_nucleus  # [B]
     if keys is not None:
         def draw(kd, pool_lg, full_lg):
             k = jax.random.wrap_key_data(kd.astype(jnp.uint32))
