@@ -25,24 +25,22 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def apply_penalties(
-    logits: jax.Array,  # [B, V] f32
+def penalty_count_tables(
     hist: jax.Array,  # [B, L] int32 token history (prompt + generated)
     hist_len: jax.Array,  # [B] int32 total valid tokens in hist
     prompt_len: jax.Array,  # [B] int32 prompt prefix length within hist
-    frequency_penalty: jax.Array,  # [B] f32; 0 disables
-    presence_penalty: jax.Array,  # [B] f32; 0 disables
-    repetition_penalty: jax.Array,  # [B] f32; 1 disables
-) -> jax.Array:
-    """vLLM-semantics penalties:
+    vocab_size: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter the history into per-vocab tables: (out_counts [B, V] —
+    generated-token counts, seen [B, V] — prompt+generated occupancy).
 
-    * frequency/presence apply over GENERATED tokens only:
-      ``logits -= freq * count(v) + pres * [count(v) > 0]``
-    * repetition (HF-style) applies over prompt+generated seen tokens:
-      positive logits divided by rp, negative multiplied by rp.
-    """
-    B, V = logits.shape
+    These tables are the penalty state. The horizon program builds them
+    ONCE per dispatch and updates them with each on-device sampled token
+    (history is append-only during a horizon), instead of paying the
+    [B, L] upload + scatter every step."""
+    B = hist.shape[0]
     L = hist.shape[1]
+    V = vocab_size
     idx = jnp.arange(L)[None, :]
     valid = idx < hist_len[:, None]  # [B, L]
     is_out = valid & (idx >= prompt_len[:, None])
@@ -54,6 +52,26 @@ def apply_penalties(
     seen = jnp.zeros((B, V), jnp.float32).at[rows, safe_hist].max(
         valid.astype(jnp.float32)
     )
+    return out_counts, seen
+
+
+def apply_penalties_from_tables(
+    logits: jax.Array,  # [B, V] f32
+    out_counts: jax.Array,  # [B, V] f32 generated-token counts
+    seen: jax.Array,  # [B, V] f32 (>0 where token appeared at all)
+    frequency_penalty: jax.Array,  # [B] f32; 0 disables
+    presence_penalty: jax.Array,  # [B] f32; 0 disables
+    repetition_penalty: jax.Array,  # [B] f32; 1 disables
+) -> jax.Array:
+    """vLLM-semantics penalties from precomputed count tables:
+
+    * frequency/presence apply over GENERATED tokens only:
+      ``logits -= freq * count(v) + pres * [count(v) > 0]``
+    * repetition (HF-style) applies over prompt+generated seen tokens:
+      positive logits divided by rp, negative multiplied by rp.
+
+    A lane with freq=0, pres=0, rep=1 passes through bit-exactly, so one
+    program serves mixed penalty/plain batches."""
     logits = (
         logits
         - frequency_penalty[:, None] * out_counts
@@ -62,6 +80,26 @@ def apply_penalties(
     rp = repetition_penalty[:, None]
     penalized = jnp.where(logits > 0, logits / rp, logits * rp)
     return jnp.where(seen > 0, penalized, logits)
+
+
+def apply_penalties(
+    logits: jax.Array,  # [B, V] f32
+    hist: jax.Array,  # [B, L] int32 token history (prompt + generated)
+    hist_len: jax.Array,  # [B] int32 total valid tokens in hist
+    prompt_len: jax.Array,  # [B] int32 prompt prefix length within hist
+    frequency_penalty: jax.Array,  # [B] f32; 0 disables
+    presence_penalty: jax.Array,  # [B] f32; 0 disables
+    repetition_penalty: jax.Array,  # [B] f32; 1 disables
+) -> jax.Array:
+    """Single-step penalties: build the tables and apply (see the table
+    variants above for the horizon program's amortized form)."""
+    out_counts, seen = penalty_count_tables(
+        hist, hist_len, prompt_len, logits.shape[-1]
+    )
+    return apply_penalties_from_tables(
+        logits, out_counts, seen,
+        frequency_penalty, presence_penalty, repetition_penalty,
+    )
 
 
 def apply_repetition_penalty_from_prompt(
