@@ -81,8 +81,24 @@ def load_hf_safetensors(
             "wk": lin(p + "self_attn.k_proj.weight"),
             "wv": lin(p + "self_attn.v_proj.weight"),
             "wo": lin(p + "self_attn.o_proj.weight"),
-            "mlp_norm": norm(p + "post_attention_layernorm.weight"),
         }
+        if config.sandwich_norms:
+            # Gemma2/3: HF's post_attention_layernorm is the sandwich
+            # post-ATTENTION norm (not the pre-MLP norm it names in
+            # llama-family checkpoints); the pre-MLP norm is
+            # pre_feedforward_layernorm
+            layer.update(
+                post_attn_norm=norm(p + "post_attention_layernorm.weight"),
+                mlp_norm=norm(p + "pre_feedforward_layernorm.weight"),
+                post_mlp_norm=norm(p + "post_feedforward_layernorm.weight"),
+            )
+        else:
+            layer["mlp_norm"] = norm(p + "post_attention_layernorm.weight")
+        if config.qk_norm:
+            layer.update(
+                q_norm=norm(p + "self_attn.q_norm.weight"),
+                k_norm=norm(p + "self_attn.k_norm.weight"),
+            )
         if config.attn_bias:
             layer.update(
                 bq=get(p + "self_attn.q_proj.bias"),
@@ -127,6 +143,8 @@ def load_hf_safetensors(
         logger.debug("unused tensors: %s", sorted(tensors)[:5])
     per_layer = 6 + (1 + 3 * config.num_experts if config.num_experts else 3)
     per_layer += 3 if config.attn_bias else 0
+    per_layer += 2 if config.sandwich_norms else 0
+    per_layer += 2 if config.qk_norm else 0
     mapped = 2 + per_layer * config.num_layers + (
         1 if "lm_head" in params else 0
     )

@@ -396,28 +396,56 @@ def config_from_gguf(g: GgufFile):
     n_vocab = md.get(f"{arch}.vocab_size") or (
         len(md.get("tokenizer.ggml.tokens", [])) or 32000
     )
-    if arch.startswith("gemma") and arch != "gemma":
-        # gemma2/gemma3/gemma3n/...: soft-caps, local attention — refuse
-        # rather than load as a silently-wrong plain llama
+    if arch.startswith("gemma") and arch not in ("gemma", "gemma2", "gemma3"):
+        # gemma3n etc.: architectures we haven't mapped — refuse rather
+        # than load as a silently-wrong plain llama
         raise NotImplementedError(
-            f"GGUF architecture {arch!r} not supported (only gemma v1)"
+            f"GGUF architecture {arch!r} not supported"
         )
+    gemma_like = arch.startswith("gemma")
+    num_layers = int(key("block_count", 32))
+    sliding = key("attention.sliding_window")
+    layer_pattern = None
+    if arch == "gemma2" and sliding:
+        layer_pattern = tuple(i % 2 == 0 for i in range(num_layers))
+    elif arch == "gemma3" and sliding:
+        layer_pattern = tuple((i + 1) % 6 != 0 for i in range(num_layers))
     return LlamaConfig(
         attn_bias=arch.startswith("qwen2"),
-        mlp_act="gelu_tanh" if arch == "gemma" else "silu",
-        embed_scale=arch == "gemma",
-        norm_plus_one=arch == "gemma",
-        tie_word_embeddings=arch == "gemma",
+        mlp_act="gelu_tanh" if gemma_like else "silu",
+        embed_scale=gemma_like,
+        norm_plus_one=gemma_like,
+        tie_word_embeddings=gemma_like,
         vocab_size=int(n_vocab),
         hidden_size=hidden,
         intermediate_size=int(key("feed_forward_length", 4 * hidden)),
-        num_layers=int(key("block_count", 32)),
+        num_layers=num_layers,
         num_heads=n_heads,
         num_kv_heads=int(key("attention.head_count_kv", n_heads)),
         head_dim=int(key("attention.key_length", hidden // n_heads)),
         rope_theta=float(key("rope.freq_base", 10000.0)),
         rms_eps=float(key("attention.layer_norm_rms_epsilon", 1e-5)),
         max_position_embeddings=int(key("context_length", 8192)),
+        sliding_window=int(sliding) if sliding else None,
+        layer_pattern=layer_pattern,
+        attn_logit_softcap=(
+            float(key("attn_logit_softcapping", 50.0))
+            if arch == "gemma2" else None
+        ),
+        final_logit_softcap=(
+            float(key("final_logit_softcapping", 30.0))
+            if arch == "gemma2" else None
+        ),
+        query_pre_attn_scalar=(
+            float(key("attention.query_pre_attn_scalar"))
+            if key("attention.query_pre_attn_scalar") else None
+        ),
+        sandwich_norms=arch in ("gemma2", "gemma3"),
+        qk_norm=arch == "gemma3",
+        rope_local_theta=(
+            float(key("rope.local_freq_base", 10000.0))
+            if arch == "gemma3" else None
+        ),
     )
 
 
@@ -433,6 +461,14 @@ _LAYER_MAP = {
     "ffn_gate.weight": ("wg", True),
     "ffn_up.weight": ("wu", True),
     "ffn_down.weight": ("wd", True),
+}
+
+# gemma2/3 extras (absent in llama-family files; loaded when present)
+_OPTIONAL_LAYER_MAP = {
+    "post_attention_norm.weight": ("post_attn_norm", False),
+    "post_ffw_norm.weight": ("post_mlp_norm", False),
+    "attn_q_norm.weight": ("q_norm", False),
+    "attn_k_norm.weight": ("k_norm", False),
 }
 
 
@@ -466,6 +502,11 @@ def params_from_gguf(g: GgufFile, cfg=None, dtype=None):
                 f"blk.{i}.{suffix}", transpose=tr,
                 plus_one=ours in ("attn_norm", "mlp_norm"),
             )
+        for suffix, (ours, tr) in _OPTIONAL_LAYER_MAP.items():
+            if f"blk.{i}.{suffix}" in g.tensors:
+                layer[ours] = get(
+                    f"blk.{i}.{suffix}", transpose=tr, plus_one=True
+                )
         # qwen2-family q/k/v biases, when the file ships them
         for suffix, ours in (
             ("attn_q.bias", "bq"), ("attn_k.bias", "bk"),

@@ -63,6 +63,28 @@ class LlamaConfig:
     # without stomping the ops-level global (e.g. a TP-meshed engine on
     # the XLA path next to a single-chip engine on the pallas path)
     attn_impl: Optional[str] = None
+    # Sliding-window attention (Mistral / Gemma2 / Gemma3 local layers):
+    # token i attends to (i-window, i]. None = full attention. The paged
+    # cache still stores every position (the mask, not a rolling buffer,
+    # enforces the window), so prefix-cache hashes stay exact.
+    sliding_window: Optional[int] = None
+    # Per-layer pattern: tuple[bool] (True = sliding) of len num_layers.
+    # None with sliding_window set = every layer slides (Mistral).
+    layer_pattern: Optional[tuple] = None
+    # Gemma2: logit soft-caps (cap*tanh(x/cap)) on attention scores and
+    # final logits; custom attention scale via query_pre_attn_scalar.
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
+    query_pre_attn_scalar: Optional[float] = None
+    # Gemma2/3: sandwich norms — post-attention and post-feedforward
+    # RMSNorms applied to each sublayer's OUTPUT before the residual add
+    # (the pre-norms are the standard attn_norm/mlp_norm slots).
+    sandwich_norms: bool = False
+    # Gemma3: per-head RMSNorm on q and k after projection, before RoPE.
+    qk_norm: bool = False
+    # Gemma3: local (sliding) layers use their own rope theta (10k) with
+    # no scaling; global layers use rope_theta (1M) + rope_scaling.
+    rope_local_theta: Optional[float] = None
     # MoE (Mixtral-style): num_experts == 0 means dense SwiGLU FFN
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -81,44 +103,91 @@ class LlamaConfig:
             a.startswith("Qwen2") for a in d.get("architectures") or []
         )
         # Gemma (v1): GeGLU + scaled embeddings + (1+w) norms + tied head.
-        # Gemma-2/3 add logit soft-caps and alternating local attention —
-        # refuse those explicitly rather than serve a silently-wrong model.
+        # Gemma2 adds soft-caps + alternating local/global attention +
+        # sandwich norms; Gemma3 swaps soft-caps for qk-norm, runs 5
+        # local : 1 global with a separate local rope theta.
         mt = d.get("model_type", "")
         archs = d.get("architectures") or []
-        if mt in ("gemma2", "gemma3", "gemma3_text") or any(
-            a.startswith(("Gemma2", "Gemma3")) for a in archs
-        ):
-            raise NotImplementedError(
-                "gemma2/gemma3 (soft-caps, local attention) not supported"
-            )
+        is_gemma2 = mt == "gemma2" or any(a.startswith("Gemma2") for a in archs)
+        is_gemma3 = mt in ("gemma3", "gemma3_text") or any(
+            a.startswith("Gemma3") for a in archs
+        )
         is_gemma = mt == "gemma" or any(a.startswith("GemmaFor") for a in archs)
+        gemma_like = is_gemma or is_gemma2 or is_gemma3
+        num_layers = d.get("num_hidden_layers", 32)
+        # Sliding window (Mistral/Qwen2 full-depth; Gemma2/3 patterned).
+        # Qwen2-family configs ship a numeric sliding_window with
+        # use_sliding_window=false — window disabled, full attention is
+        # exact over the whole declared context (ADVICE r4 #1).
+        sliding = d.get("sliding_window")
+        if not d.get("use_sliding_window", True):
+            sliding = None
+        layer_pattern = None
+        if d.get("layer_types"):
+            # HF's explicit per-layer list ("sliding_attention"/"full_…")
+            layer_pattern = tuple(
+                t == "sliding_attention" for t in d["layer_types"]
+            )
+        elif is_gemma2 and sliding:
+            layer_pattern = tuple(i % 2 == 0 for i in range(num_layers))
+        elif is_gemma3 and sliding:
+            pat = d.get("sliding_window_pattern", 6)
+            layer_pattern = tuple(
+                (i + 1) % pat != 0 for i in range(num_layers)
+            )
+        if layer_pattern is not None and not any(layer_pattern):
+            sliding, layer_pattern = None, None
         return cls(
             attn_bias=is_qwen2,
-            mlp_act="gelu_tanh" if is_gemma else "silu",
-            embed_scale=is_gemma,
-            norm_plus_one=is_gemma,
+            mlp_act="gelu_tanh" if gemma_like else "silu",
+            embed_scale=gemma_like,
+            norm_plus_one=gemma_like,
             vocab_size=d.get("vocab_size", 32000),
             hidden_size=hidden,
             intermediate_size=d.get("intermediate_size", 4 * hidden),
-            num_layers=d.get("num_hidden_layers", 32),
+            num_layers=num_layers,
             num_heads=num_heads,
             num_kv_heads=d.get("num_key_value_heads", num_heads),
             head_dim=d.get("head_dim", hidden // num_heads),
             rope_theta=d.get("rope_theta", 10000.0),
             rms_eps=d.get("rms_norm_eps", 1e-5),
-            # Mistral-family sliding-window attention: full attention is
-            # EXACT for contexts within the window, so serve those and
-            # clamp the model length to the window instead of silently
-            # attending beyond it without the sliding mask
-            max_position_embeddings=min(
-                d.get("max_position_embeddings", 8192),
-                d.get("sliding_window") or (1 << 62),
-            ),
-            tie_word_embeddings=d.get("tie_word_embeddings", is_gemma),
+            max_position_embeddings=d.get("max_position_embeddings", 8192),
+            tie_word_embeddings=d.get("tie_word_embeddings", gemma_like),
             rope_scaling=d.get("rope_scaling"),
             num_experts=d.get("num_local_experts", 0),
             num_experts_per_tok=d.get("num_experts_per_tok", 2),
+            sliding_window=sliding,
+            layer_pattern=layer_pattern,
+            attn_logit_softcap=d.get("attn_logit_softcapping")
+            if is_gemma2
+            else None,
+            final_logit_softcap=d.get("final_logit_softcapping")
+            if is_gemma2
+            else None,
+            query_pre_attn_scalar=d.get("query_pre_attn_scalar")
+            if (is_gemma2 or is_gemma3)
+            else None,
+            sandwich_norms=is_gemma2 or is_gemma3,
+            qk_norm=is_gemma3,
+            rope_local_theta=d.get("rope_local_base_freq", 10000.0)
+            if is_gemma3
+            else None,
         )
+
+    def layer_window(self, i: int) -> Optional[int]:
+        """This layer's sliding window, or None for full attention."""
+        if self.sliding_window is None:
+            return None
+        if self.layer_pattern is None:
+            return self.sliding_window  # Mistral: every layer slides
+        return self.sliding_window if self.layer_pattern[i] else None
+
+    @property
+    def attn_scale(self) -> Optional[float]:
+        """Custom attention score scale (Gemma2/3), or None for 1/sqrt(D)."""
+        if self.query_pre_attn_scalar is None:
+            return None
+        return self.query_pre_attn_scalar ** -0.5
 
     @classmethod
     def from_model_dir(cls, model_dir: str) -> "LlamaConfig":
@@ -196,6 +265,16 @@ def init_params(
                 bk=jnp.zeros((c.kv_dim,), dtype),
                 bv=jnp.zeros((c.kv_dim,), dtype),
             )
+        if c.sandwich_norms:
+            layer.update(
+                post_attn_norm=jnp.ones((c.hidden_size,), dtype),
+                post_mlp_norm=jnp.ones((c.hidden_size,), dtype),
+            )
+        if c.qk_norm:
+            layer.update(
+                q_norm=jnp.ones((c.head_dim,), dtype),
+                k_norm=jnp.ones((c.head_dim,), dtype),
+            )
         if c.num_experts:
             # Mixtral MoE FFN: router + stacked expert SwiGLU weights
             # (experts kept bf16; expert einsums go through ops/moe.py)
@@ -259,10 +338,25 @@ def _embed(params, cfg, tokens):
     return x
 
 
+def _rope_pair(cfg):
+    """(global_freqs, local_freqs): Gemma3 runs its sliding layers on a
+    separate unscaled theta; everyone else shares one table."""
+    g = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    if cfg.rope_local_theta is None:
+        return g, g
+    return g, rope_freqs(cfg.head_dim, cfg.rope_local_theta, None)
+
+
+def _layer_freqs(cfg, li, pair):
+    """This layer's rope table: local freqs on sliding layers (Gemma3)."""
+    return pair[1] if cfg.layer_window(li) is not None else pair[0]
+
+
 def _qkv(x, layer, cfg, inv_freqs, positions):
-    """Shared projection head: norm -> q/k/v -> RoPE. One definition so the
-    serial, context-parallel, and decode paths cannot drift. Qwen2-family
-    models carry q/k/v biases (bq/bk/bv)."""
+    """Shared projection head: norm -> q/k/v -> (qk-norm) -> RoPE. One
+    definition so the serial, context-parallel, and decode paths cannot
+    drift. Qwen2-family models carry q/k/v biases (bq/bk/bv); Gemma3
+    carries per-head q/k RMSNorms."""
     T = x.shape[0]
     h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
     q = linear(h, layer["wq"])
@@ -275,32 +369,43 @@ def _qkv(x, layer, cfg, inv_freqs, positions):
     q = q.reshape(T, cfg.num_heads, cfg.head_dim)
     k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
     v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    if "q_norm" in layer:
+        q = rms_norm(q, layer["q_norm"], cfg.rms_eps)
+        k = rms_norm(k, layer["k_norm"], cfg.rms_eps)
     q = apply_rope(q, positions, inv_freqs)
     k = apply_rope(k, positions, inv_freqs)
     return q, k, v
 
 
-def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_cache_l, block_table, mesh=None, head_axis=None):
-    P = x.shape[0]
+def _attn_out(attn, x, layer, cfg):
+    """Output projection + (sandwich post-norm) + residual add."""
+    out = linear(attn.reshape(x.shape[0], cfg.q_dim), layer["wo"])
+    if "post_attn_norm" in layer:
+        out = rms_norm(out, layer["post_attn_norm"], cfg.rms_eps)
+    return x + out
+
+
+def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_cache_l, block_table, mesh=None, head_axis=None, li=0):
     q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
     k_cache_l, v_cache_l = write_prefill_kv(k_cache_l, v_cache_l, k, v, block_table)
     attn = causal_prefill_attention(
-        q, k, v, valid_len, impl=cfg.attn_impl, mesh=mesh, head_axis=head_axis
+        q, k, v, valid_len, impl=cfg.attn_impl, mesh=mesh, head_axis=head_axis,
+        window=cfg.layer_window(li), scale=cfg.attn_scale,
+        logit_softcap=cfg.attn_logit_softcap,
     )
-    out = linear(attn.reshape(P, cfg.q_dim), layer["wo"])
-    return x + out, k_cache_l, v_cache_l
+    return _attn_out(attn, x, layer, cfg), k_cache_l, v_cache_l
 
 
-def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, block_tables, slot_indices, mesh=None, head_axis=None):
-    B = x.shape[0]
+def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, block_tables, slot_indices, mesh=None, head_axis=None, li=0):
     q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
     k_cache_l, v_cache_l = write_decode_kv(k_cache_l, v_cache_l, k, v, slot_indices)
     attn = paged_decode_attention(
         q, k_cache_l, v_cache_l, block_tables, positions + 1,
         impl=cfg.attn_impl, mesh=mesh, head_axis=head_axis,
+        window=cfg.layer_window(li), scale=cfg.attn_scale,
+        logit_softcap=cfg.attn_logit_softcap,
     )
-    out = linear(attn.reshape(B, cfg.q_dim), layer["wo"])
-    return x + out, k_cache_l, v_cache_l
+    return _attn_out(attn, x, layer, cfg), k_cache_l, v_cache_l
 
 
 def _mlp(x, layer, cfg, mesh=None):
@@ -350,15 +455,23 @@ def _mlp(x, layer, cfg, mesh=None):
         ) * up
     else:
         act = swiglu(gate, up)
-    return x + linear(act, layer["wd"])
+    y = linear(act, layer["wd"])
+    if "post_mlp_norm" in layer:  # Gemma2/3 sandwich norm
+        y = rms_norm(y, layer["post_mlp_norm"], cfg.rms_eps)
+    return x + y
 
 
 def _logits(x, params, cfg):
     h = rms_norm(x, params["final_norm"], cfg.rms_eps)
     w = params.get("lm_head")
     if w is None:
-        return jnp.matmul(h, params["embed"].T.astype(h.dtype)).astype(jnp.float32)
-    return linear(h, w).astype(jnp.float32)
+        out = jnp.matmul(h, params["embed"].T.astype(h.dtype)).astype(jnp.float32)
+    else:
+        out = linear(h, w).astype(jnp.float32)
+    if cfg.final_logit_softcap is not None:  # Gemma2
+        cap = cfg.final_logit_softcap
+        out = cap * jnp.tanh(out / cap)
+    return out
 
 
 def prefill(
@@ -423,13 +536,13 @@ def _prefill_from_embeds(
     mesh=None,
     attn_head_axis=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    freqs = _rope_pair(cfg)
     positions = jnp.arange(x.shape[0], dtype=jnp.int32)
     for i, layer in enumerate(params["layers"]):
         x, kc, vc = _attn_prefill(
-            x, layer, cfg, inv_freqs, positions, valid_len,
+            x, layer, cfg, _layer_freqs(cfg, i, freqs), positions, valid_len,
             k_cache[i], v_cache[i], block_table,
-            mesh=mesh, head_axis=attn_head_axis,
+            mesh=mesh, head_axis=attn_head_axis, li=i,
         )
         k_cache = k_cache.at[i].set(kc)
         v_cache = v_cache.at[i].set(vc)
@@ -460,16 +573,20 @@ def prefill_chunk(
     logits [V], caches) — logits are meaningful only on the final chunk.
     """
     C = tokens.shape[0]
-    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    freqs = _rope_pair(cfg)
     positions = chunk_start + jnp.arange(C, dtype=jnp.int32)
     x = _embed(params, cfg, tokens)
     for i, layer in enumerate(params["layers"]):
-        q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
+        q, k, v = _qkv(x, layer, cfg, _layer_freqs(cfg, i, freqs), positions)
         kc, vc = write_chunk_kv(
             k_cache[i], v_cache[i], k, v, block_table, chunk_start
         )
-        attn = chunked_prefill_attention(q, kc, vc, block_table, chunk_start)
-        x = x + linear(attn.reshape(C, cfg.q_dim), layer["wo"])
+        attn = chunked_prefill_attention(
+            q, kc, vc, block_table, chunk_start,
+            window=cfg.layer_window(i), scale=cfg.attn_scale,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+        x = _attn_out(attn, x, layer, cfg)
         x = _mlp(x, layer, cfg, mesh)
         k_cache = k_cache.at[i].set(kc)
         v_cache = v_cache.at[i].set(vc)
@@ -502,14 +619,17 @@ def prefill_packed(
     logits [N, V], caches). Unused last_idx lanes read token 0 — callers
     ignore those rows.
     """
-    P = tokens.shape[0]
-    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    freqs = _rope_pair(cfg)
     x = _embed(params, cfg, tokens)
     for i, layer in enumerate(params["layers"]):
-        q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
+        q, k, v = _qkv(x, layer, cfg, _layer_freqs(cfg, i, freqs), positions)
         kc, vc = write_decode_kv(k_cache[i], v_cache[i], k, v, slot_indices)
-        attn = packed_prefill_attention(q, k, v, segment_ids)
-        x = x + linear(attn.reshape(P, cfg.q_dim), layer["wo"])
+        attn = packed_prefill_attention(
+            q, k, v, segment_ids,
+            window=cfg.layer_window(i), scale=cfg.attn_scale,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+        x = _attn_out(attn, x, layer, cfg)
         x = _mlp(x, layer, cfg, mesh)
         k_cache = k_cache.at[i].set(kc)
         v_cache = v_cache.at[i].set(vc)
@@ -543,6 +663,14 @@ def prefill_context_parallel(
     """
     from dynamo_tpu.parallel.ring_attention import ring_prefill_attention
 
+    if cfg.sliding_window is not None:
+        # ring attention streams KV around the sp ring with no window
+        # masking yet; serving a sliding-window model through it would be
+        # silently wrong. Sliding models prefill via the serial/chunked
+        # paths (which mask exactly) instead.
+        raise NotImplementedError(
+            "sliding-window models don't support context-parallel prefill"
+        )
     paginate = k_cache is not None
     P_len = tokens.shape[0]
     inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
@@ -554,7 +682,7 @@ def prefill_context_parallel(
         attn = ring_prefill_attention(
             mesh, q, k, v, valid_len, head_axis=head_axis
         )
-        x = x + linear(attn.reshape(P_len, cfg.q_dim), layer["wo"])
+        x = _attn_out(attn, x, layer, cfg)
         x = _mlp(x, layer, cfg, mesh)
         if paginate:
             kc, vc = write_prefill_kv(k_cache[i], v_cache[i], k, v, block_table)
@@ -579,14 +707,18 @@ def embed_pooled(
     hidden states mean-pooled over valid tokens. The /v1/embeddings path
     (ref http/service/openai.rs:222) — cacheless because embedding traffic
     never decodes."""
-    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    freqs = _rope_pair(cfg)
     P = tokens.shape[0]
     positions = jnp.arange(P, dtype=jnp.int32)
     x = _embed(params, cfg, tokens)
-    for layer in params["layers"]:
-        q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
-        attn = causal_prefill_attention(q, k, v, valid_len, impl=cfg.attn_impl)
-        x = x + linear(attn.reshape(P, cfg.q_dim), layer["wo"])
+    for i, layer in enumerate(params["layers"]):
+        q, k, v = _qkv(x, layer, cfg, _layer_freqs(cfg, i, freqs), positions)
+        attn = causal_prefill_attention(
+            q, k, v, valid_len, impl=cfg.attn_impl,
+            window=cfg.layer_window(i), scale=cfg.attn_scale,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+        x = _attn_out(attn, x, layer, cfg)
         x = _mlp(x, layer, cfg)
     h = rms_norm(x, params["final_norm"], cfg.rms_eps).astype(jnp.float32)
     mask = (positions < valid_len)[:, None].astype(jnp.float32)
@@ -607,13 +739,13 @@ def decode(
     attn_head_axis=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One decode step for a batch; returns (logits [B, V], caches)."""
-    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    freqs = _rope_pair(cfg)
     x = _embed(params, cfg, tokens)
     for i, layer in enumerate(params["layers"]):
         x, kc, vc = _attn_decode(
-            x, layer, cfg, inv_freqs, positions,
+            x, layer, cfg, _layer_freqs(cfg, i, freqs), positions,
             k_cache[i], v_cache[i], block_tables, slot_indices,
-            mesh=mesh, head_axis=attn_head_axis,
+            mesh=mesh, head_axis=attn_head_axis, li=i,
         )
         k_cache = k_cache.at[i].set(kc)
         v_cache = v_cache.at[i].set(vc)

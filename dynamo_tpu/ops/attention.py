@@ -63,6 +63,13 @@ def _pallas_tileable(head_dim: int, block_size: int = 8) -> bool:
     return head_dim % 128 == 0 and block_size % 8 == 0
 
 
+def _softcap(scores: jax.Array, cap: Optional[float]) -> jax.Array:
+    """Gemma-2 style logit soft-capping: cap * tanh(scores / cap)."""
+    if cap is None:
+        return scores
+    return cap * jnp.tanh(scores / cap)
+
+
 def causal_prefill_attention(
     q: jax.Array,  # [P, Hq, D]
     k: jax.Array,  # [P, Hkv, D]
@@ -71,6 +78,9 @@ def causal_prefill_attention(
     impl: Optional[str] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
     head_axis: Optional[str] = None,
+    window: Optional[int] = None,  # sliding-window size; None = full
+    scale: Optional[float] = None,  # score scale; None = 1/sqrt(D)
+    logit_softcap: Optional[float] = None,  # gemma2 attn soft-cap
 ) -> jax.Array:
     """Single-sequence causal self-attention over a padded prompt window.
 
@@ -78,9 +88,16 @@ def causal_prefill_attention(
     under shard_map with q/k/v head-sharded — attention is embarrassingly
     parallel over kv heads, so each shard streams only its own head slice
     and no collective is needed (the wo row-parallel psum happens outside).
+
+    `window`: token i attends to j iff i-window < j <= i (Mistral/Gemma2/3
+    local layers). Sliding/soft-capped/custom-scale layers take the XLA
+    path (the pallas kernels don't carry those features yet); mixed-pattern
+    models still run their global layers on pallas.
     """
     impl = get_attention_impl(impl)
     if impl == "pallas" and not _pallas_tileable(q.shape[-1]):
+        impl = "xla"
+    if window is not None or scale is not None or logit_softcap is not None:
         impl = "xla"
     if impl != "xla":
         bq = _prefill_block(q.shape[0])
@@ -113,16 +130,21 @@ def causal_prefill_attention(
     P, Hq, D = q.shape
     Hkv = k.shape[1]
     G = Hq // Hkv
-    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    sc = jnp.float32(scale) if scale is not None else (
+        1.0 / jnp.sqrt(D).astype(jnp.float32)
+    )
     qr = q.reshape(P, Hkv, G, D)
     scores = jnp.einsum(
         "qhgd,khd->hgqk", qr.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
+    ) * sc
+    scores = _softcap(scores, logit_softcap)
     pos = jnp.arange(P)
     causal = pos[None, :] <= pos[:, None]  # [q, k]
     in_seq = pos[None, :] < valid_len
-    mask = (causal & in_seq)[None, None, :, :]
-    scores = jnp.where(mask, scores, NEG_INF)
+    mask = causal & in_seq
+    if window is not None:
+        mask &= pos[:, None] - pos[None, :] < window
+    scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("hgqk,khd->qhgd", weights, v.astype(jnp.float32))
     return out.reshape(P, Hq, D).astype(q.dtype)
@@ -133,6 +155,9 @@ def packed_prefill_attention(
     k: jax.Array,  # [P, Hkv, D]
     v: jax.Array,  # [P, Hkv, D]
     segment_ids: jax.Array,  # [P] int32; -1 marks padding lanes
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jax.Array:
     """Causal attention over a PACKED buffer of independent prompts.
 
@@ -150,16 +175,24 @@ def packed_prefill_attention(
     P, Hq, D = q.shape
     Hkv = k.shape[1]
     G = Hq // Hkv
-    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    sc = jnp.float32(scale) if scale is not None else (
+        1.0 / jnp.sqrt(D).astype(jnp.float32)
+    )
     qr = q.reshape(P, Hkv, G, D)
     scores = jnp.einsum(
         "qhgd,khd->hgqk", qr.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
+    ) * sc
+    scores = _softcap(scores, logit_softcap)
     pos = jnp.arange(P)
     causal = pos[None, :] <= pos[:, None]  # [q, k]
     same_seg = segment_ids[None, :] == segment_ids[:, None]
-    mask = (causal & same_seg)[None, None, :, :]
-    scores = jnp.where(mask, scores, NEG_INF)
+    mask = causal & same_seg
+    if window is not None:
+        # packed positions within a segment differ from true sequence
+        # positions by the segment's start offset, which cancels in the
+        # q-k difference — the window test works on packed indices
+        mask &= pos[:, None] - pos[None, :] < window
+    scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("hgqk,khd->qhgd", weights, v.astype(jnp.float32))
     return out.reshape(P, Hq, D).astype(q.dtype)
@@ -174,6 +207,9 @@ def paged_decode_attention(
     impl: Optional[str] = None,
     mesh: Optional[jax.sharding.Mesh] = None,
     head_axis: Optional[str] = None,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jax.Array:
     """Decode-step attention: gather each sequence's blocks and attend.
 
@@ -192,6 +228,8 @@ def paged_decode_attention(
     if impl == "pallas" and not _pallas_tileable(
         q.shape[-1], k_cache.shape[2]
     ):
+        impl = "xla"
+    if window is not None or scale is not None or logit_softcap is not None:
         impl = "xla"
     if impl != "xla":
         from dynamo_tpu.ops.pallas_attention import paged_decode_attention_pallas
@@ -225,16 +263,24 @@ def paged_decode_attention(
     G = Hq // Hkv
     max_blocks = block_tables.shape[1]
     S = max_blocks * block_size
-    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    sc = jnp.float32(scale) if scale is not None else (
+        1.0 / jnp.sqrt(D).astype(jnp.float32)
+    )
     # [Hkv, B, max_blocks, block_size, D] -> [Hkv, B, S, D]
     k = k_cache[:, block_tables].reshape(Hkv, B, S, D)
     v = v_cache[:, block_tables].reshape(Hkv, B, S, D)
     qr = q.reshape(B, Hkv, G, D)
     scores = jnp.einsum(
         "bhgd,hbsd->bhgs", qr.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
-    mask = (jnp.arange(S)[None, :] < context_lens[:, None])[:, None, None, :]
-    scores = jnp.where(mask, scores, NEG_INF)
+    ) * sc
+    scores = _softcap(scores, logit_softcap)
+    kpos = jnp.arange(S)[None, :]
+    mask = kpos < context_lens[:, None]
+    if window is not None:
+        # the query sits at position context_len-1; it sees the last
+        # `window` positions (itself included)
+        mask &= kpos >= context_lens[:, None] - window
+    scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgs,hbsd->bhgd", weights, v.astype(jnp.float32))
     return out.reshape(B, Hq, D).astype(q.dtype)
@@ -246,6 +292,9 @@ def chunked_prefill_attention(
     v_cache: jax.Array,
     block_table: jax.Array,  # [max_nb] int32 — the WHOLE prompt's blocks
     chunk_start: jax.Array,  # scalar int32 — position of q[0]
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jax.Array:
     """Attention for one prefill chunk against all previously written KV.
 
@@ -264,17 +313,22 @@ def chunked_prefill_attention(
     Hkv, _, block_size, _ = k_cache.shape
     G = Hq // Hkv
     S = block_table.shape[0] * block_size
-    scale = 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    sc = jnp.float32(scale) if scale is not None else (
+        1.0 / jnp.sqrt(D).astype(jnp.float32)
+    )
     k = k_cache[:, block_table].reshape(Hkv, S, D)
     v = v_cache[:, block_table].reshape(Hkv, S, D)
     qr = q.reshape(C, Hkv, G, D)
     scores = jnp.einsum(
         "chgd,hsd->hgcs", qr.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
+    ) * sc
+    scores = _softcap(scores, logit_softcap)
     qpos = chunk_start + jnp.arange(C)
     kpos = jnp.arange(S)
-    mask = (kpos[None, :] <= qpos[:, None])[None, None, :, :]
-    scores = jnp.where(mask, scores, NEG_INF)
+    mask = kpos[None, :] <= qpos[:, None]
+    if window is not None:
+        mask &= qpos[:, None] - kpos[None, :] < window
+    scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
     weights = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("hgcs,hsd->chgd", weights, v.astype(jnp.float32))
     return out.reshape(C, Hq, D).astype(q.dtype)

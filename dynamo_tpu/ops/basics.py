@@ -24,10 +24,14 @@ def rope_freqs(
     theta: float = 10000.0,
     scaling: dict | None = None,
 ) -> jax.Array:
-    """Inverse frequencies [head_dim//2], with optional llama3-style scaling."""
+    """Inverse frequencies [head_dim//2], with optional llama3/linear scaling."""
     inv = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling and scaling.get("rope_type") == "linear":
+        # position-interpolation scaling (gemma3 global layers ship
+        # {"rope_type": "linear", "factor": 8})
+        inv = inv / scaling.get("factor", 1.0)
     if scaling and scaling.get("rope_type") in ("llama3",):
         factor = scaling.get("factor", 8.0)
         low_factor = scaling.get("low_freq_factor", 1.0)

@@ -24,19 +24,46 @@ def gemma_cfg():
     )
 
 
-def test_hf_config_detection_and_gemma2_refusal():
+def test_hf_config_detection():
     cfg = L.LlamaConfig.from_hf_dict(
         {"model_type": "gemma", "hidden_size": 64, "num_attention_heads": 4,
          "tie_word_embeddings": True}
     )
     assert cfg.mlp_act == "gelu_tanh"
     assert cfg.embed_scale and cfg.norm_plus_one and cfg.tie_word_embeddings
+    assert not cfg.sandwich_norms and not cfg.qk_norm
     plain = L.LlamaConfig.from_hf_dict({"model_type": "llama"})
     assert plain.mlp_act == "silu" and not plain.embed_scale
-    with pytest.raises(NotImplementedError):
-        L.LlamaConfig.from_hf_dict({"model_type": "gemma2"})
-    with pytest.raises(NotImplementedError):
-        L.LlamaConfig.from_hf_dict({"architectures": ["Gemma3ForCausalLM"]})
+
+
+def test_hf_config_gemma2_and_gemma3():
+    g2 = L.LlamaConfig.from_hf_dict(
+        {"model_type": "gemma2", "num_hidden_layers": 4,
+         "sliding_window": 4096, "attn_logit_softcapping": 50.0,
+         "final_logit_softcapping": 30.0, "query_pre_attn_scalar": 256}
+    )
+    assert g2.sandwich_norms and not g2.qk_norm
+    assert g2.attn_logit_softcap == 50.0 and g2.final_logit_softcap == 30.0
+    assert g2.layer_pattern == (True, False, True, False)  # even slide
+    assert g2.attn_scale == 256 ** -0.5
+    g3 = L.LlamaConfig.from_hf_dict(
+        {"model_type": "gemma3_text", "num_hidden_layers": 12,
+         "sliding_window": 1024, "rope_theta": 1_000_000.0,
+         "rope_local_base_freq": 10000.0, "query_pre_attn_scalar": 256,
+         "rope_scaling": {"rope_type": "linear", "factor": 8.0}}
+    )
+    assert g3.sandwich_norms and g3.qk_norm
+    assert g3.attn_logit_softcap is None  # gemma3 dropped soft-caps
+    assert g3.rope_local_theta == 10000.0
+    # 5 local : 1 global — every 6th layer is global
+    assert g3.layer_pattern[:6] == (True,) * 5 + (False,)
+    # explicit HF layer_types list wins over the pattern rule
+    lt = L.LlamaConfig.from_hf_dict(
+        {"model_type": "gemma3", "num_hidden_layers": 2,
+         "sliding_window": 512,
+         "layer_types": ["full_attention", "sliding_attention"]}
+    )
+    assert lt.layer_pattern == (False, True)
 
 
 def _logits(cfg, params, toks=8):

@@ -215,19 +215,27 @@ def test_chunked_prefill_ragged_table_no_clamp(tiny_setup):
     np.testing.assert_allclose(k_ref[:, :T], k_new[:, :T], atol=1e-2, rtol=1e-2)
 
 
-def test_mistral_sliding_window_clamps_context():
-    """Mistral-family configs declare sliding-window attention; full
-    attention is exact only within the window, so the model length clamps
-    to it rather than silently attending past it without the mask."""
+def test_mistral_sliding_window_serves_full_context():
+    """Mistral-family configs declare sliding-window attention; the mask
+    is implemented in the attention ops, so the model serves its FULL
+    declared context (the r4 clamp is gone)."""
     cfg = L.LlamaConfig.from_hf_dict(
         {"model_type": "mistral", "hidden_size": 64,
          "num_attention_heads": 4, "max_position_embeddings": 32768,
          "sliding_window": 4096}
     )
-    assert cfg.max_position_embeddings == 4096
-    # null / absent windows leave the length alone
+    assert cfg.max_position_embeddings == 32768
+    assert cfg.sliding_window == 4096
+    assert cfg.layer_window(0) == 4096  # every layer slides (no pattern)
+    # null / absent windows -> plain full attention
     cfg2 = L.LlamaConfig.from_hf_dict(
         {"model_type": "mistral", "max_position_embeddings": 32768,
          "sliding_window": None}
     )
-    assert cfg2.max_position_embeddings == 32768
+    assert cfg2.sliding_window is None and cfg2.layer_window(0) is None
+    # qwen2-style numeric window with use_sliding_window=false: disabled
+    cfg3 = L.LlamaConfig.from_hf_dict(
+        {"model_type": "qwen2", "max_position_embeddings": 32768,
+         "sliding_window": 4096, "use_sliding_window": False}
+    )
+    assert cfg3.sliding_window is None
