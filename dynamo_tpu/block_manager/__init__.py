@@ -13,9 +13,12 @@ a tiered pool of KV blocks addressed by sequence hash —
 Blocks follow the reference's lifecycle (block_manager/block.rs state
 machine): RESET -> PARTIAL -> COMPLETE -> REGISTERED, with a sequence-hash
 registry deduplicating identical content across requests
-(block/registry.rs). Offload flows G1->G2 on sequence completion and
-G2->G3 under host pressure (offload.rs priority queues); onboarding walks
-the other way on prefix hits.
+(block/registry.rs). Offload flows G1->G2 mid-generation as blocks become
+KV-complete (offload.py bounded queue, drained by the engine loop —
+reference offload.rs register-time offload), at preemption time, and in
+bulk at sequence completion; G2->G3 under host pressure. Onboarding walks
+the other way on prefix hits — including into requests whose prefix is
+still live on another running sequence.
 
 TPU-specific design: no RDMA descriptors — G1 movement is jitted
 gather/scatter on the cache (model_runner.extract_blocks/inject_blocks),
@@ -25,11 +28,13 @@ so the device side stays inside XLA and reshards automatically under TP.
 from dynamo_tpu.block_manager.block import Block, BlockState
 from dynamo_tpu.block_manager.layout import LayoutConfig, LayoutKind
 from dynamo_tpu.block_manager.manager import TieredBlockManager
+from dynamo_tpu.block_manager.offload import OffloadQueue
 
 __all__ = [
     "Block",
     "BlockState",
     "LayoutConfig",
     "LayoutKind",
+    "OffloadQueue",
     "TieredBlockManager",
 ]

@@ -1,0 +1,130 @@
+"""Bounded queue for mid-generation KV block offload.
+
+Role-equivalent of the reference's offload machinery
+(lib/llm/src/block_manager/offload.rs: offload queues with sequence-hash
+dedupe against the target pool and rate-limited transfer managers). The
+reference enqueues a block the moment it is *registered* (i.e. completed,
+mid-generation) rather than when its sequence finishes; this queue gives
+our engine the same semantics:
+
+- `_emit_stored` enqueues every newly KV-complete block (decode boundary,
+  prefill completion).
+- the engine loop drains a few validated candidates per iteration
+  (rate limiting — one bounded extract per decode step keeps the copy
+  traffic off the latency path, reference offload.rs's transfer-manager
+  queue depth).
+
+Preemption and sequence completion do NOT ride this queue: their device
+blocks are about to be recycled, so the engine transfers block ownership
+to a dedicated offload task instead (engine._offload_task) — the copy is
+then unconditionally safe and needs no urgency ordering here.
+
+Entries reference live scheduler sequences, so validity is re-checked at
+pop time: the sequence may have finished (its completion path offloads
+everything anyway), been preempted, or the hash may have landed through
+another sequence (dedupe).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, NamedTuple
+
+
+class _Entry(NamedTuple):
+    seq: Any  # engine _Sequence (scheduler-owned)
+    seq_hash: int
+    position: int  # index into the block hash chain / block_ids
+
+
+@dataclass
+class OffloadQueueStats:
+    enqueued: int = 0
+    offloaded: int = 0
+    dropped_full: int = 0
+    dropped_stale: int = 0
+    dropped_dup: int = 0
+
+
+class OffloadQueue:
+    """FIFO of (sequence, block-position) offload candidates.
+
+    Bounded: when full, new candidates are dropped — the completion-time
+    offload still catches their blocks when the sequence finishes, so a
+    drop costs reuse opportunity, never correctness.
+    """
+
+    def __init__(self, max_pending: int = 256) -> None:
+        self._fifo: deque[_Entry] = deque()
+        self._pending: set[int] = set()  # hashes queued (dedupe)
+        self.max_pending = max_pending
+        self.stats = OffloadQueueStats()
+
+    def __len__(self) -> int:
+        return len(self._fifo)
+
+    def enqueue(self, seq: Any, entries: list[tuple[int, int]]) -> int:
+        """Queue (seq_hash, position) pairs; returns #accepted."""
+        accepted = 0
+        for seq_hash, position in entries:
+            if seq_hash in self._pending:
+                self.stats.dropped_dup += 1
+                continue
+            if len(self._fifo) >= self.max_pending:
+                self.stats.dropped_full += 1
+                continue
+            self._fifo.append(_Entry(seq, seq_hash, position))
+            self._pending.add(seq_hash)
+            accepted += 1
+            self.stats.enqueued += 1
+        return accepted
+
+    def pop_valid(
+        self, limit: int, manager: Any
+    ) -> list[tuple[Any, int, int]]:
+        """Pop up to `limit` still-valid candidates.
+
+        Valid = the sequence is still scheduled (holds a slot, not mid
+        remote-prefill), its hash chain still carries `seq_hash` at
+        `position`, the device block at that position is still owned, and
+        the hash hasn't landed in the manager meanwhile. Stale entries are
+        discarded (their blocks either already offloaded via the
+        completion path or were recycled).
+        """
+        out: list[tuple[Any, int, int]] = []
+        while self._fifo and len(out) < limit:
+            e = self._fifo.popleft()
+            self._pending.discard(e.seq_hash)
+            seq = e.seq
+            if e.seq_hash in manager:
+                self.stats.dropped_dup += 1
+                continue
+            chain = getattr(seq, "hash_seq", None)
+            if (
+                seq.slot is None
+                or getattr(seq, "pending_remote", False)
+                or chain is None
+                or e.position >= len(chain.blocks)
+                or chain.blocks[e.position].block_hash != e.seq_hash
+                or e.position >= len(seq.block_ids)
+            ):
+                self.stats.dropped_stale += 1
+                continue
+            out.append((seq, e.seq_hash, seq.block_ids[e.position]))
+        return out
+
+    def forget_seq(self, seq: Any) -> None:
+        """Drop queued candidates for a sequence whose device blocks are
+        being recycled (free/preempt/cancel paths), so their hashes can
+        re-enqueue through another live holder."""
+        if not any(e.seq is seq for e in self._fifo):
+            return
+        kept: deque[_Entry] = deque()
+        for e in self._fifo:
+            if e.seq is seq:
+                self._pending.discard(e.seq_hash)
+                self.stats.dropped_stale += 1
+            else:
+                kept.append(e)
+        self._fifo = kept

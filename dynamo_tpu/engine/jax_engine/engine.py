@@ -56,6 +56,11 @@ class JaxEngineConfig:
     # min_tokens + more stop ids than the device mask carries falls back
     # to single-step for that iteration.
     decode_horizon: int = 1
+    # mid-generation offload rate limit: max blocks copied to the host
+    # tier per engine-loop iteration (reference offload.rs bounds its
+    # transfer-manager queues the same way — copies must not crowd the
+    # decode latency path)
+    offload_per_step: int = 4
 
 
 @dataclass
@@ -135,6 +140,7 @@ class _Sequence(SequenceState):
         for j, t in enumerate(sorted(self.eos)[:MAX_EOS_IDS]):
             self.eos_row[j] = t
         self.eos_drops = 0  # suppressed-EOS resamples past the device mask
+        self.offload_mark = 0  # chain blocks already queued for offload
 
     @property
     def needs_eos_suppress(self) -> bool:
@@ -147,6 +153,15 @@ class _Sequence(SequenceState):
     @property
     def num_generated(self) -> int:
         return len(self.token_ids) - self.num_prompt
+
+    @property
+    def kv_written(self) -> int:
+        """Positions whose KV is actually in the device cache. A sampled
+        token's KV is only written when it is FED on the next decode step,
+        so the newest appended token is always unwritten — offloading a
+        block that contains it would store a hole and corrupt every later
+        onboard of that hash."""
+        return self.num_prompt + max(0, self.num_generated - 1)
 
 
 class JaxEngine:
@@ -193,10 +208,18 @@ class JaxEngine:
         # shipped to the prefill fleet instead of running locally.
         self.disagg_router = disagg_router
         self.remote_prefill_client = remote_prefill_client
-        # Tiered KV offload (KVBM equivalent): finished sequences' blocks
-        # are copied to the host/disk tiers keyed by sequence hash and
+        # Tiered KV offload (KVBM equivalent): blocks are copied to the
+        # host/disk tiers keyed by sequence hash — mid-generation at block
+        # boundaries (rate-limited through the priority queue below, like
+        # the reference's register-time offload in offload.rs), at
+        # preemption time, and in bulk at sequence completion — and
         # onboarded on later prefix hits.
         self.block_manager = block_manager
+        self._offload_queue = None
+        if block_manager is not None:
+            from dynamo_tpu.block_manager.offload import OffloadQueue
+
+            self._offload_queue = OffloadQueue()
         # G4-lite (block_manager/peer.py): pull a missing prefix from a
         # peer worker's host tier instead of recomputing it
         self.peer_block_client = peer_block_client
@@ -324,7 +347,9 @@ class JaxEngine:
             # stored events already published for these sequences are about
             # to be wiped by the Cleared event; re-emitting on the next
             # block boundary re-registers live prefixes with the router
+            # (and re-queues their offload into the freshly emptied tier)
             seq.emitted_hashes = 0
+            seq.offload_mark = 0
         if self.on_cache_cleared is not None:
             self.on_cache_cleared()
         return {
@@ -346,6 +371,25 @@ class JaxEngine:
             self._hash_refs[b.block_hash] = (
                 self._hash_refs.get(b.block_hash, 0) + 1
             )
+        if self._offload_queue is not None:
+            # mid-generation offload: completed blocks become host-tier
+            # candidates as soon as they are KV-complete, so waiting
+            # requests can prefix-hit a sequence that is still generating
+            # (reference offload.rs enqueues at block *registration*, not
+            # completion). Hash-complete lags KV-complete by one token
+            # (see kv_written), hence the separate offload_mark cursor.
+            bs = self.config.block_size
+            ready = min(len(seq.hash_seq.blocks), seq.kv_written // bs)
+            if ready > seq.offload_mark:
+                self._offload_queue.enqueue(
+                    seq,
+                    [
+                        (b.block_hash, b.position)
+                        for b in seq.hash_seq.blocks[seq.offload_mark:ready]
+                        if b.block_hash not in self.block_manager
+                    ],
+                )
+                seq.offload_mark = ready
         if not new or self.on_blocks_stored is None:
             seq.emitted_hashes = len(seq.hash_seq.blocks)
             return
@@ -380,6 +424,10 @@ class JaxEngine:
     # ----------------------------------------------------------- schedule
 
     def _free_seq(self, seq: _Sequence, emit_remove: bool = True) -> None:
+        if self._offload_queue is not None:
+            # queued candidates now point at blocks about to be recycled;
+            # drop them so their hashes can re-enqueue via another holder
+            self._offload_queue.forget_seq(seq)
         if seq.slot is not None:
             self.slots[seq.slot] = None
             seq.slot = None
@@ -413,44 +461,97 @@ class JaxEngine:
             or reason in (FinishReason.ERROR, FinishReason.CANCELLED)
         ):
             return
-        full = seq.hash_seq.blocks
         pairs = [
-            (b.block_hash, seq.block_ids[i])
-            for i, b in enumerate(full)
-            if i < len(seq.block_ids) and b.block_hash not in self.block_manager
+            (h, seq.block_ids[i]) for h, i in self._offload_pairs(seq)
         ]
         if not pairs:
             return
         owned, seq.block_ids = seq.block_ids, []
-        t = asyncio.get_running_loop().create_task(
-            self._offload_task(owned, pairs)
-        )
+        self._spawn_tracked(self._offload_task(owned, pairs))
+
+    def _spawn_tracked(self, coro) -> asyncio.Task:
+        t = asyncio.get_running_loop().create_task(coro)
         self._remote_tasks.add(t)
         t.add_done_callback(self._remote_tasks.discard)
+        return t
 
-    async def _offload_task(
-        self, owned_ids: list[int], pairs: list[tuple[int, int]]
+    async def _copy_blocks_to_tier(
+        self, ids: list[int], hashes: list[int]
     ) -> None:
+        """Extract device blocks (serialized with all runner calls), then
+        store them in the host tier from a background task — the memcpys
+        and possible disk spill must not sit on the decode latency path.
+        Returns once the device copies are safe on host (the extract), so
+        callers may free/recycle the device blocks immediately."""
         loop = asyncio.get_running_loop()
         try:
-            ids = [bid for _, bid in pairs]
             async with self._device_lock:
                 k, v = await loop.run_in_executor(
                     None, self.runner.extract_blocks, ids
                 )
-            # host memcpys + possible disk spill: keep off the event loop
-            await loop.run_in_executor(
-                None,
-                self.block_manager.store_blocks,
-                [h for h, _ in pairs],
-                k,
-                v,
-            )
         except Exception:  # noqa: BLE001 — offload is best-effort
-            logger.exception("block offload failed")
+            logger.exception("block offload extract failed")
+            return
+        self._spawn_tracked(self._store_blocks_task(hashes, k, v))
+
+    async def _store_blocks_task(self, hashes, k, v) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            stored = await loop.run_in_executor(
+                None, self.block_manager.store_blocks, hashes, k, v
+            )
+            if self._offload_queue is not None:
+                self._offload_queue.stats.offloaded += stored
+        except Exception:  # noqa: BLE001 — offload is best-effort
+            logger.exception("block offload store failed")
         finally:
+            self._wake.set()
+
+    async def _offload_task(
+        self, owned_ids: list[int], pairs: list[tuple[int, int]]
+    ) -> None:
+        try:
+            await self._copy_blocks_to_tier(
+                [bid for _, bid in pairs], [h for h, _ in pairs]
+            )
+        finally:
+            # the extract has completed (or failed) — device blocks are
+            # recyclable now; the host-side store continues in background
             self.allocator.free(owned_ids)
             self._wake.set()
+
+    def _offload_pairs(
+        self, seq: _Sequence
+    ) -> list[tuple[int, int]]:
+        """(hash, chain-index) pairs of this sequence's offloadable blocks:
+        KV-complete (see kv_written — when the final sampled token exactly
+        completes a block, that block's last KV slot was never written and
+        storing it would poison later onboards), device-resident, and not
+        already in the host tier."""
+        kv_complete = seq.kv_written // self.config.block_size
+        return [
+            (b.block_hash, i)
+            for i, b in enumerate(seq.hash_seq.blocks)
+            if i < min(len(seq.block_ids), kv_complete)
+            and b.block_hash not in self.block_manager
+        ]
+
+    async def _drain_offload(self) -> None:
+        """Copy a few queued mid-generation blocks to the host tier.
+
+        Runs on the engine loop between scheduling phases, so candidate
+        validity (checked in pop_valid) cannot change before the extract:
+        preemption and sequence completion only happen on this same loop.
+        Rate-limited to offload_per_step blocks per iteration."""
+        q = self._offload_queue
+        if q is None or not len(q):
+            return
+        cands = q.pop_valid(self.config.offload_per_step, self.block_manager)
+        if not cands:
+            return
+        await self._copy_blocks_to_tier(
+            [bid for _, _, bid in cands], [h for _, h, _ in cands]
+        )
 
     def _key_row(self, seq: _Sequence) -> np.ndarray:
         """Raw threefry key row for this sequence's next sampled token:
@@ -477,13 +578,52 @@ class JaxEngine:
             if victim is exclude or victim.slot is None or victim.pending_remote:
                 continue
             logger.debug("preempting seq %d", victim.seq_id)
-            # drop generated KV; it will re-prefill from its full token_ids
+            # spill completed blocks to the host tier before the device
+            # copies are recycled; re-admission then onboards them instead
+            # of recomputing (reference offload.rs eviction-time offload)
+            self._spill_preempted(victim)
             self._free_seq(victim)
             victim.hash_seq = None
             victim.emitted_hashes = 0
+            victim.offload_mark = 0
             self.waiting.insert(0, victim)
             return True
         return False
+
+    def _spill_preempted(self, victim: _Sequence) -> None:
+        """Move ownership of the victim's not-yet-offloaded full blocks to
+        an offload task; everything else (partial tail + already-offloaded
+        blocks) frees immediately. At least one block is always freed now —
+        the preemptor's allocation (the reason we preempt) must succeed
+        without waiting for the host copies."""
+        bm = self.block_manager
+        if (
+            bm is None
+            or self._closed
+            or victim.hash_seq is None
+            or not victim.block_ids
+        ):
+            return
+        pairs = self._offload_pairs(victim)
+        if len(pairs) >= len(victim.block_ids):
+            # every device block is a spill candidate: sacrifice the NEWEST
+            # so the preemptor can allocate immediately — dropping the
+            # oldest would break prefix contiguity and make the whole spill
+            # unreachable (lookup_prefix only counts leading hits)
+            pairs = pairs[:-1]
+        if not pairs:
+            return
+        spill_positions = {i for _, i in pairs}
+        owned = [victim.block_ids[i] for _, i in pairs]
+        hash_block = [
+            (h, victim.block_ids[i]) for h, i in pairs
+        ]
+        victim.block_ids = [
+            bid
+            for i, bid in enumerate(victim.block_ids)
+            if i not in spill_positions
+        ]
+        self._spawn_tracked(self._offload_task(owned, hash_block))
 
     def _try_admit(self, seq: _Sequence) -> bool:
         """Allocate blocks + a slot and run prefill. False if no capacity."""
@@ -506,6 +646,7 @@ class JaxEngine:
         while not self._closed:
             self._reap_cancelled()
             self._process_landed()
+            await self._drain_offload()
             admitted = await self._admit_phase(loop)
             # one chunk of at most one long prefill per iteration, so the
             # decode step below never waits longer than one chunk
@@ -613,13 +754,22 @@ class JaxEngine:
                 # ship the prefill out; the sequence holds its slot+blocks
                 # and joins the decode batch when the KV lands
                 seq.pending_remote = True
-                t = loop.create_task(self._remote_prefill_task(seq))
-                self._remote_tasks.add(t)
-                t.add_done_callback(self._remote_tasks.discard)
+                self._spawn_tracked(self._remote_prefill_task(seq))
                 continue
             # re-admission after preemption replays generated tokens too
             replay = seq.token_ids
-            if chunk_c and len(replay) > chunk_c:
+            bs = self.config.block_size
+            # a prefix hit that skips >=1 full block routes through the
+            # chunked path even for short prompts: prefill_chunk is the
+            # only program that computes from an offset, so this is what
+            # turns a host-tier hit into saved compute (onboard-into-
+            # waiting-request, reference offload.rs onboarding)
+            skippable = 0
+            if self.block_manager is not None and seq.cached_prefix_blocks:
+                skippable = min(
+                    seq.cached_prefix_blocks, (len(replay) - 1) // bs
+                )
+            if chunk_c and (len(replay) > chunk_c or skippable > 0):
                 # long prompt: prefill one chunk per loop iteration so the
                 # in-flight decode batch never stalls more than one chunk
                 seq.prefilling = True
@@ -631,7 +781,6 @@ class JaxEngine:
                     # sample comes from real logits
                     onboarded = await self._onboard_prefix(seq, loop)
                     if onboarded:
-                        bs = self.config.block_size
                         skip = min(onboarded, (len(replay) - 1) // bs)
                         seq.prefill_pos = skip * bs
                 self._prefilling.append(seq)

@@ -167,8 +167,45 @@ async def build_jax_engine(
             rng_seed=rng_seed,
             decode_horizon=default_decode_horizon(),
         ),
+        block_manager=_maybe_block_manager(config, kv_block_size),
     )
     return engine, mdc
+
+
+def _maybe_block_manager(config, kv_block_size: int):
+    """Tiered KV offload (the KVBM role, reference block_manager/):
+    DYN_KV_HOST_OFFLOAD_GB > 0 enables the host tier (G2), sized in
+    whole blocks; DYN_KV_DISK_DIR adds the disk tier (G3), capped at
+    DYN_KV_DISK_GB (0 = unbounded). Unset => disabled, matching the
+    reference where KVBM is opt-in per deployment."""
+    gb = float(os.environ.get("DYN_KV_HOST_OFFLOAD_GB", "0") or 0)
+    if gb <= 0:
+        return None
+    from dynamo_tpu.block_manager import LayoutConfig, TieredBlockManager
+
+    layout = LayoutConfig(
+        num_layers=config.num_layers,
+        page_size=kv_block_size,
+        num_kv_heads=config.num_kv_heads,
+        head_dim=config.head_dim,
+        dtype="bfloat16",
+    )
+    host_blocks = max(1, int(gb * 2**30 // layout.block_nbytes))
+    disk_dir = os.environ.get("DYN_KV_DISK_DIR") or None
+    disk_blocks = 0
+    if disk_dir:
+        disk_gb = float(os.environ.get("DYN_KV_DISK_GB", "0") or 0)
+        disk_blocks = int(disk_gb * 2**30 // layout.block_nbytes)
+    logger.info(
+        "KV offload tiers: host %d blocks (%.2f GiB)%s",
+        host_blocks, gb,
+        f", disk at {disk_dir} ({disk_blocks or 'unbounded'} blocks)"
+        if disk_dir else "",
+    )
+    return TieredBlockManager(
+        layout, host_blocks=host_blocks,
+        disk_dir=disk_dir, disk_blocks=disk_blocks,
+    )
 
 
 def default_decode_horizon() -> int:

@@ -183,10 +183,11 @@ async def test_engine_offloads_on_finish():
     prompt = list(range(2, 15))  # 13 tokens -> 3 full blocks
     await collect(engine, prompt, max_tokens=8)
     for _ in range(100):
-        if bm.stats.offloaded_g2 >= 3:
+        if bm.stats.offloaded_g2 >= 5:
             break
         await asyncio.sleep(0.02)
     # 13 prompt + 8 generated = 21 tokens -> 5 full blocks offloaded
+    # (mid-generation drain + completion offload together cover them)
     assert bm.stats.offloaded_g2 == 5
     await engine.close()
     await engine0.close()
