@@ -1,0 +1,41 @@
+"""Kubernetes operator: GraphDeployment -> running workloads.
+
+Role-equivalent of the reference's Go operator (deploy/cloud/operator,
+~8.7k LoC): CRDs DynamoGraphDeployment / DynamoComponentDeployment
+(api/v1alpha1/dynamographdeployment_types.go — spec.services maps service
+name -> component spec) reconciled by controllers into Deployments and
+Services. Ours is a Python controller over the same minimal REST client
+the planner already uses (planner/connectors.py KubernetesApi):
+
+  * resources.py — the GraphDeployment object model (spec.services map,
+    replicas/image/command/env/ports per service) + the Deployment /
+    Service manifests each service renders to.
+  * controller.py — the reconcile loop: observe CRs, create missing
+    workloads, heal deleted ones, patch drift (replicas/image), delete
+    orphans, write CR status.
+
+The planner closes the loop the same way the reference does: it patches
+`spec.services.<name>.replicas` on the CR (planner/connectors.py
+GraphCRDConnector), and the operator actuates the change.
+
+Run in-cluster: `python -m dynamo_tpu.operator` (deploy/k8s/operator.yaml).
+"""
+
+from dynamo_tpu.operator.controller import GraphOperator, ReconcileResult
+from dynamo_tpu.operator.resources import (
+    GRAPH_GROUP,
+    GRAPH_PLURAL,
+    GRAPH_VERSION,
+    GraphDeployment,
+    ServiceSpec,
+)
+
+__all__ = [
+    "GRAPH_GROUP",
+    "GRAPH_PLURAL",
+    "GRAPH_VERSION",
+    "GraphDeployment",
+    "GraphOperator",
+    "ReconcileResult",
+    "ServiceSpec",
+]

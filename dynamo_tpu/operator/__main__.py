@@ -1,0 +1,47 @@
+"""In-cluster operator entrypoint: `python -m dynamo_tpu.operator`.
+
+Env: DYN_OPERATOR_POLL_S (reconcile interval, default 5),
+DYN_OPERATOR_NAMESPACE (defaults to the serviceaccount namespace).
+Deployed by deploy/k8s/operator.yaml.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+
+from dynamo_tpu.operator.controller import GraphOperator
+from dynamo_tpu.planner.connectors import KubernetesApi
+from dynamo_tpu.runtime import logging as dyn_logging
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.operator.main")
+
+
+async def amain() -> None:
+    api = KubernetesApi(namespace=os.environ.get("DYN_OPERATOR_NAMESPACE"))
+    op = GraphOperator(
+        api, poll_s=float(os.environ.get("DYN_OPERATOR_POLL_S", "5"))
+    )
+    loop = asyncio.get_running_loop()
+    stop = asyncio.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    task = op.start()
+    logger.info(
+        "operator watching %s (poll %.1fs)", api.namespace, op.poll_s
+    )
+    await stop.wait()
+    await op.stop()
+    await task
+    await api.close()
+
+
+def main() -> None:
+    dyn_logging.init()
+    asyncio.run(amain())
+
+
+if __name__ == "__main__":
+    main()

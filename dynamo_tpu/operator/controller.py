@@ -1,0 +1,210 @@
+"""The reconcile loop: GraphDeployment CRs -> Deployments/Services.
+
+Role-equivalent of the reference operator's controllers
+(deploy/cloud/operator/internal/controller: DynamoGraphDeployment
+reconciler creating one component workload per spec.services entry, with
+drift correction and garbage collection via ownerReferences). Level-
+triggered like controller-runtime: each pass observes ALL state and
+converges it — create missing workloads, re-create deleted ones ("heal"),
+patch drift (replicas/image/env/...), delete orphans whose service left
+the spec or whose CR is gone — so a missed event costs one poll interval,
+never correctness.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.operator.resources import (
+    GRAPH_GROUP,
+    GRAPH_PLURAL,
+    GRAPH_VERSION,
+    LABEL_GRAPH,
+    LABEL_MANAGED,
+    MANAGER_NAME,
+    GraphDeployment,
+    drift,
+)
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.operator")
+
+_MANAGED_SELECTOR = f"{LABEL_MANAGED}={MANAGER_NAME}"
+
+
+@dataclass
+class ReconcileResult:
+    created: list[str] = field(default_factory=list)
+    patched: list[str] = field(default_factory=list)
+    deleted: list[str] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)
+
+    @property
+    def changed(self) -> bool:
+        return bool(self.created or self.patched or self.deleted)
+
+
+class GraphOperator:
+    """Reconciles every GraphDeployment in one namespace."""
+
+    def __init__(self, api, poll_s: float = 5.0) -> None:
+        self.api = api  # planner.connectors.KubernetesApi (or a fake)
+        self.poll_s = poll_s
+        self._stop = asyncio.Event()
+        self._task: Optional[asyncio.Task] = None
+        self.reconciles = 0
+
+    # ------------------------------------------------------------ one pass
+
+    async def reconcile_once(self) -> ReconcileResult:
+        res = ReconcileResult()
+        crs = await self.api.list_resources(
+            GRAPH_GROUP, GRAPH_VERSION, GRAPH_PLURAL
+        )
+        graphs: dict[str, GraphDeployment] = {}
+        # graphs whose CR exists but failed to parse: their workloads are
+        # EXEMPT from orphan GC — a malformed edit must leave the running
+        # graph untouched, not wipe it
+        broken: set[str] = set()
+        for obj in crs:
+            name = obj.get("metadata", {}).get("name", "")
+            try:
+                g = GraphDeployment.from_object(obj)
+                graphs[g.name] = g
+            except ValueError as e:
+                broken.add(name)
+                res.errors.append(str(e))
+                logger.error(
+                    "invalid GraphDeployment %r skipped (workloads kept): %s",
+                    name, e,
+                )
+        deployments = await self._reconcile_kind(
+            "apps", "v1", "deployments", graphs, broken, res,
+            render=lambda g, s: g.render_deployment(s),
+        )
+        await self._reconcile_kind(
+            "", "v1", "services", graphs, broken, res,
+            render=lambda g, s: g.render_service(s),
+        )
+        for g in graphs.values():
+            await self._write_status(g, deployments)
+        self.reconciles += 1
+        if res.changed:
+            logger.info(
+                "reconcile: created=%s patched=%s deleted=%s",
+                res.created, res.patched, res.deleted,
+            )
+        return res
+
+    async def _reconcile_kind(
+        self, group: str, version: str, plural: str,
+        graphs: dict[str, GraphDeployment], broken: set[str],
+        res: ReconcileResult, render,
+    ) -> dict[str, dict]:
+        """Converge one kind; returns the post-reconcile objects by name
+        (listed state updated with create/patch responses, so callers can
+        read fresh status without extra GETs)."""
+        actual = {
+            o["metadata"]["name"]: o
+            for o in await self.api.list_resources(
+                group, version, plural, label_selector=_MANAGED_SELECTOR
+            )
+        }
+        desired: dict[str, dict] = {}
+        for g in graphs.values():
+            for svc in g.services.values():
+                obj = render(g, svc)
+                if obj is not None:
+                    desired[obj["metadata"]["name"]] = obj
+        for name, obj in desired.items():
+            cur = actual.get(name)
+            if cur is None:
+                actual[name] = await self.api.create_resource(
+                    group, version, plural, obj
+                )
+                res.created.append(f"{plural}/{name}")
+            else:
+                patch = drift(obj, cur)
+                if patch is not None:
+                    actual[name] = await self.api.patch_resource(
+                        group, version, plural, name, patch
+                    )
+                    res.patched.append(f"{plural}/{name}")
+        # orphans: managed objects whose graph/service no longer exists.
+        # Only objects carrying our managed-by label are ever deleted —
+        # the operator must not GC workloads it didn't create, nor those
+        # of a graph whose CR merely failed to parse.
+        for name, obj in list(actual.items()):
+            if name in desired:
+                continue
+            labels = obj.get("metadata", {}).get("labels", {})
+            if labels.get(LABEL_MANAGED) != MANAGER_NAME:
+                continue
+            graph = labels.get(LABEL_GRAPH)
+            if graph is None or graph in broken:
+                continue
+            await self.api.delete_resource(group, version, plural, name)
+            del actual[name]
+            res.deleted.append(f"{plural}/{name}")
+        return actual
+
+    async def _write_status(
+        self, g: GraphDeployment, deployments: dict[str, dict]
+    ) -> None:
+        """Publish observed readiness onto the CR's status SUBRESOURCE
+        (the CRD enables it, so a main-resource patch would be silently
+        stripped; reference: reconciler status updates on
+        DynamoGraphDeployment)."""
+        services: dict[str, dict] = {}
+        ready_all = True
+        for svc in g.services.values():
+            dep = deployments.get(g.workload_name(svc.name))
+            ready = int(
+                ((dep or {}).get("status", {}) or {}).get("readyReplicas", 0)
+                or 0
+            )
+            services[svc.name] = {"replicas": svc.replicas, "ready": ready}
+            if ready < svc.replicas:
+                ready_all = False
+        try:
+            await self.api.patch_resource(
+                GRAPH_GROUP, GRAPH_VERSION, GRAPH_PLURAL, g.name,
+                {
+                    "status": {
+                        "state": "Ready" if ready_all else "Progressing",
+                        "observedGeneration": g.generation,
+                        "services": services,
+                    }
+                },
+                subresource="status",
+            )
+        except Exception:  # noqa: BLE001 — status is best-effort
+            logger.exception("status update failed for %s", g.name)
+
+    # ------------------------------------------------------------ run loop
+
+    async def run(self) -> None:
+        """Poll-and-reconcile until stop() — level-triggered, so a poll
+        interval is the only cost of not holding a watch connection."""
+        while not self._stop.is_set():
+            try:
+                await self.reconcile_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.exception("reconcile pass failed")
+            try:
+                await asyncio.wait_for(
+                    self._stop.wait(), timeout=self.poll_s
+                )
+            except asyncio.TimeoutError:
+                pass
+
+    def start(self) -> asyncio.Task:
+        self._task = asyncio.get_running_loop().create_task(self.run())
+        return self._task
+
+    async def stop(self) -> None:
+        self._stop.set()
+        if self._task is not None:
+            await self._task

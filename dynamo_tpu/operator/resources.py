@@ -1,0 +1,230 @@
+"""GraphDeployment object model and the workloads it renders to.
+
+Matches the reference CRD's shape (deploy/cloud/operator/api/v1alpha1/
+dynamographdeployment_types.go: `spec.services` maps service name ->
+component overrides; dynamocomponentdeployment_types.go carries replicas /
+resources / envs per component): a GraphDeployment names every process of
+one serving graph (frontend, workers, prefill fleet, router, planner) and
+the operator owns turning that into apps/v1 Deployments + v1 Services.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+GRAPH_GROUP = "dynamo.tpu"
+GRAPH_VERSION = "v1alpha1"
+GRAPH_PLURAL = "graphdeployments"
+GRAPH_KIND = "GraphDeployment"
+
+# every object the operator creates carries these labels; the graph label
+# is how reconcile finds (and garbage-collects) what it owns — the role
+# the reference delegates to ownerReferences + controller-runtime GC
+LABEL_GRAPH = "dynamo.tpu/graph"
+LABEL_SERVICE = "dynamo.tpu/service"
+LABEL_MANAGED = "app.kubernetes.io/managed-by"
+MANAGER_NAME = "dynamo-tpu-operator"
+
+
+@dataclass
+class ServiceSpec:
+    """One service (component) of the graph."""
+
+    name: str
+    replicas: int = 1
+    image: str = ""
+    command: list[str] = field(default_factory=list)
+    env: dict[str, str] = field(default_factory=dict)
+    ports: list[int] = field(default_factory=list)
+    resources: dict[str, Any] = field(default_factory=dict)  # k8s resources
+    service: bool = False  # render a ClusterIP Service for the ports
+
+    @classmethod
+    def from_dict(cls, name: str, d: dict) -> "ServiceSpec":
+        if not isinstance(d, dict):
+            raise ValueError(f"service {name!r}: spec must be a mapping")
+        replicas = int(d.get("replicas", 1))
+        if replicas < 0:
+            raise ValueError(f"service {name!r}: replicas must be >= 0")
+        env = d.get("env", {}) or {}
+        if isinstance(env, list):  # k8s EnvVar list form
+            env = {e["name"]: str(e.get("value", "")) for e in env}
+        return cls(
+            name=name,
+            replicas=replicas,
+            image=str(d.get("image", "")),
+            command=[str(c) for c in d.get("command", []) or []],
+            env={str(k): str(v) for k, v in env.items()},
+            ports=[int(p) for p in d.get("ports", []) or []],
+            resources=d.get("resources", {}) or {},
+            service=bool(d.get("service", bool(d.get("ports")))),
+        )
+
+
+@dataclass
+class GraphDeployment:
+    """Parsed GraphDeployment custom resource."""
+
+    name: str
+    namespace: str
+    services: dict[str, ServiceSpec]
+    uid: str = ""
+    generation: int = 0
+
+    @classmethod
+    def from_object(cls, obj: dict) -> "GraphDeployment":
+        meta = obj.get("metadata", {})
+        spec = obj.get("spec", {}) or {}
+        raw = spec.get("services", {}) or {}
+        if not raw:
+            raise ValueError("GraphDeployment.spec.services must not be empty")
+        services = {
+            name: ServiceSpec.from_dict(name, d or {})
+            for name, d in raw.items()
+        }
+        return cls(
+            name=meta.get("name", ""),
+            namespace=meta.get("namespace", "default"),
+            services=services,
+            uid=meta.get("uid", ""),
+            generation=int(meta.get("generation", 0)),
+        )
+
+    def workload_name(self, service: str) -> str:
+        return f"{self.name}-{service}"
+
+    # ------------------------------------------------------------ render
+
+    def render_deployment(self, svc: ServiceSpec) -> dict:
+        """The apps/v1 Deployment this service reconciles to (reference:
+        operator controller generateDeployment for each CRD service)."""
+        labels = {
+            LABEL_GRAPH: self.name,
+            LABEL_SERVICE: svc.name,
+            LABEL_MANAGED: MANAGER_NAME,
+        }
+        container: dict[str, Any] = {
+            "name": svc.name,
+            "image": svc.image or "dynamo-tpu:latest",
+        }
+        if svc.command:
+            container["command"] = svc.command
+        if svc.env:
+            container["env"] = [
+                {"name": k, "value": v} for k, v in sorted(svc.env.items())
+            ]
+        if svc.ports:
+            container["ports"] = [{"containerPort": p} for p in svc.ports]
+        if svc.resources:
+            container["resources"] = svc.resources
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": self.workload_name(svc.name),
+                "namespace": self.namespace,
+                "labels": labels,
+            },
+            "spec": {
+                "replicas": svc.replicas,
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {"containers": [container]},
+                },
+            },
+        }
+
+    def render_service(self, svc: ServiceSpec) -> Optional[dict]:
+        if not (svc.service and svc.ports):
+            return None
+        labels = {
+            LABEL_GRAPH: self.name,
+            LABEL_SERVICE: svc.name,
+            LABEL_MANAGED: MANAGER_NAME,
+        }
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": self.workload_name(svc.name),
+                "namespace": self.namespace,
+                "labels": labels,
+            },
+            "spec": {
+                "selector": labels,
+                "ports": [
+                    {"name": f"port-{p}", "port": p, "targetPort": p}
+                    for p in svc.ports
+                ],
+            },
+        }
+
+
+def _env_map(env_list) -> dict:
+    return {e.get("name"): e.get("value") for e in (env_list or [])}
+
+
+def _port_set(ports, key: str) -> set:
+    return {p.get(key) for p in (ports or [])}
+
+
+def _resources_satisfied(desired: dict, actual: dict) -> bool:
+    """Every limits/requests entry we set must be present and equal in the
+    actual (the apiserver defaults requests FROM limits — extra actual
+    entries are its work, not drift)."""
+    for section, want in (desired or {}).items():
+        have = (actual or {}).get(section, {})
+        for k, v in (want or {}).items():
+            if str(have.get(k)) != str(v):
+                return False
+    return True
+
+
+def drift(desired: dict, actual: dict) -> Optional[dict]:
+    """Merge patch bringing `actual` to `desired`, or None.
+
+    Only fields the operator owns are compared, and each comparison is
+    defaulting-aware: the apiserver adds protocol:TCP to every port,
+    defaults resources.requests from limits, and may inject env — none of
+    that may cause patch churn on every poll (the reference relies on
+    controller-runtime's semantic DeepEqual for the same reason). When a
+    container field HAS drifted, the complete desired container is sent
+    (merge-patch replaces the containers list wholesale).
+    """
+    d_spec, a_spec = desired.get("spec", {}), actual.get("spec", {})
+    patch_spec: dict[str, Any] = {}
+    if "template" not in d_spec:
+        # a v1 Service: the operator owns port numbers + selector only
+        if _port_set(d_spec.get("ports"), "port") != _port_set(
+            a_spec.get("ports"), "port"
+        ):
+            patch_spec["ports"] = d_spec.get("ports")
+        if d_spec.get("selector") != a_spec.get("selector"):
+            patch_spec["selector"] = d_spec.get("selector")
+        return {"spec": patch_spec} if patch_spec else None
+    if int(d_spec.get("replicas", 1)) != int(a_spec.get("replicas", 1) or 0):
+        patch_spec["replicas"] = int(d_spec.get("replicas", 1))
+    d_c = d_spec["template"]["spec"]["containers"][0]
+    try:
+        a_c = a_spec["template"]["spec"]["containers"][0]
+    except (KeyError, IndexError):
+        a_c = {}
+    a_env = _env_map(a_c.get("env"))
+    dirty = (
+        d_c.get("image") != a_c.get("image")
+        or (d_c.get("command") or []) != (a_c.get("command") or [])
+        # envs we set must hold their values; injected extras are fine
+        or any(a_env.get(k) != v for k, v in _env_map(d_c.get("env")).items())
+        or _port_set(d_c.get("ports"), "containerPort")
+        != _port_set(a_c.get("ports"), "containerPort")
+        or not _resources_satisfied(
+            d_c.get("resources"), a_c.get("resources")
+        )
+    )
+    if dirty:
+        patch_spec["template"] = {"spec": {"containers": [d_c]}}
+    if not patch_spec:
+        return None
+    return {"spec": patch_spec}

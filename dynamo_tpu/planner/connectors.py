@@ -123,6 +123,8 @@ class KubernetesApi:
         ca_path: Optional[str] = None,
     ) -> None:
         if base_url is None:
+            base_url = os.environ.get("DYN_KUBE_API_URL")  # dev/kind/proxy
+        if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
             base_url = f"https://{host}:{port}"
@@ -169,36 +171,105 @@ class KubernetesApi:
             self._session = aiohttp.ClientSession()
         return self._session
 
-    def _url(self, plural: str, name: str = "") -> str:
-        path = (
-            f"{self.base_url}/apis/apps/v1/namespaces/{self.namespace}/{plural}"
+# ---------------------------------------------- generic resource API
+    # (used by the operator's reconcile loop and the CRD connector; covers
+    # core-group resources — group="" — and named API groups alike)
+
+    def resource_url(
+        self, group: str, version: str, plural: str, name: str = ""
+    ) -> str:
+        prefix = (
+            f"{self.base_url}/api/{version}"
+            if not group
+            else f"{self.base_url}/apis/{group}/{version}"
         )
+        path = f"{prefix}/namespaces/{self.namespace}/{plural}"
         return f"{path}/{name}" if name else path
 
-    async def get_workload(self, plural: str, name: str) -> Optional[dict]:
-        """GET one Deployment/StatefulSet; None on 404."""
+    async def list_resources(
+        self, group: str, version: str, plural: str,
+        label_selector: Optional[str] = None,
+    ) -> list[dict]:
+        s = await self._sess()
+        params = {"labelSelector": label_selector} if label_selector else None
+        async with s.get(
+            self.resource_url(group, version, plural),
+            params=params, headers=self._headers(), ssl=self._ssl,
+        ) as r:
+            r.raise_for_status()
+            return (await r.json()).get("items", [])
+
+    async def get_resource(
+        self, group: str, version: str, plural: str, name: str
+    ) -> Optional[dict]:
         s = await self._sess()
         async with s.get(
-            self._url(plural, name), headers=self._headers(), ssl=self._ssl
+            self.resource_url(group, version, plural, name),
+            headers=self._headers(), ssl=self._ssl,
         ) as r:
             if r.status == 404:
                 return None
             r.raise_for_status()
             return await r.json()
 
-    async def patch_replicas(self, plural: str, name: str, n: int) -> None:
-        """Strategic-merge-patch spec.replicas (the reference patches the
-        same field on its CRD, kube.py update_graph_replicas)."""
+    async def create_resource(
+        self, group: str, version: str, plural: str, obj: dict
+    ) -> dict:
+        s = await self._sess()
+        headers = dict(self._headers(), **{"Content-Type": "application/json"})
+        async with s.post(
+            self.resource_url(group, version, plural),
+            data=json.dumps(obj), headers=headers, ssl=self._ssl,
+        ) as r:
+            r.raise_for_status()
+            return await r.json()
+
+    async def patch_resource(
+        self, group: str, version: str, plural: str, name: str, patch: dict,
+        subresource: str = "",
+    ) -> dict:
+        """JSON merge-patch (RFC 7386). Strategic merge is NOT used: real
+        apiservers reject it with 415 for custom resources, and every
+        patch we send (replicas, whole-container template, status) is
+        merge-patch shaped — lists are always sent complete. `subresource`
+        (e.g. "status") targets .../{name}/{subresource}; with the status
+        subresource enabled on a CRD, patching the main resource silently
+        drops status changes."""
         s = await self._sess()
         headers = dict(
             self._headers(),
-            **{"Content-Type": "application/strategic-merge-patch+json"},
+            **{"Content-Type": "application/merge-patch+json"},
         )
-        body = json.dumps({"spec": {"replicas": int(n)}})
+        url = self.resource_url(group, version, plural, name)
+        if subresource:
+            url = f"{url}/{subresource}"
         async with s.patch(
-            self._url(plural, name), data=body, headers=headers, ssl=self._ssl
+            url, data=json.dumps(patch), headers=headers, ssl=self._ssl,
         ) as r:
             r.raise_for_status()
+            return await r.json()
+
+    async def delete_resource(
+        self, group: str, version: str, plural: str, name: str
+    ) -> None:
+        s = await self._sess()
+        async with s.delete(
+            self.resource_url(group, version, plural, name),
+            headers=self._headers(), ssl=self._ssl,
+        ) as r:
+            if r.status != 404:
+                r.raise_for_status()
+
+    async def get_workload(self, plural: str, name: str) -> Optional[dict]:
+        """GET one Deployment/StatefulSet; None on 404."""
+        return await self.get_resource("apps", "v1", plural, name)
+
+    async def patch_replicas(self, plural: str, name: str, n: int) -> None:
+        """Merge-patch spec.replicas (the reference patches the same field
+        on its CRD, kube.py update_graph_replicas)."""
+        await self.patch_resource(
+            "apps", "v1", plural, name, {"spec": {"replicas": int(n)}}
+        )
 
     async def wait_ready(
         self,
@@ -226,6 +297,68 @@ class KubernetesApi:
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
+
+
+class GraphCRDConnector:
+    """Planner Connector that scales through the GraphDeployment CR.
+
+    The reference planner's KubernetesConnector patches
+    `spec.services.<name>.replicas` on the DynamoGraphDeployment CRD and
+    lets the operator actuate (planner/kube.py update_graph_replicas).
+    This is our equivalent: planner writes intent into the CR, the
+    operator's reconcile loop (dynamo_tpu/operator/) converges workloads.
+
+    mapping: {planner component: CR service name}.
+    """
+
+    def __init__(
+        self,
+        graph_name: str,
+        mapping: dict[str, str],
+        api: Optional["KubernetesApi"] = None,
+    ) -> None:
+        from dynamo_tpu.operator.resources import (
+            GRAPH_GROUP,
+            GRAPH_PLURAL,
+            GRAPH_VERSION,
+        )
+
+        self._gvp = (GRAPH_GROUP, GRAPH_VERSION, GRAPH_PLURAL)
+        self.graph_name = graph_name
+        self.mapping = mapping
+        self.api = api or KubernetesApi()
+        self._cache: dict[str, int] = {}
+
+    def replicas(self, component: str) -> int:
+        return self._cache.get(component, 0)
+
+    async def refresh(self) -> None:
+        g, v, p = self._gvp
+        obj = await self.api.get_resource(g, v, p, self.graph_name)
+        if obj is None:
+            return
+        services = (obj.get("spec", {}) or {}).get("services", {}) or {}
+        for comp, svc in self.mapping.items():
+            if svc in services:
+                self._cache[comp] = int(
+                    (services[svc] or {}).get("replicas", 1)
+                )
+
+    async def set_replicas(self, component: str, n: int) -> None:
+        g, v, p = self._gvp
+        svc = self.mapping[component]
+        await self.api.patch_resource(
+            g, v, p, self.graph_name,
+            {"spec": {"services": {svc: {"replicas": int(n)}}}},
+        )
+        self._cache[component] = n
+        logger.info(
+            "planner intent: %s (%s.%s) -> %d replicas",
+            component, self.graph_name, svc, n,
+        )
+
+    async def close(self) -> None:
+        await self.api.close()
 
 
 class KubernetesConnector:

@@ -53,6 +53,7 @@ async def serve_graph(
     extra_env: Optional[dict[str, str]] = None,
     replica_overrides: Optional[dict[str, int]] = None,
     fabric_addr: Optional[str] = None,
+    only: Optional[set[str]] = None,
 ) -> Supervisor:
     """Start the graph; returns the running Supervisor (also the FT-test
     entry point — tests kill members and assert recovery)."""
@@ -69,6 +70,16 @@ async def serve_graph(
         fabric_proc.stop_last = True  # services deregister before it dies
         addr = f"127.0.0.1:{port}"
     specs = load_graph(graph_module)
+    if only:
+        # one service of the graph per process — how the k8s operator
+        # deploys graphs (each spec.services entry is its own Deployment)
+        unknown = only - {s.name for s in specs}
+        if unknown:
+            raise SystemExit(
+                f"--only {sorted(unknown)}: not in graph "
+                f"{[s.name for s in specs]}"
+            )
+        specs = [s for s in specs if s.name in only]
     logger.info(
         "graph %s: %s (fabric %s)",
         graph_module, [s.name for s in specs], addr,
@@ -105,6 +116,11 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="override a service's replica count",
     )
     parser.add_argument("--fabric-addr", default=None)
+    parser.add_argument(
+        "--only", action="append", default=[], metavar="NAME",
+        help="launch only these graph services (repeatable; the k8s "
+        "operator runs one service per Deployment this way)",
+    )
     args = parser.parse_args(argv)
     extra_env = dict(kv.split("=", 1) for kv in args.env)
     replicas = {
@@ -117,6 +133,7 @@ def main(argv: Optional[list[str]] = None) -> None:
             extra_env=extra_env,
             replica_overrides=replicas,
             fabric_addr=args.fabric_addr,
+            only=set(args.only) or None,
         )
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()

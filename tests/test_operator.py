@@ -1,0 +1,424 @@
+"""Operator e2e against a faked cluster API: create CR -> workloads
+appear; delete a workload -> it returns; planner patches the CR ->
+replicas change; service leaves the spec / CR deleted -> GC.
+
+Role parity: the reference's Go operator reconciles DynamoGraphDeployment
+into Deployments/Services (deploy/cloud/operator/internal/controller);
+its envtest-style controller tests are the model for testing against a
+fake API server instead of a cluster.
+"""
+
+import asyncio
+import copy
+
+from dynamo_tpu.operator import GraphOperator
+from dynamo_tpu.operator.resources import (
+    GRAPH_GROUP,
+    GRAPH_PLURAL,
+    GRAPH_VERSION,
+    GraphDeployment,
+    ServiceSpec,
+    drift,
+)
+from dynamo_tpu.planner.connectors import GraphCRDConnector, KubernetesApi
+
+
+def _merge(base, patch):
+    """Strategic-merge-lite: dict keys merge recursively, everything else
+    (lists, scalars) replaces — enough for the patches the operator and
+    planner send."""
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _merge(base[k], v)
+        else:
+            base[k] = v
+    return base
+
+
+class _FakeCluster:
+    """In-memory cluster API: list/get/create/patch/delete on any group,
+    labelSelector filtering, deployments instantly 'ready'."""
+
+    def __init__(self):
+        self.objects = {}  # (group, plural, name) -> obj
+        self.log = []
+
+    def put(self, group, plural, obj):
+        name = obj["metadata"]["name"]
+        self.objects[(group, plural, name)] = obj
+
+    async def start(self):
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route(
+            "*", "/api/{version}/namespaces/{ns}/{plural}", self._coll
+        )
+        app.router.add_route(
+            "*", "/api/{version}/namespaces/{ns}/{plural}/{name}", self._one
+        )
+        app.router.add_route(
+            "*", "/apis/{group}/{version}/namespaces/{ns}/{plural}",
+            self._coll,
+        )
+        app.router.add_route(
+            "*", "/apis/{group}/{version}/namespaces/{ns}/{plural}/{name}",
+            self._one,
+        )
+        app.router.add_route(
+            "*",
+            "/apis/{group}/{version}/namespaces/{ns}/{plural}/{name}/status",
+            self._status,
+        )
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        return f"http://127.0.0.1:{port}"
+
+    async def stop(self):
+        await self._runner.cleanup()
+
+    def _gp(self, request):
+        return (
+            request.match_info.get("group", ""),
+            request.match_info["plural"],
+        )
+
+    @staticmethod
+    def _matches(obj, selector):
+        labels = obj.get("metadata", {}).get("labels", {})
+        for clause in selector.split(","):
+            k, _, v = clause.partition("=")
+            if labels.get(k) != v:
+                return False
+        return True
+
+    def _settle(self, obj, plural):
+        """Model apiserver behavior that bit the first implementation:
+        deployments become instantly 'ready', every port gets a defaulted
+        protocol, and resources.requests defaults from limits — drift()
+        must not see any of that as divergence."""
+        if plural == "deployments":
+            obj.setdefault("status", {})["readyReplicas"] = obj["spec"].get(
+                "replicas", 1
+            )
+            try:
+                c = obj["spec"]["template"]["spec"]["containers"][0]
+            except (KeyError, IndexError):
+                return
+            for p in c.get("ports", []) or []:
+                p.setdefault("protocol", "TCP")
+            limits = (c.get("resources") or {}).get("limits")
+            if limits:
+                c["resources"].setdefault("requests", dict(limits))
+        if plural == "services":
+            for p in obj["spec"].get("ports", []) or []:
+                p.setdefault("protocol", "TCP")
+            obj["spec"].setdefault("clusterIP", "10.0.0.1")
+
+    async def _coll(self, request):
+        from aiohttp import web
+
+        group, plural = self._gp(request)
+        if request.method == "GET":
+            sel = request.query.get("labelSelector")
+            items = [
+                o
+                for (g, p, _), o in self.objects.items()
+                if g == group and p == plural
+                and (not sel or self._matches(o, sel))
+            ]
+            return web.json_response({"items": items})
+        if request.method == "POST":
+            obj = await request.json()
+            name = obj["metadata"]["name"]
+            if (group, plural, name) in self.objects:
+                return web.json_response({"kind": "Status"}, status=409)
+            self._settle(obj, plural)
+            self.objects[(group, plural, name)] = obj
+            self.log.append(("create", plural, name))
+            return web.json_response(obj)
+        return web.json_response({"kind": "Status"}, status=405)
+
+    async def _one(self, request):
+        from aiohttp import web
+
+        group, plural = self._gp(request)
+        name = request.match_info["name"]
+        obj = self.objects.get((group, plural, name))
+        if request.method == "GET":
+            if obj is None:
+                return web.json_response({"kind": "Status"}, status=404)
+            return web.json_response(obj)
+        if request.method == "PATCH":
+            if obj is None:
+                return web.json_response({"kind": "Status"}, status=404)
+            body = await request.json()
+            if group == "dynamo.tpu":
+                # the CRD enables the status subresource: main-resource
+                # patches silently drop status (real apiserver behavior)
+                body.pop("status", None)
+            _merge(obj, body)
+            self._settle(obj, plural)
+            self.log.append(("patch", plural, name))
+            return web.json_response(obj)
+        if request.method == "DELETE":
+            if obj is not None:
+                del self.objects[(group, plural, name)]
+                self.log.append(("delete", plural, name))
+            return web.json_response({})
+        return web.json_response({"kind": "Status"}, status=405)
+
+    async def _status(self, request):
+        """The status subresource: only the status stanza merges."""
+        from aiohttp import web
+
+        group, plural = self._gp(request)
+        name = request.match_info["name"]
+        obj = self.objects.get((group, plural, name))
+        if obj is None:
+            return web.json_response({"kind": "Status"}, status=404)
+        if request.method != "PATCH":
+            return web.json_response({"kind": "Status"}, status=405)
+        body = await request.json()
+        _merge(obj, {"status": body.get("status", {})})
+        self.log.append(("patch-status", plural, name))
+        return web.json_response(obj)
+
+
+CR = {
+    "apiVersion": f"{GRAPH_GROUP}/{GRAPH_VERSION}",
+    "kind": "GraphDeployment",
+    "metadata": {"name": "demo", "namespace": "ns", "generation": 1},
+    "spec": {
+        "services": {
+            "frontend": {
+                "replicas": 1,
+                "image": "dynamo-tpu:latest",
+                "command": ["python", "-m", "dynamo_tpu.run", "in=http"],
+                "ports": [8080],
+            },
+            "worker": {
+                "replicas": 2,
+                "image": "dynamo-tpu:latest",
+                "env": {"DYN_MODEL_PATH": "/models/m"},
+                "resources": {"limits": {"google.com/tpu": "4"}},
+            },
+        }
+    },
+}
+
+
+async def _cluster_and_op():
+    fake = _FakeCluster()
+    base = await fake.start()
+    api = KubernetesApi(base_url=base, token="t", namespace="ns")
+    op = GraphOperator(api, poll_s=0.05)
+    return fake, api, op
+
+
+# ----------------------------------------------------------------- units
+
+
+def test_resource_model_and_render():
+    g = GraphDeployment.from_object(copy.deepcopy(CR))
+    assert set(g.services) == {"frontend", "worker"}
+    dep = g.render_deployment(g.services["worker"])
+    assert dep["metadata"]["name"] == "demo-worker"
+    assert dep["spec"]["replicas"] == 2
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"] == {"limits": {"google.com/tpu": "4"}}
+    assert c["env"] == [{"name": "DYN_MODEL_PATH", "value": "/models/m"}]
+    # frontend has ports -> renders a Service; worker doesn't
+    assert g.render_service(g.services["frontend"]) is not None
+    assert g.render_service(g.services["worker"]) is None
+
+
+def test_drift_only_owned_fields():
+    g = GraphDeployment.from_object(copy.deepcopy(CR))
+    desired = g.render_deployment(g.services["frontend"])
+    actual = copy.deepcopy(desired)
+    # cluster-side defaulted fields must not cause churn: spec-level
+    # defaults, port protocol, requests-from-limits, injected env
+    actual["spec"]["progressDeadlineSeconds"] = 600
+    actual["spec"]["template"]["spec"]["dnsPolicy"] = "ClusterFirst"
+    c = actual["spec"]["template"]["spec"]["containers"][0]
+    for p in c.get("ports", []):
+        p["protocol"] = "TCP"
+    c["resources"] = {"requests": {"cpu": "100m"}}  # injected by LimitRange
+    c.setdefault("env", []).append({"name": "INJECTED", "value": "x"})
+    assert drift(desired, actual) is None
+    actual["spec"]["replicas"] = 5
+    actual["spec"]["template"]["spec"]["containers"][0]["image"] = "other"
+    p = drift(desired, actual)
+    assert p["spec"]["replicas"] == 1
+    assert (
+        p["spec"]["template"]["spec"]["containers"][0]["image"]
+        == "dynamo-tpu:latest"
+    )
+    # service drift: protocol/clusterIP defaults are not drift
+    dsvc = g.render_service(g.services["frontend"])
+    asvc = copy.deepcopy(dsvc)
+    for p in asvc["spec"]["ports"]:
+        p["protocol"] = "TCP"
+    asvc["spec"]["clusterIP"] = "10.1.2.3"
+    assert drift(dsvc, asvc) is None
+    asvc["spec"]["ports"][0]["port"] = 9999
+    assert drift(dsvc, asvc) is not None
+
+
+def test_service_spec_validation():
+    try:
+        ServiceSpec.from_dict("w", {"replicas": -1})
+        raise AssertionError("negative replicas must be rejected")
+    except ValueError:
+        pass
+    # k8s EnvVar-list form accepted
+    s = ServiceSpec.from_dict(
+        "w", {"env": [{"name": "A", "value": "1"}]}
+    )
+    assert s.env == {"A": "1"}
+
+
+# ------------------------------------------------------------------- e2e
+
+
+async def test_create_heal_gc_and_planner_scale():
+    fake, api, op = await _cluster_and_op()
+    try:
+        # 1. create CR -> workloads appear
+        fake.put(GRAPH_GROUP, GRAPH_PLURAL, copy.deepcopy(CR))
+        res = await op.reconcile_once()
+        assert sorted(res.created) == [
+            "deployments/demo-frontend",
+            "deployments/demo-worker",
+            "services/demo-frontend",
+        ]
+        assert ("apps", "deployments", "demo-worker") in fake.objects
+        # status written back to the CR
+        cr = fake.objects[(GRAPH_GROUP, GRAPH_PLURAL, "demo")]
+        assert cr["status"]["state"] == "Ready"
+        assert cr["status"]["services"]["worker"]["ready"] == 2
+
+        # 2. converged: a second pass changes nothing
+        res = await op.reconcile_once()
+        assert not res.changed
+
+        # 3. kill a workload -> healed on the next pass
+        del fake.objects[("apps", "deployments", "demo-worker")]
+        res = await op.reconcile_once()
+        assert res.created == ["deployments/demo-worker"]
+
+        # 4. out-of-band drift (someone kubectl-edited) -> patched back
+        fake.objects[("apps", "deployments", "demo-worker")]["spec"][
+            "replicas"
+        ] = 7
+        res = await op.reconcile_once()
+        assert res.patched == ["deployments/demo-worker"]
+        assert (
+            fake.objects[("apps", "deployments", "demo-worker")]["spec"][
+                "replicas"
+            ]
+            == 2
+        )
+
+        # 5. planner scales through the CR (reference: planner patches the
+        # CRD, operator actuates)
+        conn = GraphCRDConnector("demo", {"decode": "worker"}, api=api)
+        await conn.refresh()
+        assert conn.replicas("decode") == 2
+        await conn.set_replicas("decode", 4)
+        res = await op.reconcile_once()
+        assert res.patched == ["deployments/demo-worker"]
+        assert (
+            fake.objects[("apps", "deployments", "demo-worker")]["spec"][
+                "replicas"
+            ]
+            == 4
+        )
+
+        # 6. service leaves the spec -> its workloads are GC'd
+        del fake.objects[(GRAPH_GROUP, GRAPH_PLURAL, "demo")]["spec"][
+            "services"
+        ]["frontend"]
+        res = await op.reconcile_once()
+        assert sorted(res.deleted) == [
+            "deployments/demo-frontend",
+            "services/demo-frontend",
+        ]
+
+        # 7. CR deleted -> everything it owned is GC'd
+        del fake.objects[(GRAPH_GROUP, GRAPH_PLURAL, "demo")]
+        res = await op.reconcile_once()
+        assert res.deleted == ["deployments/demo-worker"]
+        assert not [
+            k for k in fake.objects if k[1] in ("deployments", "services")
+        ]
+    finally:
+        await api.close()
+        await fake.stop()
+
+
+async def test_unmanaged_workloads_never_touched():
+    fake, api, op = await _cluster_and_op()
+    try:
+        # a workload the operator did NOT create, with no managed-by label
+        fake.put(
+            "apps", "deployments",
+            {
+                "metadata": {"name": "user-app", "labels": {"app": "x"}},
+                "spec": {"replicas": 1},
+            },
+        )
+        fake.put(GRAPH_GROUP, GRAPH_PLURAL, copy.deepcopy(CR))
+        await op.reconcile_once()
+        del fake.objects[(GRAPH_GROUP, GRAPH_PLURAL, "demo")]
+        res = await op.reconcile_once()
+        assert ("apps", "deployments", "user-app") in fake.objects
+        assert "deployments/user-app" not in res.deleted
+    finally:
+        await api.close()
+        await fake.stop()
+
+
+async def test_invalid_cr_keeps_workloads_and_other_graphs_reconcile():
+    """A CR that turns malformed must NOT have its running workloads
+    GC'd as orphans — the failure mode is 'frozen', never 'wiped'."""
+    fake, api, op = await _cluster_and_op()
+    try:
+        fake.put(GRAPH_GROUP, GRAPH_PLURAL, copy.deepcopy(CR))
+        await op.reconcile_once()
+        assert ("apps", "deployments", "demo-worker") in fake.objects
+        # the CR goes bad (e.g. a stray edit empties services)
+        fake.objects[(GRAPH_GROUP, GRAPH_PLURAL, "demo")]["spec"][
+            "services"
+        ] = {}
+        res = await op.reconcile_once()
+        assert res.errors  # recorded, not raised
+        assert not res.deleted  # workloads kept
+        assert ("apps", "deployments", "demo-worker") in fake.objects
+        assert ("", "services", "demo-frontend") in fake.objects
+    finally:
+        await api.close()
+        await fake.stop()
+
+
+async def test_run_loop_converges_and_stops():
+    fake, api, op = await _cluster_and_op()
+    try:
+        op.start()
+        fake.put(GRAPH_GROUP, GRAPH_PLURAL, copy.deepcopy(CR))
+        for _ in range(100):
+            if ("apps", "deployments", "demo-worker") in fake.objects:
+                break
+            await asyncio.sleep(0.02)
+        assert ("apps", "deployments", "demo-worker") in fake.objects
+        await op.stop()
+        n = op.reconciles
+        await asyncio.sleep(0.15)
+        assert op.reconciles == n  # loop actually stopped
+    finally:
+        await api.close()
+        await fake.stop()
