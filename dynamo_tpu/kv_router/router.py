@@ -137,7 +137,9 @@ class KvRouter:
         self._sync_workers()
         chain = compute_seq_hash_chain(token_ids, self.block_size)
         overlap = self.indexer.find_matches(chain)
-        result = self.scheduler.schedule(token_ids, overlap, request_id)
+        result = self.scheduler.schedule(
+            token_ids, overlap, request_id, chain=chain
+        )
         if isinstance(self.indexer, ApproxKvIndexer):
             self.indexer.process_routing_decision_for_request(
                 token_ids, result.worker_id

@@ -16,6 +16,7 @@ from typing import Optional, Protocol
 from dynamo_tpu.kv_router.indexer import OverlapScores
 from dynamo_tpu.kv_router.protocols import KVHitRateEvent
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.tokens import compute_seq_hash_chain
 
 logger = get_logger("dynamo_tpu.kv_router.scheduler")
 
@@ -172,17 +173,29 @@ class KvScheduler:
         token_ids: list[int],
         overlap: OverlapScores,
         request_id: Optional[str] = None,
+        chain: Optional[list[int]] = None,
     ) -> WorkerSelectionResult:
+        """`chain` = the request's precomputed block-hash chain; the
+        router already built it for the indexer query, and passing it
+        avoids hashing the prompt twice more (potential_blocks +
+        add_request)."""
+        if chain is None:
+            chain = compute_seq_hash_chain(token_ids, self.block_size)
+        partial = 1 if len(token_ids) % self.block_size else 0
         worker_ids = list(self.sequences.workers.keys())
         request = SchedulingRequest(
             isl_tokens=len(token_ids),
             overlap=overlap,
-            potential_blocks=self.sequences.potential_blocks(token_ids),
+            potential_blocks=self.sequences.potential_blocks_chain(
+                chain, partial
+            ),
         )
         result = self.selector.select_worker(
             worker_ids, request, self.block_size
         )
-        self.sequences.add_request(result.worker_id, token_ids, request_id)
+        self.sequences.add_request_chain(
+            result.worker_id, chain, partial, request_id
+        )
         if self.on_hit_rate_event is not None:
             self.on_hit_rate_event(
                 KVHitRateEvent(

@@ -45,9 +45,14 @@ class ActiveSequences:
 
     def new_blocks(self, block_hashes: list[int], partial: int = 0) -> int:
         """How many blocks this request would ADD to the worker."""
+        return self.new_blocks_set(set(block_hashes), partial)
+
+    def new_blocks_set(self, uniq: set[int], partial: int = 0) -> int:
+        """Same, for a pre-deduplicated hash set — the multi-worker path
+        dedupes once instead of per worker (64 workers would otherwise
+        build 64 identical sets per scheduling decision)."""
         return (
-            sum(1 for h in set(block_hashes) if h not in self._block_refs)
-            + partial
+            sum(1 for h in uniq if h not in self._block_refs) + partial
         )
 
     def potential_blocks(self, block_hashes: list[int], partial: int = 0) -> int:
@@ -122,8 +127,18 @@ class ActiveSequencesMultiWorker:
 
     def potential_blocks(self, token_ids: list[int]) -> dict[int, int]:
         chain, partial = self._hashes(token_ids)
+        return self.potential_blocks_chain(chain, partial)
+
+    def potential_blocks_chain(
+        self, chain: list[int], partial: int
+    ) -> dict[int, int]:
+        """Per-worker potential from a precomputed hash chain — the
+        scheduler computes the chain once per decision and threads it
+        through here and add_request_chain (it used to be recomputed
+        three times per routed request)."""
+        uniq = set(chain)
         return {
-            w: seqs.potential_blocks(chain, partial)
+            w: seqs.active_blocks + seqs.new_blocks_set(uniq, partial)
             for w, seqs in self.workers.items()
         }
 
@@ -136,10 +151,19 @@ class ActiveSequencesMultiWorker:
         token_ids: list[int],
         request_id: Optional[str] = None,
     ) -> str:
+        chain, partial = self._hashes(token_ids)
+        return self.add_request_chain(worker_id, chain, partial, request_id)
+
+    def add_request_chain(
+        self,
+        worker_id: int,
+        chain: list[int],
+        partial: int,
+        request_id: Optional[str] = None,
+    ) -> str:
         request_id = request_id or uuid.uuid4().hex
         seqs = self.workers.get(worker_id)
         if seqs is not None:
-            chain, partial = self._hashes(token_ids)
             seqs.add_request(request_id, chain, max(partial, 1))
             self._request_worker[request_id] = worker_id
         return request_id
