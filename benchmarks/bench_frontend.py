@@ -1,0 +1,193 @@
+"""Frontend saturation bench: the HTTP/SSE hot path with a zero-compute
+engine.
+
+SURVEY hard-part (c): the reference pays Rust/axum for per-token SSE
+framing; ours is Python asyncio (aiohttp + msgpack hops). This bench
+quantifies that tax: it serves `in=http out=echo_core` with
+DYN_TOKEN_ECHO_DELAY_MS=0 (engine emits tokens as fast as the loop
+allows, so every measured cost is framing/transport) and drives streaming
+completions at several concurrency levels, reporting aggregate tok/s,
+TTFT, and inter-token latency percentiles.
+
+    python -m benchmarks.bench_frontend [--concurrency 1,16,64]
+        [--requests-per-level 64] [--max-tokens 128] [--json out.json]
+
+The resulting number IS the frontend ceiling: an engine faster than this
+per-process rate will be SSE-framing-bound (then: shard frontends behind
+a load balancer — each is stateless — or move framing native). Committed
+results: benchmarks/frontend_bench.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+from dynamo_tpu.serve import _free_port
+
+
+async def _one_request(session, url, model, prompt, max_tokens):
+    """Stream one completion; returns (ttft_s, [inter-chunk gaps], ntok)."""
+    body = {
+        "model": model,
+        "prompt": prompt,
+        "max_tokens": max_tokens,
+        "stream": True,
+    }
+    t0 = time.perf_counter()
+    last = None
+    ttft = None
+    gaps = []
+    ntok = 0
+    async with session.post(url, json=body) as resp:
+        resp.raise_for_status()
+        async for line in resp.content:
+            if not line.startswith(b"data: ") or line.startswith(b"data: [DONE]"):
+                continue
+            now = time.perf_counter()
+            if ttft is None:
+                ttft = now - t0
+            elif last is not None:
+                gaps.append(now - last)
+            last = now
+            ntok += 1
+    # the stream ends with one finish-reason-only chunk (no token) — it
+    # must not count toward token throughput
+    return ttft, gaps, max(0, ntok - 1)
+
+
+async def _run_level(base, model, concurrency, requests, prompt, max_tokens):
+    import aiohttp
+
+    url = f"{base}/v1/completions"
+    sem = asyncio.Semaphore(concurrency)
+    results = []
+
+    async def worker():
+        async with sem:
+            results.append(
+                await _one_request(session, url, model, prompt, max_tokens)
+            )
+
+    conn = aiohttp.TCPConnector(limit=concurrency + 4)
+    async with aiohttp.ClientSession(connector=conn) as session:
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker() for _ in range(requests)])
+        wall = time.perf_counter() - t0
+    ttfts = sorted(t for t, _, _ in results if t is not None)
+    gaps = sorted(g for _, gs, _ in results for g in gs)
+    tokens = sum(n for _, _, n in results)
+
+    def pct_ms(xs, p, digits):
+        if not xs:
+            return None
+        return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, digits)
+
+    return {
+        "concurrency": concurrency,
+        "requests": requests,
+        "tokens": tokens,
+        "tok_per_s": round(tokens / wall, 1),
+        "ttft_p50_ms": pct_ms(ttfts, 0.50, 2),
+        "ttft_p99_ms": pct_ms(ttfts, 0.99, 2),
+        "itl_p50_ms": pct_ms(gaps, 0.50, 3),
+        "itl_p99_ms": pct_ms(gaps, 0.99, 3),
+    }
+
+
+async def run_bench(levels, requests, max_tokens, prompt_tokens=128):
+    port = _free_port()
+    env = dict(
+        os.environ,
+        DYN_TOKEN_ECHO_DELAY_MS="0",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    errlog = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".frontend-bench.log", delete=False
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dynamo_tpu.run",
+            "in=http", "out=echo_core",
+            "--model-name", "bench-echo",
+            "--http-port", str(port),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=errlog,
+        cwd="/tmp",
+    )
+
+    def _startup_failure(reason: str) -> RuntimeError:
+        errlog.flush()
+        with open(errlog.name) as f:
+            tail = "".join(f.readlines()[-15:])
+        return RuntimeError(f"{reason}; server stderr tail:\n{tail}")
+
+    base = f"http://127.0.0.1:{port}"
+    try:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            for _ in range(100):
+                if proc.poll() is not None:
+                    raise _startup_failure(
+                        f"frontend exited rc={proc.returncode} during startup"
+                    )
+                try:
+                    async with s.get(f"{base}/health") as r:
+                        if r.status == 200:
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.1)
+            else:
+                raise _startup_failure("frontend never became healthy")
+        # the echo engine replays prompt tokens: prompt length bounds output
+        prompt = " ".join(f"w{i % 50}" for i in range(prompt_tokens))
+        out = []
+        for c in levels:
+            r = await _run_level(
+                base, "bench-echo", c, max(requests, c * 2), prompt,
+                max_tokens,
+            )
+            out.append(r)
+            print(json.dumps(r), flush=True)
+        return out
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--concurrency", default="1,16,64")
+    ap.add_argument("--requests-per-level", type=int, default=64)
+    ap.add_argument("--max-tokens", type=int, default=128)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    levels = [int(x) for x in args.concurrency.split(",")]
+    results = asyncio.run(
+        run_bench(levels, args.requests_per_level, args.max_tokens)
+    )
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(
+                {"bench": "frontend_sse", "results": results}, f, indent=1
+            )
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -45,3 +45,23 @@ def test_tiny_bench_emits_nonnull_value():
     assert result["requests_done"] == 4
     # tiny/cpu numbers must never claim a baseline comparison
     assert result["vs_baseline"] is None
+
+
+@pytest.mark.slow
+def test_frontend_saturation_bench_runs():
+    """The SSE saturation harness (benchmarks/bench_frontend.py) must
+    drive the real `in=http out=echo_core` process and clear a floor far
+    below the recorded ceiling (~7k tok/s in frontend_bench.json) —
+    catching harness rot and order-of-magnitude framing regressions."""
+    import asyncio
+
+    from benchmarks.bench_frontend import run_bench
+
+    results = asyncio.run(
+        run_bench(levels=[1, 4], requests=8, max_tokens=32)
+    )
+    assert len(results) == 2
+    for r in results:
+        assert r["tokens"] >= 8 * 32
+        assert r["tok_per_s"] > 300, r
+        assert r["itl_p99_ms"] < 500, r
