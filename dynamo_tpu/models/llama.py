@@ -32,6 +32,7 @@ from dynamo_tpu.ops.attention import (
     write_prefill_kv,
 )
 from dynamo_tpu.ops.basics import apply_rope, rms_norm, rope_freqs, swiglu
+from dynamo_tpu.ops.layers import attn_out, qkv_head
 from dynamo_tpu.ops.linear import linear, maybe_quantize
 
 
@@ -352,37 +353,11 @@ def _layer_freqs(cfg, li, pair):
     return pair[1] if cfg.layer_window(li) is not None else pair[0]
 
 
-def _qkv(x, layer, cfg, inv_freqs, positions):
-    """Shared projection head: norm -> q/k/v -> (qk-norm) -> RoPE. One
-    definition so the serial, context-parallel, and decode paths cannot
-    drift. Qwen2-family models carry q/k/v biases (bq/bk/bv); Gemma3
-    carries per-head q/k RMSNorms."""
-    T = x.shape[0]
-    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
-    q = linear(h, layer["wq"])
-    k = linear(h, layer["wk"])
-    v = linear(h, layer["wv"])
-    if "bq" in layer:
-        q = q + layer["bq"].astype(q.dtype)
-        k = k + layer["bk"].astype(k.dtype)
-        v = v + layer["bv"].astype(v.dtype)
-    q = q.reshape(T, cfg.num_heads, cfg.head_dim)
-    k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
-    v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
-    if "q_norm" in layer:
-        q = rms_norm(q, layer["q_norm"], cfg.rms_eps)
-        k = rms_norm(k, layer["k_norm"], cfg.rms_eps)
-    q = apply_rope(q, positions, inv_freqs)
-    k = apply_rope(k, positions, inv_freqs)
-    return q, k, v
-
-
-def _attn_out(attn, x, layer, cfg):
-    """Output projection + (sandwich post-norm) + residual add."""
-    out = linear(attn.reshape(x.shape[0], cfg.q_dim), layer["wo"])
-    if "post_attn_norm" in layer:
-        out = rms_norm(out, layer["post_attn_norm"], cfg.rms_eps)
-    return x + out
+# the shared projection head / output projection live in ops/layers.py so
+# the pipeline-parallel stage scan uses the SAME definition (a hand-copied
+# head is how qwen2 biases once went missing from pp)
+_qkv = qkv_head
+_attn_out = attn_out
 
 
 def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_cache_l, block_table, mesh=None, head_axis=None, li=0):

@@ -1,0 +1,44 @@
+"""Shared transformer layer heads used by every forward path.
+
+One definition so the serial, context-parallel, decode, and
+pipeline-parallel paths cannot drift (a hand-copied projection head is
+how qwen2 biases silently went missing from pp). Family coverage:
+qwen2 q/k/v biases (bq/bk/bv), gemma3 per-head q/k RMSNorms
+(q_norm/k_norm), gemma2 sandwich post-attention norm (post_attn_norm) —
+each applied iff the layer carries the key (static pytree check).
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.ops.basics import apply_rope, rms_norm
+from dynamo_tpu.ops.linear import linear
+
+
+def qkv_head(x, layer, cfg, inv_freqs, positions):
+    """Projection head: norm -> q/k/v -> bias -> (qk-norm) -> RoPE."""
+    T = x.shape[0]
+    h = rms_norm(x, layer["attn_norm"], cfg.rms_eps)
+    q = linear(h, layer["wq"])
+    k = linear(h, layer["wk"])
+    v = linear(h, layer["wv"])
+    if "bq" in layer:
+        q = q + layer["bq"].astype(q.dtype)
+        k = k + layer["bk"].astype(k.dtype)
+        v = v + layer["bv"].astype(v.dtype)
+    q = q.reshape(T, cfg.num_heads, cfg.head_dim)
+    k = k.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    v = v.reshape(T, cfg.num_kv_heads, cfg.head_dim)
+    if "q_norm" in layer:
+        q = rms_norm(q, layer["q_norm"], cfg.rms_eps)
+        k = rms_norm(k, layer["k_norm"], cfg.rms_eps)
+    q = apply_rope(q, positions, inv_freqs)
+    k = apply_rope(k, positions, inv_freqs)
+    return q, k, v
+
+
+def attn_out(attn, x, layer, cfg):
+    """Output projection + (sandwich post-norm) + residual add."""
+    out = linear(attn.reshape(x.shape[0], cfg.q_dim), layer["wo"])
+    if "post_attn_norm" in layer:
+        out = rms_norm(out, layer["post_attn_norm"], cfg.rms_eps)
+    return x + out

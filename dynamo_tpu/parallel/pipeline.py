@@ -17,12 +17,13 @@ engine is ours, so PP is implemented in the model math). TPU-first shape:
     full (the classic GPipe inference schedule, SPMD-formulated so all
     stages run ONE program).
 
-Scope (documented de-scope, SURVEY §2.7): dense bf16/fp32 llama layers.
-Quantized int8 layer stacks and MoE expert layers are rejected at
-stack-time — quantized serving under PP needs per-stage scale plumbing and
-MoE wants ep over the same devices instead; both are follow-on work, and
-PP's reason-to-exist (fitting a model that TP alone cannot) applies to the
-dense giants first.
+Scope: dense llama/qwen2-family layers — bf16/fp32 AND int8
+weight-only quantized (each quantized weight {"q": [in,out] int8,
+"s": [out]} stacks to {"q": [L,in,out], "s": [L,out]} and pp-shards on
+the leading layer axis like any other leaf; the stage scan slices the
+pytree per layer and ops/linear.py dequantizes inside the matmul).
+Qwen2 attention biases ride along. MoE expert layers remain rejected at
+stack-time — MoE wants ep over the same devices instead.
 """
 
 from __future__ import annotations
@@ -35,27 +36,32 @@ from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dynamo_tpu.ops.attention import NEG_INF
-from dynamo_tpu.ops.basics import apply_rope, rms_norm, rope_freqs, swiglu
+from dynamo_tpu.ops.basics import rms_norm, rope_freqs, swiglu
+from dynamo_tpu.ops.layers import attn_out, qkv_head
+from dynamo_tpu.ops.linear import linear
 
 
 def stack_layer_params(params: dict) -> dict:
     """[{wq, wk, ...}] x L -> {"wq": [L, ...], ...} for pp sharding.
 
-    Dense bf16 layers only (see module docstring for the de-scope)."""
+    int8-quantized weights ({"q", "s"} dicts) stack per leaf, so the
+    scanned per-layer slice keeps the exact shape ops/linear.py consumes."""
     layers = params["layers"]
-    if any(isinstance(v, dict) for v in layers[0].values()):
-        raise NotImplementedError(
-            "pipeline parallelism requires dense unquantized layers "
-            "(int8 layer stacks need per-stage scale plumbing)"
-        )
     if "router" in layers[0]:
         raise NotImplementedError(
             "pipeline parallelism over MoE layers is not supported — use "
             "expert parallelism (ep) for Mixtral-family models"
         )
-    stacked = {
-        k: jnp.stack([lyr[k] for lyr in layers]) for k in layers[0]
-    }
+
+    def stack_leaf(key):
+        vals = [lyr[key] for lyr in layers]
+        if isinstance(vals[0], dict):
+            return {
+                k2: jnp.stack([v[k2] for v in vals]) for k2 in vals[0]
+            }
+        return jnp.stack(vals)
+
+    stacked = {k: stack_leaf(k) for k in layers[0]}
     return {
         "embed": params["embed"],
         "layers": stacked,
@@ -75,13 +81,16 @@ def shard_stacked_pp(
     out = {
         "embed": jax.device_put(stacked["embed"], repl),
         "final_norm": jax.device_put(stacked["final_norm"], repl),
-        "layers": {
-            k: jax.device_put(v, pp_first)
-            for k, v in stacked["layers"].items()
-        },
+        # every layer leaf — including int8 {"q","s"} pairs — has the
+        # stacked layer axis leading, so one prefix spec shards them all
+        "layers": jax.tree.map(
+            lambda v: jax.device_put(v, pp_first), stacked["layers"]
+        ),
     }
     if "lm_head" in stacked:
-        out["lm_head"] = jax.device_put(stacked["lm_head"], repl)
+        out["lm_head"] = jax.tree.map(
+            lambda v: jax.device_put(v, repl), stacked["lm_head"]
+        )
     kv_sharding = NamedSharding(mesh, P("pp"))  # [L, Hkv, nb, bs, D]
     return out, kv_sharding
 
@@ -90,14 +99,27 @@ def shard_stacked_pp(
 
 
 def _check_pp_supported(cfg) -> None:
-    """The pp forward hardcodes the llama/qwen dense path (SwiGLU,
-    unscaled embeddings); family flags it does not implement must refuse
-    loudly instead of serving silently-wrong outputs."""
+    """The pp forward hardcodes the llama/qwen2 dense path (SwiGLU,
+    unscaled embeddings, optional attention biases); family flags it does
+    not implement must refuse loudly instead of serving silently-wrong
+    outputs."""
     if cfg.mlp_act != "silu" or cfg.embed_scale:
         raise NotImplementedError(
             "pipeline parallelism supports the SwiGLU/unscaled-embedding "
             "families only (llama/qwen2/mixtral-dense); gemma's GeGLU and "
             "embedding scaling are not plumbed through the pp stages"
+        )
+    if getattr(cfg, "sandwich_norms", False):
+        raise NotImplementedError(
+            "pipeline parallelism does not implement the post-MLP sandwich "
+            "norm; serving gemma2/3-style layers through pp would silently "
+            "skip it"
+        )
+    if any(cfg.layer_window(i) for i in range(cfg.num_layers)):
+        raise NotImplementedError(
+            "pipeline parallelism implements full attention only; a "
+            "sliding-window config served through pp would silently attend "
+            "past the window"
         )
 
 
@@ -112,29 +134,17 @@ def _scan_layers(cfg, layers, x, positions, attend, write_kv, k_cache, v_cache):
 
     def body(x, per_layer):
         lyr, kc, vc = per_layer
-        h = rms_norm(x, lyr["attn_norm"], cfg.rms_eps)
-        q = jnp.matmul(h, lyr["wq"].astype(h.dtype)).reshape(
-            T, cfg.num_heads, cfg.head_dim
-        )
-        k = jnp.matmul(h, lyr["wk"].astype(h.dtype)).reshape(
-            T, cfg.num_kv_heads, cfg.head_dim
-        )
-        v = jnp.matmul(h, lyr["wv"].astype(h.dtype)).reshape(
-            T, cfg.num_kv_heads, cfg.head_dim
-        )
-        q = apply_rope(q, positions, inv_freqs)
-        k = apply_rope(k, positions, inv_freqs)
+        # the SAME projection head as the serial/cp/decode paths
+        # (ops/layers.py — handles int8 {"q","s"} weights and qwen2
+        # biases); only the attention itself differs per phase
+        q, k, v = qkv_head(x, lyr, cfg, inv_freqs, positions)
         kc, vc = write_kv(kc, vc, k, v)
         attn = attend(q, kc, vc, k, v)
-        x = x + jnp.matmul(
-            attn.reshape(T, cfg.q_dim), lyr["wo"].astype(h.dtype)
-        )
+        x = attn_out(attn, x, lyr, cfg)
         h2 = rms_norm(x, lyr["mlp_norm"], cfg.rms_eps)
-        gate = jnp.matmul(h2, lyr["wg"].astype(h2.dtype))
-        up = jnp.matmul(h2, lyr["wu"].astype(h2.dtype))
-        x = x + jnp.matmul(
-            swiglu(gate, up), lyr["wd"].astype(h2.dtype)
-        )
+        gate = linear(h2, lyr["wg"])
+        up = linear(h2, lyr["wu"])
+        x = x + linear(swiglu(gate, up), lyr["wd"])
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = jax.lax.scan(
@@ -214,9 +224,7 @@ def prefill_pp(
         # so the logits output is genuinely replicated
         h = rms_norm(x, final_norm, cfg.rms_eps)
         last = h[valid_len - 1]
-        logits = jnp.matmul(
-            last.astype(jnp.float32), lm_head.astype(jnp.float32)
-        )
+        logits = linear(last.astype(jnp.float32), lm_head)
         logits = jnp.where(stage == 0, logits, 0.0)
         logits = jax.lax.psum(logits, "pp")
         return logits, k_cache, v_cache
@@ -324,9 +332,7 @@ def decode_pp(
             # last stage emits logits for its finished microbatch
             emit = active & (stage == pp - 1)
             h = rms_norm(buf, final_norm, cfg.rms_eps)
-            logits_mb = jnp.matmul(
-                h.astype(jnp.float32), lm_head.astype(jnp.float32)
-            )
+            logits_mb = linear(h.astype(jnp.float32), lm_head)
             upd = jnp.zeros_like(out).at[seq_idx].set(logits_mb)
             out = jnp.where(emit, out + upd, out)
             # rotate activations + metadata forward one stage
