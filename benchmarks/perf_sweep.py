@@ -1,0 +1,256 @@
+"""Concurrency-sweep perf harness: throughput-vs-latency frontier.
+
+Role-equivalent of the reference's perf harness
+(benchmarks/llm/perf.sh — genai-perf sweeps over concurrency against a
+running deployment — and plot_pareto.py): drive the real HTTP/SSE serving
+process at increasing concurrency, record output tok/s + TTFT + ITL per
+level, and emit the Pareto frontier.
+
+    # CPU (tiny random model, exercises the full engine + frontend):
+    python -m benchmarks.perf_sweep --json benchmarks/perf_sweep.json
+
+    # real model (TPU when available; any HF dir):
+    python -m benchmarks.perf_sweep --model-path /models/llama3-8b \
+        --concurrency 1,4,16,64 --max-tokens 150 --prompt-tokens 3000
+
+    # plot the frontier from one or more sweep files:
+    python -m benchmarks.plot_pareto benchmarks/perf_sweep.json
+
+Each level reports: output tok/s (aggregate), request throughput,
+TTFT p50/p99, ITL p50/p99 — the same axes the reference plots
+(throughput/GPU vs ITL; ours is throughput/chip vs ITL).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+from dynamo_tpu.serve import _free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_tiny_model_dir(path: str, vocab_words: int = 61) -> None:
+    """Self-contained tiny llama HF dir (config + word-level tokenizer) —
+    the CPU stand-in for a real checkpoint (weights random-init)."""
+    os.makedirs(path, exist_ok=True)
+    cfg = {
+        "model_type": "llama", "vocab_size": 3 + vocab_words,
+        "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "num_key_value_heads": 2, "head_dim": 16, "rope_theta": 10000.0,
+        "max_position_embeddings": 512, "rms_norm_eps": 1e-5,
+        "eos_token_id": 2, "bos_token_id": 1,
+    }
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(cfg, f)
+    from tokenizers import Tokenizer, models, pre_tokenizers
+
+    vocab = {"<unk>": 0, "<s>": 1, "</s>": 2}
+    for i in range(vocab_words):
+        vocab[f"w{i}"] = 3 + i
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.save(os.path.join(path, "tokenizer.json"))
+
+
+async def _one(session, url, model, prompt, max_tokens):
+    body = {
+        "model": model, "prompt": prompt, "max_tokens": max_tokens,
+        "stream": True, "temperature": 0.7,
+        # fixed-length generation (the nvext-style extension block): a
+        # throughput sweep must not let random EOS shorten outputs
+        "ext": {"ignore_eos": True},
+    }
+    t0 = time.perf_counter()
+    ttft, last, gaps, ntok = None, None, [], 0
+    async with session.post(url, json=body) as resp:
+        resp.raise_for_status()
+        async for line in resp.content:
+            if not line.startswith(b"data: ") or line.startswith(b"data: [DONE]"):
+                continue
+            now = time.perf_counter()
+            if ttft is None:
+                ttft = now - t0
+            elif last is not None:
+                gaps.append(now - last)
+            last = now
+            ntok += 1
+    return ttft, gaps, max(0, ntok - 1)
+
+
+async def _level(base, model, c, requests, prompt, max_tokens):
+    import aiohttp
+
+    url = f"{base}/v1/completions"
+    sem = asyncio.Semaphore(c)
+    results = []
+
+    async def worker():
+        async with sem:
+            results.append(await _one(session, url, model, prompt, max_tokens))
+
+    conn = aiohttp.TCPConnector(limit=c + 4)
+    async with aiohttp.ClientSession(
+        connector=conn, timeout=aiohttp.ClientTimeout(total=600)
+    ) as session:
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker() for _ in range(requests)])
+        wall = time.perf_counter() - t0
+    ttfts = sorted(t for t, _, _ in results if t is not None)
+    gaps = sorted(g for _, gs, _ in results for g in gs)
+    tokens = sum(n for _, _, n in results)
+
+    def pct_ms(xs, p, d=2):
+        if not xs:
+            return None
+        return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, d)
+
+    return {
+        "concurrency": c,
+        "requests": requests,
+        "output_tokens": tokens,
+        "output_tok_per_s": round(tokens / wall, 1),
+        "req_per_s": round(len(results) / wall, 2),
+        "ttft_p50_ms": pct_ms(ttfts, 0.50),
+        "ttft_p99_ms": pct_ms(ttfts, 0.99),
+        "itl_p50_ms": pct_ms(gaps, 0.50, 3),
+        "itl_p99_ms": pct_ms(gaps, 0.99, 3),
+    }
+
+
+async def run_sweep(
+    model_path, levels, requests_per_level, prompt_tokens, max_tokens,
+    decode_horizon=None,
+):
+    own_dir = None
+    port = _free_port()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    if model_path is None:
+        own_dir = tempfile.mkdtemp(prefix="perf-sweep-model-")
+        make_tiny_model_dir(own_dir)
+        model_path = own_dir
+        # tiny-model mode is the CPU harness; a real --model-path keeps
+        # the ambient platform (TPU under axon when the tunnel is up)
+        env["JAX_PLATFORMS"] = "cpu"
+    if decode_horizon:
+        env["DYN_DECODE_HORIZON"] = str(decode_horizon)
+    errlog = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".perf-sweep.log", delete=False
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dynamo_tpu.run",
+            "in=http", "out=jax",
+            "--model-path", model_path,
+            "--model-name", "sweep-model",
+            "--http-port", str(port),
+            "--max-batch", "16",
+        ],
+        env=env, stdout=subprocess.DEVNULL, stderr=errlog, cwd="/tmp",
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            for _ in range(600):  # first jax compile can take ~40s
+                if proc.poll() is not None:
+                    errlog.flush()
+                    with open(errlog.name) as f:
+                        tail = "".join(f.readlines()[-15:])
+                    raise RuntimeError(
+                        f"server exited rc={proc.returncode}:\n{tail}"
+                    )
+                try:
+                    async with s.get(f"{base}/health") as r:
+                        if r.status == 200:
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.2)
+            else:
+                raise RuntimeError("server never became healthy")
+        prompt = " ".join(f"w{i % 50}" for i in range(prompt_tokens))
+        # warmup: trigger prefill+decode compiles outside the measurement
+        await _level(base, "sweep-model", 1, 2, prompt, min(8, max_tokens))
+        out = []
+        for c in levels:
+            r = await _level(
+                base, "sweep-model", c, max(requests_per_level, c * 2),
+                prompt, max_tokens,
+            )
+            out.append(r)
+            print(json.dumps(r), flush=True)
+        return out
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def pareto_frontier(results: list[dict]) -> list[dict]:
+    """Levels not dominated on (higher tok/s, lower ITL p50)."""
+    out = []
+    for r in results:
+        dominated = any(
+            o is not r
+            and o["output_tok_per_s"] >= r["output_tok_per_s"]
+            and (o["itl_p50_ms"] or 0) <= (r["itl_p50_ms"] or 0)
+            and (
+                o["output_tok_per_s"] > r["output_tok_per_s"]
+                or (o["itl_p50_ms"] or 0) < (r["itl_p50_ms"] or 0)
+            )
+            for o in results
+        )
+        if not dominated:
+            out.append(r)
+    return sorted(out, key=lambda r: r["concurrency"])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model-path", default=None,
+                    help="HF model dir; default = tiny random model")
+    ap.add_argument("--concurrency", default="1,2,4,8,16")
+    ap.add_argument("--requests-per-level", type=int, default=16)
+    ap.add_argument("--prompt-tokens", type=int, default=96)
+    ap.add_argument("--max-tokens", type=int, default=32)
+    ap.add_argument("--decode-horizon", type=int, default=None)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+    levels = [int(x) for x in args.concurrency.split(",")]
+    results = asyncio.run(
+        run_sweep(
+            args.model_path, levels, args.requests_per_level,
+            args.prompt_tokens, args.max_tokens,
+            decode_horizon=args.decode_horizon,
+        )
+    )
+    doc = {
+        "bench": "perf_sweep",
+        "model": args.model_path or "tiny-random",
+        "prompt_tokens": args.prompt_tokens,
+        "max_tokens": args.max_tokens,
+        "results": results,
+        "pareto": pareto_frontier(results),
+    }
+    print(json.dumps({"pareto": [r["concurrency"] for r in doc["pareto"]]}))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Plot throughput-vs-ITL Pareto frontiers from perf_sweep.py output.
+
+Role-equivalent of the reference's benchmarks/llm/plot_pareto.py (which
+plots genai-perf sweeps as tok/s/GPU vs ITL): one curve per sweep file,
+Pareto-efficient points emphasized, annotated with concurrency.
+
+    python -m benchmarks.plot_pareto sweep_a.json [sweep_b.json ...] \
+        [--out pareto.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("sweeps", nargs="+", help="perf_sweep.py --json files")
+    ap.add_argument("--out", default="pareto.png")
+    args = ap.parse_args()
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(7, 5))
+    for path in args.sweeps:
+        with open(path) as f:
+            doc = json.load(f)
+        results = doc["results"]
+        label = os.path.basename(path).removesuffix(".json")
+        xs = [r["itl_p50_ms"] for r in results]
+        ys = [r["output_tok_per_s"] for r in results]
+        ax.plot(xs, ys, "o--", alpha=0.45, label=f"{label} (all levels)")
+        par = doc.get("pareto") or results
+        pxs = [r["itl_p50_ms"] for r in par]
+        pys = [r["output_tok_per_s"] for r in par]
+        ax.plot(pxs, pys, "o-", linewidth=2, label=f"{label} (pareto)")
+        for r in results:
+            ax.annotate(
+                f"c={r['concurrency']}",
+                (r["itl_p50_ms"], r["output_tok_per_s"]),
+                textcoords="offset points", xytext=(4, 4), fontsize=8,
+            )
+    ax.set_xlabel("inter-token latency p50 (ms)")
+    ax.set_ylabel("output tokens/s")
+    ax.set_title("throughput vs ITL — Pareto frontier")
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=120)
+    print(args.out)
+
+
+if __name__ == "__main__":
+    main()

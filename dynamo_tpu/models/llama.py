@@ -31,7 +31,7 @@ from dynamo_tpu.ops.attention import (
     write_decode_kv,
     write_prefill_kv,
 )
-from dynamo_tpu.ops.basics import apply_rope, rms_norm, rope_freqs, swiglu
+from dynamo_tpu.ops.basics import rms_norm, rope_freqs, swiglu
 from dynamo_tpu.ops.layers import attn_out, qkv_head
 from dynamo_tpu.ops.linear import linear, maybe_quantize
 

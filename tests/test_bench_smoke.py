@@ -65,3 +65,39 @@ def test_frontend_saturation_bench_runs():
         assert r["tokens"] >= 8 * 32
         assert r["tok_per_s"] > 300, r
         assert r["itl_p99_ms"] < 500, r
+
+
+@pytest.mark.slow
+def test_perf_sweep_harness_runs(tmp_path):
+    """The concurrency-sweep harness (benchmarks/perf_sweep.py, the
+    reference's perf.sh + plot_pareto.py role) must drive the real
+    `in=http out=jax` process, produce monotone-sane stats, and plot."""
+    import asyncio
+    import json as _json
+
+    from benchmarks.perf_sweep import pareto_frontier, run_sweep
+
+    results = asyncio.run(
+        run_sweep(
+            model_path=None, levels=[1, 4], requests_per_level=4,
+            prompt_tokens=32, max_tokens=8,
+        )
+    )
+    assert len(results) == 2
+    for r in results:
+        assert r["output_tokens"] == r["requests"] * 8  # ignore_eos held
+        assert r["output_tok_per_s"] > 0
+    assert pareto_frontier(results)  # never empty
+    # plot path (matplotlib Agg)
+    sweep = tmp_path / "sweep.json"
+    sweep.write_text(_json.dumps({"results": results, "pareto": results}))
+    out = tmp_path / "pareto.png"
+    import subprocess as sp
+    import sys as _sys
+
+    sp.run(
+        [_sys.executable, "-m", "benchmarks.plot_pareto", str(sweep),
+         "--out", str(out)],
+        check=True, cwd=REPO,
+    )
+    assert out.stat().st_size > 1000
