@@ -37,15 +37,28 @@ def reset_shared_state() -> None:
 
 
 class Watch:
-    """Async iterator of WatchEvents for a key prefix, with initial snapshot."""
+    """Async iterator of WatchEvents for a key prefix, with initial snapshot.
+
+    Tracks the currently-known key set so a failover can replay the new
+    primary's snapshot as puts and synthesize deletes for keys that
+    vanished during the outage — consumers stay level-consistent without
+    knowing a failover happened."""
 
     def __init__(self, initial: list[WatchEvent], cancel_fn) -> None:
         self.initial = initial
         self._queue: asyncio.Queue = asyncio.Queue()
         self._cancel_fn = cancel_fn
         self._done = False
+        self.known: set[str] = {ev.key for ev in initial}
+        self._prefix = ""  # failover re-establishment
+        self._stream_id = 0
 
     def _feed(self, ev: Optional[WatchEvent]) -> None:
+        if ev is not None:
+            if ev.type == "put":
+                self.known.add(ev.key)
+            else:
+                self.known.discard(ev.key)
         self._queue.put_nowait(ev)
 
     def __aiter__(self) -> "Watch":
@@ -73,6 +86,9 @@ class Subscription:
         self._queue: asyncio.Queue = asyncio.Queue()
         self._cancel_fn = cancel_fn
         self._done = False
+        self._subject = ""  # failover re-establishment
+        self._group = ""
+        self._stream_id = 0
 
     def _feed(self, item: Optional[tuple[str, bytes]]) -> None:
         self._queue.put_nowait(item)
@@ -111,6 +127,10 @@ class FabricClient:
         self._pending: dict[int, asyncio.Future] = {}
         self._streams: dict[int, Any] = {}  # stream_id -> Watch|Subscription
         self._stream_kind: dict[int, str] = {}
+        # live targets independent of stream ids: the failover source of
+        # truth (stream ids change per connection; a partially-failed
+        # re-establish must never lose track of a consumer's stream)
+        self._stream_targets: dict[Any, str] = {}
         # pushes that raced ahead of the watch/subscribe response: the server
         # may emit an event for a stream before our coroutine has registered
         # it in _streams; buffer instead of dropping
@@ -123,6 +143,14 @@ class FabricClient:
         self._write_lock = asyncio.Lock()
         self._conn_lost = False
         self.addr: str = ""
+        # HA failover: all known fabric addresses (comma-separated in
+        # DYN_FABRIC_ADDR); on connection loss the client hunts for the
+        # promoted primary and transparently re-establishes watches/subs
+        self._addrs: list[str] = []
+        self._failover_s = 15.0
+        self._closed = False
+        self._conn_ready = asyncio.Event()
+        self._failover_task: Optional[asyncio.Task] = None
 
     # ------------------------------------------------------- construction
 
@@ -133,13 +161,57 @@ class FabricClient:
         return c
 
     @classmethod
-    async def connect(cls, addr: str) -> "FabricClient":
+    async def connect(
+        cls, addr: str, failover_s: Optional[float] = None
+    ) -> "FabricClient":
+        """`addr` may list several servers ("h1:p1,h2:p2" — primary +
+        standbys, any order); the client connects to whichever reports the
+        primary role and fails over to the survivor when it dies."""
+        import os
+
         c = cls()
+        c._addrs = [a.strip() for a in addr.split(",") if a.strip()]
+        c._failover_s = (
+            failover_s
+            if failover_s is not None
+            else float(os.environ.get("DYN_FABRIC_FAILOVER_S", "15"))
+        )
+        last_err: Optional[Exception] = None
+        for a in c._addrs:
+            try:
+                await c._connect_to(a)
+                return c
+            except (OSError, RuntimeError, ConnectionError) as e:
+                last_err = e
+        raise ConnectionError(
+            f"no reachable primary among {c._addrs}: {last_err}"
+        )
+
+    async def _connect_to(self, addr: str) -> None:
+        """Open one address; reject standbys (they serve only probes).
+
+        _conn_ready is set only AFTER the role probe passes — callers
+        parked on the failover gate must never wake into a standby."""
         host, _, port = addr.rpartition(":")
-        c._reader, c._writer = await asyncio.open_connection(host, int(port))
-        c.addr = addr
-        c._read_task = asyncio.get_running_loop().create_task(c._read_loop())
-        return c
+        reader, writer = await asyncio.open_connection(host, int(port))
+        self._reader, self._writer = reader, writer
+        self.addr = addr
+        self._conn_lost = False
+        self._read_task = asyncio.get_running_loop().create_task(
+            self._read_loop()
+        )
+        if len(self._addrs) > 1:
+            try:
+                role = await self._call_raw("role")
+            except Exception:
+                role = "unreachable"
+            if role != "primary":
+                self._read_task.cancel()
+                with contextlib.suppress(Exception):
+                    writer.close()
+                    await writer.wait_closed()
+                raise ConnectionError(f"{addr} is a {role}, not the primary")
+        self._conn_ready.set()
 
     @property
     def is_remote(self) -> bool:
@@ -156,6 +228,7 @@ class FabricClient:
             target._feed(None)
             self._streams.pop(stream_id, None)
             self._stream_kind.pop(stream_id, None)
+            self._stream_targets.pop(target, None)
         elif kind == "watch":
             target._feed(WatchEvent.from_wire(payload))
         else:
@@ -164,6 +237,7 @@ class FabricClient:
     def _register_stream(self, stream_id: int, target: Any, kind: str) -> None:
         self._streams[stream_id] = target
         self._stream_kind[stream_id] = kind
+        self._stream_targets[target] = kind
         for payload in self._early_pushes.pop(stream_id, []):
             self._deliver_push(stream_id, target, payload)
 
@@ -172,8 +246,18 @@ class FabricClient:
             self._state.start()
 
     async def close(self) -> None:
+        self._closed = True
+        if self._failover_task is not None:
+            self._failover_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._failover_task
         if self._read_task:
             self._read_task.cancel()
+        if self.is_remote:
+            # terminate every consumer stream (the cancelled read loop no
+            # longer does it, and a failover-in-progress holds targets
+            # that are in no id map at all)
+            self._fail_streams()
         if self._state is not None:
             # Unregister in-process watches/subs from the (possibly shared)
             # FabricState so its event queues don't accumulate forever. Do it
@@ -224,26 +308,101 @@ class FabricClient:
                         fut.set_result(msg[2])
                     else:
                         fut.set_exception(RuntimeError(msg[2]))
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            # deliberate cancellation (close(), or a rejected standby
+            # probe connection) — never a reason to fail over; the
+            # canceller owns the cleanup
+            return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            self._conn_ready.clear()
             self._conn_lost = True
+            # in-flight calls cannot be replayed safely (their outcome on
+            # the dead primary is unknown — etcd gives the same answer);
+            # callers see the error and retry through the failed-over conn
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(ConnectionError("fabric connection lost"))
             self._pending.clear()
-            for sid, target in list(self._streams.items()):
-                target._feed(None)
-            self._streams.clear()
-            self._stream_kind.clear()
+            if len(self._addrs) > 1 and not self._closed:
+                if self._failover_task is None or self._failover_task.done():
+                    self._failover_task = (
+                        asyncio.get_running_loop().create_task(
+                            self._failover()
+                        )
+                    )
+            else:
+                self._fail_streams()
 
-    async def _call(self, op: str, **kwargs: Any) -> Any:
+    def _fail_streams(self) -> None:
+        # terminate from the target registry, not the id map — a failover
+        # that died mid-re-establish has targets missing from _streams
+        for target in list(self._stream_targets):
+            target._feed(None)
+        self._stream_targets.clear()
+        self._streams.clear()
+        self._stream_kind.clear()
+
+    async def _failover(self) -> None:
+        """Hunt for the promoted primary and resume: same leases (they
+        were replicated), watches replayed level-consistently, pub/sub
+        re-subscribed (messages during the gap are lost — core-NATS
+        at-most-once semantics, same as the reference)."""
+        deadline = asyncio.get_event_loop().time() + self._failover_s
+        logger.warning(
+            "fabric connection lost; failing over among %s", self._addrs
+        )
+        while not self._closed:
+            for a in self._addrs:
+                try:
+                    await self._connect_to(a)
+                    await self._reestablish_streams()
+                    logger.info("fabric failover complete: now on %s", a)
+                    return
+                except (OSError, RuntimeError, ConnectionError):
+                    continue
+            if asyncio.get_event_loop().time() >= deadline:
+                break
+            await asyncio.sleep(0.25)
+        logger.error(
+            "fabric failover FAILED after %.0fs; streams closed",
+            self._failover_s,
+        )
+        self._fail_streams()
+
+    async def _reestablish_streams(self) -> None:
+        """Re-create every live watch/subscription on the new primary.
+        Driven off the persistent target registry, so a failure partway
+        through (new primary flaps) leaves every target re-creatable on
+        the next attempt — never silently dropped."""
+        self._streams.clear()
+        self._stream_kind.clear()
+        for target in list(self._stream_targets):
+            if isinstance(target, Watch):
+                wid, snapshot_wire = await self._call_raw(
+                    "watch_create", prefix=target._prefix
+                )
+                snapshot = [WatchEvent.from_wire(d) for d in snapshot_wire]
+                # keys that died with the old primary (or during the gap)
+                # get synthesized deletes; current keys replay as puts —
+                # consumers converge without noticing the failover
+                fresh = {ev.key for ev in snapshot}
+                for key in sorted(target.known - fresh):
+                    target._feed(WatchEvent("delete", key))
+                for ev in snapshot:
+                    target._feed(ev)
+                target._stream_id = wid
+                self._register_stream(wid, target, "watch")
+            else:
+                sid = await self._call_raw(
+                    "subscribe", subject=target._subject, group=target._group
+                )
+                target._stream_id = sid
+                self._register_stream(sid, target, "sub")
+
+    async def _call_raw(self, op: str, **kwargs: Any) -> Any:
+        """Issue one call on the CURRENT connection (no failover gate —
+        used by connect/role probes and stream re-establishment)."""
         assert self._writer is not None, "client not connected"
-        # fail fast once the read loop has died: a write into the dead
-        # socket often "succeeds" (kernel buffer), and with no reader the
-        # pending future would hang forever — wedging e.g. the lease
-        # keepalive loop, which must instead see the error and cancel the
-        # runtime (fabric loss is fatal; the supervisor restarts us)
-        if self._conn_lost or (self._read_task and self._read_task.done()):
-            raise ConnectionError("fabric connection lost")
         req_id = next(self._req_ids)
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
@@ -251,6 +410,27 @@ class FabricClient:
             self._writer.write(wire.pack([req_id, op, kwargs]))
             await self._writer.drain()
         return await fut
+
+    async def _call(self, op: str, **kwargs: Any) -> Any:
+        # fail fast once the read loop has died: a write into the dead
+        # socket often "succeeds" (kernel buffer), and with no reader the
+        # pending future would hang forever. With standby addresses the
+        # call WAITS for the failover to land and proceeds on the new
+        # primary; single-address clients keep the fatal-loss contract
+        # (the supervisor restarts the process).
+        if not self._conn_ready.is_set():
+            if len(self._addrs) > 1 and not self._closed:
+                try:
+                    await asyncio.wait_for(
+                        self._conn_ready.wait(), self._failover_s + 1.0
+                    )
+                except asyncio.TimeoutError:
+                    raise ConnectionError("fabric failover timed out")
+            else:
+                raise ConnectionError("fabric connection lost")
+        if self._conn_lost and self._read_task and self._read_task.done():
+            raise ConnectionError("fabric connection lost")
+        return await self._call_raw(op, **kwargs)
 
     # ------------------------------------------------------------- leases
 
@@ -335,12 +515,17 @@ class FabricClient:
         wid, snapshot_wire = await self._call("watch_create", prefix=prefix)
 
         async def cancel_remote() -> None:
-            self._streams.pop(wid, None)
-            self._stream_kind.pop(wid, None)
+            # _stream_id may have been remapped by a failover
+            cur = watch._stream_id
+            self._streams.pop(cur, None)
+            self._stream_kind.pop(cur, None)
+            self._stream_targets.pop(watch, None)
             with contextlib.suppress(Exception):
-                await self._call("watch_cancel", watch_id=wid)
+                await self._call("watch_cancel", watch_id=cur)
 
         watch = Watch([WatchEvent.from_wire(d) for d in snapshot_wire], cancel_remote)
+        watch._prefix = prefix
+        watch._stream_id = wid
         self._register_stream(wid, watch, "watch")
         return watch
 
@@ -373,12 +558,17 @@ class FabricClient:
         sid = await self._call("subscribe", subject=subject, group=group)
 
         async def cancel_remote() -> None:
-            self._streams.pop(sid, None)
-            self._stream_kind.pop(sid, None)
+            cur = sub._stream_id
+            self._streams.pop(cur, None)
+            self._stream_kind.pop(cur, None)
+            self._stream_targets.pop(sub, None)
             with contextlib.suppress(Exception):
-                await self._call("unsubscribe", sub_id=sid)
+                await self._call("unsubscribe", sub_id=cur)
 
         sub = Subscription(cancel_remote)
+        sub._subject = subject
+        sub._group = group
+        sub._stream_id = sid
         self._register_stream(sid, sub, "sub")
         return sub
 

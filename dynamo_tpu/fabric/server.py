@@ -1,10 +1,25 @@
 """Fabric TCP server: exposes a FabricState over the msgpack wire protocol.
 
-The single external infrastructure process of a dynamo_tpu cluster, playing
-the role that the etcd + NATS server pair plays for the reference
+The external infrastructure process of a dynamo_tpu cluster, playing the
+role that the etcd + NATS server pair plays for the reference
 (deploy/metrics/docker-compose.yml runs both; we run one).
 
     python -m dynamo_tpu.fabric.server --host 0.0.0.0 --port 6650
+
+High availability (the reference's raft-etcd + clustered-NATS role):
+a standby replicates the primary and promotes itself when the primary
+dies; clients carry both addresses and fail over.
+
+    python -m dynamo_tpu.fabric.server --port 6651 --replica-of host:6650
+
+The primary journals every successful mutation (state.py @_replicated)
+to standby connections in order; the standby applies them to an identical
+state machine. Queue pops and watches/subscriptions are connection-local
+and deliberately not replicated: promotion redelivers in-flight queue
+messages (at-least-once, same as the redelivery timer) and failover
+clients re-establish their watches against the new primary's snapshot.
+On promotion every lease gets a grace window so the fleet can reconnect
+before its instances expire.
 """
 
 from __future__ import annotations
@@ -19,6 +34,8 @@ from dynamo_tpu.fabric.state import FabricState
 from dynamo_tpu.runtime.logging import get_logger, init as init_logging
 
 logger = get_logger("dynamo_tpu.fabric.server")
+
+PROMOTION_LEASE_GRACE_S = 10.0
 
 
 class _Conn:
@@ -37,24 +54,72 @@ class _Conn:
 
 
 class FabricServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 6650) -> None:
+    """One HA member. Three start modes:
+
+    * plain primary (no replica_of/peer) — the classic single server.
+    * `replica_of=addr` — explicit standby: syncs from that primary
+      (retrying forever until the FIRST sync — a standby that has never
+      seen the primary must not promote an empty state) and promotes
+      when an established primary stays dead past the resync window.
+    * `peer=addr, advertise=own` — symmetric auto-role for supervised
+      deployments (k8s restarts a pod with its original args, so roles
+      cannot be baked into the command line): probe the peer at boot;
+      follow it if it is primary, else the lexically-smaller advertise
+      address claims primacy and the other follows. A restarted member
+      therefore rejoins as standby of the survivor instead of booting
+      as a second empty primary.
+    """
+
+    RESYNC_ATTEMPTS = 4  # established-primary blips tolerated (1s apart)
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 6650,
+        replica_of: Optional[str] = None,
+        peer: Optional[str] = None,
+        advertise: Optional[str] = None,
+    ) -> None:
+        if replica_of and peer:
+            raise ValueError("--replica-of and --peer are exclusive")
+        if peer and not advertise:
+            raise ValueError("--peer requires --advertise")
         self.host = host
         self.port = port
         self.state = FabricState()
+        self.role = "standby" if (replica_of or peer) else "primary"
+        self.replica_of = replica_of
+        self.peer = peer
+        self.advertise = advertise
         self._server: Optional[asyncio.base_events.Server] = None
+        # standby connections fed by the journal hook; each has an
+        # ordered queue + pump task (order is the replication contract)
+        self._replicas: dict[int, tuple[asyncio.Queue, asyncio.Task]] = {}
+        self._replica_ids = 0
+        self._repl_task: Optional[asyncio.Task] = None
+        self.promoted = asyncio.Event()
 
     @property
     def addr(self) -> str:
         return f"{self.host}:{self.port}"
 
     async def start(self) -> None:
-        self.state.start()
+        if self.role == "primary":
+            self.state.start()
+            self.state.on_replicate = self._journal
         self._server = await asyncio.start_server(
             self._handle_conn, self.host, self.port
         )
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
-        logger.info("fabric server listening on %s:%d", self.host, self.port)
+        logger.info(
+            "fabric server (%s) listening on %s:%d",
+            self.role, self.host, self.port,
+        )
+        if self.role == "standby":
+            self._repl_task = asyncio.get_running_loop().create_task(
+                self._peer_boot() if self.peer else self._follow_primary()
+            )
 
     async def serve_forever(self) -> None:
         assert self._server is not None
@@ -62,10 +127,150 @@ class FabricServer:
             await self._server.serve_forever()
 
     async def close(self) -> None:
+        if self._repl_task is not None:
+            self._repl_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._repl_task
+        for q, t in self._replicas.values():
+            t.cancel()
+        self._replicas.clear()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
         await self.state.close()
+
+    # -------------------------------------------------------- replication
+
+    def _journal(self, op: str, kwargs: dict, result: Any) -> None:
+        """State-layer hook: fan one mutation out to every standby, in
+        order (the enqueue happens synchronously on the mutating loop)."""
+        for q, _ in self._replicas.values():
+            q.put_nowait([op, kwargs, result])
+
+    async def _pump_replica(self, conn: _Conn, rid: int, q: asyncio.Queue) -> None:
+        try:
+            while True:
+                entry = await q.get()
+                await conn.send([0, "repl", rid, entry])
+        except (ConnectionError, asyncio.CancelledError):
+            self._replicas.pop(rid, None)
+
+    async def _peer_boot(self) -> None:
+        """Symmetric auto-role: follow the peer if it is primary, else
+        claim primacy iff our advertise address sorts first. The
+        designated secondary waits for its peer instead of self-promoting
+        with empty state — a two-member pair has no quorum, so 'peer
+        unreachable at cold boot' must not mint a second primary."""
+        assert self.peer is not None and self.advertise is not None
+        waits = 0
+        while True:
+            role = await self._probe_role(self.peer)
+            if role == "primary":
+                self.replica_of = self.peer
+                await self._follow_primary()
+                return
+            if self.advertise < self.peer:
+                logger.info(
+                    "peer %s is %s; claiming primary (tie-break %s < %s)",
+                    self.peer, role or "unreachable",
+                    self.advertise, self.peer,
+                )
+                self._promote()
+                return
+            waits += 1
+            if waits % 10 == 1:
+                logger.warning(
+                    "designated secondary waiting for peer %s (%s so far)",
+                    self.peer, role or "unreachable",
+                )
+            await asyncio.sleep(1.0)
+
+    @staticmethod
+    async def _probe_role(addr: str) -> Optional[str]:
+        host, _, port = addr.rpartition(":")
+        try:
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(host, int(port)), 2.0
+            )
+            try:
+                writer.write(wire.pack([1, "role", {}]))
+                await writer.drain()
+                msg = await asyncio.wait_for(wire.read_frame(reader), 2.0)
+                return msg[2] if msg[1] == "ok" else None
+            finally:
+                writer.close()
+                with contextlib.suppress(Exception):
+                    await writer.wait_closed()
+        except (OSError, asyncio.TimeoutError, ValueError):
+            return None
+
+    async def _follow_primary(self) -> None:
+        """Standby: stream the primary's journal; promote when an
+        ESTABLISHED primary stays dead past the resync window. Before the
+        first successful sync there is nothing safe to promote, so the
+        initial connect retries forever (a standby booting ahead of its
+        primary must not become a second, empty primary)."""
+        assert self.replica_of is not None
+        host, _, port = self.replica_of.rpartition(":")
+        synced_once = False
+        failures = 0
+        while True:
+            try:
+                reader, writer = await asyncio.open_connection(
+                    host, int(port)
+                )
+                try:
+                    writer.write(wire.pack([1, "repl_subscribe", {}]))
+                    await writer.drain()
+                    msg = await wire.read_frame(reader)
+                    if msg[1] != "ok":
+                        raise RuntimeError(f"repl_subscribe failed: {msg[2]}")
+                    self.state.restore(msg[2])
+                    synced_once = True
+                    failures = 0
+                    logger.info(
+                        "standby synced: %d keys, %d leases (following %s)",
+                        len(self.state.kv), len(self.state.leases),
+                        self.replica_of,
+                    )
+                    while True:
+                        msg = await wire.read_frame(reader)
+                        if msg[0] == 0 and msg[1] == "repl":
+                            op, kwargs, result = msg[3]
+                            self.state.apply_replicated(op, kwargs, result)
+                finally:
+                    writer.close()
+                    with contextlib.suppress(Exception):
+                        await writer.wait_closed()
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — classify below
+                failures += 1
+                if synced_once and failures >= self.RESYNC_ATTEMPTS:
+                    logger.warning(
+                        "primary lost (%s, %d attempts); promoting",
+                        e, failures,
+                    )
+                    self._promote()
+                    return
+                if not synced_once and failures % 15 == 1:
+                    logger.warning(
+                        "standby waiting for primary %s (%s)",
+                        self.replica_of, e,
+                    )
+                await asyncio.sleep(1.0)
+
+    def _promote(self) -> None:
+        self.role = "primary"
+        self.state.grace_all_leases(PROMOTION_LEASE_GRACE_S)
+        self.state.start()  # janitor: expiry + redelivery begin here
+        self.state.on_replicate = self._journal
+        self.promoted.set()
+        logger.info(
+            "promoted: %d keys, %d leases under %.0fs grace",
+            len(self.state.kv), len(self.state.leases),
+            PROMOTION_LEASE_GRACE_S,
+        )
 
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
@@ -126,6 +331,21 @@ class FabricServer:
         st = self.state
         if op == "ping":
             return "pong"
+        if op == "role":
+            return self.role
+        if op == "repl_subscribe":
+            rid = self._replica_ids = self._replica_ids + 1
+            q: asyncio.Queue = asyncio.Queue()
+            task = asyncio.get_running_loop().create_task(
+                self._pump_replica(conn, rid, q)
+            )
+            self._replicas[rid] = (q, task)
+            conn.watch_tasks[-rid] = task  # cancelled with the connection
+            return self.state.snapshot()
+        if self.role != "primary":
+            # a standby answers ping/role (so clients can probe) and the
+            # replication handshake; everything else must go to the primary
+            raise RuntimeError("standby: not serving client operations")
         if op == "lease_grant":
             lease_id = st.lease_grant(a["ttl"])
             conn.leases.add(lease_id)
@@ -217,11 +437,30 @@ def main() -> None:
     parser = argparse.ArgumentParser(description="dynamo_tpu fabric server")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=6650)
+    parser.add_argument(
+        "--replica-of", default=None, metavar="HOST:PORT",
+        help="start as a hot standby of this primary; promotes itself "
+        "when the primary dies (control-plane HA)",
+    )
+    parser.add_argument(
+        "--peer", default=None, metavar="HOST:PORT",
+        help="symmetric HA member: probe the peer at boot and follow it "
+        "if primary, else the smaller --advertise address claims primacy "
+        "(restart-safe under supervisors that replay original args)",
+    )
+    parser.add_argument(
+        "--advertise", default=None, metavar="HOST:PORT",
+        help="this member's address as the peer sees it (tie-break key)",
+    )
     args = parser.parse_args()
     init_logging()
 
     async def run() -> None:
-        server = FabricServer(args.host, args.port)
+        server = FabricServer(
+            args.host, args.port,
+            replica_of=args.replica_of,
+            peer=args.peer, advertise=args.advertise,
+        )
         await server.start()
         await server.serve_forever()
 

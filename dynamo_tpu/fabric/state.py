@@ -19,11 +19,12 @@ Capability map to the reference:
 from __future__ import annotations
 
 import asyncio
-import itertools
+import functools
+import inspect
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Any, Callable, Optional
 
 from dynamo_tpu.runtime.logging import get_logger
 
@@ -109,6 +110,31 @@ class _WorkQueue:
         return len(self.ready) + len(self.inflight)
 
 
+def _replicated(fn):
+    """Journal a successful mutation to `on_replicate` (primary->standby
+    stream). Hooked at the STATE layer, not the server dispatch, so
+    internally-driven mutations — the janitor expiring a lease — replicate
+    too. Nested mutators (kv_create -> kv_put, lease_revoke -> deletes)
+    journal only the outermost call; replicas replay it whole."""
+    sig = inspect.signature(fn)
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        self._mut_depth += 1
+        try:
+            result = fn(self, *args, **kwargs)
+        finally:
+            self._mut_depth -= 1
+        if self._mut_depth == 0 and self.on_replicate is not None:
+            bound = sig.bind(self, *args, **kwargs)
+            bound.apply_defaults()
+            a = {k: v for k, v in bound.arguments.items() if k != "self"}
+            self.on_replicate(fn.__name__, a, result)
+        return result
+
+    return wrapper
+
+
 def subject_matches(pattern: str, subject: str) -> bool:
     """NATS-style: tokens split on '.', '*' matches one token, '>' the rest."""
     if pattern == subject:
@@ -136,12 +162,25 @@ class FabricState:
         self.subs: dict[int, _Subscription] = {}
         self.queues: dict[str, _WorkQueue] = {}
         self.objects: dict[str, dict[str, bytes]] = {}
-        self._ids = itertools.count(1)
+        # plain int (not itertools.count) so a standby can pin its counter
+        # past ids minted by the primary (see apply_replicated)
+        self._next_id = 1
         self._group_rr: dict[tuple[str, str], int] = {}
         self._janitor: Optional[asyncio.Task] = None
+        # HA journal hook: (op, kwargs, result) per outermost mutation
+        self.on_replicate: Optional[Callable[[str, dict, Any], None]] = None
+        self._mut_depth = 0
 
     def next_id(self) -> int:
-        return next(self._ids)
+        n = self._next_id
+        self._next_id += 1
+        return n
+
+    def _pin_id(self, used: int) -> None:
+        """Ensure future next_id() calls never re-mint `used` (replication:
+        ids assigned by the primary must stay unique after promotion)."""
+        if used >= self._next_id:
+            self._next_id = used + 1
 
     def start(self) -> None:
         if self._janitor is None or self._janitor.done():
@@ -180,6 +219,7 @@ class FabricState:
 
     # ------------------------------------------------------------- leases
 
+    @_replicated
     def lease_grant(self, ttl: float) -> int:
         lease_id = self.next_id()
         self.leases[lease_id] = _Lease(
@@ -187,6 +227,7 @@ class FabricState:
         )
         return lease_id
 
+    @_replicated
     def lease_keepalive(self, lease_id: int) -> bool:
         lease = self.leases.get(lease_id)
         if lease is None:
@@ -194,6 +235,7 @@ class FabricState:
         lease.deadline = time.monotonic() + lease.ttl
         return True
 
+    @_replicated
     def lease_revoke(self, lease_id: int) -> None:
         lease = self.leases.pop(lease_id, None)
         if lease is None:
@@ -208,6 +250,7 @@ class FabricState:
             if ev.key.startswith(w.prefix):
                 w.queue.put_nowait(ev)
 
+    @_replicated
     def kv_put(self, key: str, value: bytes, lease_id: int = 0) -> int:
         if lease_id and lease_id not in self.leases:
             raise KeyError(f"unknown lease {lease_id}")
@@ -231,6 +274,7 @@ class FabricState:
         )
         return self.revision
 
+    @_replicated
     def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
         """CAS create: fails if the key exists with a different value
         (reference etcd.rs:203 kv_create_or_validate). On a matching value
@@ -264,9 +308,11 @@ class FabricState:
         self._notify(WatchEvent("delete", key, rev=self.revision))
         return True
 
+    @_replicated
     def kv_delete(self, key: str) -> bool:
         return self._delete_key(key)
 
+    @_replicated
     def kv_delete_prefix(self, prefix: str) -> int:
         keys = [k for k in self.kv if k.startswith(prefix)]
         for k in keys:
@@ -342,6 +388,7 @@ class FabricState:
             q.inflight[msg.id] = (msg, time.monotonic() + q.redeliver_after)
             fut.set_result(msg)
 
+    @_replicated
     def queue_put(self, name: str, payload: bytes) -> int:
         q = self._queue(name)
         msg = _QueueMsg(id=self.next_id(), payload=payload)
@@ -378,6 +425,7 @@ class FabricState:
                 fut.cancel()
             raise
 
+    @_replicated
     def queue_ack(self, name: str, msg_id: int) -> bool:
         q = self._queue(name)
         return q.inflight.pop(msg_id, None) is not None
@@ -387,12 +435,14 @@ class FabricState:
 
     # ------------------------------------------------------------ objects
 
+    @_replicated
     def obj_put(self, bucket: str, name: str, data: bytes) -> None:
         self.objects.setdefault(bucket, {})[name] = data
 
     def obj_get(self, bucket: str, name: str) -> Optional[bytes]:
         return self.objects.get(bucket, {}).get(name)
 
+    @_replicated
     def obj_delete(self, bucket: str, name: str) -> bool:
         b = self.objects.get(bucket)
         if b is None:
@@ -401,3 +451,124 @@ class FabricState:
 
     def obj_list(self, bucket: str) -> list[str]:
         return sorted(self.objects.get(bucket, {}).keys())
+
+    # ------------------------------------------------- replication (HA)
+    # The reference's availability story is raft etcd + clustered NATS;
+    # ours is primary/standby: the primary journals every successful
+    # mutating op (op, kwargs, result) to standbys, which apply it with
+    # apply_replicated — deterministic because the only nondeterminism,
+    # id assignment, is pinned from the primary's result. queue POPS are
+    # deliberately not replicated: a standby keeps messages ready, so
+    # promotion redelivers anything the dead primary had in flight
+    # (at-least-once, the same contract as the 30 s redelivery timer).
+
+    def snapshot(self) -> dict:
+        """Full durable state as a msgpack-able dict (watches and subs are
+        connection-local and die with their connections)."""
+        now = time.monotonic()
+        return {
+            "revision": self.revision,
+            "next_id": self._next_id,
+            "kv": {
+                k: [e.value, e.lease_id, e.create_rev, e.mod_rev]
+                for k, e in self.kv.items()
+            },
+            "leases": [
+                [l.id, l.ttl, max(0.0, l.deadline - now), sorted(l.keys)]
+                for l in self.leases.values()
+            ],
+            "queues": {
+                name: {
+                    "redeliver_after": q.redeliver_after,
+                    # in-flight joins ready: the importer redelivers
+                    "ready": [
+                        [m.id, m.payload]
+                        for m in list(q.ready)
+                        + [m for m, _ in q.inflight.values()]
+                    ],
+                }
+                for name, q in self.queues.items()
+            },
+            "objects": {
+                b: dict(items) for b, items in self.objects.items()
+            },
+        }
+
+    def restore(self, snap: dict, lease_grace: float = 0.0) -> None:
+        """Replace state from a snapshot. `lease_grace` widens every lease
+        deadline (promotion: clients need time to fail over before their
+        instances vanish)."""
+        now = time.monotonic()
+        self.kv = {
+            k: KVEntry(value=v[0], lease_id=v[1], create_rev=v[2], mod_rev=v[3])
+            for k, v in snap["kv"].items()
+        }
+        self.revision = snap["revision"]
+        self._next_id = snap["next_id"]
+        self.leases = {
+            lid: _Lease(
+                id=lid, ttl=ttl,
+                deadline=now + max(remaining, lease_grace),
+                keys=set(keys),
+            )
+            for lid, ttl, remaining, keys in snap["leases"]
+        }
+        self.queues = {}
+        for name, qd in snap["queues"].items():
+            q = _WorkQueue(name, redeliver_after=qd["redeliver_after"])
+            q.ready.extend(_QueueMsg(id=m[0], payload=m[1]) for m in qd["ready"])
+            self.queues[name] = q
+        self.objects = {
+            b: dict(items) for b, items in snap["objects"].items()
+        }
+
+    def grace_all_leases(self, grace: float) -> None:
+        """Extend every lease to at least now+grace (promotion time: the
+        fleet must get a failover window before instances expire)."""
+        floor = time.monotonic() + grace
+        for lease in self.leases.values():
+            lease.deadline = max(lease.deadline, floor)
+
+    def apply_replicated(self, op: str, a: dict, result) -> None:
+        """Apply one journaled mutation from the primary."""
+        if op == "lease_grant":
+            self._pin_id(result)
+            self.leases[result] = _Lease(
+                id=result, ttl=a["ttl"],
+                deadline=time.monotonic() + a["ttl"],
+            )
+        elif op == "lease_keepalive":
+            self.lease_keepalive(a["lease_id"])
+        elif op == "lease_revoke":
+            self.lease_revoke(a["lease_id"])
+        elif op == "kv_put":
+            # pin the revision so replica mod_revs match the primary's
+            self.revision = result - 1
+            self.kv_put(a["key"], a["value"], a.get("lease_id", 0))
+        elif op == "kv_create":
+            if result:
+                self.kv_create(a["key"], a["value"], a.get("lease_id", 0))
+        elif op == "kv_delete":
+            self.kv_delete(a["key"])
+        elif op == "kv_delete_prefix":
+            self.kv_delete_prefix(a["prefix"])
+        elif op == "queue_put":
+            self._pin_id(result)
+            q = self._queue(a["name"])
+            q.ready.append(_QueueMsg(id=result, payload=a["payload"]))
+            self._wake_queue(q)
+        elif op == "queue_ack":
+            q = self._queue(a["name"])
+            if q.inflight.pop(a["msg_id"], None) is None:
+                # pops are not replicated, so the acked message is still
+                # sitting in this replica's ready deque — drop it there
+                for i, m in enumerate(q.ready):
+                    if m.id == a["msg_id"]:
+                        del q.ready[i]
+                        break
+        elif op == "obj_put":
+            self.obj_put(a["bucket"], a["name"], a["data"])
+        elif op == "obj_delete":
+            self.obj_delete(a["bucket"], a["name"])
+        else:
+            logger.warning("unknown replicated op %r ignored", op)

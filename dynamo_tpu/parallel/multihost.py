@@ -310,10 +310,13 @@ class SpmdModelRunner:
 
     def decode_multi(self, H, tokens, positions, block_tables, temps,
                      top_ps, top_ks, keys, active, limit_remaining,
-                     min_remaining, eos_ids):
+                     min_remaining, eos_ids, penalties=None):
         # horizon decode is a collective program: broadcast the full input
         # set so followers launch the identical H-step scan (without this
-        # the leader would wedge the slice — same hazard as embed/extract)
+        # the leader would wedge the slice — same hazard as embed/extract).
+        # Penalty batches run a DIFFERENT program (on-device count tables),
+        # so the penalty arrays must ride the broadcast too — a follower
+        # launching the plain program against a penalty leader wedges.
         payload = (
             np.asarray(tokens, np.int32),
             np.asarray(positions, np.int32),
@@ -327,12 +330,25 @@ class SpmdModelRunner:
             np.asarray(min_remaining, np.int32),
             np.asarray(eos_ids, np.int32),
         )
+        pen_payload = None
+        if penalties is not None:
+            hist, hist_len, prompt_len, freq, pres, rep = penalties
+            pen_payload = (
+                np.asarray(hist, np.int32),
+                np.asarray(hist_len, np.int32),
+                np.asarray(prompt_len, np.int32),
+                np.asarray(freq, np.float32),
+                np.asarray(pres, np.float32),
+                np.asarray(rep, np.float32),
+            )
         B = payload[0].shape[0]
         self._channel.send(
-            OP_DECODE_MULTI, [int(H), B, block_tables.shape[1]], payload
+            OP_DECODE_MULTI,
+            [int(H), B, block_tables.shape[1], 1 if pen_payload else 0],
+            payload + (pen_payload or ()),
         )
         return self._runner.decode_multi(
-            int(H), *payload
+            int(H), *payload, penalties=pen_payload
         )
 
     def _fetch_sample(self, out: tuple):
@@ -664,18 +680,26 @@ def follower_loop(runner, channel: SpmdStepChannel, progress_cb=None) -> None:
             )
         elif op == OP_DECODE_MULTI:
             Hn, B, nb = int(h[1]), int(h[2]), int(h[3])
-            got = channel.recv_payload(
-                (
-                    np.zeros(B, np.int32), np.zeros(B, np.int32),
-                    np.zeros((B, nb), np.int32),
-                    np.zeros(B, np.float32), np.zeros(B, np.float32),
-                    np.zeros(B, np.int32), np.zeros((B, 2), np.uint32),
-                    np.zeros(B, bool), np.zeros(B, np.int32),
-                    np.zeros(B, np.int32),
-                    np.full((B, _EOS_K), -1, np.int32),
-                )
+            has_pen = len(h) > 4 and int(h[4])
+            templates = (
+                np.zeros(B, np.int32), np.zeros(B, np.int32),
+                np.zeros((B, nb), np.int32),
+                np.zeros(B, np.float32), np.zeros(B, np.float32),
+                np.zeros(B, np.int32), np.zeros((B, 2), np.uint32),
+                np.zeros(B, bool), np.zeros(B, np.int32),
+                np.zeros(B, np.int32),
+                np.full((B, _EOS_K), -1, np.int32),
             )
-            runner.decode_multi(Hn, *(np.asarray(a) for a in got))
+            if has_pen:
+                L = runner.max_model_len
+                templates = templates + (
+                    np.zeros((B, L), np.int32), np.zeros(B, np.int32),
+                    np.zeros(B, np.int32), np.zeros(B, np.float32),
+                    np.zeros(B, np.float32), np.ones(B, np.float32),
+                )
+            got = [np.asarray(a) for a in channel.recv_payload(templates)]
+            pen = tuple(got[11:]) if has_pen else None
+            runner.decode_multi(Hn, *got[:11], penalties=pen)
         elif op == OP_EMBED:
             T = int(h[1])
             (t,) = channel.recv_payload((np.zeros(T, np.int32),))

@@ -94,7 +94,14 @@ class DistributedRuntime:
                 try:
                     alive = await self.fabric.lease_keepalive(lease_id)
                 except ConnectionError:
-                    alive = False
+                    # the fabric may be mid-failover (HA standby promoting,
+                    # client hunting for it): one retry rides the client's
+                    # failover gate. A single-address client raises again
+                    # immediately, keeping the fatal-loss contract.
+                    try:
+                        alive = await self.fabric.lease_keepalive(lease_id)
+                    except ConnectionError:
+                        alive = False
                 if not alive:
                     logger.error(
                         "primary lease %d lost; cancelling runtime", lease_id

@@ -85,8 +85,18 @@ async def serve_graph(
         graph_module, [s.name for s in specs], addr,
     )
     await sup.start_all()  # fabric first, so children can connect
-    host, _, port_s = addr.partition(":")
-    await _wait_port(host, int(port_s))
+    # addr may list an HA pair ("h1:p1,h2:p2"); any reachable member is
+    # enough to proceed (the client finds the primary itself)
+    last_err: Optional[Exception] = None
+    for member in addr.split(","):
+        host, _, port_s = member.strip().partition(":")
+        try:
+            await _wait_port(host, int(port_s))
+            break
+        except TimeoutError as e:
+            last_err = e
+    else:
+        raise last_err or TimeoutError(f"no fabric member reachable: {addr}")
     for spec in specs:
         n = (replica_overrides or {}).get(spec.name, spec.replicas)
         for r in range(n):
