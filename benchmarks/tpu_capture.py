@@ -45,6 +45,7 @@ BENCH_CONFIG = {
     "concurrency": 32,
     "max_batch": 16,
     "measure_s": 150.0,
+    "workload": "sharegpt",
 }
 
 
@@ -76,6 +77,7 @@ def run_bench(budget_s: float) -> dict | None:
         "--concurrency", str(BENCH_CONFIG["concurrency"]),
         "--max-batch", str(BENCH_CONFIG["max_batch"]),
         "--measure-s", str(BENCH_CONFIG["measure_s"]),
+        "--workload", BENCH_CONFIG["workload"],
     ]
     try:
         cp = subprocess.run(
@@ -102,13 +104,22 @@ def bank(result: dict) -> None:
     result["source"] = "mid_round_tpu_capture"
     result["config"] = dict(BENCH_CONFIG)
     prev_value = None
+    prev_config = None
     if os.path.exists(ARTIFACT):
         try:
             with open(ARTIFACT) as f:
-                prev_value = json.load(f).get("value")
+                prev = json.load(f)
+                prev_value = prev.get("value")
+                prev_config = prev.get("config")
         except (OSError, json.JSONDecodeError):
             pass
-    if prev_value is not None and result.get("value", 0) <= prev_value:
+    # best-of only within the same config; a different-config artifact
+    # (e.g. another workload) never blocks banking this one
+    if (
+        prev_value is not None
+        and prev_config == result["config"]
+        and result.get("value", 0) <= prev_value
+    ):
         print(
             f"capture {result.get('value')} <= banked {prev_value}; keeping",
             flush=True,
