@@ -66,6 +66,9 @@ class Worker:
             EncodeClient(runtime, _encode_endpoint()),
             placeholder_id=int(os.environ.get("DYN_MM_PLACEHOLDER", "0")),
             num_patches=int(os.environ.get("DYN_MM_PATCHES", "16")),
+            # video span = frames * patches placeholder positions; must
+            # leave prompt room inside the engine's max_model_len
+            video_frames=int(os.environ.get("DYN_MM_VIDEO_FRAMES", "8")),
         )
         config = EngineConfig.static_(mm_engine, mdc)
         await run_endpoint(

@@ -411,7 +411,10 @@ class HttpService:
             return self._error(404, f"model {chat_req.model!r} not found", "not_found_error")
         has_images = any(
             isinstance(m.content, list)
-            and any(p.get("type") == "image_url" for p in m.content)
+            and any(
+                p.get("type") in ("image_url", "video_url")
+                for p in m.content
+            )
             for m in chat_req.messages
         )
         if has_images and not execution.supports_images:

@@ -19,12 +19,18 @@ TPU-first:
 
 from dynamo_tpu.multimodal.processor import (  # noqa: F401
     IMAGE_PLACEHOLDER,
+    VIDEO_PLACEHOLDER,
     expand_image_prompt,
+    expand_video_prompt,
     load_image_array,
+    load_video_frames,
     preprocess_pixels,
+    preprocess_video,
+    sample_frames,
 )
 from dynamo_tpu.multimodal.vision import (  # noqa: F401
     ViTConfig,
+    encode_frames,
     encode_pixels,
     init_vit_params,
 )

@@ -47,16 +47,25 @@ class EncodeWorker:
 
     # ------------------------------------------------------------ compute
 
-    def _pixels(self, image_url: str) -> np.ndarray:
-        cached = self._cache.get(image_url)
+    def _cached(self, key: str, build) -> np.ndarray:
+        """Decoded-pixel LRU shared by the image and video paths (one
+        eviction policy — the reference's CACHE_SIZE_MAXIMUM url cache)."""
+        cached = self._cache.get(key)
         if cached is not None:
             return cached
-        img = load_image_array(image_url)
-        px = preprocess_pixels(img, self.cfg.image_size)
+        px = build()
         if len(self._cache) >= _IMAGE_CACHE_MAX:
             self._cache.pop(next(iter(self._cache)))
-        self._cache[image_url] = px
+        self._cache[key] = px
         return px
+
+    def _pixels(self, image_url: str) -> np.ndarray:
+        return self._cached(
+            image_url,
+            lambda: preprocess_pixels(
+                load_image_array(image_url), self.cfg.image_size
+            ),
+        )
 
     def encode_device(self, image_url: str) -> jax.Array:
         """Device path: returns [num_patches, out_dim] as a DEVICE array."""
@@ -66,14 +75,48 @@ class EncodeWorker:
     def encode_numpy(self, image_url: str) -> np.ndarray:
         return np.asarray(self.encode_device(image_url))
 
+    def encode_video_device(
+        self, video_url: str, num_frames: int = 8
+    ) -> jax.Array:
+        """Video path (reference: the video encode-worker variants):
+        num_frames uniformly-sampled frames batch through the SAME tower
+        jit, yielding one spliceable [num_frames * num_patches, out_dim]
+        span. num_frames is static per call so the jit stays warm."""
+        from dynamo_tpu.multimodal.processor import (
+            load_video_frames,
+            preprocess_video,
+        )
+        from dynamo_tpu.multimodal.vision import flatten_frame_embeddings
+
+        px = self._cached(
+            f"{video_url}#t={num_frames}",
+            lambda: preprocess_video(
+                load_video_frames(video_url, num_frames),
+                self.cfg.image_size,
+            ),
+        )
+        return flatten_frame_embeddings(self._encode_jit(self.params, px))
+
+    def encode_video_numpy(
+        self, video_url: str, num_frames: int = 8
+    ) -> np.ndarray:
+        return np.asarray(self.encode_video_device(video_url, num_frames))
+
     # ------------------------------------------------------------- serve
 
     async def handler(
         self, request: dict, ctx: Context
     ) -> AsyncIterator[dict]:
-        """Fabric endpoint handler: {image_url} -> wire-coded embeddings."""
+        """Fabric endpoint handler: {image_url} or {video_url[,
+        num_frames]} -> wire-coded embeddings."""
         try:
-            emb = self.encode_numpy(request["image_url"])
+            if request.get("video_url"):
+                emb = self.encode_video_numpy(
+                    request["video_url"],
+                    int(request.get("num_frames", 8)),
+                )
+            else:
+                emb = self.encode_numpy(request["image_url"])
             wire = to_wire_array(emb)
             yield {
                 "shape": list(emb.shape),
@@ -122,10 +165,20 @@ class EncodeClient:
         self._client: Optional[Any] = None
 
     async def encode(self, image_url: str) -> np.ndarray:
+        return await self._request({"image_url": image_url})
+
+    async def encode_video(
+        self, video_url: str, num_frames: int = 8
+    ) -> np.ndarray:
+        return await self._request(
+            {"video_url": video_url, "num_frames": num_frames}
+        )
+
+    async def _request(self, payload: dict) -> np.ndarray:
         if self._client is None:
             self._client = await self._endpoint.client()
             await self._client.wait_for_instances()
-        stream = await self._client.round_robin({"image_url": image_url})
+        stream = await self._client.round_robin(payload)
         try:
             async for item in stream:
                 if item.is_error():

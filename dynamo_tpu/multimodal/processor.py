@@ -92,3 +92,124 @@ def expand_image_prompt(
         + list(token_ids[i + 1 :])
     )
     return expanded, i
+
+
+VIDEO_PLACEHOLDER = "<video>"
+
+
+def load_video_frames(video_url: str, num_frames: int = 8) -> np.ndarray:
+    """Decode a video source to uniformly-sampled RGB frames
+    [T, H, W, 3] uint8 (reference: the video encode-worker variants under
+    examples/multimodal — decord there, cv2/PIL here).
+
+    Sources: local paths / file:// (any container OpenCV reads), animated
+    GIFs (PIL), and data:video/...;base64 payloads (staged to a temp file
+    for the decoder). http(s) is rejected like the image path — this
+    deployment has no egress.
+    """
+    parsed = urlparse(video_url)
+    tmp_path = None
+    try:
+        if parsed.scheme == "data":
+            if not parsed.path.startswith(("video/", "image/gif")):
+                raise ValueError("data URL must carry a video media type")
+            media, _, payload = parsed.path.partition(",")
+            if ";base64" not in media:
+                raise ValueError("data URL must be base64 encoded")
+            raw = base64.b64decode(payload)
+            if "gif" in media:
+                # PIL reads GIFs from memory; no temp-file hop needed
+                frames = _decode_gif_bytes(raw)
+            else:
+                # cv2's demuxer needs a real path: stage, decode, unlink
+                import tempfile
+
+                with tempfile.NamedTemporaryFile(
+                    suffix=".mp4", delete=False
+                ) as f:
+                    f.write(raw)
+                    tmp_path = f.name
+                frames = _decode_frames(tmp_path)
+        elif parsed.scheme == "file" or not parsed.scheme:
+            frames = _decode_frames(
+                parsed.path if parsed.scheme else video_url
+            )
+        elif parsed.scheme in ("http", "https"):
+            raise ValueError(
+                "http(s) video sources are not reachable from this "
+                "deployment; inline the video as a data: URL"
+            )
+        else:
+            raise ValueError(
+                f"unsupported video source scheme {parsed.scheme!r}"
+            )
+        if not frames:
+            raise ValueError(f"no decodable frames in {video_url!r}")
+        return sample_frames(np.stack(frames), num_frames)
+    finally:
+        if tmp_path is not None:
+            import os
+
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+
+
+def _decode_gif_bytes(raw: bytes) -> list[np.ndarray]:
+    from PIL import Image, ImageSequence
+
+    with Image.open(io.BytesIO(raw)) as img:
+        return [
+            np.asarray(frame.convert("RGB"), dtype=np.uint8)
+            for frame in ImageSequence.Iterator(img)
+        ]
+
+
+def _decode_frames(path: str) -> list[np.ndarray]:
+    if path.lower().endswith(".gif"):
+        with open(path, "rb") as f:
+            return _decode_gif_bytes(f.read())
+    import cv2
+
+    cap = cv2.VideoCapture(path)
+    frames: list[np.ndarray] = []
+    try:
+        while True:
+            ok, bgr = cap.read()
+            if not ok:
+                break
+            frames.append(bgr[:, :, ::-1].astype(np.uint8))  # BGR -> RGB
+    finally:
+        cap.release()
+    return frames
+
+
+def sample_frames(frames: np.ndarray, num_frames: int) -> np.ndarray:
+    """Uniform temporal sampling to exactly num_frames (repeating frames
+    when the clip is shorter — static shapes keep the encoder jit warm)."""
+    T = frames.shape[0]
+    idx = np.linspace(0, T - 1, num_frames).round().astype(np.int64)
+    return frames[idx]
+
+
+def preprocess_video(frames: np.ndarray, image_size: int) -> np.ndarray:
+    """[T, H, W, 3] uint8 -> [T, S, S, 3] float32 in [-1, 1]."""
+    return np.stack(
+        [preprocess_pixels(f, image_size) for f in frames]
+    )
+
+
+def expand_video_prompt(
+    token_ids: list[int],
+    placeholder_id: int,
+    num_frames: int,
+    num_patches: int,
+) -> tuple[list[int], int]:
+    """Expand ONE video placeholder to num_frames*num_patches positions —
+    the spliced span carries every frame's patch embeddings in temporal
+    order (same single-span mm mask the image path uses, so the prefill
+    program needs no video-specific plumbing)."""
+    return expand_image_prompt(
+        token_ids, placeholder_id, num_frames * num_patches
+    )

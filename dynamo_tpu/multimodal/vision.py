@@ -117,3 +117,20 @@ def encode_pixels(
         x = _block(x, layer, cfg)
     x = rms_norm(x, params["final_norm"], cfg.eps)
     return jax.nn.gelu(x @ params["proj_w1"]) @ params["proj_w2"]
+
+
+def flatten_frame_embeddings(emb):
+    """[T, P, D] -> [T * P, D]: per-frame patch embeddings concatenated
+    in temporal order — the layout expand_video_prompt sizes the
+    placeholder span for."""
+    return emb.reshape(emb.shape[0] * emb.shape[1], emb.shape[2])
+
+
+def encode_frames(
+    params: dict, cfg: "ViTConfig", frames  # [T, S, S, 3] f32
+):
+    """Video clip -> one spliceable span [T * num_patches, out_dim].
+
+    Frames batch through the SAME tower as images (leading axis is the
+    batch), so video costs one dispatch."""
+    return flatten_frame_embeddings(encode_pixels(params, cfg, frames))

@@ -47,6 +47,7 @@ class MultimodalEngine:
         encoder: Any,  # EncodeWorker | EncodeClient
         placeholder_id: int = 0,
         num_patches: Optional[int] = None,
+        video_frames: int = 8,
     ) -> None:
         self.inner = inner
         self.encoder = encoder
@@ -55,6 +56,9 @@ class MultimodalEngine:
             cfg = getattr(encoder, "cfg", None)
             num_patches = cfg.num_patches if cfg is not None else 16
         self.num_patches = num_patches
+        # video clips sample this many frames; the spliced span is
+        # video_frames * num_patches placeholder positions
+        self.video_frames = video_frames
 
     # advertises image support to the serving layer (http 501 otherwise)
     supports_images = True
@@ -91,43 +95,84 @@ class MultimodalEngine:
     def on_cache_cleared(self, fn) -> None:
         self.inner.on_cache_cleared = fn
 
+    def _land_device(self, emb: Any) -> Any:
+        """Colocated path: re-commit a device span under the engine mesh."""
+        runner = getattr(self.inner, "runner", None)
+        return (
+            transfer_embeds_device(emb, runner)
+            if runner is not None
+            else np.asarray(emb)
+        )
+
     async def _resolve_embeds(self, image_url: str) -> Any:
         if isinstance(self.encoder, EncodeWorker):
             # colocated: stay on device, re-commit under the engine's mesh
-            emb = self.encoder.encode_device(image_url)
-            runner = getattr(self.inner, "runner", None)
-            return (
-                transfer_embeds_device(emb, runner)
-                if runner is not None
-                else np.asarray(emb)
-            )
+            return self._land_device(self.encoder.encode_device(image_url))
         if isinstance(self.encoder, EncodeClient):
             return await self.encoder.encode(image_url)
+        raise TypeError(f"unsupported encoder {type(self.encoder)!r}")
+
+    async def _resolve_video_embeds(self, video_url: str) -> Any:
+        if isinstance(self.encoder, EncodeWorker):
+            return self._land_device(
+                self.encoder.encode_video_device(
+                    video_url, self.video_frames
+                )
+            )
+        if isinstance(self.encoder, EncodeClient):
+            return await self.encoder.encode_video(
+                video_url, self.video_frames
+            )
         raise TypeError(f"unsupported encoder {type(self.encoder)!r}")
 
     async def generate(
         self, request: PreprocessedRequest, context: Context
     ) -> AsyncIterator[LLMEngineOutput]:
         urls = request.extra.get("mm_images")
-        if urls:
-            if len(urls) > 1:
+        vids = request.extra.get("mm_videos")
+        if urls or vids:
+            n_sources = len(urls or []) + len(vids or [])
+            if n_sources > 1:
                 logger.warning(
-                    "multi-image request: using first of %d images "
-                    "(parity with the reference's single-image TODO, "
-                    "encode_worker.py:192)", len(urls),
+                    "mixed-media request: serving the %s, dropping %d "
+                    "other source(s) (single-media parity with the "
+                    "reference's TODO, encode_worker.py:192)",
+                    "video" if vids else "image", n_sources - 1,
                 )
-            try:
-                embeds = await self._resolve_embeds(urls[0])
-            except Exception:  # noqa: BLE001
-                logger.exception("image encode failed")
+            span = (
+                self.video_frames * self.num_patches
+                if vids
+                else self.num_patches
+            )
+            # fail BEFORE the encode when the spliced sequence cannot fit
+            # (the span is prepended after the preprocessor's budgeting,
+            # so a near-limit prompt + a video's frames*patches span can
+            # exceed the context; the engine would reject it anyway, but
+            # without saying why)
+            max_len = getattr(
+                getattr(self.inner, "config", None), "max_model_len", None
+            )
+            if max_len is not None and span + len(request.token_ids) >= max_len:
+                logger.error(
+                    "media span (%d) + prompt (%d) exceeds max_model_len "
+                    "(%d); reduce video_frames or shorten the prompt",
+                    span, len(request.token_ids), max_len,
+                )
                 yield LLMEngineOutput.final(FinishReason.ERROR)
                 return
-            ids = (
-                [self.placeholder_id] * self.num_patches
-                + list(request.token_ids)
-            )
+            try:
+                if vids:
+                    embeds = await self._resolve_video_embeds(vids[0])
+                else:
+                    embeds = await self._resolve_embeds(urls[0])
+            except Exception:  # noqa: BLE001
+                logger.exception("media encode failed")
+                yield LLMEngineOutput.final(FinishReason.ERROR)
+                return
+            ids = [self.placeholder_id] * span + list(request.token_ids)
             extra = dict(request.extra)
             extra.pop("mm_images", None)
+            extra.pop("mm_videos", None)
             extra["mm"] = {"embeds": embeds, "start": 0}
             request = dataclasses.replace(
                 request, token_ids=ids, extra=extra

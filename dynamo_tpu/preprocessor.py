@@ -56,16 +56,21 @@ class OpenAIPreprocessor:
         # + expanded placeholder tokens (ref multimodal processor.py)
         messages = []
         image_urls: list[str] = []
+        video_urls: list[str] = []
         for m in request.messages:
             d = m.model_dump(exclude_none=True)
             if isinstance(d.get("content"), list):
                 for part in d["content"]:
-                    if part.get("type") == "image_url":
-                        url = part.get("image_url")
+                    if part.get("type") in ("image_url", "video_url"):
+                        url = part.get(part["type"])
                         if isinstance(url, dict):
                             url = url.get("url")
                         if url:
-                            image_urls.append(url)
+                            (
+                                video_urls
+                                if part["type"] == "video_url"
+                                else image_urls
+                            ).append(url)
                 d["content"] = m.text_content()
             messages.append(d)
         prompt = self.template.render(
@@ -77,6 +82,8 @@ class OpenAIPreprocessor:
         pre = self._build(request, enc.ids, request.output_limit())
         if image_urls:
             pre.extra["mm_images"] = image_urls
+        if video_urls:
+            pre.extra["mm_videos"] = video_urls
         return pre, prompt
 
     def preprocess_completion(
