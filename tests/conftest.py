@@ -21,6 +21,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# The suite builds dozens of tiny ModelRunners whose XLA programs are
+# byte-identical; the persistent compilation cache turns every repeat
+# into a disk hit (biggest single lever on the CI budget). Scoped to a
+# temp dir per machine/user, populated on the first run.
+import tempfile  # noqa: E402
+
+_CACHE_DIR = os.path.join(
+    tempfile.gettempdir(), f"dynamo-tpu-test-xla-cache-{os.getuid()}"
+)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 

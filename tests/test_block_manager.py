@@ -175,6 +175,7 @@ async def collect(engine, prompt, max_tokens=8):
     return out
 
 
+@pytest.mark.slow
 async def test_engine_offloads_on_finish():
     engine, cfg = None, None
     engine0, cfg = make_engine()
@@ -193,6 +194,7 @@ async def test_engine_offloads_on_finish():
     await engine0.close()
 
 
+@pytest.mark.slow
 async def test_prefix_reuse_via_remote_prefill():
     """Second identical prompt onboards offloaded blocks; prefill worker
     ships only the remainder. Output must stay token-identical."""

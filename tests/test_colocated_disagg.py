@@ -10,6 +10,12 @@ import time
 
 import jax
 import numpy as np
+import pytest
+
+# engine-pair parity suite (~2 min of compiles): slow tier; the default
+# tier still covers the colocated role through test_disagg's wire-path
+# short-prompt + queue tests
+pytestmark = pytest.mark.slow
 
 from dynamo_tpu.disagg.colocated import ColocatedPrefillClient
 from dynamo_tpu.disagg.router import DisaggConfig, DisaggregatedRouter

@@ -9,6 +9,7 @@ _decode_multi_impl; motivated by the measured ~65 ms per-step fetch RTT.)
 """
 
 import numpy as np
+import pytest
 
 import jax
 
@@ -74,6 +75,7 @@ async def test_horizon_matches_single_step_greedy():
         assert len(toks) == 11 and reason is FinishReason.LENGTH
 
 
+@pytest.mark.slow
 async def test_horizon_matches_single_step_seeded_sampling():
     prompt = [7, 12, 30]
     outs = {}
@@ -89,6 +91,7 @@ async def test_horizon_matches_single_step_seeded_sampling():
     assert outs[1] == outs[3]
 
 
+@pytest.mark.slow
 async def test_horizon_respects_max_tokens_not_divisible_by_h():
     engine = make_engine(4)
     toks, reason = await collect(engine, greedy_request([5, 6, 7], 7))
@@ -97,6 +100,7 @@ async def test_horizon_respects_max_tokens_not_divisible_by_h():
     assert reason is FinishReason.LENGTH
 
 
+@pytest.mark.slow
 async def test_horizon_min_tokens_suppresses_eos():
     # pin EOS to whatever greedy emits first so suppression must kick in
     probe = make_engine(1)
@@ -169,6 +173,7 @@ async def test_horizon_lane_near_model_len_with_fresh_lane():
     assert len(tb) == 8
 
 
+@pytest.mark.slow
 async def test_horizon_mixed_batch_and_penalty_fallback():
     # one plain + one penalty request: the batch must fall back to
     # single-step (penalties need the history program) and still match
@@ -194,6 +199,7 @@ async def test_horizon_mixed_batch_and_penalty_fallback():
     assert await run(4) == await run(1)
 
 
+@pytest.mark.slow
 async def test_horizon_penalties_match_single_step_and_keep_h():
     """A mixed penalty/plain batch must (a) produce the same tokens as
     single-step decoding and (b) actually execute with H>1 — penalties no
@@ -235,6 +241,7 @@ async def test_horizon_penalties_match_single_step_and_keep_h():
     assert multi_calls[4] and max(multi_calls[4]) > 1
 
 
+@pytest.mark.slow
 async def test_horizon_penalty_only_batch_diverges_from_unpenalized():
     """Sanity: the penalty program actually changes the distribution —
     a strong repetition penalty under greedy must alter the token stream

@@ -192,6 +192,10 @@ async def test_extract_inject_transfers_kv_exactly():
 # ---------------------------------------------------------------- e2e level
 
 
+# slow tier: full P/D parity needs two engine builds; the default tier
+# keeps the routing decision (short-prompt-stays-local), queue semantics,
+# and the remote-FAILURE fallback below — the error path nothing else runs
+@pytest.mark.slow
 async def test_disagg_end_to_end_matches_local():
     fabric = FabricClient.in_process()
     ns = "disagg-e2e"

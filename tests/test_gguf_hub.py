@@ -213,6 +213,7 @@ def test_gguf_q8_0_dequant(tmp_path):
     g.close()
 
 
+@pytest.mark.slow
 async def test_factory_serves_from_gguf(tmp_path):
     """build_jax_engine('model.gguf') serves greedy tokens identical to the
     same weights loaded from a directory."""

@@ -10,6 +10,7 @@ and pool.rs (reuse of blocks still held by active sequences).
 import asyncio
 
 import jax
+import pytest
 
 from dynamo_tpu.block_manager.layout import LayoutConfig
 from dynamo_tpu.block_manager.manager import TieredBlockManager
@@ -205,6 +206,7 @@ async def test_completion_only_offload_misses_live_prefix():
     assert b == a[:8]  # still correct, just slower
 
 
+@pytest.mark.slow
 async def test_preemption_spills_and_resumes_via_onboard():
     """Two growing decodes exceed the device pool: the youngest is
     preempted, its completed blocks spill to G2 (not dropped), and its

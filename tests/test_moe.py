@@ -89,6 +89,7 @@ def test_moe_ffn_matches_naive(topk):
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_moe_shard_map_matches_gspmd():
     mesh = build_mesh(ep=4)
     T, D, F, E = 12, 8, 16, 8
@@ -159,6 +160,7 @@ def test_mixtral_safetensors_roundtrip(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_mixtral_prefill_decode_runs():
     cfg = mixtral.tiny_moe()
     params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
@@ -183,6 +185,7 @@ def test_mixtral_prefill_decode_runs():
     assert not bool(jnp.isnan(logits_d).any())
 
 
+@pytest.mark.slow
 def test_mixtral_engine_ep_mesh_matches_single_device():
     """Full engine generate with experts over ep=2 x tp=2 == single device."""
     import asyncio

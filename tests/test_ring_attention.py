@@ -132,6 +132,7 @@ def test_engine_with_sp_mesh_matches_serial():
     assert t_sp == t_1, (t_sp, t_1)
 
 
+@pytest.mark.slow
 def test_context_parallel_prefill_matches_serial():
     """Full-model sp prefill == serial prefill (logits + produced KV)."""
     mesh = _mesh({"sp": 4})

@@ -323,6 +323,7 @@ def _hf_round_trip(tmp_path, hf_cfg_dict, hf_model, T=12):
     return np.asarray(ours, np.float32), hf_logits
 
 
+@pytest.mark.slow
 def test_gemma2_matches_hf_transformers(tmp_path):
     torch = pytest.importorskip("torch")
     from transformers import Gemma2Config, Gemma2ForCausalLM
@@ -341,6 +342,7 @@ def test_gemma2_matches_hf_transformers(tmp_path):
     np.testing.assert_allclose(ours, hf, atol=2e-3, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_gemma3_matches_hf_transformers(tmp_path):
     torch = pytest.importorskip("torch")
     from transformers import Gemma3TextConfig
