@@ -41,9 +41,12 @@ from benchmarks.tpu_probe import probe_fresh  # noqa: E402
 # The knobs run_bench passes to the worker — kept in the banked artifact so
 # bench.py's supervisor can tell whether a banked number is same-config.
 BENCH_CONFIG = {
-    "requests": 48,
-    "concurrency": 32,
-    "max_batch": 16,
+    # 320 x ~180 mean OSL ~= 58k output tokens: enough demand to keep all
+    # 64 lanes full through the whole 150 s window at the measured ~385
+    # tok/s decode rate (159 requests drained early and diluted the avg)
+    "requests": 320,
+    "concurrency": 96,
+    "max_batch": 64,
     "measure_s": 150.0,
     "workload": "sharegpt",
 }
@@ -129,8 +132,12 @@ def bank(result: dict) -> None:
         json.dump(result, f, indent=1)
         f.write("\n")
     # --only: commit JUST this artifact, never sweeping up whatever the
-    # developer happens to have staged in the shared working repo
+    # developer happens to have staged in the shared working repo (the
+    # add makes --only work on the first, untracked capture too)
     subprocess.run(
+        ["git", "add", "BENCH_TPU_LOCAL.json"], cwd=REPO, check=False
+    )
+    cp = subprocess.run(
         [
             "git",
             "commit",
@@ -138,11 +145,21 @@ def bank(result: dict) -> None:
             "BENCH_TPU_LOCAL.json",
             "-m",
             f"Bank TPU bench capture: {result.get('value')} tok/s/chip",
-            "--no-verify",
         ],
         cwd=REPO,
         check=False,
+        capture_output=True,
+        text=True,
     )
+    if cp.returncode != 0:
+        # don't leave the artifact staged for the developer's next commit
+        # to sweep up — the exact hazard --only exists to prevent
+        subprocess.run(
+            ["git", "reset", "--", "BENCH_TPU_LOCAL.json"],
+            cwd=REPO, check=False,
+        )
+        print(f"bank commit failed (artifact unstaged): {cp.stderr.strip()}",
+              flush=True)
     print(f"banked {result.get('value')} tok/s/chip", flush=True)
 
 

@@ -907,19 +907,25 @@ class JaxEngine:
         total = len(seq.token_ids)
         chunk = seq.token_ids[start : start + c]
         key_row = self._key_row(seq)
+        final = start + c >= total
         async with self._device_lock:
-            sample = await loop.run_in_executor(
-                None,
-                lambda: self.runner.fetch_sample(
-                    self.runner.prefill_chunk(
-                        chunk, start, total, seq.block_ids,
-                        seq.temperature, seq.top_p, seq.top_k,
-                        rep_pen=seq.rep_pen, key_data=key_row,
-                        eos_ids=seq.eos_row,
-                        eos_suppress=seq.needs_eos_suppress,
-                    )
-                ),
-            )
+            # only the FINAL chunk's sample is consumed; syncing the
+            # fetch on intermediate chunks left the device idle for one
+            # full tunnel round trip per chunk (live-v5e measured ~70 ms
+            # against ~80 ms of chunk compute — nearly half the prefill
+            # wall). Intermediate chunks dispatch asynchronously; JAX
+            # orders them through the donated-cache dataflow.
+            def run_chunk():
+                out = self.runner.prefill_chunk(
+                    chunk, start, total, seq.block_ids,
+                    seq.temperature, seq.top_p, seq.top_k,
+                    rep_pen=seq.rep_pen, key_data=key_row,
+                    eos_ids=seq.eos_row,
+                    eos_suppress=seq.needs_eos_suppress,
+                )
+                return self.runner.fetch_sample(out) if final else None
+
+            sample = await loop.run_in_executor(None, run_chunk)
         if seq.slot is None:  # cancelled during the device call
             return
         seq.prefill_pos = min(start + c, total)

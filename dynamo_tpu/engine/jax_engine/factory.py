@@ -209,13 +209,21 @@ def _maybe_block_manager(config, kv_block_size: int):
 
 
 def default_decode_horizon() -> int:
-    """Horizon decode default: DYN_DECODE_HORIZON env override, else 8 on
-    TPU (amortizes the per-step host round trip), 1 elsewhere (CPU tests
-    exercise the single-step path unless they opt in)."""
+    """Horizon decode default: DYN_DECODE_HORIZON env override, else 4 on
+    TPU, 1 elsewhere (CPU tests exercise the single-step path unless they
+    opt in).
+
+    Why 4: measured end-to-end on a live tunneled v5e (llama3-8b int8,
+    B=64, saturated ShareGPT serving): H=4 and H=8 deliver the SAME
+    serving throughput (249 vs 245 tok/s/chip — dispatch-rate gains are
+    absorbed by the loop's prefill share), while H=4 compiles in half the
+    time (~62 s vs ~131 s; the unrolled horizon is linear in H) and emits
+    smaller token bursts. The per-dispatch tunnel round trip (~70 ms) is
+    already under 10% of the H=4 program (~660 ms)."""
     override = os.environ.get("DYN_DECODE_HORIZON")
     if override:
         return max(1, int(override))
-    return 8 if jax.default_backend() == "tpu" else 1
+    return 4 if jax.default_backend() == "tpu" else 1
 
 
 def _gguf_model_card(
