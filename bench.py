@@ -257,7 +257,8 @@ def init_devices(want_tpu: bool, retries: int = 3, probe_timeout_s: float = 90.0
     return None, failures, False
 
 
-def build_engine(tiny: bool, max_batch: int):
+def build_engine(tiny: bool, max_batch: int, spec_k: int = 0,
+                 lazy_horizon: bool = False):
     import jax
 
     from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
@@ -320,6 +321,8 @@ def build_engine(tiny: bool, max_batch: int):
             num_blocks=num_blocks,
             max_model_len=max_len,
             decode_horizon=default_decode_horizon(),
+            spec_k=spec_k,
+            lazy_horizon=lazy_horizon,
         ),
     )
     return engine, cfg, max_len
@@ -381,7 +384,14 @@ def compile_phase(engine) -> None:
         ),
     )
     H = engine.config.decode_horizon
-    if H > 1:
+    if H > 1 and engine.config.lazy_horizon:
+        # cold-start saver (tpu_capture path): kick the unrolled-horizon
+        # compile in the BACKGROUND and let the engine single-step until
+        # it lands — measurement starts ~30 s sooner (BENCH_r05 clocked
+        # decode_multi@H4B64 at 30.4 s of the 46.6 s compile bill)
+        heartbeat(f"decode_multi@H{H} compiling in background (lazy)")
+        runner.prepare_decode_multi_async(H)
+    elif H > 1:
         from dynamo_tpu.engine.jax_engine.model_runner import MAX_EOS_IDS as EK
 
         try:
@@ -415,31 +425,46 @@ def compile_phase(engine) -> None:
             # decode_multi donates k_cache/v_cache: an *execution*-time
             # failure (runtime HBM OOM) may have consumed the buffers even
             # though runner still references them — the single-step path
-            # would then crash on deleted arrays. Rebuild if dead.
-            try:
-                dead = getattr(runner.k_cache, "is_deleted", lambda: False)()
-            except Exception:  # noqa: BLE001
-                dead = True
-            if dead:
-                # shape/dtype are metadata — readable even on a deleted
-                # array; the engine has admitted nothing yet, so zeros are
-                # the correct contents. Respect the runner's kv_sharding
-                # (allocate on-device under the mesh, as __init__ does) or
-                # the next donated decode hits a sharding mismatch.
-                heartbeat("KV caches consumed by failed horizon — rebuilding")
-                import jax
-                import jax.numpy as jnp
+            # would then crash on deleted arrays. The engine has admitted
+            # nothing yet, so zeros are the correct contents.
+            if runner.ensure_kv_alive():
+                heartbeat("KV caches consumed by failed horizon — rebuilt")
+    if engine.config.spec_k > 0:
+        # warm the verify program too (it replaces decode dispatches the
+        # moment a lane drafts; compiling it mid-measure would stall the
+        # first speculative batch)
+        from dynamo_tpu.engine.jax_engine.model_runner import MAX_EOS_IDS as EK
 
-                for name in ("k_cache", "v_cache"):
-                    old = getattr(runner, name)
-                    if runner._kv_sharding is not None:
-                        make = jax.jit(
-                            lambda s=old.shape, d=old.dtype: jnp.zeros(s, d),
-                            out_shardings=runner._kv_sharding,
-                        )
-                        setattr(runner, name, make())
-                    else:
-                        setattr(runner, name, jnp.zeros(old.shape, old.dtype))
+        K = engine.config.spec_k
+        E = max(0, engine.config.decode_horizon - 1)
+        try:
+            timed(
+                f"spec_verify@K{K}E{E}B{B}",
+                lambda: np.asarray(
+                    runner.spec_verify(
+                        K, E,
+                        np.zeros(B, np.int32),
+                        np.full((B, K), -1, np.int32),
+                        np.zeros(B, np.int32),
+                        np.zeros(B, np.int32),
+                        np.zeros((B, runner.max_blocks_per_seq), np.int32),
+                        np.zeros(B, np.float32),
+                        np.ones(B, np.float32),
+                        np.zeros(B, np.int32),
+                        np.zeros((B, 2), np.uint32),
+                        np.zeros(B, bool),
+                        np.ones(B, np.int32),
+                        np.zeros(B, np.int32),
+                        np.full((B, EK), -1, np.int32),
+                    )
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — e.g. HBM OOM at compile
+            heartbeat(f"spec_verify compile failed ({e!r:.200}); spec off")
+            engine.config.spec_k = 0
+            engine.drafter = None
+            if runner.ensure_kv_alive():
+                heartbeat("KV caches consumed by failed verify — rebuilt")
 
 
 def sharegpt_workload(n: int, vocab: int, max_len: int, seed: int = 0):
@@ -542,6 +567,7 @@ def _bench_config(args) -> dict:
         "max_batch": args.max_batch,
         "measure_s": args.measure_s,
         "workload": args.workload,
+        "spec_k": args.spec_k,
     }
 
 
@@ -626,6 +652,8 @@ def supervise(args) -> None:
                     "--max-batch", str(args.max_batch),
                     "--measure-s", str(args.measure_s),
                     "--workload", args.workload,
+                    "--spec-k", str(args.spec_k),
+                    *(["--lazy-horizon"] if args.lazy_horizon else []),
                 ],
                 # kill 20s after the worker's own budget, still inside the
                 # supervisor watchdog (budget + 25s)
@@ -734,6 +762,22 @@ def main() -> None:
         default="sharegpt",
         help="sharegpt = lognormal ISL/OSL (metric of record); canonical "
         "= fixed ISL 3000 / OSL 150 (the reference's genai-perf profile)",
+    )
+    parser.add_argument(
+        "--spec-k",
+        type=int,
+        default=int(os.environ.get("DYN_SPEC_K", "0") or 0),
+        help="self-drafting speculative decoding: draft tokens per lane "
+        "per dispatch (0 = off); benchmarks/spec_smoke.py banks the "
+        "on/off comparison on deterministic traces",
+    )
+    parser.add_argument(
+        "--lazy-horizon",
+        action="store_true",
+        default=os.environ.get("DYN_LAZY_HORIZON", "0") in ("1", "true"),
+        help="compile the decode_multi horizon program in the background "
+        "and single-step until ready (saves ~30 s of tunnel-window "
+        "compile on opportunistic captures)",
     )
     parser.add_argument(
         "--cpu-fallback",
@@ -850,7 +894,10 @@ def main() -> None:
         STATE["phase"] = "build"
         heartbeat("building engine (weights + KV cache)")
         t = time.monotonic()
-        engine, cfg, max_len = build_engine(args.tiny, args.max_batch)
+        engine, cfg, max_len = build_engine(
+            args.tiny, args.max_batch,
+            spec_k=args.spec_k, lazy_horizon=args.lazy_horizon,
+        )
         STATE["phase_times_s"]["build"] = time.monotonic() - t
 
         STATE["phase"] = "compile"

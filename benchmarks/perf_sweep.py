@@ -129,7 +129,7 @@ async def _level(base, model, c, requests, prompt, max_tokens):
 
 async def run_sweep(
     model_path, levels, requests_per_level, prompt_tokens, max_tokens,
-    decode_horizon=None,
+    decode_horizon=None, context_length=None,
 ):
     own_dir = None
     port = _free_port()
@@ -146,16 +146,18 @@ async def run_sweep(
     errlog = tempfile.NamedTemporaryFile(
         mode="w+", suffix=".perf-sweep.log", delete=False
     )
+    cmd = [
+        sys.executable, "-m", "dynamo_tpu.run",
+        "in=http", "out=jax",
+        "--model-path", model_path,
+        "--model-name", "sweep-model",
+        "--http-port", str(port),
+        "--max-batch", "16",
+    ]
+    if context_length:
+        cmd += ["--context-length", str(context_length)]
     proc = subprocess.Popen(
-        [
-            sys.executable, "-m", "dynamo_tpu.run",
-            "in=http", "out=jax",
-            "--model-path", model_path,
-            "--model-name", "sweep-model",
-            "--http-port", str(port),
-            "--max-batch", "16",
-        ],
-        env=env, stdout=subprocess.DEVNULL, stderr=errlog, cwd="/tmp",
+        cmd, env=env, stdout=subprocess.DEVNULL, stderr=errlog, cwd="/tmp",
     )
     base = f"http://127.0.0.1:{port}"
     try:
@@ -227,21 +229,38 @@ def main() -> None:
     ap.add_argument("--prompt-tokens", type=int, default=96)
     ap.add_argument("--max-tokens", type=int, default=32)
     ap.add_argument("--decode-horizon", type=int, default=None)
+    ap.add_argument("--context-length", type=int, default=None)
+    ap.add_argument(
+        "--preset", choices=["canonical"], default=None,
+        help="canonical = the reference's genai-perf workload "
+        "(examples/llm/benchmarks/README.md:41 — ISL 3000 / OSL 150, "
+        "served at max_model_len 3328 = 3000 prompt + 150 output + "
+        "slack), so sweeps are directly comparable to its published "
+        "throughput/latency curves",
+    )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
+    if args.preset == "canonical":
+        args.prompt_tokens = 3000
+        args.max_tokens = 150
+        if args.context_length is None:
+            args.context_length = 3328
     levels = [int(x) for x in args.concurrency.split(",")]
     results = asyncio.run(
         run_sweep(
             args.model_path, levels, args.requests_per_level,
             args.prompt_tokens, args.max_tokens,
             decode_horizon=args.decode_horizon,
+            context_length=args.context_length,
         )
     )
     doc = {
         "bench": "perf_sweep",
         "model": args.model_path or "tiny-random",
+        "preset": args.preset,
         "prompt_tokens": args.prompt_tokens,
         "max_tokens": args.max_tokens,
+        "context_length": args.context_length,
         "results": results,
         "pareto": pareto_frontier(results),
     }

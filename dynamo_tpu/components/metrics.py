@@ -66,6 +66,21 @@ class MetricsComponent:
             "kv_prefix_cache_hit_rate", "Mean engine prefix hit rate"
         )
         self.g_workers = g("worker_count", "Workers reporting stats")
+        # speculative decoding (SpecDecodeStats): absent until a worker
+        # reports spec counters, then summed across the fleet
+        self.g_spec_drafts = g(
+            "spec_decode_drafts", "Lane-dispatches carrying draft tokens"
+        )
+        self.g_spec_draft_tokens = g(
+            "spec_decode_draft_tokens", "Draft tokens proposed"
+        )
+        self.g_spec_accepted = g(
+            "spec_decode_accepted_tokens", "Draft tokens accepted"
+        )
+        self.g_spec_accept_rate = g(
+            "spec_decode_acceptance_rate",
+            "Accepted / proposed draft tokens",
+        )
         self.c_hit_events = Counter(
             f"{PREFIX}_kv_hit_rate_events_total",
             "kv-hit-rate events seen",
@@ -117,6 +132,12 @@ class MetricsComponent:
                 self.g_kv_total.set(agg.kv_stats.kv_total_blocks)
                 self.g_cache_usage.set(agg.kv_stats.gpu_cache_usage_perc)
                 self.g_hit_rate.set(agg.kv_stats.gpu_prefix_cache_hit_rate)
+                spec = agg.spec_decode_stats
+                if spec is not None:
+                    self.g_spec_drafts.set(spec.num_drafts or 0)
+                    self.g_spec_draft_tokens.set(spec.num_draft_tokens or 0)
+                    self.g_spec_accepted.set(spec.num_accepted_tokens or 0)
+                    self.g_spec_accept_rate.set(spec.acceptance_rate)
             except Exception:  # noqa: BLE001 — scrape failures are transient
                 logger.exception("metrics poll failed")
             await asyncio.sleep(self.poll_interval)

@@ -1,0 +1,86 @@
+"""Host-side self-drafting proposers for speculative decoding.
+
+No second model in HBM: drafts come from the sequence's OWN token history
+(n-gram / prompt-lookup, after "Prompt Lookup Decoding" and the self-draft
+end of the Medusa/EAGLE line in PAPERS.md). ShareGPT-like serving traffic
+repeats itself — quoted code, restated instructions, templated phrasing —
+so the most recent continuation of the current tail n-gram is an accurate
+guess often enough to pay for one extra logits column per draft token,
+while the verify pass (model_runner.spec_verify) keeps the output stream
+exactly the model's own.
+
+The drafter is stateless per call and pure host/numpy: the engine calls
+`draft(token_ids)` per lane between dispatches, off the device critical
+path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+# Cap the backwards search window: repetition that pays is overwhelmingly
+# recent (current doc/turn), and an O(context) scan per lane per dispatch
+# would creep onto the scheduling path at long context.
+SEARCH_WINDOW = 4096
+
+
+class NgramDrafter:
+    """Prompt-lookup drafter: propose the continuation of the most recent
+    earlier occurrence of the sequence's tail n-gram.
+
+    Tries the longest configured n-gram first (precise match, high
+    acceptance) and falls back to shorter ones; `min_n` >= 2 by default so
+    a bare unigram's noisy continuations don't burn verify positions on
+    low-repetition traffic.
+    """
+
+    def __init__(self, max_k: int, min_n: int = 2, max_n: int = 4) -> None:
+        assert max_k >= 1 and 1 <= min_n <= max_n
+        self.max_k = max_k
+        self.min_n = min_n
+        self.max_n = max_n
+
+    def draft(self, token_ids: list[int], k: int | None = None) -> list[int]:
+        """Up to min(k, max_k) proposed continuation tokens; [] = no draft
+        (no match found — the lane decodes normally this dispatch)."""
+        k = self.max_k if k is None else min(k, self.max_k)
+        if k <= 0:
+            return []
+        arr = np.asarray(token_ids[-SEARCH_WINDOW:], dtype=np.int64)
+        L = len(arr)
+        for n in range(min(self.max_n, L - 1), self.min_n - 1, -1):
+            tail = arr[L - n:]
+            # candidate starts: occurrences of the tail's first token in
+            # arr[0 : L-n] (the tail's own occurrence excluded)
+            starts = np.nonzero(arr[: L - n] == tail[0])[0]
+            if starts.size == 0:
+                continue
+            # Most recent match first (it reflects the current local
+            # pattern — prompt-lookup picks the last occurrence too), but
+            # prefer one whose continuation has all k tokens available:
+            # the very latest match usually sits right before the tail
+            # and its continuation is truncated by the end of history,
+            # which starves the verify pass to 1-2 drafts per dispatch.
+            short: Optional[np.ndarray] = None
+            for s in starts[::-1]:
+                if not np.array_equal(arr[s : s + n], tail):
+                    continue
+                cont = arr[s + n : s + n + k]
+                if cont.size == k:
+                    return [int(t) for t in cont]
+                if cont.size and short is None:
+                    short = cont
+            if short is not None:
+                return [int(t) for t in short]
+        return []
+
+
+def make_drafter(kind: str, max_k: int, min_n: int = 2, max_n: int = 4):
+    """Drafter factory (the engine/factory knob surface): "ngram" is the
+    only self-drafting kind today; the name parameter reserves the seam
+    for tree/eagle-style drafters without an engine change."""
+    if kind in ("ngram", "prompt_lookup"):
+        return NgramDrafter(max_k, min_n=min_n, max_n=max_n)
+    raise ValueError(f"unknown drafter kind: {kind!r}")

@@ -166,6 +166,8 @@ async def build_jax_engine(
             max_model_len=max_len,
             rng_seed=rng_seed,
             decode_horizon=default_decode_horizon(),
+            lazy_horizon=default_lazy_horizon(),
+            **spec_decode_settings(),
         ),
         block_manager=_maybe_block_manager(config, kv_block_size),
     )
@@ -206,6 +208,36 @@ def _maybe_block_manager(config, kv_block_size: int):
         layout, host_blocks=host_blocks,
         disk_dir=disk_dir, disk_blocks=disk_blocks,
     )
+
+
+def spec_decode_settings() -> dict:
+    """Self-drafting speculative decoding knobs (JaxEngineConfig fields):
+
+      DYN_SPEC_K           draft tokens per lane per dispatch (0 = off,
+                           the default — spec decoding is opt-in)
+      DYN_SPEC_DRAFTER     "ngram" (prompt-lookup; the only kind today)
+      DYN_SPEC_NGRAM_MIN / DYN_SPEC_NGRAM_MAX   lookup n-gram bounds
+    """
+    return {
+        "spec_k": max(0, int(os.environ.get("DYN_SPEC_K", "0") or 0)),
+        "spec_drafter": os.environ.get("DYN_SPEC_DRAFTER", "ngram"),
+        "spec_ngram_min": max(
+            1, int(os.environ.get("DYN_SPEC_NGRAM_MIN", "2") or 2)
+        ),
+        "spec_ngram_max": max(
+            1, int(os.environ.get("DYN_SPEC_NGRAM_MAX", "4") or 4)
+        ),
+        "spec_min_coverage": float(
+            os.environ.get("DYN_SPEC_COVERAGE", "0.5") or 0.5
+        ),
+    }
+
+
+def default_lazy_horizon() -> bool:
+    """DYN_LAZY_HORIZON=1: compile the decode_multi horizon program in the
+    background and single-step until it lands (opportunistic TPU captures
+    stop burning ~30 s of the tunnel window on the unrolled compile)."""
+    return os.environ.get("DYN_LAZY_HORIZON", "0") in ("1", "true", "yes")
 
 
 def default_decode_horizon() -> int:

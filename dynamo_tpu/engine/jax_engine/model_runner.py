@@ -31,6 +31,7 @@ from dynamo_tpu.ops.sampling import (
     apply_repetition_penalty_packed,
     mask_eos_logits,
     sample_tokens_full,
+    spec_accept_len,
 )
 from dynamo_tpu.runtime.logging import get_logger
 
@@ -568,6 +569,191 @@ class ModelRunner:
         carry, packed = unrolled_steps(step, init, H)
         k_cache, v_cache = carry[2], carry[3]
         return packed, k_cache, v_cache  # packed [H, B, 2+2K]
+
+    @staticmethod
+    def _spec_verify_impl(
+        cfg, attn_mesh, attn_head_axis, block_size, S, E,
+        params, k_cache, v_cache,
+        tokens,           # [B] i32 — last accepted token per lane
+        drafts,           # [B, S-1] i32 — n-gram draft tokens (junk pads)
+        draft_len,        # [B] i32 — valid drafts per lane (0 = no draft)
+        positions,        # [B] i32 — position of `tokens`
+        block_tables,     # [B, max_blocks] i32
+        keys,             # [B, 2] u32 — threefry rows for step 0; the
+                          # counter column advances by 1 per emitted
+                          # position, exactly matching _key_row per token
+        temps, top_ps, top_ks,  # [B]
+        active,           # [B] bool
+        limit_remaining,  # [B] i32 — tokens the lane may still emit
+        min_remaining,    # [B] i32 — steps during which EOS stays masked
+        eos_ids,          # [B, MAX_EOS_IDS] i32, -1 pads
+        pen=None,         # optional (hist, hist_len, prompt_len, freq,
+                          # pres, rep) — same 6-tuple as decode_multi
+    ):
+        """Draft-verify dispatch for self-drafting speculative decoding.
+
+        ONE weight pass (llama.decode_verify) scores all S = spec_k + 1
+        positions per lane: position 0 re-feeds the last accepted token,
+        positions 1..draft_len feed the host drafter's n-gram proposals.
+        Each position is sampled with the SAME (stream, counter+h) threefry
+        key the per-token path would use, so under greedy AND temperature
+        sampling the emitted stream is bit-identical to non-speculative
+        decoding — acceptance (spec_accept_len) is pure token-id
+        comparison on both device and host.
+
+        Horizon composition: after the verify pass the device computes the
+        accept point and chains E extra plain decode steps from the bonus
+        token (decode_multi's step semantics: freeze on EOS / budget),
+        so one dispatch = 1 verify weight pass + E decode weight passes
+        emitting up to draft_len + 1 + E tokens. The engine passes E = 0
+        for penalty batches: the on-device count tables cannot subtract a
+        REJECTED draft back out, so penalties ride the verify positions
+        (where rejected outputs are discarded anyway) but not the chained
+        continuation.
+
+        KV discipline: every fed position scatters into its real slot, so
+        rejected draft positions leave garbage KV *ahead* of the accepted
+        frontier. That is safe by construction: the engine only advances
+        kv_written over ACCEPTED tokens, decode attention masks by
+        position, and the very next fed token overwrites the first garbage
+        slot — rejected speculation rolls back by being overwritten before
+        it can ever be attended or offloaded.
+
+        Returns packed [S + E, B, 2 + 2*num_top] f32 (token/-1, logprob,
+        top ids, top lps per position).
+        """
+        B = tokens.shape[0]
+        rows = jnp.arange(B)
+        eos_valid = eos_ids >= 0
+        fed = jnp.concatenate([tokens[:, None], drafts], axis=1)  # [B, S]
+        step = jnp.arange(S)[None, :]
+        valid = active[:, None] & (step <= draft_len[:, None])  # [B, S]
+        qpos = positions[:, None] + step  # [B, S]
+        slot = (
+            block_tables[rows[:, None], qpos // block_size] * block_size
+            + qpos % block_size
+        )
+        slot = jnp.where(valid, slot, 0)  # frozen lanes hit the null sink
+        logits, k_cache, v_cache = llama.decode_verify(
+            params, cfg, fed, qpos, k_cache, v_cache, block_tables, slot,
+            mesh=attn_mesh,
+        )
+        if pen is None:
+            # fold S into the batch and sample every position in ONE pass
+            # (row-wise sampler => bit-identical to the per-step loop; the
+            # per-row threefry counters are exactly keys[:,1] + h)
+            V = logits.shape[-1]
+            lg = logits.reshape(B * S, V)
+            suppress = (step < min_remaining[:, None]).reshape(-1)
+            lg = mask_eos_logits(lg, jnp.repeat(eos_ids, S, axis=0), suppress)
+            keys_rep = jnp.repeat(keys, S, axis=0).at[:, 1].add(
+                jnp.tile(jnp.arange(S, dtype=jnp.uint32), B)
+            )
+            tok, lp, top_ids, top_lps = sample_tokens_full(
+                lg, None,
+                jnp.repeat(temps, S), jnp.repeat(top_ps, S),
+                jnp.repeat(top_ks, S), keys=keys_rep,
+            )
+            t = tok.reshape(B, S)
+            packed_v = jnp.concatenate(
+                [
+                    jnp.where(valid, t, -1)[:, :, None].astype(jnp.float32),
+                    lp.reshape(B, S, 1),
+                    top_ids.reshape(B, S, -1).astype(jnp.float32),
+                    top_lps.reshape(B, S, -1),
+                ],
+                axis=-1,
+            ).transpose(1, 0, 2)  # [S, B, 2+2K]
+            packed_rows = [packed_v[h] for h in range(S)]
+        else:
+            hist, hist_len, prompt_len, freq, pres, rep = pen
+            out_counts, seen = penalty_count_tables(
+                hist, hist_len, prompt_len, cfg.vocab_size
+            )
+            toks = []
+            packed_rows = []
+            for h in range(S):
+                lg = logits[:, h]
+                if h >= 1:
+                    # the draft token fed at step h entered the context;
+                    # matched prefixes make this exactly the appended
+                    # history of the single-step path, and a mismatch only
+                    # pollutes positions whose outputs the host discards
+                    adv = valid[:, h].astype(jnp.float32)
+                    fed_h = jnp.clip(fed[:, h], 0, cfg.vocab_size - 1)
+                    out_counts = out_counts.at[rows, fed_h].add(adv)
+                    seen = seen.at[rows, fed_h].max(adv)
+                lg = apply_penalties_from_tables(
+                    lg, out_counts, seen, freq, pres, rep
+                )
+                suppress = h < min_remaining  # [B] bool
+                lg = mask_eos_logits(lg, eos_ids, suppress)
+                step_keys = keys.at[:, 1].add(jnp.uint32(h))
+                tok, lp, top_ids, top_lps = sample_tokens_full(
+                    lg, None, temps, top_ps, top_ks, keys=step_keys
+                )
+                toks.append(tok)
+                out_tok = jnp.where(valid[:, h], tok, -1)
+                packed_rows.append(
+                    jnp.concatenate(
+                        [
+                            out_tok[:, None].astype(jnp.float32),
+                            lp[:, None].astype(jnp.float32),
+                            top_ids.astype(jnp.float32),
+                            top_lps.astype(jnp.float32),
+                        ],
+                        axis=-1,
+                    )
+                )
+            t = jnp.stack(toks, axis=1)  # [B, S]
+        if E > 0:
+            m = spec_accept_len(t, drafts, draft_len)  # [B] accepted drafts
+            # freeze the continuation when an EOS lands anywhere in the
+            # accepted region (the host stops appending there)
+            emitted = step <= m[:, None]
+            t_eos = jnp.any(
+                (t[:, :, None] == eos_ids[:, None, :]) & eos_valid[:, None, :],
+                axis=-1,
+            )
+            done = (~active) | jnp.any(t_eos & emitted & valid, axis=1)
+            count = m + 1  # tokens emitted by the verify pass
+            last_tok = t[rows, m]  # the bonus token — next to feed
+            for _ in range(E):
+                alive = (~done) & (count < limit_remaining)
+                qpos_e = positions + count
+                slot_e = (
+                    block_tables[rows, qpos_e // block_size] * block_size
+                    + qpos_e % block_size
+                )
+                slot_e = jnp.where(alive, slot_e, 0)
+                lg, k_cache, v_cache = llama.decode(
+                    params, cfg, last_tok, qpos_e, k_cache, v_cache,
+                    block_tables, slot_e,
+                    mesh=attn_mesh, attn_head_axis=attn_head_axis,
+                )
+                suppress = count < min_remaining
+                lg = mask_eos_logits(lg, eos_ids, suppress)
+                step_keys = keys.at[:, 1].add(count.astype(jnp.uint32))
+                tok, lp, top_ids, top_lps = sample_tokens_full(
+                    lg, None, temps, top_ps, top_ks, keys=step_keys
+                )
+                is_eos = jnp.any((tok[:, None] == eos_ids) & eos_valid, axis=-1)
+                out_tok = jnp.where(alive, tok, -1)
+                packed_rows.append(
+                    jnp.concatenate(
+                        [
+                            out_tok[:, None].astype(jnp.float32),
+                            lp[:, None].astype(jnp.float32),
+                            top_ids.astype(jnp.float32),
+                            top_lps.astype(jnp.float32),
+                        ],
+                        axis=-1,
+                    )
+                )
+                last_tok = jnp.where(alive & (~is_eos), tok, last_tok)
+                done = done | (alive & is_eos)
+                count = count + alive.astype(jnp.int32)
+        return jnp.stack(packed_rows), k_cache, v_cache  # [S+E, B, 2+2K]
 
     @staticmethod
     def _decode_pen_impl(
@@ -1131,13 +1317,86 @@ class ModelRunner:
         """H chained decode steps; returns the packed [H, B, 2+2*num_top]
         f32 device array (token, logprob, top_ids, top_lps per step) — ONE
         host fetch per horizon. See _decode_multi_impl for freeze rules."""
+        args = (
+            self.params, self.k_cache, self.v_cache,
+            self._to_dev(tokens), self._to_dev(positions),
+            self._to_dev(block_tables), self._to_dev(keys),
+            self._to_dev(temps), self._to_dev(top_ps), self._to_dev(top_ks),
+            self._to_dev(active), self._to_dev(limit_remaining),
+            self._to_dev(min_remaining), self._to_dev(eos_ids),
+        )
+        aot = (
+            getattr(self, "_decode_multi_aot", {}).get(H)
+            if penalties is None
+            else None
+        )
+        if aot is not None:
+            # background-compiled executable (lazy_horizon): same program,
+            # no first-call compile stall
+            out, self.k_cache, self.v_cache = aot(*args)
+            return out
         kwargs = {}
         if penalties is not None:
             kwargs["pen"] = tuple(self._to_dev(p) for p in penalties)
         out, self.k_cache, self.v_cache = self._decode_multi_fn(
-            H,
+            H, *args, **kwargs
+        )
+        return out
+
+    def spec_verify(
+        self,
+        spec_k: int,
+        extras: int,
+        tokens: np.ndarray,  # [B] i32 last accepted token per lane
+        drafts: np.ndarray,  # [B, spec_k] i32 draft tokens (-1 pads)
+        draft_len: np.ndarray,  # [B] i32
+        positions: np.ndarray,  # [B] i32 position of `tokens`
+        block_tables: np.ndarray,  # [B, max_blocks_per_seq] i32 — must
+        # already cover positions + draft_len + extras writes
+        temps: np.ndarray,
+        top_ps: np.ndarray,
+        top_ks: np.ndarray,
+        keys: np.ndarray,  # [B, 2] u32 step-0 threefry rows
+        active: np.ndarray,  # [B] bool
+        limit_remaining: np.ndarray,  # [B] i32
+        min_remaining: np.ndarray,  # [B] i32
+        eos_ids: np.ndarray,  # [B, MAX_EOS_IDS] i32
+        penalties: Optional[tuple] = None,  # decode_multi's 6-tuple
+    ) -> jax.Array:
+        """Speculative draft-verify dispatch: ONE weight pass scores the
+        spec_k + 1 draft positions per lane, then `extras` chained decode
+        steps ride the same dispatch from the device-computed accept point
+        (see _spec_verify_impl). Returns the packed
+        [spec_k + 1 + extras, B, 2 + 2*num_top] f32 device array. Jitted
+        lazily so spec-off deployments never trace it; one program per
+        (spec_k, extras) pair."""
+        if not hasattr(self, "_spec_verify_jit"):
+            spec_out = (
+                (self._repl, self._kv_sharding, self._kv_sharding)
+                if self._kv_sharding is not None
+                else None
+            )
+            self._spec_verify_jit = jax.jit(
+                functools.partial(
+                    self._spec_verify_impl, self.config,
+                    self.mesh, self._attn_head_axis, self.block_size,
+                ),
+                static_argnums=(0, 1),  # S, E
+                donate_argnums=(3, 4),  # k_cache, v_cache
+                **(
+                    {"out_shardings": spec_out}
+                    if spec_out is not None
+                    else {}
+                ),
+            )
+        kwargs = {}
+        if penalties is not None:
+            kwargs["pen"] = tuple(self._to_dev(p) for p in penalties)
+        out, self.k_cache, self.v_cache = self._spec_verify_jit(
+            spec_k + 1, extras,
             self.params, self.k_cache, self.v_cache,
-            self._to_dev(tokens), self._to_dev(positions),
+            self._to_dev(tokens), self._to_dev(drafts),
+            self._to_dev(draft_len), self._to_dev(positions),
             self._to_dev(block_tables), self._to_dev(keys),
             self._to_dev(temps), self._to_dev(top_ps), self._to_dev(top_ks),
             self._to_dev(active), self._to_dev(limit_remaining),
@@ -1145,3 +1404,87 @@ class ModelRunner:
             **kwargs,
         )
         return out
+
+    # ------------------------------------------------- lazy horizon compile
+
+    def decode_multi_ready(self, H: int) -> bool:
+        """True once the horizon program for this H has a compiled
+        executable (the engine's lazy_horizon mode single-steps until
+        then, so cold starts never stall the first tokens ~30 s behind
+        the unrolled-horizon compile)."""
+        return H in getattr(self, "_decode_multi_aot", {})
+
+    def prepare_decode_multi_async(self, H: int) -> None:
+        """Kick one background AOT compile of the plain (penalty-free)
+        decode_multi program for this H; idempotent. The compiled
+        executable is picked up by decode_multi_ready; compile failures
+        are recorded so the engine stays on the single-step path instead
+        of re-kicking forever."""
+        if not hasattr(self, "_decode_multi_aot"):
+            self._decode_multi_aot: dict[int, Any] = {}
+            self._decode_multi_aot_pending: set[int] = set()
+        if H in self._decode_multi_aot or H in self._decode_multi_aot_pending:
+            return
+        self._decode_multi_aot_pending.add(H)
+        import threading
+
+        B = self.max_batch
+
+        def build() -> None:
+            try:
+                f32 = jnp.float32
+                args = (
+                    self.params,
+                    jax.ShapeDtypeStruct(self.k_cache.shape, self.k_cache.dtype),
+                    jax.ShapeDtypeStruct(self.v_cache.shape, self.v_cache.dtype),
+                    jax.ShapeDtypeStruct((B,), jnp.int32),
+                    jax.ShapeDtypeStruct((B,), jnp.int32),
+                    jax.ShapeDtypeStruct((B, self.max_blocks_per_seq), jnp.int32),
+                    jax.ShapeDtypeStruct((B, 2), jnp.uint32),
+                    jax.ShapeDtypeStruct((B,), f32),
+                    jax.ShapeDtypeStruct((B,), f32),
+                    jax.ShapeDtypeStruct((B,), jnp.int32),
+                    jax.ShapeDtypeStruct((B,), jnp.bool_),
+                    jax.ShapeDtypeStruct((B,), jnp.int32),
+                    jax.ShapeDtypeStruct((B,), jnp.int32),
+                    jax.ShapeDtypeStruct((B, MAX_EOS_IDS), jnp.int32),
+                )
+                compiled = self._decode_multi_fn.lower(H, *args).compile()
+                self._decode_multi_aot[H] = compiled
+                logger.info("decode_multi@H%d compiled in background", H)
+            except Exception:  # noqa: BLE001 — engine stays on H=1
+                logger.exception(
+                    "background decode_multi@H%d compile failed; "
+                    "staying single-step", H
+                )
+            finally:
+                self._decode_multi_aot_pending.discard(H)
+
+        threading.Thread(
+            target=build, daemon=True, name=f"decode-multi-compile-H{H}"
+        ).start()
+
+    def ensure_kv_alive(self) -> bool:
+        """Rebuild the KV caches with zeros if a failed donated call
+        consumed them (runtime OOM in a horizon/verify program leaves the
+        runner referencing deleted arrays — the single-step fallback would
+        then crash). Returns True if a rebuild happened. Shape/dtype are
+        metadata, readable even on a deleted array; the caller is
+        responsible for knowing that live sequences' cached KV is gone."""
+        try:
+            dead = getattr(self.k_cache, "is_deleted", lambda: False)()
+        except Exception:  # noqa: BLE001
+            dead = True
+        if not dead:
+            return False
+        for name in ("k_cache", "v_cache"):
+            old = getattr(self, name)
+            if self._kv_sharding is not None:
+                make = jax.jit(
+                    lambda s=old.shape, d=old.dtype: jnp.zeros(s, d),
+                    out_shardings=self._kv_sharding,
+                )
+                setattr(self, name, make())
+            else:
+                setattr(self, name, jnp.zeros(old.shape, old.dtype))
+        return True

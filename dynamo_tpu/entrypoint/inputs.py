@@ -120,6 +120,11 @@ async def run_http(
                 clear_fn=_local_clear_fn(config.engine),
             ),
         )
+        # colocated engine: expose spec-decode counters on the frontend
+        # /metrics (only when spec decoding is actually configured)
+        stats = getattr(config.engine, "stats", None)
+        if stats is not None and getattr(stats, "num_spec_tokens", 0):
+            service.metrics.attach_spec_stats(stats)
     else:
         watcher = ModelWatcher(
             drt, manager, config.router_mode, config.kv_router_config
@@ -281,6 +286,7 @@ async def run_endpoint(
     from dynamo_tpu.kv_router.protocols import (
         ForwardPassMetrics,
         KvStats,
+        SpecDecodeStats,
         WorkerStats,
     )
     from dynamo_tpu.kv_router.publisher import (
@@ -317,6 +323,19 @@ async def run_endpoint(
         d = s if isinstance(s, dict) else getattr(s, "__dict__", {})
         total = d.get("total_blocks", 1) or 1
         used = d.get("used_blocks", 0)
+        spec = None
+        if d.get("num_spec_tokens") or d.get("num_drafts"):
+            # speculative decoding live on this worker: ship the counters
+            # so the metrics plane surfaces fleet acceptance rates
+            spec = SpecDecodeStats(
+                num_spec_tokens=d.get("num_spec_tokens") or None,
+                num_drafts=d.get("num_drafts", 0),
+                num_draft_tokens=d.get("num_draft_tokens", 0),
+                num_accepted_tokens=d.get("num_accepted_tokens", 0),
+                num_accepted_tokens_per_pos=(
+                    list(d.get("accepted_per_pos") or []) or None
+                ),
+            )
         return ForwardPassMetrics(
             worker_stats=WorkerStats(
                 request_active_slots=d.get("active_slots", 0),
@@ -328,6 +347,7 @@ async def run_endpoint(
                 kv_total_blocks=total,
                 gpu_cache_usage_perc=used / total,
             ),
+            spec_decode_stats=spec,
         )
 
     if stats_fn is not None:

@@ -81,6 +81,41 @@ class ServiceMetrics:
     def render(self) -> bytes:
         return generate_latest(self.registry)
 
+    def attach_spec_stats(self, stats_src) -> None:
+        """Surface a colocated engine's speculative-decoding counters on
+        this registry (in=http out=jax runs frontend and engine in one
+        process, so there is no fabric scrape between them). `stats_src`
+        is the engine's stats object or a zero-arg callable returning it;
+        values are read lazily at scrape time via gauge callbacks."""
+
+        def read(attr, denom_attr=None):
+            def _read() -> float:
+                s = stats_src() if callable(stats_src) else stats_src
+                d = s if isinstance(s, dict) else getattr(s, "__dict__", {})
+                v = float(d.get(attr, 0) or 0)
+                if denom_attr is not None:
+                    v /= max(1.0, float(d.get(denom_attr, 0) or 0))
+                return v
+
+            return _read
+
+        for attr, name, doc in (
+            ("num_drafts", "spec_decode_drafts",
+             "Lane-dispatches carrying draft tokens"),
+            ("num_draft_tokens", "spec_decode_draft_tokens",
+             "Draft tokens proposed"),
+            ("num_accepted_tokens", "spec_decode_accepted_tokens",
+             "Draft tokens accepted"),
+        ):
+            g = Gauge(f"{PREFIX}_{name}", doc, registry=self.registry)
+            g.set_function(read(attr))
+        rate = Gauge(
+            f"{PREFIX}_spec_decode_acceptance_rate",
+            "Accepted / proposed draft tokens",
+            registry=self.registry,
+        )
+        rate.set_function(read("num_accepted_tokens", "num_draft_tokens"))
+
     @contextmanager
     def track(self, model: str, endpoint: str):
         """Track one request: inflight gauge + duration + status count."""

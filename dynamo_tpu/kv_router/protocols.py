@@ -127,11 +127,43 @@ class KvStats:
 
 @dataclass
 class SpecDecodeStats:
-    num_spec_tokens: Optional[int] = None
+    """Speculative-decoding counters (reference protocols.rs:43-104 wire
+    shape). Populated by the JaxEngine's self-drafting verify path; all
+    counters are monotonic over the worker's lifetime."""
+
+    num_spec_tokens: Optional[int] = None  # configured draft window (k)
     num_drafts: Optional[int] = None
     num_draft_tokens: Optional[int] = None
     num_accepted_tokens: Optional[int] = None
     num_accepted_tokens_per_pos: Optional[list[int]] = None
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Accepted / proposed draft tokens (0.0 when nothing drafted)."""
+        if not self.num_draft_tokens:
+            return 0.0
+        return (self.num_accepted_tokens or 0) / self.num_draft_tokens
+
+    def merge(self, other: "SpecDecodeStats") -> None:
+        """Accumulate another worker's counters (aggregator support)."""
+        self.num_drafts = (self.num_drafts or 0) + (other.num_drafts or 0)
+        self.num_draft_tokens = (self.num_draft_tokens or 0) + (
+            other.num_draft_tokens or 0
+        )
+        self.num_accepted_tokens = (self.num_accepted_tokens or 0) + (
+            other.num_accepted_tokens or 0
+        )
+        if self.num_spec_tokens is None:
+            self.num_spec_tokens = other.num_spec_tokens
+        if other.num_accepted_tokens_per_pos:
+            mine = list(self.num_accepted_tokens_per_pos or [])
+            theirs = other.num_accepted_tokens_per_pos
+            out = [0] * max(len(mine), len(theirs))
+            for i, v in enumerate(mine):
+                out[i] += v
+            for i, v in enumerate(theirs):
+                out[i] += v
+            self.num_accepted_tokens_per_pos = out
 
 
 @dataclass

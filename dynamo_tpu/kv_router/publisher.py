@@ -24,6 +24,7 @@ from dynamo_tpu.kv_router.protocols import (
     KvCacheEvent,
     KvCacheStoredBlock,
     RouterEvent,
+    SpecDecodeStats,
 )
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.runtime.logging import get_logger
@@ -215,6 +216,10 @@ class KvMetricsAggregator:
             agg.kv_stats.gpu_prefix_cache_hit_rate += (
                 m.kv_stats.gpu_prefix_cache_hit_rate
             )
+            if m.spec_decode_stats is not None:
+                if agg.spec_decode_stats is None:
+                    agg.spec_decode_stats = SpecDecodeStats()
+                agg.spec_decode_stats.merge(m.spec_decode_stats)
         if n:
             agg.kv_stats.gpu_cache_usage_perc /= n
             agg.kv_stats.gpu_prefix_cache_hit_rate /= n

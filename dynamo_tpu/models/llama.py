@@ -27,6 +27,7 @@ from dynamo_tpu.ops.attention import (
     chunked_prefill_attention,
     packed_prefill_attention,
     paged_decode_attention,
+    paged_verify_attention,
     write_chunk_kv,
     write_decode_kv,
     write_prefill_kv,
@@ -698,6 +699,46 @@ def embed_pooled(
     h = rms_norm(x, params["final_norm"], cfg.rms_eps).astype(jnp.float32)
     mask = (positions < valid_len)[:, None].astype(jnp.float32)
     return (h * mask).sum(axis=0) / jnp.maximum(valid_len, 1)
+
+
+def decode_verify(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, S] int32 — last accepted token + draft window
+    positions: jax.Array,  # [B, S] int32 true positions
+    k_cache: jax.Array,  # [L, Hkv, num_blocks, block_size, D]
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32
+    slot_indices: jax.Array,  # [B, S] int32 flat cache slots (0 = null sink)
+    *,
+    mesh=None,  # for MoE dispatch-path selection in _mlp
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Draft-verify forward for speculative decoding: ONE weight pass
+    scores S positions per sequence (vs S chained decode steps, each a
+    full weight read — on a weight-bandwidth-bound chip that is the whole
+    point of drafting). Each lane's S tokens write K/V into their real
+    slots first, then attend causally over the lane's paged context
+    (draft tokens see each other through the cache, like chunked prefill).
+    Returns (logits [B, S, V], caches)."""
+    freqs = _rope_pair(cfg)
+    B, S = tokens.shape
+    pos_flat = positions.reshape(-1)
+    slots_flat = slot_indices.reshape(-1)
+    x = _embed(params, cfg, tokens.reshape(-1))  # [B*S, hidden]
+    for i, layer in enumerate(params["layers"]):
+        q, k, v = _qkv(x, layer, cfg, _layer_freqs(cfg, i, freqs), pos_flat)
+        kc, vc = write_decode_kv(k_cache[i], v_cache[i], k, v, slots_flat)
+        attn = paged_verify_attention(
+            q.reshape(B, S, cfg.num_heads, cfg.head_dim), kc, vc,
+            block_tables, positions,
+            window=cfg.layer_window(i), scale=cfg.attn_scale,
+            logit_softcap=cfg.attn_logit_softcap,
+        )
+        x = _attn_out(attn.reshape(B * S, cfg.num_heads, cfg.head_dim), x, layer, cfg)
+        x = _mlp(x, layer, cfg, mesh)
+        k_cache = k_cache.at[i].set(kc)
+        v_cache = v_cache.at[i].set(vc)
+    return _logits(x, params, cfg).reshape(B, S, -1), k_cache, v_cache
 
 
 def decode(

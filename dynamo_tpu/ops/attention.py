@@ -286,6 +286,53 @@ def paged_decode_attention(
     return out.reshape(B, Hq, D).astype(q.dtype)
 
 
+def paged_verify_attention(
+    q: jax.Array,  # [B, S, Hq, D] — S speculative positions per sequence
+    k_cache: jax.Array,  # [Hkv, num_blocks, block_size, D] (this layer)
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32 block ids
+    positions: jax.Array,  # [B, S] int32 — true position of each query
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
+) -> jax.Array:
+    """Attention for a draft-verify pass: S new tokens per sequence attend
+    to the paged cache (which already holds their own K/V — write first,
+    like chunked prefill) with exact per-position causal masking.
+
+    This is the single-weight-pass heart of speculative decoding: one
+    forward over [B, S] positions scores a whole draft window per lane,
+    instead of S sequential decode steps each re-reading the weights.
+    XLA gather implementation (same pattern as the paged decode fallback);
+    S is small (spec_k + 1), so the [Hkv, B, S_ctx, D] gather window is the
+    same size decode already pays.
+    """
+    B, S, Hq, D = q.shape
+    Hkv, _, block_size, _ = k_cache.shape
+    G = Hq // Hkv
+    max_blocks = block_tables.shape[1]
+    S_ctx = max_blocks * block_size
+    sc = jnp.float32(scale) if scale is not None else (
+        1.0 / jnp.sqrt(D).astype(jnp.float32)
+    )
+    # [Hkv, B, max_blocks, block_size, D] -> [Hkv, B, S_ctx, D]
+    k = k_cache[:, block_tables].reshape(Hkv, B, S_ctx, D)
+    v = v_cache[:, block_tables].reshape(Hkv, B, S_ctx, D)
+    qr = q.reshape(B, S, Hkv, G, D)
+    scores = jnp.einsum(
+        "bshgd,hbkd->bhgsk", qr.astype(jnp.float32), k.astype(jnp.float32)
+    ) * sc
+    scores = _softcap(scores, logit_softcap)
+    kpos = jnp.arange(S_ctx)[None, None, :]
+    mask = kpos <= positions[:, :, None]  # [B, S, S_ctx]
+    if window is not None:
+        mask &= positions[:, :, None] - kpos < window
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgsk,hbkd->bshgd", weights, v.astype(jnp.float32))
+    return out.reshape(B, S, Hq, D).astype(q.dtype)
+
+
 def chunked_prefill_attention(
     q: jax.Array,  # [C, Hq, D] — one chunk of the prompt
     k_cache: jax.Array,  # [Hkv, num_blocks, block_size, D] (this layer)

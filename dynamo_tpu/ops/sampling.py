@@ -143,6 +143,25 @@ def apply_repetition_penalty_packed(
     return jnp.where(seen > 0, penalized, logits)
 
 
+def spec_accept_len(
+    sampled: jax.Array,  # [B, S] i32 — model tokens at draft positions
+    drafts: jax.Array,  # [B, S-1] i32 — draft tokens fed at steps 1..S-1
+    draft_len: jax.Array,  # [B] i32 — valid drafts per lane
+) -> jax.Array:
+    """Vectorized draft acceptance: number of accepted draft tokens per
+    lane. Draft d_{h+1} (fed at step h+1) is accepted iff it equals the
+    model's token t_h at the previous position AND every earlier draft
+    matched too — the longest-matching-prefix rule of draft-k/verify-1
+    speculative decoding. Works identically under greedy and temperature
+    sampling because `sampled` is already the model's (argmax or keyed
+    categorical) choice per position — acceptance is pure id comparison.
+    """
+    S = sampled.shape[1]
+    step = jnp.arange(1, S)[None, :]  # draft index 1..S-1
+    match = (sampled[:, :-1] == drafts) & (step <= draft_len[:, None])
+    return jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+
+
 MAX_EOS_IDS = 4  # eos-id slots carried into the jitted programs
 
 

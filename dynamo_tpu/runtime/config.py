@@ -7,7 +7,11 @@ Role-equivalent of the reference's Figment-based RuntimeConfig/WorkerConfig
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib  # Python 3.11+
+except ImportError:  # Python 3.10: tomli is the same parser, different name
+    import tomli as tomllib
 from dataclasses import dataclass, field, fields
 from typing import Any, Optional
 

@@ -8,6 +8,8 @@ per host round trip. (engine.py _decode_multi_phase / model_runner.py
 _decode_multi_impl; motivated by the measured ~65 ms per-step fetch RTT.)
 """
 
+import asyncio
+
 import numpy as np
 import pytest
 
@@ -26,7 +28,7 @@ from dynamo_tpu.protocols.common import (
 
 
 def make_engine(decode_horizon, num_blocks=64, max_batch=4, block_size=4,
-                max_len=64):
+                max_len=64, lazy_horizon=False):
     cfg = L.LlamaConfig.tiny(vocab_size=64)
     params = L.init_params(cfg, jax.random.PRNGKey(0))
     runner = ModelRunner(
@@ -40,6 +42,7 @@ def make_engine(decode_horizon, num_blocks=64, max_batch=4, block_size=4,
             max_batch=max_batch, block_size=block_size,
             num_blocks=num_blocks, max_model_len=max_len,
             watermark_blocks=2, decode_horizon=decode_horizon,
+            lazy_horizon=lazy_horizon,
         ),
     )
 
@@ -197,6 +200,44 @@ async def test_horizon_mixed_batch_and_penalty_fallback():
         return a, b
 
     assert await run(4) == await run(1)
+
+
+async def test_lazy_horizon_single_steps_then_ramps():
+    """lazy_horizon: the engine single-steps while the decode_multi
+    program AOT-compiles in a background thread, then rides the horizon —
+    same tokens as the eager engine either way (the cold-start saver for
+    opportunistic TPU captures: BENCH_r05 clocked the eager compile at
+    30.4 s of a 46.6 s budget)."""
+    import time
+
+    eager = make_engine(4)
+    ref = await collect(eager, greedy_request([5, 9, 17, 23], 24, ignore_eos=True))
+    await eager.close()
+    lazy = make_engine(4, lazy_horizon=True)
+    multi_calls = []
+    orig = lazy.runner.decode_multi
+
+    def spy(H, *a, **kw):
+        multi_calls.append(H)
+        return orig(H, *a, **kw)
+
+    lazy.runner.decode_multi = spy
+    first = await collect(
+        lazy, greedy_request([5, 9, 17, 23], 24, ignore_eos=True)
+    )
+    assert first == ref
+    # the background compile must land (CPU compiles this in seconds)
+    deadline = time.monotonic() + 60
+    while not lazy.runner.decode_multi_ready(4):
+        assert time.monotonic() < deadline, "background compile never landed"
+        await asyncio.sleep(0.05)
+    second = await collect(
+        lazy, greedy_request([5, 9, 17, 23], 24, ignore_eos=True)
+    )
+    await lazy.close()
+    assert second == ref
+    # once ready, the engine actually used the horizon program
+    assert multi_calls and max(multi_calls) == 4
 
 
 @pytest.mark.slow
