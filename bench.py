@@ -809,18 +809,18 @@ def main() -> None:
     import jax
 
     # Persistent compilation cache: a warm rerun (or a cache pre-warmed in
-    # an earlier session) pays near-zero compile bill.
-    cache_dir = os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    # an earlier session) pays near-zero compile bill. Shares the serving
+    # knob (DYN_JAX_CACHE_DIR / JAX_COMPILATION_CACHE_DIR override the
+    # repo-local default; "off" disables).
+    from dynamo_tpu.runtime.config import setup_jax_compilation_cache
+
+    cache_dir = setup_jax_compilation_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
     )
-    try:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    if cache_dir:
         heartbeat(f"compilation cache at {cache_dir}")
-    except Exception as e:  # cache is an optimization, never a blocker
-        heartbeat(f"compilation cache unavailable: {e}")
+    else:
+        heartbeat("compilation cache disabled/unavailable")
 
     if args.tiny:
         jax.config.update("jax_platforms", "cpu")

@@ -38,9 +38,13 @@ from dynamo_tpu.serve import _free_port
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def make_tiny_model_dir(path: str, vocab_words: int = 61) -> None:
+def make_tiny_model_dir(
+    path: str, vocab_words: int = 61, extra_cfg: dict | None = None
+) -> None:
     """Self-contained tiny llama HF dir (config + word-level tokenizer) —
-    the CPU stand-in for a real checkpoint (weights random-init)."""
+    the CPU stand-in for a real checkpoint (weights random-init).
+    extra_cfg merges into config.json (e.g. sliding_window for the swa
+    preset's Mistral-style tiny model)."""
     os.makedirs(path, exist_ok=True)
     cfg = {
         "model_type": "llama", "vocab_size": 3 + vocab_words,
@@ -49,6 +53,7 @@ def make_tiny_model_dir(path: str, vocab_words: int = 61) -> None:
         "num_key_value_heads": 2, "head_dim": 16, "rope_theta": 10000.0,
         "max_position_embeddings": 512, "rms_norm_eps": 1e-5,
         "eos_token_id": 2, "bos_token_id": 1,
+        **(extra_cfg or {}),
     }
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump(cfg, f)
@@ -129,14 +134,14 @@ async def _level(base, model, c, requests, prompt, max_tokens):
 
 async def run_sweep(
     model_path, levels, requests_per_level, prompt_tokens, max_tokens,
-    decode_horizon=None, context_length=None,
+    decode_horizon=None, context_length=None, tiny_extra_cfg=None,
 ):
     own_dir = None
     port = _free_port()
     env = dict(os.environ, PYTHONPATH=REPO)
     if model_path is None:
         own_dir = tempfile.mkdtemp(prefix="perf-sweep-model-")
-        make_tiny_model_dir(own_dir)
+        make_tiny_model_dir(own_dir, extra_cfg=tiny_extra_cfg)
         model_path = own_dir
         # tiny-model mode is the CPU harness; a real --model-path keeps
         # the ambient platform (TPU under axon when the tunnel is up)
@@ -231,20 +236,30 @@ def main() -> None:
     ap.add_argument("--decode-horizon", type=int, default=None)
     ap.add_argument("--context-length", type=int, default=None)
     ap.add_argument(
-        "--preset", choices=["canonical"], default=None,
+        "--preset", choices=["canonical", "swa"], default=None,
         help="canonical = the reference's genai-perf workload "
         "(examples/llm/benchmarks/README.md:41 — ISL 3000 / OSL 150, "
         "served at max_model_len 3328 = 3000 prompt + 150 output + "
         "slack), so sweeps are directly comparable to its published "
-        "throughput/latency curves",
+        "throughput/latency curves. swa = sliding-window serving: the "
+        "tiny model (or a real --model-path like Mistral) runs with "
+        "window << prompt, exercising the windowed flash kernels on the "
+        "serving hot path end to end",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
+    tiny_extra_cfg = None
     if args.preset == "canonical":
         args.prompt_tokens = 3000
         args.max_tokens = 150
         if args.context_length is None:
             args.context_length = 3328
+    elif args.preset == "swa":
+        # long-ish prompt over a small window: the regime where windowed
+        # decode traffic (O(window)) separates from the dense gather
+        # (O(context)); Mistral-style full-depth sliding on the tiny model
+        args.prompt_tokens = max(args.prompt_tokens, 192)
+        tiny_extra_cfg = {"model_type": "mistral", "sliding_window": 64}
     levels = [int(x) for x in args.concurrency.split(",")]
     results = asyncio.run(
         run_sweep(
@@ -252,6 +267,7 @@ def main() -> None:
             args.prompt_tokens, args.max_tokens,
             decode_horizon=args.decode_horizon,
             context_length=args.context_length,
+            tiny_extra_cfg=tiny_extra_cfg,
         )
     )
     doc = {

@@ -58,6 +58,17 @@ async def build_jax_engine(
     etcd barrier bring-up (lib/llm/src/engines.rs:43,
     leader_worker_barrier.rs:137).
     """
+    # persistent XLA compile cache before anything traces (idempotent;
+    # DYN_JAX_CACHE_DIR overrides, "off" disables). This is the layer every
+    # serving entrypoint funnels through — run.py CLI, sdk service workers
+    # spawned by serve.py, operator deployments — so no process pays the
+    # cold-compile bill twice for the same program set.
+    from dynamo_tpu.runtime.config import (
+        default_jax_cache_dir,
+        setup_jax_compilation_cache,
+    )
+
+    setup_jax_compilation_cache(default_jax_cache_dir())
     is_multihost = multinode is not None and multinode.num_nodes > 1
     if is_multihost:
         from dynamo_tpu.parallel.multihost import rendezvous_and_initialize

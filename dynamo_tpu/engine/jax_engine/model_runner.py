@@ -636,7 +636,7 @@ class ModelRunner:
         slot = jnp.where(valid, slot, 0)  # frozen lanes hit the null sink
         logits, k_cache, v_cache = llama.decode_verify(
             params, cfg, fed, qpos, k_cache, v_cache, block_tables, slot,
-            mesh=attn_mesh,
+            mesh=attn_mesh, attn_head_axis=attn_head_axis,
         )
         if pen is None:
             # fold S into the batch and sample every position in ONE pass

@@ -639,24 +639,22 @@ def prefill_context_parallel(
     """
     from dynamo_tpu.parallel.ring_attention import ring_prefill_attention
 
-    if cfg.sliding_window is not None:
-        # ring attention streams KV around the sp ring with no window
-        # masking yet; serving a sliding-window model through it would be
-        # silently wrong. Sliding models prefill via the serial/chunked
-        # paths (which mask exactly) instead.
-        raise NotImplementedError(
-            "sliding-window models don't support context-parallel prefill"
-        )
     paginate = k_cache is not None
     P_len = tokens.shape[0]
-    inv_freqs = rope_freqs(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    freqs = _rope_pair(cfg)
     positions = jnp.arange(P_len, dtype=jnp.int32)
     x = _embed(params, cfg, tokens)
     k_all, v_all = [], []
     for i, layer in enumerate(params["layers"]):
-        q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
+        q, k, v = _qkv(x, layer, cfg, _layer_freqs(cfg, i, freqs), positions)
+        # sliding layers ride the same ring; hops whose KV chunk is wholly
+        # outside [i-window, i] skip their flash update (window masking is
+        # exact inside ring_attention_body), so Mistral/Gemma2/3 long
+        # prefills context-parallelize like everyone else
         attn = ring_prefill_attention(
-            mesh, q, k, v, valid_len, head_axis=head_axis
+            mesh, q, k, v, valid_len, head_axis=head_axis,
+            window=cfg.layer_window(i), scale=cfg.attn_scale,
+            logit_softcap=cfg.attn_logit_softcap,
         )
         x = _attn_out(attn, x, layer, cfg)
         x = _mlp(x, layer, cfg, mesh)
@@ -712,6 +710,7 @@ def decode_verify(
     slot_indices: jax.Array,  # [B, S] int32 flat cache slots (0 = null sink)
     *,
     mesh=None,  # for MoE dispatch-path selection in _mlp
+    attn_head_axis=None,  # with mesh: shard_map the pallas verify kernel
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Draft-verify forward for speculative decoding: ONE weight pass
     scores S positions per sequence (vs S chained decode steps, each a
@@ -733,6 +732,7 @@ def decode_verify(
             block_tables, positions,
             window=cfg.layer_window(i), scale=cfg.attn_scale,
             logit_softcap=cfg.attn_logit_softcap,
+            impl=cfg.attn_impl, mesh=mesh, head_axis=attn_head_axis,
         )
         x = _attn_out(attn.reshape(B * S, cfg.num_heads, cfg.head_dim), x, layer, cfg)
         x = _mlp(x, layer, cfg, mesh)

@@ -10,7 +10,15 @@ implementations share this public API:
     GSPMD-partitionable, but the decode path materializes the gathered
     [Hkv, B, max_blocks*block_size, D] window every step;
   * "pallas"/"pallas_interpret" — flash kernels (ops/pallas_attention.py)
-    that stream only the live pages (decode) / blockwise tiles (prefill).
+    that stream only the live pages (decode/verify) / blockwise tiles
+    (prefill).
+
+Both implementations carry the full per-layer feature set — sliding
+window (Mistral, Gemma2/3 local layers), custom score scale and logit
+softcap (Gemma2/3) — so kernel choice is purely a layout/perf decision:
+the only thing that forces the XLA path is a shape the Mosaic tiling
+can't express (_pallas_tileable) or an unpadded prompt length
+(_prefill_block). See README "Kernel coverage" for the full matrix.
 
 All functions are jit-safe: static shapes, masks instead of dynamic slicing.
 """
@@ -90,14 +98,13 @@ def causal_prefill_attention(
     and no collective is needed (the wo row-parallel psum happens outside).
 
     `window`: token i attends to j iff i-window < j <= i (Mistral/Gemma2/3
-    local layers). Sliding/soft-capped/custom-scale layers take the XLA
-    path (the pallas kernels don't carry those features yet); mixed-pattern
-    models still run their global layers on pallas.
+    local layers). Window, scale, and logit_softcap all run on BOTH
+    implementations — mixed-pattern models (Gemma3's 5:1 local:global)
+    keep every layer on the flash path; only Mosaic tileability or an
+    unpadded prompt length forces XLA.
     """
     impl = get_attention_impl(impl)
     if impl == "pallas" and not _pallas_tileable(q.shape[-1]):
-        impl = "xla"
-    if window is not None or scale is not None or logit_softcap is not None:
         impl = "xla"
     if impl != "xla":
         bq = _prefill_block(q.shape[0])
@@ -114,6 +121,8 @@ def causal_prefill_attention(
                 fn = shard_map(
                     lambda q_, k_, v_, vl_: flash_prefill_attention_pallas(
                         q_, k_, v_, vl_, block_q=bq, block_k=bq,
+                        window=window, scale=scale,
+                        logit_softcap=logit_softcap,
                         interpret=interp,
                     ),
                     mesh=mesh,
@@ -125,6 +134,7 @@ def causal_prefill_attention(
             return flash_prefill_attention_pallas(
                 q, k, v, valid_len,
                 block_q=bq, block_k=bq,
+                window=window, scale=scale, logit_softcap=logit_softcap,
                 interpret=interp,
             )
     P, Hq, D = q.shape
@@ -229,8 +239,6 @@ def paged_decode_attention(
         q.shape[-1], k_cache.shape[2]
     ):
         impl = "xla"
-    if window is not None or scale is not None or logit_softcap is not None:
-        impl = "xla"
     if impl != "xla":
         from dynamo_tpu.ops.pallas_attention import paged_decode_attention_pallas
 
@@ -240,7 +248,8 @@ def paged_decode_attention(
 
             fn = shard_map(
                 lambda q_, k_, v_, bt_, cl_: paged_decode_attention_pallas(
-                    q_, k_, v_, bt_, cl_, interpret=interp
+                    q_, k_, v_, bt_, cl_, window=window, scale=scale,
+                    logit_softcap=logit_softcap, interpret=interp
                 ),
                 mesh=mesh,
                 in_specs=(
@@ -256,6 +265,7 @@ def paged_decode_attention(
             return fn(q, k_cache, v_cache, block_tables, context_lens)
         return paged_decode_attention_pallas(
             q, k_cache, v_cache, block_tables, context_lens,
+            window=window, scale=scale, logit_softcap=logit_softcap,
             interpret=interp,
         )
     B, Hq, D = q.shape
@@ -291,10 +301,15 @@ def paged_verify_attention(
     k_cache: jax.Array,  # [Hkv, num_blocks, block_size, D] (this layer)
     v_cache: jax.Array,
     block_tables: jax.Array,  # [B, max_blocks] int32 block ids
-    positions: jax.Array,  # [B, S] int32 — true position of each query
+    positions: jax.Array,  # [B, S] int32 — true position of each query;
+    # consecutive per lane (positions[b, s] = positions[b, 0] + s), which
+    # is what decode_verify feeds and what the pallas kernel assumes
     window: Optional[int] = None,
     scale: Optional[float] = None,
     logit_softcap: Optional[float] = None,
+    impl: Optional[str] = None,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    head_axis: Optional[str] = None,
 ) -> jax.Array:
     """Attention for a draft-verify pass: S new tokens per sequence attend
     to the paged cache (which already holds their own K/V — write first,
@@ -303,10 +318,50 @@ def paged_verify_attention(
     This is the single-weight-pass heart of speculative decoding: one
     forward over [B, S] positions scores a whole draft window per lane,
     instead of S sequential decode steps each re-reading the weights.
-    XLA gather implementation (same pattern as the paged decode fallback);
-    S is small (spec_k + 1), so the [Hkv, B, S_ctx, D] gather window is the
-    same size decode already pays.
+    A pallas impl streams each lane's pages once for the whole draft
+    window (paged_verify_attention_pallas — the decode kernel's DMA
+    pattern, so spec decode keeps working on SWA/softcap models without
+    falling back); otherwise the XLA gather reference below runs (same
+    pattern as the paged decode fallback; S is small, spec_k + 1, so the
+    [Hkv, B, S_ctx, D] gather window is the same size decode already
+    pays).
     """
+    impl = get_attention_impl(impl)
+    if impl == "pallas" and not _pallas_tileable(
+        q.shape[-1], k_cache.shape[2]
+    ):
+        impl = "xla"
+    if impl != "xla":
+        from dynamo_tpu.ops.pallas_attention import (
+            paged_verify_attention_pallas,
+        )
+
+        interp = impl == "pallas_interpret"
+        if mesh is not None and head_axis is not None:
+            from jax.experimental.shard_map import shard_map
+
+            fn = shard_map(
+                lambda q_, k_, v_, bt_, ps_: paged_verify_attention_pallas(
+                    q_, k_, v_, bt_, ps_, window=window, scale=scale,
+                    logit_softcap=logit_softcap, interpret=interp
+                ),
+                mesh=mesh,
+                in_specs=(
+                    PSpec(None, None, head_axis, None),  # q [B, S, Hq, D]
+                    PSpec(head_axis, None, None, None),  # k cache
+                    PSpec(head_axis, None, None, None),
+                    PSpec(None, None),  # block tables
+                    PSpec(None, None),  # positions
+                ),
+                out_specs=PSpec(None, None, head_axis, None),
+                check_rep=False,
+            )
+            return fn(q, k_cache, v_cache, block_tables, positions)
+        return paged_verify_attention_pallas(
+            q, k_cache, v_cache, block_tables, positions,
+            window=window, scale=scale, logit_softcap=logit_softcap,
+            interpret=interp,
+        )
     B, S, Hq, D = q.shape
     Hkv, _, block_size, _ = k_cache.shape
     G = Hq // Hkv

@@ -16,6 +16,18 @@ streams exactly the blocks named by each sequence's block table:
   the cache layout is head-major [Hkv, pages, bs, D] precisely so each
   (head, page) is one contiguous DMA-able tile.
 
+All three programs (decode, prefill, verify) carry the full attention
+feature set of the model zoo, applied INSIDE the online softmax:
+
+  * sliding window (Mistral / Gemma2/3 local layers): chunk/block ranges
+    wholly left of `[i - window + 1, i]` are never DMA'd — the chunk loop
+    STARTS at the window's first chunk, so SWA decode reads O(window) KV
+    bytes per step instead of O(context);
+  * custom score scale (Gemma2/3 query_pre_attn_scalar);
+  * logit softcap (Gemma2): `cap * tanh(s / cap)` applied to the scaled
+    scores before the running max/sum update, matching the XLA reference
+    bit-for-bit in f32.
+
 GQA: q for one kv head is the [G, D] group slice; scores are a [G, W*bs]
 matmul per chunk.
 
@@ -28,6 +40,7 @@ precedent). Runs in interpret mode on CPU for tests.
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +49,37 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+
+# jax renamed TPUCompilerParams -> CompilerParams around 0.5; accept both so
+# the kernels run on every toolchain the fleet has deployed
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
+
+def _apply_softcap(s: jax.Array, softcap: Optional[float]) -> jax.Array:
+    """Gemma-2 logit soft-capping on the scaled scores (static no-op when
+    the model doesn't use it, so non-Gemma programs compile unchanged)."""
+    if softcap is None:
+        return s
+    return softcap * jnp.tanh(s / softcap)
+
+
+def decode_kv_chunks_read(
+    ctx_len: int,
+    *,
+    block_size: int,
+    pages_per_chunk: int = 8,
+    window: Optional[int] = None,
+) -> int:
+    """Number of KV chunks the decode kernel DMAs for one sequence — the
+    same arithmetic the kernel runs, exported so benches/tests can assert
+    the O(window) traffic claim without a hardware counter. Each chunk is
+    `pages_per_chunk * block_size` tokens of K plus the same of V."""
+    chunk_tokens = pages_per_chunk * block_size
+    n_chunks = -(-ctx_len // chunk_tokens)
+    kv_start = 0 if window is None else max(ctx_len - window, 0)
+    return max(n_chunks - kv_start // chunk_tokens, 0)
 
 
 def _decode_kernel(
@@ -59,6 +103,8 @@ def _decode_kernel(
     block_size: int,
     pages_per_chunk: int,
     scale: float,
+    window: Optional[int],
+    softcap: Optional[float],
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
@@ -67,6 +113,15 @@ def _decode_kernel(
     chunk_tokens = W * block_size
     n_chunks = lax.div(ctx_len + chunk_tokens - 1, chunk_tokens)
     last_page = jnp.maximum((ctx_len - 1) // block_size, 0)
+    # sliding window: the query sits at ctx_len-1 and sees positions
+    # [ctx_len - window, ctx_len); chunks wholly before that are never
+    # fetched — per-step KV traffic is O(window), not O(context)
+    if window is None:
+        kv_start = jnp.int32(0)
+        c_start = jnp.int32(0)
+    else:
+        kv_start = jnp.maximum(ctx_len - window, 0)
+        c_start = lax.div(kv_start, chunk_tokens)
 
     m_ref[...] = jnp.full_like(m_ref, NEG_INF)
     l_ref[...] = jnp.zeros_like(l_ref)
@@ -87,9 +142,9 @@ def _decode_kernel(
             dma(c, slot, i, k_buf, k_hbm, 0).start()
             dma(c, slot, i, v_buf, v_hbm, 1).start()
 
-    @pl.when(n_chunks > 0)
+    @pl.when(n_chunks > c_start)
     def _go():
-        issue(0, 0)
+        issue(c_start, c_start % 2)
 
         def loop_body(c, _):
             slot = c % 2
@@ -109,10 +164,14 @@ def _decode_kernel(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             ) * scale  # [G, W*bs]
+            s = _apply_softcap(s, softcap)
             pos = c * chunk_tokens + jax.lax.broadcasted_iota(
                 jnp.int32, s.shape, dimension=1
             )
-            s = jnp.where(pos < ctx_len, s, NEG_INF)
+            valid = pos < ctx_len
+            if window is not None:
+                valid &= pos >= kv_start
+            s = jnp.where(valid, s, NEG_INF)
 
             m_prev = m_ref[:, :1]  # [G, 1]
             l_prev = l_ref[:, :1]
@@ -128,7 +187,7 @@ def _decode_kernel(
             l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
             return 0
 
-        lax.fori_loop(0, n_chunks, loop_body, 0)
+        lax.fori_loop(c_start, n_chunks, loop_body, 0)
 
     l = l_ref[:, :1]
     safe_l = jnp.where(l == 0.0, 1.0, l)
@@ -143,15 +202,19 @@ def paged_decode_attention_pallas(
     context_lens: jax.Array,  # [B] int32, INCLUDING the token just written
     *,
     pages_per_chunk: int = 8,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash paged decode attention; numerics match the XLA reference."""
+    """Flash paged decode attention; numerics match the XLA reference for
+    every feature combination (window / scale / softcap)."""
     B, Hq, D = q.shape
     Hkv, num_blocks, block_size, _ = k_cache.shape
     G = Hq // Hkv
     max_blocks = block_tables.shape[1]
     W = max(1, min(pages_per_chunk, max_blocks))
-    scale = 1.0 / float(D) ** 0.5
+    sc = float(scale) if scale is not None else 1.0 / float(D) ** 0.5
 
     # index maps receive (b, h, *prefetch_refs); units are block-sized
     def q_index(b, h, bt, cl):
@@ -183,11 +246,13 @@ def paged_decode_attention_pallas(
             _decode_kernel,
             block_size=block_size,
             pages_per_chunk=W,
-            scale=scale,
+            scale=sc,
+            window=int(window) if window is not None else None,
+            softcap=float(logit_softcap) if logit_softcap is not None else None,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -203,6 +268,218 @@ def paged_decode_attention_pallas(
     return out.reshape(B, Hq, D)
 
 
+# ---------------------------------------------------------- paged verify
+
+
+def _verify_kernel(
+    # scalar prefetch
+    block_tables_ref,  # [B, max_blocks] int32 (SMEM)
+    positions_ref,  # [B, S] int32 (SMEM) — consecutive per lane
+    # inputs
+    q_ref,  # [1, 1, S*G, D] VMEM — this lane+head's draft-window queries
+    k_hbm,  # [Hkv, num_blocks, block_size, D]
+    v_hbm,
+    # blocked output
+    o_ref,  # [1, 1, S*G, D]
+    # scratch
+    k_buf,
+    v_buf,
+    sems,
+    m_ref,  # [S*G, 128] f32
+    l_ref,
+    acc_ref,  # [S*G, D] f32
+    *,
+    block_size: int,
+    pages_per_chunk: int,
+    num_spec: int,  # S
+    group: int,  # G
+    max_blocks: int,
+    scale: float,
+    window: Optional[int],
+    softcap: Optional[float],
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    W = pages_per_chunk
+    chunk_tokens = W * block_size
+    # per-lane draft positions are consecutive (qpos = base + s — what
+    # decode_verify feeds); the last query bounds the live context
+    base = positions_ref[b, 0]
+    ctx_len = positions_ref[b, num_spec - 1] + 1
+    n_chunks = lax.div(ctx_len + chunk_tokens - 1, chunk_tokens)
+    last_page = jnp.clip((ctx_len - 1) // block_size, 0, max_blocks - 1)
+    # earliest KV any query in the window can see: base - window + 1
+    if window is None:
+        c_start = jnp.int32(0)
+    else:
+        kv_start = jnp.maximum(base - (window - 1), 0)
+        c_start = lax.div(kv_start, chunk_tokens)
+
+    m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+    l_ref[...] = jnp.zeros_like(l_ref)
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def dma(c, slot, i, buf, hbm, kv):
+        page = block_tables_ref[b, jnp.minimum(c * W + i, last_page)]
+        return pltpu.make_async_copy(
+            hbm.at[h, page],
+            buf.at[slot, pl.ds(i * block_size, block_size), :],
+            sems.at[slot, kv, i],
+        )
+
+    def issue(c, slot):
+        for i in range(W):
+            dma(c, slot, i, k_buf, k_hbm, 0).start()
+            dma(c, slot, i, v_buf, v_hbm, 1).start()
+
+    @pl.when(n_chunks > c_start)
+    def _go():
+        issue(c_start, c_start % 2)
+
+        def loop_body(c, _):
+            slot = c % 2
+
+            @pl.when(c + 1 < n_chunks)
+            def _prefetch():
+                issue(c + 1, (c + 1) % 2)
+
+            for i in range(W):
+                dma(c, slot, i, k_buf, k_hbm, 0).wait()
+                dma(c, slot, i, v_buf, v_hbm, 1).wait()
+
+            q = q_ref[0, 0].astype(jnp.float32)  # [S*G, D]
+            k = k_buf[slot].astype(jnp.float32)  # [W*bs, D]
+            v = v_buf[slot].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [S*G, W*bs]
+            s = _apply_softcap(s, softcap)
+            # row r is draft position r // G at true position base + r//G
+            qpos = base + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, dimension=0
+            ) // group
+            kpos = c * chunk_tokens + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, dimension=1
+            )
+            valid = kpos <= qpos
+            if window is not None:
+                valid &= qpos - kpos < window
+            s = jnp.where(valid, s, NEG_INF)
+
+            m_prev = m_ref[:, :1]
+            l_prev = l_ref[:, :1]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+            l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+            return 0
+
+        lax.fori_loop(c_start, n_chunks, loop_body, 0)
+
+    l = l_ref[:, :1]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
+def paged_verify_attention_pallas(
+    q: jax.Array,  # [B, S, Hq, D] — S speculative positions per sequence
+    k_cache: jax.Array,  # [Hkv, num_blocks, block_size, D] (head-major)
+    v_cache: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] int32
+    positions: jax.Array,  # [B, S] int32 — CONSECUTIVE per lane
+    *,
+    pages_per_chunk: int = 8,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash paged attention for the spec-decode verify pass: the S draft
+    positions of each lane stream the lane's pages once (the decode
+    kernel's DMA pattern amortized over the whole draft window) instead of
+    the XLA path's dense [Hkv, B, S_ctx, D] gather.
+
+    Assumes each lane's positions are consecutive (positions[b, s] =
+    positions[b, 0] + s) — exactly what llama.decode_verify feeds; the
+    dispatcher in ops/attention.py only routes that shape here.
+    """
+    B, S, Hq, D = q.shape
+    Hkv, num_blocks, block_size, _ = k_cache.shape
+    G = Hq // Hkv
+    max_blocks = block_tables.shape[1]
+    W = max(1, min(pages_per_chunk, max_blocks))
+    sc = float(scale) if scale is not None else 1.0 / float(D) ** 0.5
+
+    def q_index(b, h, bt, ps):
+        return (b, h, 0, 0)
+
+    def o_index(b, h, bt, ps):
+        return (b, h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, S * G, D), q_index),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, S * G, D), o_index),
+        scratch_shapes=[
+            pltpu.VMEM((2, W * block_size, D), k_cache.dtype),
+            pltpu.VMEM((2, W * block_size, D), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2, W)),
+            pltpu.VMEM((S * G, 128), jnp.float32),
+            pltpu.VMEM((S * G, 128), jnp.float32),
+            pltpu.VMEM((S * G, D), jnp.float32),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(
+            _verify_kernel,
+            block_size=block_size,
+            pages_per_chunk=W,
+            num_spec=S,
+            group=G,
+            max_blocks=max_blocks,
+            scale=sc,
+            window=int(window) if window is not None else None,
+            softcap=float(logit_softcap) if logit_softcap is not None else None,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, S * G, D), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )
+    # [B, S, Hkv, G, D] -> [B, Hkv, S, G, D] -> rows are (draft pos, group)
+    q_grouped = (
+        q.reshape(B, S, Hkv, G, D).transpose(0, 2, 1, 3, 4).reshape(
+            B, Hkv, S * G, D
+        )
+    )
+    out = kernel(
+        block_tables.astype(jnp.int32),
+        positions.astype(jnp.int32),
+        q_grouped,
+        k_cache,
+        v_cache,
+    )
+    return (
+        out.reshape(B, Hkv, S, G, D).transpose(0, 2, 1, 3, 4).reshape(
+            B, S, Hq, D
+        )
+    )
+
+
 # --------------------------------------------------------- flash prefill
 
 
@@ -214,6 +491,9 @@ def flash_prefill_attention_pallas(
     *,
     block_q: int = 128,
     block_k: int = 128,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Blockwise causal flash attention for the prefill pass (GQA-aware).
@@ -221,6 +501,12 @@ def flash_prefill_attention_pallas(
     Requires P % block_q == 0 (callers pad prompts to the KV page size and
     choose block sizes accordingly). KV heads are the outer grid dim; q is
     group-expanded so each kv head attends its [G * P, D] query slab.
+
+    Sliding window: k blocks wholly left of a q block's window (every pair
+    has qpos - kpos >= window) are skipped — no compute AND no DMA (the
+    index map clamps them onto the window's first block, so Mosaic's
+    repeated-index rule elides the copies). Prefill FLOPs/traffic are
+    O(P * window) instead of O(P^2).
     """
     P, Hq, D = q.shape
     Hkv = k.shape[1]
@@ -228,7 +514,9 @@ def flash_prefill_attention_pallas(
     block_q = min(block_q, P)
     block_k = min(block_k, P)
     assert P % block_q == 0 and P % block_k == 0
-    scale = 1.0 / float(D) ** 0.5
+    sc = float(scale) if scale is not None else 1.0 / float(D) ** 0.5
+    win = int(window) if window is not None else None
+    softcap = float(logit_softcap) if logit_softcap is not None else None
 
     # [P, Hkv, G, D] -> [Hkv, P, G, D] -> per-head queries stay position-major
     qh = q.reshape(P, Hkv, G, D).transpose(1, 0, 2, 3)  # [Hkv, P, G, D]
@@ -239,11 +527,15 @@ def flash_prefill_attention_pallas(
         return (h, iq, 0, 0)
 
     def kv_index(h, iq, jk, vl):
-        # Clamp skipped k blocks (acausal or fully padded) to the last
-        # useful one so their DMAs are elided (repeated index rule).
+        # Clamp skipped k blocks (acausal, fully padded, or wholly left of
+        # the sliding window) to a fetched one so their DMAs are elided
+        # (repeated index rule).
         causal_last = (iq * block_q + block_q - 1) // block_k
         valid_last = jnp.maximum((vl[0] - 1) // block_k, 0)
         jj = jnp.minimum(jk, jnp.minimum(causal_last, valid_last))
+        if win is not None:
+            win_first = jnp.maximum(iq * block_q - (win - 1), 0) // block_k
+            jj = jnp.maximum(jj, jnp.minimum(win_first, causal_last))
         return (h, jj, 0)
 
     def o_index(h, iq, jk, vl):
@@ -278,10 +570,17 @@ def flash_prefill_attention_pallas(
             l_ref[...] = jnp.zeros_like(l_ref)
             acc_ref[...] = jnp.zeros_like(acc_ref)
 
-        @pl.when(
+        live = (
             (jk * block_k <= iq * block_q + block_q - 1)
             & (jk * block_k < valid_len)
         )
+        if win is not None:
+            # block-level window test: the block's NEWEST k vs this q
+            # block's OLDEST query — false means every (q, k) pair in the
+            # tile is out of window
+            live &= jk * block_k + block_k - 1 >= iq * block_q - (win - 1)
+
+        @pl.when(live)
         def _attend():
             qb = q_ref[0].astype(jnp.float32).reshape(block_q * G, D)
             kb = k_ref[0].astype(jnp.float32)  # [bk, D]
@@ -289,11 +588,14 @@ def flash_prefill_attention_pallas(
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ) * scale  # [bq*G, bk]
+            ) * sc  # [bq*G, bk]
+            s = _apply_softcap(s, softcap)
             row = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) // G
             qpos = iq * block_q + row
             kpos = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             mask = (kpos <= qpos) & (kpos < valid_len)
+            if win is not None:
+                mask &= qpos - kpos < win
             s = jnp.where(mask, s, NEG_INF)
             m_prev = m_ref[:, :1]
             l_prev = l_ref[:, :1]
@@ -321,7 +623,7 @@ def flash_prefill_attention_pallas(
         kernel_body,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((Hkv, P, G, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,

@@ -35,7 +35,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 NEG_INF = -1e30
 
 
-def _flash_update(q, k, v, m, l, acc, qpos, kpos, valid_len, scale):
+def _flash_update(
+    q, k, v, m, l, acc, qpos, kpos, valid_len, scale,
+    window=None, softcap=None,
+):
     """One online-softmax accumulation of q-chunk against one k/v-chunk.
 
     q: [C, Hkv, G, D]; k/v: [C, Hkv, D]; m/l: [C, Hkv, G, 1]; acc like q.
@@ -43,7 +46,11 @@ def _flash_update(q, k, v, m, l, acc, qpos, kpos, valid_len, scale):
     s = jnp.einsum(
         "qhgd,khd->hgqk", q.astype(jnp.float32), k.astype(jnp.float32)
     ) * scale  # [Hkv, G, Cq, Ck]
+    if softcap is not None:  # Gemma2 logit soft-cap, pre-mask like XLA
+        s = softcap * jnp.tanh(s / softcap)
     mask = (kpos[None, :] <= qpos[:, None]) & (kpos[None, :] < valid_len)
+    if window is not None:  # sliding window: i sees (i-window, i]
+        mask &= qpos[:, None] - kpos[None, :] < window
     s = jnp.where(mask[None, None, :, :], s, NEG_INF)
     # carry layout: [C, Hkv, G, 1] -> work in [Hkv, G, C, 1]
     m_t = jnp.transpose(m, (1, 2, 0, 3))
@@ -70,12 +77,15 @@ def ring_attention_body(
     *,
     axis_name: str = "sp",
     axis_size: int,
+    window: Optional[int] = None,
+    scale: Optional[float] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jax.Array:
     """SPMD body: call under shard_map with P over `axis_name`."""
     C, Hq, D = q.shape
     Hkv = k.shape[1]
     G = Hq // Hkv
-    scale = 1.0 / float(D) ** 0.5
+    sc = float(scale) if scale is not None else 1.0 / float(D) ** 0.5
     my = lax.axis_index(axis_name)
     qpos = my * C + jnp.arange(C)
 
@@ -91,9 +101,23 @@ def ring_attention_body(
         # after i hops we hold the chunk originally on device (my - i)
         src = (my - i) % axis_size
         kpos = src * C + jnp.arange(C)
-        m, l, acc = _flash_update(
-            qr, k_cur, v_cur, m, l, acc, qpos, kpos, valid_len, scale
-        )
+        # hop-level early-out: a KV chunk entirely in the future (acausal,
+        # src > my) or entirely left of the sliding window (its newest key
+        # is >= window behind our oldest query) contributes nothing — skip
+        # the whole flash update and only keep the rotate. For Mistral-
+        # class windows << P/sp most hops are skipped, so SWA ring prefill
+        # compute scales with the window, not the ring length.
+        needed = src <= my
+        if window is not None:
+            needed &= src * C + C - 1 >= my * C - (window - 1)
+
+        def _update(_):
+            return _flash_update(
+                qr, k_cur, v_cur, m, l, acc, qpos, kpos, valid_len, sc,
+                window=window, softcap=logit_softcap,
+            )
+
+        m, l, acc = lax.cond(needed, _update, lambda _: (m, l, acc), None)
         # rotate for the next step (the last rotate is wasted but keeps the
         # loop uniform; XLA overlaps it with the epilogue)
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
@@ -117,15 +141,21 @@ def ring_prefill_attention(
     *,
     axis_name: str = "sp",
     head_axis: Optional[str] = None,  # e.g. "tp" when heads are TP-sharded
+    window: Optional[int] = None,  # sliding-window size; None = full
+    scale: Optional[float] = None,  # score scale; None = 1/sqrt(D)
+    logit_softcap: Optional[float] = None,  # gemma2 attn soft-cap
 ) -> jax.Array:
     """Causal self-attention with the sequence sharded over `axis_name`.
 
     Composes with tensor parallelism: pass head_axis="tp" and the body runs
     per (sp, tp) shard — the ring rotates K/V chunks within each tp group.
+    Sliding-window layers (window set) skip the flash update on every hop
+    whose KV chunk is wholly outside the window — see ring_attention_body.
     """
     sp = mesh.shape[axis_name]
     body = functools.partial(
-        ring_attention_body, axis_name=axis_name, axis_size=sp
+        ring_attention_body, axis_name=axis_name, axis_size=sp,
+        window=window, scale=scale, logit_softcap=logit_softcap,
     )
     spec = P(axis_name, head_axis, None)
     fn = shard_map(

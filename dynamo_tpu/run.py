@@ -141,9 +141,17 @@ async def amain(args: argparse.Namespace) -> None:
             config = EngineConfig.static_(engine, mdc)
         elif args.out_opt == "jax":
             from dynamo_tpu.engine.jax_engine.factory import build_jax_engine
+            from dynamo_tpu.runtime.config import (
+                default_jax_cache_dir,
+                setup_jax_compilation_cache,
+            )
 
             if not args.model_path:
                 raise SystemExit("out=jax requires a --model-path (HF dir)")
+            # persistent XLA compile cache (DYN_JAX_CACHE_DIR overrides;
+            # "off" disables): a restarted server skips the ~46.6 s cold
+            # compile of the engine program set
+            setup_jax_compilation_cache(default_jax_cache_dir())
             engine, mdc = await build_jax_engine(
                 args.model_path,
                 name,

@@ -32,6 +32,64 @@ def _env_int(name: str, default: int) -> int:
     return int(v) if v is not None else default
 
 
+# set by setup_jax_compilation_cache so repeated calls (run.py CLI, then
+# factory.build_jax_engine in the same process) configure jax only once
+_jax_cache_configured: Optional[str] = None
+
+
+def setup_jax_compilation_cache(
+    default_dir: Optional[str] = None,
+) -> Optional[str]:
+    """Point jax at a persistent compilation cache directory, so serving
+    processes stop paying the cold-compile bill (~46.6 s for the TPU
+    engine's program set) on every restart — bench.py has always done
+    this; this is the serve.py/run.py wiring.
+
+    Resolution order: DYN_JAX_CACHE_DIR env var, then JAX_COMPILATION_CACHE_DIR
+    (jax's own knob — respected, not overridden), then `default_dir` from
+    the caller. DYN_JAX_CACHE_DIR set to "" / "0" / "off" disables even
+    the default. Returns the directory in effect, or None when disabled.
+    Idempotent per process; never raises (a broken cache dir must not
+    block serving).
+    """
+    global _jax_cache_configured
+    if _jax_cache_configured is not None:
+        return _jax_cache_configured or None
+    raw = os.environ.get("DYN_JAX_CACHE_DIR")
+    if raw is not None and raw.strip().lower() in ("", "0", "off", "none"):
+        _jax_cache_configured = ""
+        return None
+    cache_dir = (
+        raw
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or default_dir
+    )
+    if not cache_dir:
+        _jax_cache_configured = ""
+        return None
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache every program: the engine compiles few, large programs, so
+        # there is no small-entry flood to guard against
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        _jax_cache_configured = ""
+        return None
+    _jax_cache_configured = cache_dir
+    return cache_dir
+
+
+def default_jax_cache_dir() -> str:
+    """Default persistent-cache location for the CLI entrypoints."""
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "dynamo_tpu", "jax_cache"
+    )
+
+
 @dataclass
 class RuntimeConfig:
     """Per-process runtime settings.
@@ -43,6 +101,10 @@ class RuntimeConfig:
       DYN_RUNTIME_HTTP_ENABLED / DYN_RUNTIME_HTTP_PORT  system health/metrics server
       DYN_LEASE_TTL_S       discovery lease TTL seconds
       DYN_NAMESPACE         default namespace
+      DYN_JAX_CACHE_DIR     persistent XLA compilation cache directory for
+                            every jax-running process (serve.py/run.py/
+                            factory; "" or "off" disables) — see
+                            setup_jax_compilation_cache
     """
 
     fabric_addr: str = ""

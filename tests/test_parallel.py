@@ -39,7 +39,7 @@ def test_tp_sharded_prefill_matches_single_device():
         sharded_params, cfg, toks, jnp.int32(8), kc_sh, vc_sh, table
     )
     np.testing.assert_allclose(
-        np.asarray(logits_ref), np.asarray(logits_sh), atol=2e-2, rtol=2e-2
+        np.asarray(logits_ref), np.asarray(logits_sh), atol=3e-2, rtol=3e-2
     )
     # cache kept its tp sharding through the jit
     assert kc_out.sharding.spec == kv_sharding.spec
@@ -91,7 +91,7 @@ def test_pallas_shard_map_attention_matches_xla():
         jax.device_put(kc, kv_sharding), jax.device_put(vc, kv_sharding),
     )
     np.testing.assert_allclose(
-        np.asarray(logits_ref), np.asarray(logits_pl), atol=2e-2, rtol=2e-2
+        np.asarray(logits_ref), np.asarray(logits_pl), atol=3e-2, rtol=3e-2
     )
     assert kc_pl.sharding.spec == kv_sharding.spec
 
@@ -114,7 +114,7 @@ def test_pallas_shard_map_attention_matches_xla():
         kc_pl, vc_pl,
     )
     np.testing.assert_allclose(
-        np.asarray(logits_d_ref), np.asarray(logits_d_pl), atol=2e-2, rtol=2e-2
+        np.asarray(logits_d_ref), np.asarray(logits_d_pl), atol=3e-2, rtol=3e-2
     )
 
 

@@ -77,6 +77,77 @@ def test_ring_under_jit():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.parametrize("sp", [2, 4])
+@pytest.mark.parametrize("window,scale,softcap", [
+    (8, None, None),      # Mistral-style: window smaller than a chunk
+    (40, None, None),     # window straddling chunk boundaries
+    (1, None, None),      # degenerate self-only window
+    (16, 0.4, 20.0),      # Gemma2-style local layer: window+scale+softcap
+])
+def test_ring_sliding_window_matches_oracle(sp, window, scale, softcap):
+    """Sliding-window models ride the ring (the pre-PR-2 refusal at
+    llama.prefill_context_parallel is gone): hops whose KV chunk is wholly
+    outside the window skip their flash update, and the result matches the
+    serial windowed oracle exactly."""
+    mesh = _mesh({"sp": sp})
+    Pn, hq, hkv, D = 64, 8, 2, 32
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(keys[0], (Pn, hq, D))
+    k = jax.random.normal(keys[1], (Pn, hkv, D))
+    v = jax.random.normal(keys[2], (Pn, hkv, D))
+    for valid in (64, 41):
+        vl = jnp.int32(valid)
+        ref = causal_prefill_attention(
+            q, k, v, vl, window=window, scale=scale, logit_softcap=softcap,
+            impl="xla",
+        )
+        out = ring_prefill_attention(
+            mesh, q, k, v, vl,
+            window=window, scale=scale, logit_softcap=softcap,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out)[:valid], np.asarray(ref)[:valid],
+            atol=3e-5, rtol=3e-5,
+        )
+
+
+def test_cp_prefill_accepts_sliding_window_model():
+    """llama.prefill_context_parallel no longer refuses sliding-window
+    configs; the paginated ring prefill matches the serial prefill's
+    logits and written KV for a Mistral-style (every layer slides) tiny
+    model."""
+    import dataclasses
+
+    mesh = _mesh({"sp": 2})
+    cfg = dataclasses.replace(L.LlamaConfig.tiny(vocab_size=64), sliding_window=8)
+    params = L.init_params(cfg, jax.random.PRNGKey(4))
+    P, bs, nb = 32, 8, 12
+    cache_shape = (cfg.num_layers, cfg.num_kv_heads, nb, bs, cfg.head_dim)
+    tokens = jnp.arange(P, dtype=jnp.int32) % cfg.vocab_size
+    table = jnp.arange(1, 1 + P // bs, dtype=jnp.int32)
+
+    kc = jnp.zeros(cache_shape, jnp.float32)
+    vc = jnp.zeros(cache_shape, jnp.float32)
+    ref_logits, ref_kc, ref_vc = L.prefill(
+        params, cfg, tokens, jnp.int32(P), kc, vc, table
+    )
+    kc = jnp.zeros(cache_shape, jnp.float32)
+    vc = jnp.zeros(cache_shape, jnp.float32)
+    out_logits, out_kc, out_vc = L.prefill_context_parallel(
+        params, cfg, mesh, tokens, jnp.int32(P),
+        k_cache=kc, v_cache=vc, block_table=table,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_logits), np.asarray(ref_logits), atol=2e-4, rtol=2e-4
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_kc), np.asarray(ref_kc), atol=2e-5, rtol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_vc), np.asarray(ref_vc), atol=2e-5, rtol=2e-5
+    )
+
+
 def test_engine_with_sp_mesh_matches_serial():
     """Full engine (continuous batching) on an sp=4 mesh: greedy tokens
     must equal the single-device engine's output."""
