@@ -220,11 +220,20 @@ def init_devices(want_tpu: bool, retries: int = 3, probe_timeout_s: float = 90.0
         th.start()
         th.join(timeout=probe_timeout_s)
         if th.is_alive():
+            # hard watchdog forensics (r5: wedges recorded nothing): dump
+            # the wedged thread's Python stack so the probe log shows
+            # WHERE inside PJRT init the tunnel hung
+            from benchmarks.tpu_probe import dump_stacks
+
+            stacks = dump_stacks()
+            wedge_stack = "\n".join(
+                line for line in stacks.splitlines() if line
+            )[-2000:]
             failures.append(
                 f"attempt {attempt + 1}: backend init exceeded "
-                f"{probe_timeout_s:.0f}s (tunnel wedged)"
+                f"{probe_timeout_s:.0f}s (tunnel wedged)\n{wedge_stack}"
             )
-            heartbeat(failures[-1])
+            heartbeat(failures[-1].splitlines()[0])
             return None, failures, True
         if "devices" in result:
             return result["devices"], failures, False

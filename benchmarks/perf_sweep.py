@@ -68,6 +68,12 @@ def make_tiny_model_dir(
 
 
 async def _one(session, url, model, prompt, max_tokens):
+    """One streamed request. Returns (ttft, gaps, tokens, status) where
+    status is "ok" | "shed" (429 admission control) | "error" — the chaos
+    preset drives the server into shedding on purpose, so rejections are a
+    counted outcome, not a harness crash."""
+    import aiohttp
+
     body = {
         "model": model, "prompt": prompt, "max_tokens": max_tokens,
         "stream": True, "temperature": 0.7,
@@ -77,19 +83,24 @@ async def _one(session, url, model, prompt, max_tokens):
     }
     t0 = time.perf_counter()
     ttft, last, gaps, ntok = None, None, [], 0
-    async with session.post(url, json=body) as resp:
-        resp.raise_for_status()
-        async for line in resp.content:
-            if not line.startswith(b"data: ") or line.startswith(b"data: [DONE]"):
-                continue
-            now = time.perf_counter()
-            if ttft is None:
-                ttft = now - t0
-            elif last is not None:
-                gaps.append(now - last)
-            last = now
-            ntok += 1
-    return ttft, gaps, max(0, ntok - 1)
+    try:
+        async with session.post(url, json=body) as resp:
+            if resp.status == 429:
+                return None, [], 0, "shed"
+            resp.raise_for_status()
+            async for line in resp.content:
+                if not line.startswith(b"data: ") or line.startswith(b"data: [DONE]"):
+                    continue
+                now = time.perf_counter()
+                if ttft is None:
+                    ttft = now - t0
+                elif last is not None:
+                    gaps.append(now - last)
+                last = now
+                ntok += 1
+    except (aiohttp.ClientError, asyncio.TimeoutError):
+        return ttft, gaps, max(0, ntok - 1), "error"
+    return ttft, gaps, max(0, ntok - 1), "ok"
 
 
 async def _level(base, model, c, requests, prompt, max_tokens):
@@ -110,35 +121,44 @@ async def _level(base, model, c, requests, prompt, max_tokens):
         t0 = time.perf_counter()
         await asyncio.gather(*[worker() for _ in range(requests)])
         wall = time.perf_counter() - t0
-    ttfts = sorted(t for t, _, _ in results if t is not None)
-    gaps = sorted(g for _, gs, _ in results for g in gs)
-    tokens = sum(n for _, _, n in results)
+    ok = [r for r in results if r[3] == "ok"]
+    ttfts = sorted(t for t, _, _, _ in ok if t is not None)
+    gaps = sorted(g for _, gs, _, _ in ok for g in gs)
+    tokens = sum(n for _, _, n, _ in ok)
 
     def pct_ms(xs, p, d=2):
         if not xs:
             return None
         return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, d)
 
-    return {
+    out = {
         "concurrency": c,
         "requests": requests,
         "output_tokens": tokens,
         "output_tok_per_s": round(tokens / wall, 1),
-        "req_per_s": round(len(results) / wall, 2),
+        "req_per_s": round(len(ok) / wall, 2),
         "ttft_p50_ms": pct_ms(ttfts, 0.50),
         "ttft_p99_ms": pct_ms(ttfts, 0.99),
         "itl_p50_ms": pct_ms(gaps, 0.50, 3),
         "itl_p99_ms": pct_ms(gaps, 0.99, 3),
     }
+    shed = sum(1 for r in results if r[3] == "shed")
+    failed = sum(1 for r in results if r[3] == "error")
+    if shed:
+        out["shed"] = shed
+    if failed:
+        out["failed"] = failed
+    return out
 
 
 async def run_sweep(
     model_path, levels, requests_per_level, prompt_tokens, max_tokens,
     decode_horizon=None, context_length=None, tiny_extra_cfg=None,
+    extra_env=None,
 ):
     own_dir = None
     port = _free_port()
-    env = dict(os.environ, PYTHONPATH=REPO)
+    env = dict(os.environ, PYTHONPATH=REPO, **(extra_env or {}))
     if model_path is None:
         own_dir = tempfile.mkdtemp(prefix="perf-sweep-model-")
         make_tiny_model_dir(own_dir, extra_cfg=tiny_extra_cfg)
@@ -236,7 +256,7 @@ def main() -> None:
     ap.add_argument("--decode-horizon", type=int, default=None)
     ap.add_argument("--context-length", type=int, default=None)
     ap.add_argument(
-        "--preset", choices=["canonical", "swa"], default=None,
+        "--preset", choices=["canonical", "swa", "chaos"], default=None,
         help="canonical = the reference's genai-perf workload "
         "(examples/llm/benchmarks/README.md:41 — ISL 3000 / OSL 150, "
         "served at max_model_len 3328 = 3000 prompt + 150 output + "
@@ -244,11 +264,15 @@ def main() -> None:
         "throughput/latency curves. swa = sliding-window serving: the "
         "tiny model (or a real --model-path like Mistral) runs with "
         "window << prompt, exercising the windowed flash kernels on the "
-        "serving hot path end to end",
+        "serving hot path end to end. chaos = the sweep with fault "
+        "injection ON (DYN_FAULT dispatch delays) and a bounded admission "
+        "watermark, so the curve shows shed counts and the TTFT of "
+        "ADMITTED requests under overload instead of an unbounded queue",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
     tiny_extra_cfg = None
+    extra_env = None
     if args.preset == "canonical":
         args.prompt_tokens = 3000
         args.max_tokens = 150
@@ -260,6 +284,22 @@ def main() -> None:
         # (O(context)); Mistral-style full-depth sliding on the tiny model
         args.prompt_tokens = max(args.prompt_tokens, 192)
         tiny_extra_cfg = {"model_type": "mistral", "sliding_window": 64}
+    elif args.preset == "chaos":
+        # overload + faults: concurrency sweeps PAST the admission cap, a
+        # periodic dispatch stall jitters the engine loop, and every
+        # request carries a deadline — the lifeguard must keep admitted
+        # TTFT bounded and convert the excess into counted 429s
+        extra_env = {
+            "DYN_FAULT": "delay_dispatch=0.05,every=7",
+            "DYN_ADMISSION_MAX_INFLIGHT": os.environ.get(
+                "DYN_ADMISSION_MAX_INFLIGHT", "12"
+            ),
+            "DYN_DEFAULT_DEADLINE_MS": os.environ.get(
+                "DYN_DEFAULT_DEADLINE_MS", "120000"
+            ),
+        }
+        if args.concurrency == "1,2,4,8,16":
+            args.concurrency = "4,8,16,32,48"
     levels = [int(x) for x in args.concurrency.split(",")]
     results = asyncio.run(
         run_sweep(
@@ -268,6 +308,7 @@ def main() -> None:
             decode_horizon=args.decode_horizon,
             context_length=args.context_length,
             tiny_extra_cfg=tiny_extra_cfg,
+            extra_env=extra_env,
         )
     )
     doc = {

@@ -3,6 +3,13 @@
 The axon tunnel wedge is *per-process*: `jax.devices()` can block forever
 inside PJRT init in one interpreter while a freshly-started one succeeds.
 So the only reliable probe is a new subprocess with a hard timeout.
+
+Wedge forensics (r5: all 7 fresh probes wedged ~45 s with NO forensics):
+the child arms its own hard watchdog (`faulthandler.dump_traceback_later`)
+a few seconds inside the parent's deadline, so a wedged probe dumps every
+thread's Python stack to stderr and exits on its own — the parent banks
+that stack trace (plus any partial output) in the probe record instead of
+a bare {"outcome": "wedged"}.
 """
 
 from __future__ import annotations
@@ -13,28 +20,65 @@ import sys
 import time
 
 PROBE_SRC = (
-    "import json,time;t=time.time();import jax;ds=jax.devices();"
-    "print('PROBE'+json.dumps({'platforms':sorted({d.platform for d in ds}),"
-    "'kinds':sorted({getattr(d,'device_kind','') for d in ds}),"
-    "'n':len(ds),'init_s':round(time.time()-t,2)}))"
+    "import faulthandler,json,time;"
+    "faulthandler.dump_traceback_later({watchdog_s}, exit=True);"
+    "t=time.time();import jax;ds=jax.devices();"
+    "faulthandler.cancel_dump_traceback_later();"
+    "print('PROBE'+json.dumps({{'platforms':sorted({{d.platform for d in ds}}),"
+    "'kinds':sorted({{getattr(d,'device_kind','') for d in ds}}),"
+    "'n':len(ds),'init_s':round(time.time()-t,2)}}))"
 )
+
+
+def dump_stacks() -> str:
+    """Python stacks of every live thread in THIS process (bench.py uses
+    this when an in-process probe thread wedges inside PJRT init)."""
+    import threading
+    import traceback
+
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in frames.items():
+        out.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out)
+
+
+def _tail(raw) -> str:
+    if raw is None:
+        return ""
+    if isinstance(raw, bytes):
+        raw = raw.decode(errors="replace")
+    return raw[-2000:]
 
 
 def probe_fresh(timeout_s: float = 45.0) -> dict:
     """One fresh-subprocess jax.devices() probe.
 
-    Returns forensics: {"outcome": "tpu"|"no_tpu"|"wedged"|"error", ...}.
+    Returns forensics: {"outcome": "tpu"|"no_tpu"|"wedged"|"error", ...};
+    wedged/error records carry the child's stack dump / stderr tail.
     """
     t0 = time.monotonic()
+    # the child's own watchdog fires first so its stack dump reaches us
+    watchdog_s = max(2.0, timeout_s - 5.0)
+    src = PROBE_SRC.format(watchdog_s=watchdog_s)
     try:
         cp = subprocess.run(
-            [sys.executable, "-c", PROBE_SRC],
+            [sys.executable, "-c", src],
             capture_output=True,
             text=True,
             timeout=timeout_s,
         )
-    except subprocess.TimeoutExpired:
-        return {"outcome": "wedged", "probe_s": round(time.monotonic() - t0, 1)}
+    except subprocess.TimeoutExpired as e:
+        # the parent deadline fired before the child's watchdog: keep
+        # whatever partial output the child produced as forensics
+        return {
+            "outcome": "wedged",
+            "probe_s": round(time.monotonic() - t0, 1),
+            "stderr_tail": _tail(e.stderr),
+            "stdout_tail": _tail(e.output),
+        }
     info: dict = {
         "outcome": "error",
         "rc": cp.returncode,
@@ -51,5 +95,9 @@ def probe_fresh(timeout_s: float = 45.0) -> dict:
                 "tpu" if "tpu" in payload.get("platforms", []) else "no_tpu"
             )
             return info
-    info["stderr_tail"] = cp.stderr[-200:]
+    info["stderr_tail"] = _tail(cp.stderr)
+    # faulthandler's dump (the in-child watchdog fired) means a wedge,
+    # not a crash: classify it so the capture daemon's stats stay honest
+    if "dump_traceback_later" in src and "Timeout (0:" in (cp.stderr or ""):
+        info["outcome"] = "wedged"
     return info

@@ -30,6 +30,8 @@ class StepResult:
     # OpenAI chat logprobs content entries for tokens emitted this step
     # ({"token", "logprob", "bytes", "top_logprobs"}), when requested
     logprobs: Optional[list[dict]] = None
+    # structured failure payload riding an ERROR final (LLMEngineOutput.error)
+    error: Optional[dict] = None
 
 
 class SequenceDecoder:
@@ -122,6 +124,8 @@ class SequenceDecoder:
         if self.finished is None and output.finish_reason is not None:
             self.finished = output.finish_reason
         result.finish_reason = self.finished
+        if output.error is not None:
+            result.error = output.error
         return result
 
     def _logprob_entry(

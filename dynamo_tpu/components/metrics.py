@@ -66,6 +66,15 @@ class MetricsComponent:
             "kv_prefix_cache_hit_rate", "Mean engine prefix hit rate"
         )
         self.g_workers = g("worker_count", "Workers reporting stats")
+        # request lifeguard (fleet-summed worker counters)
+        self.g_deadline_exceeded = g(
+            "deadline_exceeded_total",
+            "Requests cancelled on deadline/TTFT expiry (fleet sum)",
+        )
+        self.g_watchdog_trips = g(
+            "watchdog_trips_total",
+            "Stuck-horizon watchdog trips (fleet sum)",
+        )
         # speculative decoding (SpecDecodeStats): absent until a worker
         # reports spec counters, then summed across the fleet
         self.g_spec_drafts = g(
@@ -130,6 +139,10 @@ class MetricsComponent:
                 self.g_waiting.set(agg.worker_stats.num_requests_waiting)
                 self.g_kv_active.set(agg.kv_stats.kv_active_blocks)
                 self.g_kv_total.set(agg.kv_stats.kv_total_blocks)
+                self.g_deadline_exceeded.set(
+                    agg.worker_stats.num_deadline_exceeded
+                )
+                self.g_watchdog_trips.set(agg.worker_stats.num_watchdog_trips)
                 self.g_cache_usage.set(agg.kv_stats.gpu_cache_usage_perc)
                 self.g_hit_rate.set(agg.kv_stats.gpu_prefix_cache_hit_rate)
                 spec = agg.spec_decode_stats

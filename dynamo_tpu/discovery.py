@@ -13,16 +13,22 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import os
+import random
 from dataclasses import dataclass
-from typing import Any, AsyncIterator, Optional
+from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.http.service import ModelExecution, ModelManager
 from dynamo_tpu.model_card import ModelDeploymentCard
 from dynamo_tpu.pipeline.annotated import Annotated
 from dynamo_tpu.pipeline.context import Context
 from dynamo_tpu.pipeline.router import PushRouter, RouterMode
-from dynamo_tpu.protocols.common import LLMEngineOutput, PreprocessedRequest
-from dynamo_tpu.runtime.component import Endpoint
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    LLMEngineOutput,
+    PreprocessedRequest,
+)
+from dynamo_tpu.runtime.component import Endpoint, NoInstancesError
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import MODEL_ROOT, EndpointId
@@ -72,24 +78,222 @@ async def register_llm(
 
 
 class RemoteEngine:
-    """EngineFn adapter: forwards PreprocessedRequests over a PushRouter and
-    yields LLMEngineOutput deltas from the response stream."""
+    """EngineFn adapter with in-flight migration: forwards
+    PreprocessedRequests over a PushRouter; when the serving worker dies
+    mid-stream (transport error frame, handshake timeout, or the response
+    stream breaking without a finish_reason — the signatures of a killed
+    decode worker or a lost discovery lease), the request is REPLAYED —
+    prompt plus already-emitted tokens — onto another healthy worker via
+    the engines' `resume_prompt_len` replay contract, under bounded retries
+    with exponential backoff + jitter. The resumed stream carries no
+    duplicated and no dropped tokens: every engine counts the replayed tail
+    as generated output, so budgets and per-token RNG counters continue
+    exactly where the dead worker stopped."""
 
-    def __init__(self, router: PushRouter) -> None:
+    def __init__(
+        self,
+        router: PushRouter,
+        on_migration: Optional[Callable[[], None]] = None,
+        cancel_token: Optional[Any] = None,
+    ) -> None:
         self.router = router
+        self.on_migration = on_migration
+        # the hosting runtime's CancellationToken: when the frontend itself
+        # is dying (fabric/lease loss), replays must abort IMMEDIATELY so
+        # the structured error still reaches the client before teardown
+        self.cancel_token = cancel_token
+        self.max_retries = int(os.environ.get("DYN_MIGRATION_MAX_RETRIES", "4"))
+        self.backoff_base_s = float(
+            os.environ.get("DYN_MIGRATION_BACKOFF_S", "0.05")
+        )
+        self.dispatch_timeout_s = float(
+            os.environ.get("DYN_MIGRATION_DISPATCH_TIMEOUT_S", "5")
+        )
+
+    def _runtime_dying(self) -> bool:
+        return self.cancel_token is not None and self.cancel_token.is_cancelled()
 
     async def __call__(
         self, request: PreprocessedRequest, ctx: Context
     ) -> AsyncIterator[LLMEngineOutput]:
-        stream = await self.router.generate(request.to_dict(), ctx)
-        try:
-            async for item in stream:
-                if item.is_error():
-                    raise RuntimeError(item.error_message() or "worker error")
-                if item.data is not None:
-                    yield LLMEngineOutput.from_dict(item.data)
-        finally:
-            await stream.close()
+        prompt_len = len(request.token_ids)
+        emitted: list[int] = []
+        failures = 0  # consecutive failed attempts (reset on progress)
+        exclude: set[int] = set()
+        req_dict = request.to_dict()
+        # vision requests carry side-channel embeddings keyed off the live
+        # worker; a mid-stream replay cannot reproduce them faithfully
+        can_replay = not any(
+            k in request.extra for k in ("mm", "mm_images", "mm_videos")
+        )
+        while True:
+            # per-attempt child context: closing a dead attempt's stream
+            # kills only the child, not the request
+            attempt_ctx = ctx.child()
+            failure: Optional[str] = None
+            progressed = False
+            no_instances = False
+            stream = None
+            try:
+                # bounded dispatch, raced against runtime shutdown: a dead
+                # fabric's failover hunt must not hang the replay past the
+                # frontend's own teardown
+                dispatch = self.router.generate(
+                    req_dict, attempt_ctx, exclude=exclude or None
+                )
+                if self.cancel_token is not None:
+                    stream = await asyncio.wait_for(
+                        self.cancel_token.run_until_cancelled(dispatch),
+                        self.dispatch_timeout_s,
+                    )
+                    if stream is None:
+                        failure = "frontend runtime shutting down"
+                else:
+                    stream = await asyncio.wait_for(
+                        dispatch, self.dispatch_timeout_s
+                    )
+            except asyncio.TimeoutError:
+                failure = (
+                    f"dispatch timed out after {self.dispatch_timeout_s:.1f}s"
+                )
+            except Exception as e:  # noqa: BLE001 — dispatch-time failure
+                failure = f"dispatch failed: {type(e).__name__}: {e}"
+                no_instances = isinstance(e, NoInstancesError)
+            if stream is not None:
+                finished = False
+                try:
+                    async for item in stream:
+                        if item.is_error():
+                            failure = (
+                                item.error_message() or "worker stream error"
+                            )
+                            break
+                        if item.data is not None:
+                            out = LLMEngineOutput.from_dict(item.data)
+                            if out.token_ids:
+                                emitted.extend(out.token_ids)
+                                progressed = True
+                            yield out
+                            if out.finish_reason is not None:
+                                finished = True
+                                return
+                except (ConnectionError, OSError) as e:
+                    failure = f"stream broke: {e}"
+                finally:
+                    with contextlib.suppress(Exception):
+                        await stream.close()
+                if failure is None and not finished:
+                    # EOF with no final: the worker's response plane died
+                    failure = "stream ended without a finish reason"
+            # ---- the attempt failed; decide whether to migrate ----
+            if ctx.is_killed() or ctx.is_stopped():
+                yield LLMEngineOutput.final(FinishReason.CANCELLED)
+                return
+            if self._runtime_dying():
+                # frontend is being torn down (fabric/lease loss): emit the
+                # structured final NOW, while the response can still flush
+                yield LLMEngineOutput.final_error(
+                    ctx.id, "migration",
+                    f"frontend runtime shutting down during worker "
+                    f"failover ({failure})",
+                    "worker_unavailable",
+                )
+                return
+            if ctx.expired():
+                yield LLMEngineOutput.final_error(
+                    ctx.id, "migration",
+                    "deadline exceeded during worker failover",
+                    "deadline_exceeded",
+                )
+                return
+            failures = 1 if progressed else failures + 1
+            bad = attempt_ctx.metadata.get("worker_instance_id")
+            if bad is not None:
+                exclude.add(bad)
+            if failures > self.max_retries or (emitted and not can_replay):
+                yield LLMEngineOutput.final_error(
+                    ctx.id, "migration",
+                    f"request failed after {failures} attempt(s): {failure}",
+                    "worker_failed",
+                )
+                return
+            logger.warning(
+                "request %s: worker %s failed mid-stream (%s) — replaying "
+                "%d emitted token(s) onto another worker (attempt %d/%d)",
+                ctx.id, bad, failure, len(emitted), failures,
+                self.max_retries,
+            )
+            if emitted:
+                req_dict = dict(req_dict)
+                req_dict["token_ids"] = (
+                    list(request.token_ids) + list(emitted)
+                )
+                extra = dict(req_dict.get("extra") or {})
+                extra["resume_prompt_len"] = prompt_len
+                req_dict["extra"] = extra
+            if self.on_migration is not None:
+                with contextlib.suppress(Exception):
+                    self.on_migration()
+            if no_instances:
+                # every worker unreachable (mass restart): pause until the
+                # discovery watch applies a change — a dead instance aging
+                # out or a restarted worker registering — instead of
+                # burning the retry budget against a stale instance list
+                waiter = getattr(
+                    self.router.client, "wait_instances_changed", None
+                )
+                if waiter is not None:
+                    await waiter(2.0)
+            delay = (
+                self.backoff_base_s
+                * (2 ** (failures - 1))
+                * (0.5 + random.random())
+            )
+            await asyncio.sleep(min(delay, 2.0))
+
+
+class WorkerCapacityPoller:
+    """Background scrape of aggregated worker `load_metrics` for one
+    endpoint: feeds the frontend's AdmissionController with the fleet's
+    total request slots (the base of the shed watermark)."""
+
+    def __init__(
+        self, component: Any, endpoint_id: EndpointId, interval_s: float = 2.0
+    ) -> None:
+        from dynamo_tpu.kv_router.publisher import KvMetricsAggregator
+
+        self.aggregator = KvMetricsAggregator(component, endpoint_id)
+        self.interval_s = interval_s
+        self.total_slots: Optional[int] = None
+        self.waiting: int = 0
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        with contextlib.suppress(asyncio.CancelledError):
+            while True:
+                try:
+                    per_worker = await self.aggregator.collect()
+                    slots = sum(
+                        m.worker_stats.request_total_slots
+                        for m in per_worker.values()
+                    )
+                    self.waiting = sum(
+                        m.worker_stats.num_requests_waiting
+                        for m in per_worker.values()
+                    )
+                    self.total_slots = slots or None
+                except Exception:  # noqa: BLE001 — scrape gaps tolerated
+                    self.total_slots = None
+                await asyncio.sleep(self.interval_s)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
 
 
 class ModelWatcher:
@@ -103,16 +307,21 @@ class ModelWatcher:
         manager: ModelManager,
         router_mode: RouterMode = RouterMode.ROUND_ROBIN,
         kv_router_config: Optional[Any] = None,
+        metrics: Optional[Any] = None,  # http ServiceMetrics
+        admission: Optional[Any] = None,  # http AdmissionController
     ) -> None:
         self.drt = drt
         self.manager = manager
         self.router_mode = router_mode
         self.kv_router_config = kv_router_config
+        self.metrics = metrics
+        self.admission = admission
         self._task: Optional[asyncio.Task] = None
         self._watch = None
         self._clients: dict[str, Any] = {}  # endpoint str -> Client
         self._key_to_model: dict[str, str] = {}
         self._kv_routers: dict[str, Any] = {}
+        self._capacity_pollers: dict[str, WorkerCapacityPoller] = {}
 
     async def start(self) -> None:
         self._watch = await self.drt.fabric.watch_prefix(MODEL_ROOT)
@@ -128,6 +337,9 @@ class ModelWatcher:
         for kv_router in self._kv_routers.values():
             await kv_router.close()
         self._kv_routers.clear()
+        for poller in self._capacity_pollers.values():
+            await poller.stop()
+        self._capacity_pollers.clear()
         for client in self._clients.values():
             await client.close()
         self._clients.clear()
@@ -205,15 +417,44 @@ class ModelWatcher:
                         await stream.close()
             return results
 
+        on_migration = None
+        if self.metrics is not None:
+            model_name = entry.name
+
+            def on_migration() -> None:
+                self.metrics.request_migrations.labels(model_name).inc()
+
         execution = ModelExecution(
-            mdc, RemoteEngine(router), clear_fn=clear_fn
+            mdc,
+            RemoteEngine(
+                router,
+                on_migration=on_migration,
+                cancel_token=self.drt.token,
+            ),
+            clear_fn=clear_fn,
         )
         self.manager.add_model(entry.name, execution, ref=key)
         self._key_to_model[key] = entry.name
+        if (
+            self.admission is not None
+            and entry.name not in self._capacity_pollers
+        ):
+            # admission watermark follows the discovered fleet's slot count
+            poller = WorkerCapacityPoller(endpoint.component, eid)
+            poller.start()
+            self._capacity_pollers[entry.name] = poller
+            self.admission.set_capacity_fn(
+                entry.name, lambda p=poller: p.total_slots
+            )
         logger.info("watcher wired model %s via %s", entry.name, entry.endpoint)
 
     async def _on_delete(self, key: str) -> None:
         model = self._key_to_model.pop(key, None)
         if model is None:
             return
-        self.manager.remove_ref(model, key)
+        if self.manager.remove_ref(model, key):
+            poller = self._capacity_pollers.pop(model, None)
+            if poller is not None:
+                await poller.stop()
+            if self.admission is not None:
+                self.admission.remove_capacity_fn(model)

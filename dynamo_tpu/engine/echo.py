@@ -18,6 +18,7 @@ from dynamo_tpu.protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
+from dynamo_tpu.testing import faults
 
 
 def _delay_s() -> float:
@@ -31,11 +32,33 @@ class EchoEngineCore:
         self, request: PreprocessedRequest, context: Context
     ) -> AsyncIterator[LLMEngineOutput]:
         delay = _delay_s()
-        limit = request.stop.max_tokens or len(request.token_ids)
+        # migration replay: the tail past resume_prompt_len was already
+        # streamed by a previous worker — echo the ORIGINAL prompt and
+        # resume the cycle where the dead worker stopped, so the stitched
+        # stream is token-identical to an unfaulted run
+        prompt = list(request.token_ids)
         count = 0
-        for tok in request.token_ids:
+        resume = int(request.extra.get("resume_prompt_len") or 0)
+        if 0 < resume < len(prompt):
+            count = len(prompt) - resume
+            prompt = prompt[:resume]
+        limit = request.stop.max_tokens or len(prompt)
+        for tok in prompt[count:]:
+            if faults.active():
+                # DYN_FAULT kill_after_tokens: the worker process dies
+                # exactly as a crashed decode worker would, mid-stream
+                inj = faults.get_injector()
+                if inj is not None:
+                    inj.on_token()
             if context.is_stopped() or count >= limit:
                 break
+            if context.expired():
+                context.kill()
+                yield LLMEngineOutput.final_error(
+                    context.id, "decode", "deadline exceeded mid-generation",
+                    "deadline_exceeded",
+                )
+                return
             await asyncio.sleep(delay)
             yield LLMEngineOutput(token_ids=[tok])
             count += 1

@@ -17,10 +17,14 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import itertools
+import os
+import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Optional
 
 import numpy as np
+
+from dynamo_tpu.testing import faults
 
 from dynamo_tpu.engine.jax_engine.kv_cache import (
     BlockAllocator,
@@ -88,6 +92,23 @@ class JaxEngineConfig:
     # compile (the tpu_capture cold-start path; BENCH_r05 measured
     # decode_multi@H4B64 at 30.4 s of a 46.6 s compile budget).
     lazy_horizon: bool = False
+    # Stuck-horizon watchdog: a dispatch that exceeds watchdog_mult × its
+    # EMA (floored at watchdog_min_s once warm; watchdog_cold_s covers the
+    # first dispatch of a label, which includes its XLA compile) trips the
+    # watchdog — the engine fails every lane with a structured error, stops
+    # admitting, and fires on_watchdog_trip (discovery deregistration)
+    # instead of hanging every stream. watchdog_min_s <= 0 disables.
+    watchdog_mult: float = field(
+        default_factory=lambda: float(os.environ.get("DYN_WATCHDOG_MULT", "8"))
+    )
+    watchdog_min_s: float = field(
+        default_factory=lambda: float(os.environ.get("DYN_WATCHDOG_MIN_S", "30"))
+    )
+    watchdog_cold_s: float = field(
+        default_factory=lambda: float(
+            os.environ.get("DYN_WATCHDOG_COLD_S", "300")
+        )
+    )
 
 
 @dataclass
@@ -108,6 +129,11 @@ class EngineStats:
     num_draft_tokens: int = 0
     num_accepted_tokens: int = 0
     accepted_per_pos: list = field(default_factory=list)  # len spec_k
+    # request lifeguard counters (monotonic; ride load_metrics to the
+    # metrics plane): requests cancelled on deadline/TTFT expiry, and
+    # stuck-horizon watchdog trips
+    deadline_exceeded: int = 0
+    watchdog_trips: int = 0
 
     @property
     def kv_usage(self) -> float:
@@ -125,8 +151,19 @@ class _Sequence(SequenceState):
             token_ids=list(request.token_ids),
             num_prompt=len(request.token_ids),
         )
+        # in-flight migration replay (router re-drives a dead worker's
+        # request here): the tail of token_ids past resume_prompt_len is
+        # output a previous worker already streamed — counting it as
+        # GENERATED keeps max_tokens budgets, min_tokens, and the per-token
+        # threefry counters (_key_row: counter = num_generated) exactly
+        # where the unfaulted run would have them, so the resumed stream is
+        # bit-identical under greedy and seeded sampling.
+        resume = int(request.extra.get("resume_prompt_len") or 0)
+        if 0 < resume < len(request.token_ids):
+            self.num_prompt = resume
         self.request = request
         self.ctx = ctx
+        self.deadline_fired = False  # structured deadline error sent once
         self.pending_remote = False  # admitted, awaiting remote prefill KV
         self.prefilling = False  # admitted, chunked prefill in progress
         self.prefill_pos = 0  # tokens already prefilled into the cache
@@ -263,6 +300,16 @@ class JaxEngine:
         self.on_blocks_removed = on_blocks_removed
         # fired by clear_kv_blocks so routers drop this worker's radix state
         self.on_cache_cleared: Optional[Callable[[], None]] = None
+        # fired (once) when the stuck-horizon watchdog trips: the host
+        # wiring deregisters this worker from discovery so routers stop
+        # sending (entrypoint/inputs.run_endpoint)
+        self.on_watchdog_trip: Optional[Callable[[], None]] = None
+        # stuck-horizon watchdog state: the in-flight dispatch (label, t0)
+        # and an EMA of past dispatch durations per label
+        self._dispatch_info: Optional[tuple[str, float]] = None
+        self._dispatch_ema: dict[str, float] = {}
+        self._watchdog_task: Optional[asyncio.Task] = None
+        self._tripped = False
         # Disaggregation (SURVEY §7.6): when both are set, long prompts are
         # shipped to the prefill fleet instead of running locally.
         self.disagg_router = disagg_router
@@ -320,10 +367,27 @@ class JaxEngine:
         self, request: PreprocessedRequest, context: Context
     ) -> AsyncIterator[LLMEngineOutput]:
         if self._closed:
-            yield LLMEngineOutput.final(FinishReason.ERROR)
+            yield LLMEngineOutput.final_error(
+                context.id, "admission",
+                "engine is closed or marked unhealthy",
+                "worker_unavailable",
+            )
+            return
+        if context.expired() or context.ttft_expired():
+            self.stats.deadline_exceeded += 1
+            yield LLMEngineOutput.final_error(
+                context.id, "admission",
+                "deadline expired before admission",
+                "deadline_exceeded",
+            )
             return
         if len(request.token_ids) > self.config.max_model_len:
-            yield LLMEngineOutput.final(FinishReason.ERROR)
+            yield LLMEngineOutput.final_error(
+                context.id, "admission",
+                f"prompt of {len(request.token_ids)} tokens exceeds "
+                f"max_model_len {self.config.max_model_len}",
+                "prompt_too_long",
+            )
             return
         seq = _Sequence(next(self._seq_ids), request, context)
         self.waiting.append(seq)
@@ -346,42 +410,148 @@ class JaxEngine:
                 self._engine_loop()
             )
             self._loop_task.add_done_callback(self._on_loop_done)
+        if (
+            self.config.watchdog_min_s > 0
+            and not self._tripped
+            and (self._watchdog_task is None or self._watchdog_task.done())
+        ):
+            self._watchdog_task = asyncio.get_running_loop().create_task(
+                self._watchdog_loop()
+            )
 
     def _on_loop_done(self, task: asyncio.Task) -> None:
         """If the engine loop dies (e.g. a compile error on the first real
         batch), every parked generate() consumer would otherwise wait on
-        its queue forever. Fail them all loudly instead."""
+        its queue forever. Fail them all loudly — each sequence gets a
+        structured error (request id, phase, cause) that reaches its SSE
+        stream as a typed error event — and free/unpublish their KV blocks."""
         if task.cancelled():
             return
         exc = task.exception()
         if exc is None or self._closed:
             return
         logger.error("engine loop crashed: %r — failing all sequences", exc)
+        cause = f"engine loop crashed: {type(exc).__name__}: {exc}"
         for seq in list(self.waiting):
             self.waiting.remove(seq)
-            seq.out.put_nowait(LLMEngineOutput.final(FinishReason.ERROR))
-        # _finish frees the slot + KV blocks too: a restarted loop must not
-        # keep decoding zombie lanes that no consumer is reading. Sequences
-        # with an in-flight remote-prefill inject keep their blocks (the
-        # late inject would otherwise land in recycled blocks and corrupt a
-        # new sequence — same hazard _reap_cancelled guards); their killed
-        # context gets them reaped once the inject lands.
+            seq.out.put_nowait(
+                LLMEngineOutput.final_error(
+                    seq.ctx.id, "queue", cause, "engine_loop_crash"
+                )
+            )
+        # _finish_error frees the slot + KV blocks (and publishes Removed)
+        # too: a restarted loop must not keep decoding zombie lanes that no
+        # consumer is reading. Sequences with an in-flight remote-prefill
+        # inject keep their blocks (the late inject would otherwise land in
+        # recycled blocks and corrupt a new sequence — same hazard
+        # _reap_cancelled guards); their killed context gets them reaped
+        # once the inject lands.
         for seq in list(self._admit_order):
             if seq.pending_remote:
                 seq.ctx.kill()
-                seq.out.put_nowait(LLMEngineOutput.final(FinishReason.ERROR))
+                seq.out.put_nowait(
+                    LLMEngineOutput.final_error(
+                        seq.ctx.id, "remote_prefill", cause,
+                        "engine_loop_crash",
+                    )
+                )
             else:
-                self._finish(seq, FinishReason.ERROR)
+                self._finish_error(
+                    seq, "decode", cause, "engine_loop_crash"
+                )
+
+    # ---------------------------------------------------------- watchdog
+
+    async def _dispatch(self, label: str, fn) -> Any:
+        """Run one device dispatch in the executor, visible to the
+        stuck-horizon watchdog (and to fault injection). Callers hold
+        self._device_lock."""
+        if faults.active():
+            inj = faults.get_injector()
+            if inj is not None:
+                await inj.on_dispatch()
+        loop = asyncio.get_running_loop()
+        self._dispatch_info = (label, time.monotonic())
+        t0 = self._dispatch_info[1]
+        try:
+            return await loop.run_in_executor(None, fn)
+        finally:
+            elapsed = time.monotonic() - t0
+            self._dispatch_info = None
+            ema = self._dispatch_ema.get(label)
+            self._dispatch_ema[label] = (
+                elapsed if ema is None else 0.8 * ema + 0.2 * elapsed
+            )
+
+    async def _watchdog_loop(self) -> None:
+        poll = max(0.02, min(1.0, self.config.watchdog_min_s / 4))
+        while not self._closed:
+            await asyncio.sleep(poll)
+            info = self._dispatch_info
+            if info is None:
+                continue
+            label, t0 = info
+            elapsed = time.monotonic() - t0
+            ema = self._dispatch_ema.get(label)
+            if ema is None:
+                # first dispatch of this label includes its XLA compile
+                budget = self.config.watchdog_cold_s
+            else:
+                budget = max(
+                    self.config.watchdog_min_s, self.config.watchdog_mult * ema
+                )
+            if elapsed > budget:
+                self._trip_watchdog(label, elapsed, budget)
+                return
+
+    def _trip_watchdog(self, label: str, elapsed: float, budget: float) -> None:
+        """A dispatch wedged past its budget: fail every lane with a
+        structured error, refuse new work, and tell the host wiring to pull
+        this worker out of discovery — instead of hanging every stream."""
+        self.stats.watchdog_trips += 1
+        self._tripped = True
+        self._closed = True  # loop exits when (if) the dispatch returns
+        cause = (
+            f"watchdog: {label} dispatch stuck {elapsed:.1f}s "
+            f"(budget {budget:.1f}s)"
+        )
+        logger.error("%s — failing all lanes, marking worker unhealthy", cause)
+        for seq in list(self.waiting):
+            self.waiting.remove(seq)
+            seq.out.put_nowait(
+                LLMEngineOutput.final_error(
+                    seq.ctx.id, "queue", cause, "watchdog_stuck"
+                )
+            )
+        for seq in list(self._admit_order):
+            # blocks are NOT freed: the wedged dispatch may still write
+            # into them, and this engine is done serving anyway — the
+            # supervisor recycles the whole process after deregistration
+            seq.ctx.kill()
+            seq.out.put_nowait(
+                LLMEngineOutput.final_error(
+                    seq.ctx.id, label, cause, "watchdog_stuck"
+                )
+            )
+        if self.on_watchdog_trip is not None:
+            with contextlib.suppress(Exception):
+                self.on_watchdog_trip()
 
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
+        if self._watchdog_task is not None:
+            self._watchdog_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._watchdog_task
         for t in list(self._remote_tasks):
             t.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await t
         if self._loop_task is not None:
-            with contextlib.suppress(asyncio.CancelledError):
+            # a crashed loop already failed its sequences with structured
+            # errors (_on_loop_done) — close() must not re-raise it
+            with contextlib.suppress(asyncio.CancelledError, Exception):
                 await self._loop_task
         # finish every parked consumer so no generate() call hangs
         for seq in list(self.waiting):
@@ -507,6 +677,16 @@ class JaxEngine:
         self._free_seq(seq)
         seq.out.put_nowait(LLMEngineOutput.final(reason))
 
+    def _finish_error(
+        self, seq: _Sequence, phase: str, cause: str, code: str
+    ) -> None:
+        """Fail one admitted sequence with a structured error: free its
+        slot + KV blocks (publishing Removed) and send the typed final."""
+        self._free_seq(seq)
+        seq.out.put_nowait(
+            LLMEngineOutput.final_error(seq.ctx.id, phase, cause, code)
+        )
+
     def _maybe_offload(self, seq: _Sequence, reason: FinishReason) -> None:
         """On normal completion, copy this sequence's full blocks to the
         host tier before the device blocks are recycled (KVBM G1->G2,
@@ -543,6 +723,10 @@ class JaxEngine:
         Returns once the device copies are safe on host (the extract), so
         callers may free/recycle the device blocks immediately."""
         loop = asyncio.get_running_loop()
+        if faults.active():
+            inj = faults.get_injector()
+            if inj is not None:
+                await inj.on_transfer()
         try:
             async with self._device_lock:
                 k, v = await loop.run_in_executor(
@@ -746,11 +930,47 @@ class JaxEngine:
             if seq.ctx.is_killed() or seq.ctx.is_stopped():
                 self.waiting.remove(seq)
                 seq.out.put_nowait(LLMEngineOutput.final(FinishReason.CANCELLED))
+            elif seq.ctx.expired() or seq.ctx.ttft_expired():
+                # queued past its deadline (or past the point where its
+                # first token could still arrive in budget): shed before it
+                # wastes prefill compute
+                self.waiting.remove(seq)
+                self.stats.deadline_exceeded += 1
+                seq.ctx.kill()
+                seq.out.put_nowait(
+                    LLMEngineOutput.final_error(
+                        seq.ctx.id, "queue",
+                        "deadline exceeded while queued",
+                        "deadline_exceeded",
+                    )
+                )
         for seq in list(self._admit_order):
             # pending_remote seqs keep their blocks until the in-flight
             # inject lands — freeing now could hand the blocks to another
             # sequence and have the late inject corrupt its KV
-            if seq.ctx.is_killed() and not seq.pending_remote:
+            if seq.pending_remote:
+                if seq.ctx.expired() and not seq.deadline_fired:
+                    seq.deadline_fired = True
+                    self.stats.deadline_exceeded += 1
+                    seq.ctx.kill()  # cascade cancels the remote prefill
+                    seq.out.put_nowait(
+                        LLMEngineOutput.final_error(
+                            seq.ctx.id, "remote_prefill",
+                            "deadline exceeded awaiting remote prefill",
+                            "deadline_exceeded",
+                        )
+                    )
+                continue
+            if seq.ctx.expired() or (
+                seq.num_generated == 0 and seq.ctx.ttft_expired()
+            ):
+                self.stats.deadline_exceeded += 1
+                seq.ctx.kill()  # cascade: frees child work, then the lane
+                self._finish_error(
+                    seq, "decode", "deadline exceeded mid-generation",
+                    "deadline_exceeded",
+                )
+            elif seq.ctx.is_killed():
                 self._finish(seq, FinishReason.CANCELLED)
 
     async def _admit_phase(self, loop) -> bool:
@@ -851,8 +1071,8 @@ class JaxEngine:
                 continue
             key_row = self._key_row(seq)
             async with self._device_lock:
-                sample = await loop.run_in_executor(
-                    None,
+                sample = await self._dispatch(
+                    "prefill",
                     lambda: self.runner.fetch_sample(
                         self.runner.prefill(
                             replay,
@@ -902,8 +1122,8 @@ class JaxEngine:
         start = int(mm["start"])
         key_row = self._key_row(seq)
         async with self._device_lock:
-            sample = await loop.run_in_executor(
-                None,
+            sample = await self._dispatch(
+                "prefill_mm",
                 lambda: self.runner.fetch_sample(
                     self.runner.prefill_mm(
                         list(seq.token_ids),
@@ -935,8 +1155,8 @@ class JaxEngine:
         ]
         packed = self.runner.pack_prefill(specs)
         async with self._device_lock:
-            sample = await loop.run_in_executor(
-                None,
+            sample = await self._dispatch(
+                "prefill_packed",
                 lambda: self.runner.fetch_sample(
                     self.runner.prefill_packed_arrays(**packed)
                 ),
@@ -984,7 +1204,7 @@ class JaxEngine:
                 )
                 return self.runner.fetch_sample(out) if final else None
 
-            sample = await loop.run_in_executor(None, run_chunk)
+            sample = await self._dispatch("prefill_chunk", run_chunk)
         if seq.slot is None:  # cancelled during the device call
             return
         seq.prefill_pos = min(start + c, total)
@@ -1006,7 +1226,14 @@ class JaxEngine:
                 continue
             seq.pending_remote = False
             if fail is not None or sample is None:
-                self._finish(seq, fail or FinishReason.ERROR)
+                if (fail or FinishReason.ERROR) is FinishReason.ERROR:
+                    self._finish_error(
+                        seq, "remote_prefill",
+                        "landing remote prefill failed",
+                        "remote_prefill_failed",
+                    )
+                else:
+                    self._finish(seq, fail)
                 continue
             token, lp, top = sample
             seq.hash_seq = seq.pending_chain or TokenBlockSequence(
@@ -1050,6 +1277,10 @@ class JaxEngine:
             resp = None
         if seq.slot is None:  # cancelled/finished while in flight
             return
+        if faults.active():
+            inj = faults.get_injector()
+            if inj is not None:
+                await inj.on_transfer()
         try:
             sample = await self._land_prefill(seq, resp, loop)
             self._landed.append((seq, sample, None))
@@ -1446,8 +1677,8 @@ class JaxEngine:
                 hist, hist_len, prompt_len, freq, pres, rep, eos_ids, eos_sup
             )
         async with self._device_lock:
-            sample = await loop.run_in_executor(
-                None,
+            sample = await self._dispatch(
+                "decode",
                 lambda: self.runner.fetch_sample(
                     self.runner.decode(
                         self._tokens,
@@ -1602,8 +1833,8 @@ class JaxEngine:
                 rep[i] = seq.rep_pen
             penalties = (hist, hist_len, prompt_len, freq, pres, rep)
         async with self._device_lock:
-            packed = await loop.run_in_executor(
-                None,
+            packed = await self._dispatch(
+                "spec_verify",
                 lambda: np.asarray(
                     self.runner.spec_verify(
                         K, E,
@@ -1725,8 +1956,8 @@ class JaxEngine:
             penalties = (hist, hist_len, prompt_len, freq, pres, rep)
         try:
             async with self._device_lock:
-                packed = await loop.run_in_executor(
-                    None,
+                packed = await self._dispatch(
+                    "decode_multi",
                     lambda: np.asarray(
                         self.runner.decode_multi(
                             H,
@@ -1796,6 +2027,11 @@ class JaxEngine:
     ) -> None:
         """Record a newly generated token: stream it, grow blocks, stop."""
         self.stats.generated_tokens += 1
+        if faults.active():
+            inj = faults.get_injector()
+            if inj is not None and inj.on_token():
+                self._abort_all("injected engine fault (abort_after_tokens)")
+                return
         if seq.ctx.is_stopped():
             self._finish(seq, FinishReason.CANCELLED)
             return
@@ -1847,7 +2083,33 @@ class JaxEngine:
                     seq.block_ids.extend(self.allocator.alloc(1))
                 else:
                     logger.error("seq %d: out of KV blocks", seq.seq_id)
-                    self._finish(seq, FinishReason.ERROR)
+                    self._finish_error(
+                        seq, "decode", "out of KV blocks with no "
+                        "preemptable sequence", "out_of_kv_blocks",
+                    )
+
+    def _abort_all(self, cause: str) -> None:
+        """In-process crash injection (faults.abort_after_tokens): fail
+        every live sequence with a structured error, freeing slots + KV
+        blocks, exactly as the engine-loop crash path does — but keep
+        serving new requests (the chaos soak asserts conservation)."""
+        for seq in list(self.waiting):
+            self.waiting.remove(seq)
+            seq.out.put_nowait(
+                LLMEngineOutput.final_error(
+                    seq.ctx.id, "queue", cause, "injected_fault"
+                )
+            )
+        for seq in list(self._admit_order):
+            if seq.pending_remote:
+                seq.ctx.kill()
+                seq.out.put_nowait(
+                    LLMEngineOutput.final_error(
+                        seq.ctx.id, "remote_prefill", cause, "injected_fault"
+                    )
+                )
+            else:
+                self._finish_error(seq, "decode", cause, "injected_fault")
 
     def _update_stats(self) -> None:
         self.stats.active_slots = sum(1 for s in self.slots if s is not None)

@@ -21,6 +21,7 @@ from dynamo_tpu.protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
+from dynamo_tpu.testing import faults
 from dynamo_tpu.tokens import TokenBlockSequence
 
 
@@ -167,8 +168,13 @@ class _MockSeq:
     out: asyncio.Queue
     hash_seq: TokenBlockSequence
     generated: int = 0
+    prompt_len: int = 0  # original prompt length (< len(token_ids) on resume)
     acquired_hashes: list[int] = field(default_factory=list)
     unique_blocks: int = 1
+
+    @property
+    def prompt(self) -> list[int]:
+        return self.request.token_ids[: self.prompt_len]
 
 
 class MockEngine:
@@ -191,6 +197,9 @@ class MockEngine:
         # cumulative UNCACHED prompt tokens actually prefilled; the routing
         # tests compare this (deterministic) rather than wall-clock TTFT
         self.prefilled_tokens = 0
+        # lifeguard counters (same names the JaxEngine stats carry)
+        self.deadline_exceeded = 0
+        self.injected_aborts = 0
 
     # Hook properties matching JaxEngine's surface so worker hosting can
     # attach a KvEventPublisher uniformly (entrypoint/inputs.py).
@@ -216,10 +225,27 @@ class MockEngine:
         self, request: PreprocessedRequest, context: Optional[Context] = None
     ) -> AsyncIterator[LLMEngineOutput]:
         ctx = context or Context()
+        if ctx.expired() or ctx.ttft_expired():
+            self.deadline_exceeded += 1
+            yield LLMEngineOutput.final_error(
+                ctx.id, "admission", "deadline expired before admission",
+                "deadline_exceeded",
+            )
+            return
+        # in-flight migration replay (see JaxEngine._Sequence): the tail of
+        # token_ids past resume_prompt_len was already streamed by a dead
+        # worker; counting it as generated keeps the deterministic token
+        # cycle and the max_tokens budget identical to an unfaulted run
+        prompt_len = len(request.token_ids)
+        resume = int(request.extra.get("resume_prompt_len") or 0)
+        if 0 < resume < prompt_len:
+            prompt_len = resume
         seq = _MockSeq(
             request=request,
             context=ctx,
             out=asyncio.Queue(),
+            prompt_len=prompt_len,
+            generated=len(request.token_ids) - prompt_len,
             hash_seq=TokenBlockSequence(
                 block_size=self.args.block_size,
                 tokens=list(request.token_ids),
@@ -249,6 +275,7 @@ class MockEngine:
             "used_blocks": self.cache.used_blocks,
             "total_blocks": self.args.num_blocks,
             "cache_usage": self.cache.usage,
+            "deadline_exceeded": self.deadline_exceeded,
         }
 
     async def close(self) -> None:
@@ -277,6 +304,20 @@ class MockEngine:
         for seq in [s for s in self.waiting if s.context.is_killed()]:
             self.waiting.remove(seq)
             seq.out.put_nowait(LLMEngineOutput.final(FinishReason.CANCELLED))
+        # shed queued requests past their deadline / TTFT budget
+        for seq in [
+            s for s in self.waiting
+            if s.context.expired() or s.context.ttft_expired()
+        ]:
+            self.waiting.remove(seq)
+            self.deadline_exceeded += 1
+            seq.context.kill()
+            seq.out.put_nowait(
+                LLMEngineOutput.final_error(
+                    seq.context.id, "queue",
+                    "deadline exceeded while queued", "deadline_exceeded",
+                )
+            )
         while self.waiting and len(self.active) < self.args.max_batch:
             seq = self.waiting[0]
             hashes = [b.block_hash for b in seq.hash_seq.blocks]
@@ -314,15 +355,65 @@ class MockEngine:
                     await asyncio.sleep(0.001)
                 continue
             # one decode iteration for the whole batch
+            if faults.active():
+                inj = faults.get_injector()
+                if inj is not None:
+                    await inj.on_dispatch()
             await self._sim_sleep(self.args.decode_per_token_s)
+            # deadline expiry mid-generation: cancel + structured error
+            for seq in [
+                s for s in list(self.active) if s.context.expired()
+            ]:
+                self.deadline_exceeded += 1
+                seq.context.kill()
+                self.active.remove(seq)
+                self.cache.release(seq.acquired_hashes, seq.unique_blocks)
+                seq.out.put_nowait(
+                    LLMEngineOutput.final_error(
+                        seq.context.id, "decode",
+                        "deadline exceeded mid-generation",
+                        "deadline_exceeded",
+                    )
+                )
             for seq in list(self.active):
                 self._step_seq(seq)
 
+    def _abort_all(self, cause: str) -> None:
+        """Injected crash (faults.abort_after_tokens): fail every live
+        sequence with a structured error and release every cache ref —
+        the simulated twin of a worker process dying mid-stream."""
+        self.injected_aborts += 1
+        for seq in list(self.waiting):
+            self.waiting.remove(seq)
+            seq.out.put_nowait(
+                LLMEngineOutput.final_error(
+                    seq.context.id, "queue", cause, "injected_fault"
+                )
+            )
+        for seq in list(self.active):
+            self.active.remove(seq)
+            self.cache.release(seq.acquired_hashes, seq.unique_blocks)
+            seq.out.put_nowait(
+                LLMEngineOutput.final_error(
+                    seq.context.id, "decode", cause, "injected_fault"
+                )
+            )
+
     def _step_seq(self, seq: _MockSeq) -> None:
-        # Deterministic fake token: cycle over the prompt
-        tok = seq.request.token_ids[
-            seq.generated % max(1, len(seq.request.token_ids))
-        ]
+        if seq not in self.active:
+            # released mid-iteration (an injected abort earlier in this
+            # batch step): stepping a zombie would re-acquire cache refs
+            return
+        if faults.active():
+            inj = faults.get_injector()
+            if inj is not None and inj.on_token():
+                self._abort_all("injected engine fault (abort_after_tokens)")
+                return
+        # Deterministic fake token: cycle over the ORIGINAL prompt (on a
+        # migration replay, token_ids carries already-emitted output too —
+        # cycling over it would diverge from the unfaulted run)
+        prompt = seq.prompt
+        tok = prompt[seq.generated % max(1, len(prompt))]
         seq.generated += 1
         self.generated_tokens += 1
         prev_blocks = len(seq.hash_seq.blocks)

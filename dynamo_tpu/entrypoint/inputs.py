@@ -10,6 +10,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import os
 import sys
 import time
 from dataclasses import dataclass, field
@@ -125,12 +126,26 @@ async def run_http(
         stats = getattr(config.engine, "stats", None)
         if stats is not None and getattr(stats, "num_spec_tokens", 0):
             service.metrics.attach_spec_stats(stats)
+        # admission watermark for the colocated engine follows its slot
+        # count (dynamic mode gets this from the discovery capacity poller)
+        if stats is not None:
+            def _local_slots() -> Optional[int]:
+                s = stats() if callable(stats) else stats
+                d = s if isinstance(s, dict) else getattr(s, "__dict__", {})
+                return d.get("total_slots") or None
+
+            service.admission.set_capacity_fn(config.mdc.name, _local_slots)
     else:
         watcher = ModelWatcher(
-            drt, manager, config.router_mode, config.kv_router_config
+            drt, manager, config.router_mode, config.kv_router_config,
+            metrics=service.metrics, admission=service.admission,
         )
         await watcher.start()
     await service.start()
+    # graceful drain on SIGTERM (sdk/runner -> drt.drain): stop admitting,
+    # let in-flight streams finish bounded by DYN_DRAIN_TIMEOUT_S, close
+    drain_timeout = float(os.environ.get("DYN_DRAIN_TIMEOUT_S", "10"))
+    drt.on_drain(lambda: service.drain(drain_timeout))
     return service
 
 
@@ -281,6 +296,24 @@ async def run_endpoint(
     service = await endpoint.serve_endpoint(handler)
     await register_llm(drt, endpoint, config.mdc)
 
+    # stuck-horizon watchdog: a tripped engine pulls this worker out of
+    # discovery immediately (routers stop sending; leases would take a
+    # full TTL) and stops serving — the supervisor recycles the process
+    if hasattr(engine, "on_watchdog_trip"):
+        loop = asyncio.get_running_loop()
+
+        def _on_trip() -> None:
+            logger.error(
+                "watchdog tripped: deregistering %s from discovery", eid
+            )
+            loop.create_task(service.stop(drain=False))
+
+        engine.on_watchdog_trip = _on_trip
+
+    # graceful drain on SIGTERM (sdk/runner -> drt.drain): deregister from
+    # discovery and finish in-flight requests before the process exits
+    drt.on_drain(lambda: service.stop(drain=True))
+
     # KV-routing feeds: publish engine cache events + load metrics so a
     # KV-mode frontend can prefix-route to this worker (kv_router/publisher).
     from dynamo_tpu.kv_router.protocols import (
@@ -341,6 +374,8 @@ async def run_endpoint(
                 request_active_slots=d.get("active_slots", 0),
                 request_total_slots=d.get("total_slots", 0),
                 num_requests_waiting=d.get("waiting", 0),
+                num_deadline_exceeded=d.get("deadline_exceeded", 0),
+                num_watchdog_trips=d.get("watchdog_trips", 0),
             ),
             kv_stats=KvStats(
                 kv_active_blocks=used,

@@ -575,6 +575,19 @@ class FabricClient:
     async def publish(self, subject: str, payload: bytes) -> int:
         if self._state is not None:
             return self._state.publish(subject, payload)
+        from dynamo_tpu.testing import faults
+
+        if faults.active():
+            inj = faults.get_injector()
+            if (
+                inj is not None
+                and inj.should_drop_fabric()
+                and self._writer is not None
+            ):
+                # injected fabric-connection drop: sever the TCP link so
+                # the HA failover path (connection loss -> hunt primary ->
+                # re-establish watches/subs) runs under test
+                self._writer.close()
         return await self._call("publish", subject=subject, payload=payload)
 
     # ------------------------------------------------------------- queues

@@ -77,6 +77,27 @@ class ServiceMetrics:
             ["model"],
             registry=self.registry,
         )
+        # request lifeguard: admission-control sheds (429s), in-flight
+        # migrations across worker failure, and deadline expiries observed
+        # at this frontend
+        self.requests_shed = Counter(
+            "dyn_llm_requests_shed_total",
+            "Requests shed by admission control (429)",
+            ["model"],
+            registry=self.registry,
+        )
+        self.request_migrations = Counter(
+            "dyn_llm_request_migrations_total",
+            "In-flight requests migrated to another worker",
+            ["model"],
+            registry=self.registry,
+        )
+        self.deadline_exceeded = Counter(
+            "dyn_llm_deadline_exceeded_total",
+            "Requests cancelled on deadline/TTFT expiry",
+            ["model"],
+            registry=self.registry,
+        )
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -11,6 +11,9 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import math
+import os
+import time
 from typing import Any, AsyncIterator, Callable, Optional
 
 from aiohttp import web
@@ -44,6 +47,115 @@ logger = get_logger("dynamo_tpu.http")
 
 # engine_fn(PreprocessedRequest, Context) -> AsyncIterator[LLMEngineOutput]
 EngineFn = Callable[[PreprocessedRequest, Context], AsyncIterator[LLMEngineOutput]]
+
+
+class EngineStreamError(Exception):
+    """A structured engine failure (LLMEngineOutput.error) surfacing
+    through the per-model chain; the HTTP layer renders it as a typed SSE
+    `event: error` (streaming) or a mapped status code (unary)."""
+
+    def __init__(self, payload: dict) -> None:
+        super().__init__(payload.get("cause") or "engine error")
+        self.payload = payload
+
+
+# machine-readable error code -> HTTP status for unary responses
+_CODE_STATUS = {
+    "deadline_exceeded": 504,
+    "worker_unavailable": 503,
+    "overloaded": 429,
+    "prompt_too_long": 400,
+}
+
+
+def _error_payload(message: Optional[str]) -> dict:
+    """Decode a stream error message: structured JSON payloads (request_id,
+    phase, cause, code) pass through; anything else wraps as internal."""
+    if message:
+        try:
+            d = json.loads(message)
+            if isinstance(d, dict) and ("code" in d or "cause" in d):
+                return d
+        except (ValueError, TypeError):
+            pass
+    return {"cause": message or "engine error", "code": "internal_error"}
+
+
+class AdmissionController:
+    """Frontend admission control and load shedding (reference: Dynamo's
+    serving fabric owns graceful backpressure; Llumnix-style bounded
+    queues). Per-model inflight is bounded by a high watermark derived
+    from the aggregated worker slot count (`load_metrics` via a capacity
+    fn) times DYN_ADMISSION_QUEUE_FACTOR, optionally capped by the static
+    DYN_ADMISSION_MAX_INFLIGHT. Past the watermark, requests are shed with
+    429 + Retry-After instead of queueing forever."""
+
+    def __init__(
+        self,
+        metrics: Optional[ServiceMetrics] = None,
+        max_inflight: Optional[int] = None,
+        queue_factor: Optional[float] = None,
+    ) -> None:
+        env = os.environ
+        self.metrics = metrics
+        if max_inflight is None:
+            max_inflight = int(env.get("DYN_ADMISSION_MAX_INFLIGHT", "0")) or None
+        self.max_inflight = max_inflight
+        self.queue_factor = (
+            queue_factor
+            if queue_factor is not None
+            else float(env.get("DYN_ADMISSION_QUEUE_FACTOR", "2.0"))
+        )
+        self.retry_after_s = float(env.get("DYN_ADMISSION_RETRY_AFTER_S", "1"))
+        self._inflight: dict[str, int] = {}
+        # model -> zero-arg fn returning the fleet's total request slots
+        # (None = unknown); installed by the model watcher / static wiring
+        self._capacity_fns: dict[str, Callable[[], Optional[int]]] = {}
+        self.shed_total = 0
+
+    def set_capacity_fn(
+        self, model: str, fn: Callable[[], Optional[int]]
+    ) -> None:
+        self._capacity_fns[model] = fn
+
+    def remove_capacity_fn(self, model: str) -> None:
+        self._capacity_fns.pop(model, None)
+
+    def watermark(self, model: str) -> Optional[int]:
+        slots: Optional[int] = None
+        fn = self._capacity_fns.get(model)
+        if fn is not None:
+            try:
+                slots = fn()
+            except Exception:  # noqa: BLE001 — stale capacity is tolerable
+                slots = None
+        if slots:
+            wm = max(1, int(math.ceil(slots * self.queue_factor)))
+            if self.max_inflight:
+                wm = min(wm, self.max_inflight)
+            return wm
+        return self.max_inflight
+
+    def try_acquire(self, model: str) -> Optional[float]:
+        """None = admitted (caller must release()); else shed — the value
+        is the Retry-After hint in seconds."""
+        wm = self.watermark(model)
+        cur = self._inflight.get(model, 0)
+        if wm is not None and cur >= wm:
+            self.shed_total += 1
+            if self.metrics is not None:
+                self.metrics.requests_shed.labels(model).inc()
+            return self.retry_after_s
+        self._inflight[model] = cur + 1
+        return None
+
+    def release(self, model: str) -> None:
+        self._inflight[model] = max(0, self._inflight.get(model, 1) - 1)
+
+    def inflight(self, model: Optional[str] = None) -> int:
+        if model is not None:
+            return self._inflight.get(model, 0)
+        return sum(self._inflight.values())
 
 
 class ModelExecution:
@@ -131,6 +243,12 @@ class ModelExecution:
                         for chunk in emit_chunk(step, i):
                             queue.put_nowait(("chunk", chunk))
                     if step.finish_reason is not None:
+                        if step.finish_reason is FinishReason.ERROR:
+                            raise EngineStreamError(
+                                step.error
+                                or {"cause": "engine error",
+                                    "code": "internal_error"}
+                            )
                         finish = step.finish_reason
                         break
                 if not ctx.is_killed():
@@ -221,6 +339,9 @@ class ModelExecution:
                 counters,
             ):
                 yield Annotated.from_data(chunk.model_dump(exclude_none=True))
+        except EngineStreamError as e:
+            yield Annotated.from_error(json.dumps(e.payload))
+            return
         except Exception as e:  # noqa: BLE001
             yield Annotated.from_error(f"engine error: {e}")
             return
@@ -259,6 +380,9 @@ class ModelExecution:
                 counters,
             ):
                 yield Annotated.from_data(chunk.model_dump(exclude_none=True))
+        except EngineStreamError as e:
+            yield Annotated.from_error(json.dumps(e.payload))
+            return
         except Exception as e:  # noqa: BLE001
             yield Annotated.from_error(f"engine error: {e}")
             return
@@ -309,12 +433,15 @@ class HttpService:
         port: int = 8080,
         metrics: Optional[ServiceMetrics] = None,
         template: Optional[Any] = None,  # request_template.RequestTemplate
+        admission: Optional[AdmissionController] = None,
     ) -> None:
         self.manager = manager or ModelManager()
         self.host = host
         self.port = port
         self.metrics = metrics or ServiceMetrics()
         self.template = template
+        self.admission = admission or AdmissionController(self.metrics)
+        self._draining = False
         self.app = web.Application(client_max_size=64 * 1024 * 1024)
         self.app.add_routes(
             [
@@ -347,6 +474,26 @@ class HttpService:
             await self._runner.cleanup()
             self._runner = None
 
+    def begin_drain(self) -> None:
+        """Stop admitting: every new request is answered 503 + Retry-After.
+        In-flight requests keep streaming until done (or drain timeout)."""
+        self._draining = True
+
+    async def drain(self, timeout_s: float = 10.0) -> None:
+        """Graceful drain for SIGTERM: stop admission, wait (bounded) for
+        in-flight requests to finish, then close the server."""
+        self.begin_drain()
+        deadline = time.monotonic() + timeout_s
+        while self.admission.inflight() > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        left = self.admission.inflight()
+        if left:
+            logger.warning(
+                "drain timeout (%.1fs): %d request(s) still in flight",
+                timeout_s, left,
+            )
+        await self.close()
+
     # ----------------------------------------------------------- helpers
 
     @staticmethod
@@ -355,11 +502,57 @@ class HttpService:
             {"error": {"message": message, "type": typ}}, status=status
         )
 
+    def _structured_error(self, model: str, message: Optional[str]):
+        """Unary rendering of a structured engine error: the payload's
+        machine-readable code picks the HTTP status."""
+        payload = _error_payload(message)
+        code = payload.get("code", "internal_error")
+        if code == "deadline_exceeded":
+            self.metrics.deadline_exceeded.labels(model).inc()
+        status = _CODE_STATUS.get(code, 500)
+        resp = web.json_response(
+            {"error": {"message": payload.get("cause") or "engine error",
+                       "type": code, **{k: v for k, v in payload.items()
+                                        if k in ("request_id", "phase")}}},
+            status=status,
+        )
+        if status == 429:
+            resp.headers["Retry-After"] = "1"
+        return resp
+
+    def _shed(self, model: str, retry_after_s: float) -> web.Response:
+        resp = self._error(
+            429,
+            "server overloaded: admission watermark reached, retry later",
+            "overloaded",
+        )
+        resp.headers["Retry-After"] = str(max(1, int(math.ceil(retry_after_s))))
+        return resp
+
+    def _draining_resp(self) -> web.Response:
+        resp = self._error(503, "server is draining", "unavailable")
+        resp.headers["Retry-After"] = "2"
+        return resp
+
+    @staticmethod
+    def _arm_deadline(ctx: Context, request: Any) -> None:
+        """Arm the request/TTFT budgets from the ext block, falling back
+        to DYN_DEFAULT_DEADLINE_MS for the overall deadline."""
+        ext = getattr(request, "ext", None)
+        timeout_ms = getattr(ext, "timeout_ms", None) if ext else None
+        ttft_ms = getattr(ext, "ttft_timeout_ms", None) if ext else None
+        if timeout_ms is None:
+            default = os.environ.get("DYN_DEFAULT_DEADLINE_MS")
+            if default:
+                timeout_ms = float(default)
+        ctx.set_deadline_ms(timeout_ms, ttft_ms)
+
     async def _stream_sse(
         self,
         request: web.Request,
         ctx: Context,
         annotated_stream: AsyncIterator[Annotated],
+        model: str = "",
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             status=200,
@@ -373,13 +566,27 @@ class HttpService:
         try:
             async for item in annotated_stream:
                 if item.is_error():
+                    # typed SSE error event: structured payloads (request
+                    # id, phase, cause, code) ride through verbatim
+                    err = _error_payload(item.error_message())
+                    if err.get("code") == "deadline_exceeded" and model:
+                        self.metrics.deadline_exceeded.labels(model).inc()
                     payload = {
                         "error": {
-                            "message": item.error_message(),
-                            "type": "internal_error",
+                            "message": err.get("cause")
+                            or err.get("message")
+                            or "engine error",
+                            "type": err.get("code", "internal_error"),
+                            **{
+                                k: v
+                                for k, v in err.items()
+                                if k in ("request_id", "phase")
+                            },
                         }
                     }
-                    await resp.write(encode_json_event(payload).encode())
+                    await resp.write(
+                        encode_json_event(payload, event="error").encode()
+                    )
                     break
                 if item.event is not None:
                     await resp.write(
@@ -399,6 +606,8 @@ class HttpService:
     # ---------------------------------------------------------- handlers
 
     async def _chat(self, request: web.Request) -> web.StreamResponse:
+        if self._draining:
+            return self._draining_resp()
         try:
             body = await request.json()
             if self.template is not None:
@@ -424,22 +633,37 @@ class HttpService:
                 501, "this model does not accept image input",
                 "not_implemented",
             )
-        ctx = Context()
-        timer = TokenTimer(self.metrics, chat_req.model)
-        with self.metrics.track(chat_req.model, "chat_completions"):
-            self.metrics.prompt_tokens.labels(chat_req.model)  # touch label
-            stream = execution.chat_stream(chat_req, ctx, timer)
-            if chat_req.stream:
-                return await self._stream_sse(request, ctx, stream)
-            agg = ChatDeltaAggregator()
-            async for item in stream:
-                if item.is_error():
-                    return self._error(500, item.error_message() or "engine error", "internal_error")
-                if item.data is not None:
-                    agg.add(ChatCompletionChunk.model_validate(item.data))
-            return web.json_response(agg.finish().model_dump(exclude_none=True))
+        retry_after = self.admission.try_acquire(chat_req.model)
+        if retry_after is not None:
+            return self._shed(chat_req.model, retry_after)
+        try:
+            ctx = Context()
+            self._arm_deadline(ctx, chat_req)
+            timer = TokenTimer(self.metrics, chat_req.model)
+            with self.metrics.track(chat_req.model, "chat_completions"):
+                self.metrics.prompt_tokens.labels(chat_req.model)  # touch label
+                stream = execution.chat_stream(chat_req, ctx, timer)
+                if chat_req.stream:
+                    return await self._stream_sse(
+                        request, ctx, stream, model=chat_req.model
+                    )
+                agg = ChatDeltaAggregator()
+                async for item in stream:
+                    if item.is_error():
+                        return self._structured_error(
+                            chat_req.model, item.error_message()
+                        )
+                    if item.data is not None:
+                        agg.add(ChatCompletionChunk.model_validate(item.data))
+                return web.json_response(
+                    agg.finish().model_dump(exclude_none=True)
+                )
+        finally:
+            self.admission.release(chat_req.model)
 
     async def _completions(self, request: web.Request) -> web.StreamResponse:
+        if self._draining:
+            return self._draining_resp()
         try:
             body = await request.json()
             if self.template is not None:
@@ -450,19 +674,32 @@ class HttpService:
         execution = self.manager.get(comp_req.model)
         if execution is None:
             return self._error(404, f"model {comp_req.model!r} not found", "not_found_error")
-        ctx = Context()
-        timer = TokenTimer(self.metrics, comp_req.model)
-        with self.metrics.track(comp_req.model, "completions"):
-            stream = execution.completion_stream(comp_req, ctx, timer)
-            if comp_req.stream:
-                return await self._stream_sse(request, ctx, stream)
-            agg = CompletionAggregator()
-            async for item in stream:
-                if item.is_error():
-                    return self._error(500, item.error_message() or "engine error", "internal_error")
-                if item.data is not None:
-                    agg.add(CompletionResponse.model_validate(item.data))
-            return web.json_response(agg.finish().model_dump(exclude_none=True))
+        retry_after = self.admission.try_acquire(comp_req.model)
+        if retry_after is not None:
+            return self._shed(comp_req.model, retry_after)
+        try:
+            ctx = Context()
+            self._arm_deadline(ctx, comp_req)
+            timer = TokenTimer(self.metrics, comp_req.model)
+            with self.metrics.track(comp_req.model, "completions"):
+                stream = execution.completion_stream(comp_req, ctx, timer)
+                if comp_req.stream:
+                    return await self._stream_sse(
+                        request, ctx, stream, model=comp_req.model
+                    )
+                agg = CompletionAggregator()
+                async for item in stream:
+                    if item.is_error():
+                        return self._structured_error(
+                            comp_req.model, item.error_message()
+                        )
+                    if item.data is not None:
+                        agg.add(CompletionResponse.model_validate(item.data))
+                return web.json_response(
+                    agg.finish().model_dump(exclude_none=True)
+                )
+        finally:
+            self.admission.release(comp_req.model)
 
     async def _embeddings(self, request: web.Request) -> web.Response:
         from dynamo_tpu.protocols.openai import EmbeddingRequest
@@ -523,9 +760,10 @@ class HttpService:
         converted to a chat request (responses.rs:152-191 TryFrom), run
         through the chat chain, and the aggregate is reshaped into a
         Response object (responses.rs:198-253)."""
-        import time
         import uuid
 
+        if self._draining:
+            return self._draining_resp()
         try:
             body = await request.json()
         except Exception as e:  # noqa: BLE001
@@ -569,19 +807,25 @@ class HttpService:
             return self._error(
                 404, f"model {chat_req.model!r} not found", "not_found_error"
             )
-        ctx = Context()
-        timer = TokenTimer(self.metrics, chat_req.model)
-        with self.metrics.track(chat_req.model, "responses"):
-            agg = ChatDeltaAggregator()
-            async for item in execution.chat_stream(chat_req, ctx, timer):
-                if item.is_error():
-                    return self._error(
-                        500, item.error_message() or "engine error",
-                        "internal_error",
-                    )
-                if item.data is not None:
-                    agg.add(ChatCompletionChunk.model_validate(item.data))
-            chat_resp = agg.finish()
+        retry_after = self.admission.try_acquire(chat_req.model)
+        if retry_after is not None:
+            return self._shed(chat_req.model, retry_after)
+        try:
+            ctx = Context()
+            self._arm_deadline(ctx, chat_req)
+            timer = TokenTimer(self.metrics, chat_req.model)
+            with self.metrics.track(chat_req.model, "responses"):
+                agg = ChatDeltaAggregator()
+                async for item in execution.chat_stream(chat_req, ctx, timer):
+                    if item.is_error():
+                        return self._structured_error(
+                            chat_req.model, item.error_message()
+                        )
+                    if item.data is not None:
+                        agg.add(ChatCompletionChunk.model_validate(item.data))
+                chat_resp = agg.finish()
+        finally:
+            self.admission.release(chat_req.model)
         content = ""
         if chat_resp.choices:
             content = chat_resp.choices[0].message.content or ""

@@ -115,6 +115,11 @@ class WorkerStats:
     request_total_slots: int = 0
     num_requests_waiting: int = 0
     data_parallel_rank: Optional[int] = None
+    # request-lifeguard counters (monotonic over the worker's lifetime):
+    # deadline/TTFT expiries enforced by the engine, and stuck-horizon
+    # watchdog trips
+    num_deadline_exceeded: int = 0
+    num_watchdog_trips: int = 0
 
 
 @dataclass

@@ -210,6 +210,12 @@ class KvMetricsAggregator:
             agg.worker_stats.num_requests_waiting += (
                 m.worker_stats.num_requests_waiting
             )
+            agg.worker_stats.num_deadline_exceeded += (
+                m.worker_stats.num_deadline_exceeded
+            )
+            agg.worker_stats.num_watchdog_trips += (
+                m.worker_stats.num_watchdog_trips
+            )
             agg.kv_stats.kv_active_blocks += m.kv_stats.kv_active_blocks
             agg.kv_stats.kv_total_blocks += m.kv_stats.kv_total_blocks
             agg.kv_stats.gpu_cache_usage_perc += m.kv_stats.gpu_cache_usage_perc

@@ -52,6 +52,7 @@ class PushRouter:
         request: Any,
         context: Optional[Context] = None,
         instance_id: Optional[int] = None,
+        exclude: Optional[set[int]] = None,
     ) -> ResponseStream:
         ctx = context or Context()
         if instance_id is not None or self.mode is RouterMode.DIRECT:
@@ -59,15 +60,23 @@ class PushRouter:
                 raise ValueError("direct mode requires instance_id")
             return await self.client.direct(request, instance_id, ctx)
         if self.mode is RouterMode.RANDOM:
-            return await self.client.random(request, ctx)
+            return await self.client.random(request, ctx, exclude=exclude)
         if self.mode is RouterMode.ROUND_ROBIN:
-            return await self.client.round_robin(request, ctx)
+            return await self.client.round_robin(request, ctx, exclude=exclude)
         # KV mode: requests must expose token_ids for prefix matching
         token_ids = (
             request.get("token_ids", []) if isinstance(request, dict) else []
         )
         assert self.selector is not None
         worker_id, overlap = await self.selector.select_worker(token_ids, ctx)
+        if exclude and worker_id in exclude:
+            # the KV-preferred worker just died on this request: any other
+            # live instance beats replaying into the same failure
+            others = [
+                i for i in self.client.instance_ids() if i not in exclude
+            ]
+            if others:
+                worker_id, overlap = others[0], 0.0
         ctx.metadata["kv_overlap_blocks"] = overlap
         on_complete = getattr(self.selector, "on_request_complete", None)
         try:

@@ -130,6 +130,9 @@ class LLMEngineOutput:
     log_probs: Optional[list[float]] = None
     # per-token top-K alternatives: [[(token_id, logprob), ...], ...]
     top_logprobs: Optional[list[list[list[float]]]] = None
+    # structured failure payload on ERROR finals: {"request_id", "phase",
+    # "cause", "code"} — reaches the SSE stream as a typed error event
+    error: Optional[dict[str, Any]] = None
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {"token_ids": self.token_ids, "index": self.index}
@@ -143,6 +146,8 @@ class LLMEngineOutput:
             out["log_probs"] = self.log_probs
         if self.top_logprobs is not None:
             out["top_logprobs"] = self.top_logprobs
+        if self.error is not None:
+            out["error"] = self.error
         return out
 
     @classmethod
@@ -156,8 +161,30 @@ class LLMEngineOutput:
             index=d.get("index", 0),
             log_probs=d.get("log_probs"),
             top_logprobs=d.get("top_logprobs"),
+            error=d.get("error"),
         )
 
     @classmethod
     def final(cls, reason: FinishReason) -> "LLMEngineOutput":
         return cls(finish_reason=reason)
+
+    @classmethod
+    def final_error(
+        cls,
+        request_id: str,
+        phase: str,
+        cause: str,
+        code: str = "internal_error",
+    ) -> "LLMEngineOutput":
+        """An ERROR final carrying a structured, per-sequence failure
+        payload (request id, pipeline phase, cause, machine-readable code)
+        instead of a bare finish reason."""
+        return cls(
+            finish_reason=FinishReason.ERROR,
+            error={
+                "request_id": request_id,
+                "phase": phase,
+                "cause": cause,
+                "code": code,
+            },
+        )

@@ -23,6 +23,13 @@ class Ext(BaseModel):
     annotations: list[str] = Field(default_factory=list)
     ignore_eos: bool = False
     greedy: bool = False
+    # request lifeguard budgets (ms, relative to arrival): the whole
+    # request must finish within timeout_ms, and the first token must be
+    # produced within ttft_timeout_ms — else the request is cancelled
+    # end-to-end and a structured `deadline_exceeded` error is streamed.
+    # Unset fields fall back to DYN_DEFAULT_DEADLINE_MS / unbounded.
+    timeout_ms: Optional[float] = Field(default=None, gt=0)
+    ttft_timeout_ms: Optional[float] = Field(default=None, gt=0)
 
 
 class ChatMessage(BaseModel):

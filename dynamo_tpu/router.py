@@ -9,15 +9,21 @@ routing brain instead of each running its own partial view.
 Endpoint: `<namespace>.router.find_best`
   request : {"token_ids": [...]}                (or {"tokens": ...})
   response: {"worker_id": int, "overlap_blocks": int}
+           | {"shed": true, "retry_after_ms": int}   (fleet overloaded)
 Frontends then `client.direct(request, worker_id, ctx)` to the chosen
-worker and report completion with {"op": "free", "request_id": ...}.
+worker and report completion with {"op": "free", "request_id": ...}. A
+`shed` response means the aggregated fleet load (active slots + queued
+requests from worker `load_metrics`) is past the admission watermark —
+the frontend should answer 429 + Retry-After instead of queueing.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import os
 import signal
+import time
 from typing import Any, Optional
 
 from dynamo_tpu.runtime.logging import get_logger
@@ -26,7 +32,8 @@ logger = get_logger("dynamo_tpu.router")
 
 
 class StandaloneRouter:
-    """Hosts a KvRouter and serves find_best decisions over the fabric."""
+    """Hosts a KvRouter and serves find_best decisions over the fabric,
+    with fleet-level load shedding derived from aggregated load_metrics."""
 
     def __init__(
         self,
@@ -36,6 +43,7 @@ class StandaloneRouter:
         endpoint: str = "generate",
         block_size: int = 16,
         kv_config: Optional[Any] = None,
+        queue_factor: Optional[float] = None,
     ) -> None:
         self.drt = drt
         self.namespace = namespace
@@ -43,10 +51,20 @@ class StandaloneRouter:
         self.worker_endpoint = self.component.endpoint(endpoint)
         self.block_size = block_size
         self.kv_config = kv_config
+        self.queue_factor = (
+            queue_factor
+            if queue_factor is not None
+            else float(os.environ.get("DYN_ADMISSION_QUEUE_FACTOR", "2.0"))
+        )
         self.router = None
         self._service = None
+        self._aggregator = None
+        self._load: Optional[tuple[int, int]] = None  # (slots, active+wait)
+        self._load_at = 0.0
+        self.shed_total = 0
 
     async def start(self) -> None:
+        from dynamo_tpu.kv_router.publisher import KvMetricsAggregator
         from dynamo_tpu.kv_router.router import KvRouter
 
         client = await self.worker_endpoint.client()
@@ -57,6 +75,9 @@ class StandaloneRouter:
             config=self.kv_config,
         )
         await self.router.start()
+        self._aggregator = KvMetricsAggregator(
+            self.component, self.worker_endpoint.id
+        )
         serve_ep = (
             self.drt.namespace(self.namespace)
             .component("router")
@@ -68,10 +89,39 @@ class StandaloneRouter:
             self.namespace, self.worker_endpoint.id,
         )
 
+    async def _overloaded(self) -> bool:
+        """Fleet past the admission watermark? Uses a load snapshot cached
+        for 1 s so routing decisions never add a scrape round trip each."""
+        if self._aggregator is None:
+            return False
+        now = time.monotonic()
+        if self._load is None or now - self._load_at > 1.0:
+            try:
+                per_worker = await self._aggregator.collect()
+                slots = sum(
+                    m.worker_stats.request_total_slots
+                    for m in per_worker.values()
+                )
+                load = sum(
+                    m.worker_stats.request_active_slots
+                    + m.worker_stats.num_requests_waiting
+                    for m in per_worker.values()
+                )
+                self._load = (slots, load)
+            except Exception:  # noqa: BLE001 — missing stats = no shedding
+                self._load = (0, 0)
+            self._load_at = now
+        slots, load = self._load
+        return bool(slots) and load >= slots * self.queue_factor
+
     async def _handler(self, request: dict, ctx):
         if request.get("op") == "free":
             self.router.free(str(request.get("request_id", "")))
             yield {"ok": True}
+            return
+        if await self._overloaded():
+            self.shed_total += 1
+            yield {"shed": True, "retry_after_ms": 1000}
             return
         tokens = request.get("token_ids") or request.get("tokens") or []
         request_id = str(request.get("request_id", ""))

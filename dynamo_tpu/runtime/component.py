@@ -240,6 +240,15 @@ class Client:
     def instance_ids(self) -> list[int]:
         return sorted(self.instances.keys())
 
+    async def wait_instances_changed(self, timeout: float) -> None:
+        """Block until the live-instance set changes (the watch applies an
+        add or a remove), or timeout. Migration uses this to pause replays
+        while a mass worker restart repopulates discovery, instead of
+        burning its retry budget against stale instances."""
+        change = self._change
+        with contextlib.suppress(asyncio.TimeoutError):
+            await asyncio.wait_for(change.wait(), timeout)
+
     async def wait_for_instances(self, timeout: float = 30.0) -> list[int]:
         deadline = asyncio.get_running_loop().time() + timeout
         while not self.instances:
@@ -255,14 +264,35 @@ class Client:
 
     # ------------------------------------------------------------ dispatch
 
-    async def random(self, request: Any, context: Optional[Context] = None):
+    def _eligible(self, exclude: Optional[set[int]]) -> list[int]:
+        """Live instances minus an exclusion set (workers a migrating
+        request just watched die). If exclusion would empty the pool, fall
+        back to the full list — a restarted worker may be healthy again."""
         ids = self.instance_ids()
+        if exclude:
+            kept = [i for i in ids if i not in exclude]
+            if kept:
+                return kept
+        return ids
+
+    async def random(
+        self,
+        request: Any,
+        context: Optional[Context] = None,
+        exclude: Optional[set[int]] = None,
+    ):
+        ids = self._eligible(exclude)
         if not ids:
             raise NoInstancesError(str(self.endpoint.id))
         return await self.direct(request, random.choice(ids), context)
 
-    async def round_robin(self, request: Any, context: Optional[Context] = None):
-        ids = self.instance_ids()
+    async def round_robin(
+        self,
+        request: Any,
+        context: Optional[Context] = None,
+        exclude: Optional[set[int]] = None,
+    ):
+        ids = self._eligible(exclude)
         if not ids:
             raise NoInstancesError(str(self.endpoint.id))
         iid = ids[self._rr_counter % len(ids)]
@@ -273,6 +303,9 @@ class Client:
         self, request: Any, instance_id: int, context: Optional[Context] = None
     ) -> ResponseStream:
         ctx = context or Context()
+        # record the serving worker so stream-break handling (in-flight
+        # migration) knows which instance to exclude on replay
+        ctx.metadata["worker_instance_id"] = instance_id
         subject = self.endpoint.id.direct_subject(instance_id)
         local = self.drt.local_endpoints.get(subject)
         if local is not None and not self.drt.fabric.is_remote:

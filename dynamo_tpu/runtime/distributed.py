@@ -44,6 +44,35 @@ class DistributedRuntime:
         self._keepalive_task: Optional[asyncio.Task] = None
         self._extra_leases: list[int] = []
         self._closed = False
+        # graceful-drain registry: serving surfaces (http frontends,
+        # endpoint workers) register async callbacks run on SIGTERM —
+        # stop admission, finish in-flight work, deregister from discovery
+        self._drain_cbs: list[Callable] = []
+
+    def on_drain(self, cb: Callable) -> None:
+        """Register an async zero-arg drain callback (run once, in
+        registration order, bounded by the caller's drain timeout)."""
+        self._drain_cbs.append(cb)
+
+    async def drain(self, timeout_s: float = 10.0) -> None:
+        """Run every registered drain callback, each bounded by the
+        remaining share of timeout_s. Errors are logged, never raised —
+        drain must always hand control back so the process can exit."""
+        cbs, self._drain_cbs = self._drain_cbs, []
+        if not cbs:
+            return
+        deadline = asyncio.get_running_loop().time() + timeout_s
+        for cb in cbs:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                logger.warning("drain budget exhausted; skipping callbacks")
+                return
+            try:
+                await asyncio.wait_for(cb(), remaining)
+            except asyncio.TimeoutError:
+                logger.warning("drain callback timed out after %.1fs", remaining)
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                logger.exception("drain callback failed")
 
     # ----------------------------------------------------- constructors
 

@@ -4,14 +4,18 @@
 
 Builds a fabric-connected DistributedRuntime from the environment
 (DYN_FABRIC_ADDR et al.), instantiates the @service class, and awaits its
-``serve(runtime)`` forever. SIGTERM cancels cleanly so the supervisor's
-graceful stop doesn't need SIGKILL. Role-equivalent of the worker entry the
-reference's circus watchers exec (serving.py:152)."""
+``serve(runtime)`` forever. SIGTERM triggers a graceful drain — serving
+surfaces registered with ``runtime.on_drain`` stop admitting, finish
+in-flight requests (bounded by DYN_DRAIN_TIMEOUT_S), and deregister from
+discovery — before the task is cancelled, so a scale-down never kills live
+streams. Role-equivalent of the worker entry the reference's circus
+watchers exec (serving.py:152)."""
 
 from __future__ import annotations
 
 import asyncio
 import importlib
+import os
 import signal
 import sys
 
@@ -36,6 +40,11 @@ async def _amain(target: str) -> None:
         # propagate a crashed serve() as a nonzero exit for the supervisor
         serve_task.result()
     else:
+        # graceful drain before teardown: stop admission, let in-flight
+        # requests finish (bounded), deregister from discovery, then exit
+        await runtime.drain(
+            timeout_s=float(os.environ.get("DYN_DRAIN_TIMEOUT_S", "10"))
+        )
         serve_task.cancel()
         try:
             await serve_task

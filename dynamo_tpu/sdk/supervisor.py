@@ -203,11 +203,17 @@ class Supervisor:
             if p.proc is None:
                 await p.start()
 
-    async def stop_all(self, timeout: float = 5.0) -> None:
+    async def stop_all(self, timeout: Optional[float] = None) -> None:
         """Stop services first (concurrently), control-plane processes
         (`stop_last=True`, e.g. the fabric server) afterwards — otherwise
         workers block their graceful deregistration on a dead fabric and
-        eat the SIGKILL timeout."""
+        eat the SIGKILL timeout.
+
+        The default SIGKILL deadline leaves headroom for each child's
+        graceful drain (runner.py: stop admission -> finish in-flight,
+        bounded by DYN_DRAIN_TIMEOUT_S -> deregister -> exit)."""
+        if timeout is None:
+            timeout = float(os.environ.get("DYN_DRAIN_TIMEOUT_S", "10")) + 2.0
         first = [
             p for p in self.procs.values()
             if not getattr(p, "stop_last", False)

@@ -26,6 +26,11 @@ from dynamo_tpu.sdk import Supervisor, load_graph
 logger = get_logger("dynamo_tpu.serve")
 
 
+def _drain_timeout_s() -> float:
+    """Graceful-drain budget for SIGTERM teardown (DYN_DRAIN_TIMEOUT_S)."""
+    return float(os.environ.get("DYN_DRAIN_TIMEOUT_S", "10"))
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -158,8 +163,11 @@ def main(argv: Optional[list[str]] = None) -> None:
         for sig in (signal.SIGINT, signal.SIGTERM):
             loop.add_signal_handler(sig, stop.set)
         await stop.wait()
-        logger.info("stopping graph")
-        await sup.stop_all()
+        logger.info("stopping graph (drain %ss)", _drain_timeout_s())
+        # SIGTERM reaches each service's runner, which drains (stop
+        # admission -> finish in-flight -> deregister) before exiting; the
+        # supervisor's SIGKILL deadline leaves headroom for that drain
+        await sup.stop_all(timeout=_drain_timeout_s() + 5.0)
 
     asyncio.run(amain())
 

@@ -1,0 +1,177 @@
+"""Reusable fault-injection harness (`DYN_FAULT=` spec).
+
+Role-equivalent of the reference's fault-tolerance test hooks
+(tests/fault_tolerance/*): a process-wide injector that engines and the
+fabric consult at well-defined fault points. Off by default and zero-cost
+when off (every hook checks a module-level ``_active`` flag first).
+
+Spec grammar — comma-separated ``key=value`` actions::
+
+    DYN_FAULT="kill_after_tokens=12"        # SIGKILL self after N tokens
+    DYN_FAULT="abort_after_tokens=5"        # abort all streams after N tokens
+    DYN_FAULT="delay_dispatch=0.05"         # sleep S before each dispatch
+    DYN_FAULT="delay_dispatch=0.2,every=4"  # ... but only every 4th dispatch
+    DYN_FAULT="stall_transfer=1.5"          # sleep S in KV-transfer paths
+    DYN_FAULT="drop_fabric_conn=3"          # drop the fabric conn once,
+                                            # after N publishes
+
+``kill_after_tokens`` is the real-process fault (the worker dies exactly as
+a crashed decode worker would, mid-stream); ``abort_after_tokens`` is its
+in-process twin for engine-level chaos tests: the engine fails every live
+sequence with a structured error and keeps serving, conserving KV blocks.
+
+Tests may also install a programmatic injector (``set_injector``) with a
+schedule instead of a static spec, then ``reset()`` afterwards.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.testing.faults")
+
+_active: bool = False
+_injector: Optional["FaultInjector"] = None
+
+
+@dataclass
+class FaultSpec:
+    kill_after_tokens: int = 0  # 0 = off
+    abort_after_tokens: int = 0
+    delay_dispatch_s: float = 0.0
+    every: int = 1  # apply delay_dispatch on every Nth dispatch
+    stall_transfer_s: float = 0.0
+    drop_fabric_conn: int = 0  # drop once, after N publishes (0 = off)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultSpec":
+        out = cls()
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            key, _, val = part.partition("=")
+            key = key.strip()
+            val = val.strip()
+            if key == "kill_after_tokens":
+                out.kill_after_tokens = int(val)
+            elif key == "abort_after_tokens":
+                out.abort_after_tokens = int(val)
+            elif key == "delay_dispatch":
+                out.delay_dispatch_s = float(val)
+            elif key == "every":
+                out.every = max(1, int(val))
+            elif key == "stall_transfer":
+                out.stall_transfer_s = float(val)
+            elif key == "drop_fabric_conn":
+                out.drop_fabric_conn = int(val)
+            else:
+                raise ValueError(f"unknown DYN_FAULT action {key!r}")
+        return out
+
+
+class FaultInjector:
+    """Counts fault-point visits and decides when each fault fires."""
+
+    def __init__(self, spec: FaultSpec) -> None:
+        self.spec = spec
+        self.tokens = 0
+        self.dispatches = 0
+        self.publishes = 0
+        self.fabric_dropped = False
+        # observability for chaos tests
+        self.fired: dict[str, int] = {}
+
+    def _mark(self, name: str) -> None:
+        self.fired[name] = self.fired.get(name, 0) + 1
+
+    # ------------------------------------------------------- fault points
+
+    def on_token(self) -> bool:
+        """Engines call this per emitted token. Returns True when the
+        in-process abort fault should fire (the caller fails its live
+        sequences); executes the kill fault directly (never returns)."""
+        self.tokens += 1
+        k = self.spec.kill_after_tokens
+        if k and self.tokens >= k:
+            logger.warning("DYN_FAULT kill_after_tokens=%d firing", k)
+            self._mark("kill")
+            os.kill(os.getpid(), signal.SIGKILL)
+        a = self.spec.abort_after_tokens
+        if a and self.tokens >= a:
+            self.tokens = 0  # re-arm: chaos soaks want repeated crashes
+            self._mark("abort")
+            return True
+        return False
+
+    async def on_dispatch(self) -> None:
+        """Engines call this before each device/sim dispatch."""
+        self.dispatches += 1
+        d = self.spec.delay_dispatch_s
+        if d and self.dispatches % self.spec.every == 0:
+            self._mark("delay_dispatch")
+            await asyncio.sleep(d)
+
+    async def on_transfer(self) -> None:
+        """KV-transfer paths (disagg ship, offload) call this."""
+        s = self.spec.stall_transfer_s
+        if s:
+            self._mark("stall_transfer")
+            await asyncio.sleep(s)
+
+    def should_drop_fabric(self) -> bool:
+        """Fabric client calls this per publish; True at most once."""
+        n = self.spec.drop_fabric_conn
+        if not n or self.fabric_dropped:
+            return False
+        self.publishes += 1
+        if self.publishes >= n:
+            self.fabric_dropped = True
+            self._mark("drop_fabric_conn")
+            return True
+        return False
+
+
+# ---------------------------------------------------------------- plumbing
+
+
+def active() -> bool:
+    """Cheap guard for hot paths: is any fault injection configured?"""
+    return _active
+
+
+def get_injector() -> Optional[FaultInjector]:
+    """The process injector, creating it from DYN_FAULT on first use."""
+    global _injector, _active
+    if _injector is None:
+        spec = os.environ.get("DYN_FAULT", "").strip()
+        if spec:
+            _injector = FaultInjector(FaultSpec.parse(spec))
+            _active = True
+            logger.warning("fault injection armed: DYN_FAULT=%s", spec)
+    return _injector
+
+
+def set_injector(injector: Optional[FaultInjector]) -> None:
+    """Install a programmatic injector (tests). None re-arms from env."""
+    global _injector, _active
+    _injector = injector
+    _active = injector is not None
+
+
+def reset() -> None:
+    """Drop any injector; re-read DYN_FAULT on next get_injector()."""
+    global _injector, _active
+    _injector = None
+    _active = bool(os.environ.get("DYN_FAULT", "").strip())
+
+
+# arm at import time in processes launched with DYN_FAULT set, so engines
+# only need the cheap active() check on their hot paths
+reset()

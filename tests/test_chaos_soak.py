@@ -1,0 +1,103 @@
+"""Chaos soak: the mocker engine under randomized DYN_FAULT schedules.
+
+Randomized crash/stall injection (abort_after_tokens + delay_dispatch)
+while waves of concurrent requests — mixed lengths, cancels, deadlines —
+hammer the simulated scheduler. Afterwards every invariant must hold:
+ZERO stuck streams (every consumer saw a final), and conserved KV blocks
+(no ref leaked through any crash/cancel/deadline path)."""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.testing import faults
+
+# randomized fault soak: excluded from the default suite (-m 'not slow') to
+# keep it under the CI budget; CI runs the slow tier separately
+pytestmark = pytest.mark.slow
+
+
+def _req(prompt, max_tokens):
+    return PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling=SamplingOptions(),
+        stop=StopConditions(max_tokens=max_tokens),
+    )
+
+
+async def test_mocker_chaos_soak_random_fault_schedules():
+    rng = random.Random(20260804)
+    # small cache so admission backpressure + eviction fire alongside faults
+    engine = MockEngine(
+        MockEngineArgs(
+            num_blocks=96, block_size=4, max_batch=8, speedup_ratio=500.0
+        )
+    )
+    outcomes = {"ok": 0, "error": 0, "cancel": 0}
+
+    async def one(i: int) -> None:
+        n = rng.randint(2, 40)
+        prompt = [rng.randint(1, 63) for _ in range(n)]
+        ctx = Context()
+        if rng.random() < 0.2:
+            ctx.set_deadline_ms(rng.uniform(1, 80))
+        cancel_at = rng.randint(1, 10) if rng.random() < 0.2 else None
+        got = 0
+        try:
+            async for out in engine.generate(
+                _req(prompt, rng.randint(1, 48)), ctx
+            ):
+                got += len(out.token_ids)
+                if cancel_at is not None and got >= cancel_at:
+                    ctx.kill()
+                if out.finish_reason is not None:
+                    if out.error is not None:
+                        outcomes["error"] += 1
+                    elif out.finish_reason.value == "cancelled":
+                        outcomes["cancel"] += 1
+                    else:
+                        outcomes["ok"] += 1
+                    return
+        finally:
+            ctx.kill()
+
+    # several waves, each under a DIFFERENT randomized fault schedule
+    for wave in range(6):
+        spec = faults.FaultSpec(
+            abort_after_tokens=rng.choice([0, 0, 25, 60, 120]),
+            delay_dispatch_s=rng.choice([0.0, 0.001, 0.003]),
+            every=rng.randint(1, 5),
+        )
+        faults.set_injector(faults.FaultInjector(spec))
+        try:
+            # every stream must terminate — a stuck stream times this out
+            await asyncio.wait_for(
+                asyncio.gather(*[one(wave * 40 + i) for i in range(40)]),
+                timeout=60,
+            )
+        finally:
+            faults.set_injector(None)
+    assert sum(outcomes.values()) == 240, outcomes
+    assert outcomes["ok"] > 0
+    # KV conservation: no live refs remain; free + cached(0-ref) == total
+    assert engine.active == [] and len(engine.waiting) == 0
+    assert all(n == 0 for n in engine.cache.refs.values()), (
+        "leaked KV refs through a fault path"
+    )
+    cached = len(engine.cache.refs)
+    assert engine.cache.free_blocks + cached == engine.args.num_blocks
+    # the engine still serves deterministically after the chaos
+    toks, final = [], None
+    async for out in engine.generate(_req([9, 8, 7], 6), Context()):
+        toks.extend(out.token_ids)
+        final = out.finish_reason
+    assert toks == [9, 8, 7, 9, 8, 7]
+    await engine.close()

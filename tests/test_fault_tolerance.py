@@ -266,3 +266,63 @@ async def test_supervisor_restart_backoff_and_give_up():
     assert proc._monitor_task.done(), "monitor should give up"
     assert proc.restarts == 2
     await proc.stop()
+
+
+async def test_midstream_kill_under_dyn_fault_migrates_stream():
+    """Acceptance: a decode worker SIGKILLed by DYN_FAULT mid-stream
+    (kill_after_tokens) must not kill the SSE stream — the frontend
+    replays prompt + emitted tokens onto the other worker, the supervisor
+    restarts the dead one, and the completed stream is token-identical to
+    an unfaulted run, with the failover counted in
+    dyn_llm_request_migrations_total."""
+    port = _free_port()
+    sup = await serve_graph(
+        "dynamo_tpu.graphs.agg",
+        extra_env={
+            **FT_ENV,
+            "DYN_HTTP_PORT": str(port),
+            # every worker process dies after emitting 10 tokens; the
+            # frontend (no engine -> no token fault points) is unaffected
+            "DYN_FAULT": "kill_after_tokens=10",
+        },
+        replica_overrides={"Worker": 2},
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        models = await _wait_models(base, want=1)
+        model = models[0]["id"]
+        words = [f"w{i}" for i in range(30)]
+        prompt = " ".join(words)
+        async with aiohttp.ClientSession() as s:
+            # 30 tokens vs kill-after-10: the stream must survive >= 2
+            # worker deaths (each replay makes progress, so the retry
+            # budget never exhausts); supervisor restarts reset counters
+            async with s.post(
+                f"{base}/v1/completions",
+                json={
+                    "model": model, "prompt": prompt,
+                    "stream": True, "max_tokens": 30,
+                },
+            ) as resp:
+                assert resp.status == 200
+                text_parts, saw_error = [], False
+                async for raw in resp.content:
+                    line = raw.decode().strip()
+                    if line.startswith("event: error"):
+                        saw_error = True
+                    if line.startswith("data: ") and line != "data: [DONE]":
+                        chunk = json.loads(line[len("data: "):])
+                        for c in chunk.get("choices", []):
+                            text_parts.append(c.get("text") or "")
+            assert not saw_error, "stream surfaced an error despite migration"
+            # token-identical to the unfaulted echo of the prompt
+            assert "".join(text_parts).split() == words
+            async with s.get(f"{base}/metrics") as r:
+                metrics = await r.text()
+        mig = [
+            ln for ln in metrics.splitlines()
+            if ln.startswith("dyn_llm_request_migrations_total{")
+        ]
+        assert mig and float(mig[0].rsplit(" ", 1)[1]) >= 2
+    finally:
+        await sup.stop_all()

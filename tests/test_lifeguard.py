@@ -1,0 +1,599 @@
+"""Request lifeguard: deadlines, admission control/load shedding, structured
+errors, the stuck-horizon watchdog, graceful drain, and in-flight migration
+across worker failure (ISSUE 3; reference: Dynamo serving fabric graceful
+shutdown/cancellation + Llumnix-style live rescheduling)."""
+
+import asyncio
+import json
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.engine.echo import EchoEngineCore
+from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.entrypoint.inputs import EngineConfig, run_http
+from dynamo_tpu.discovery import register_llm
+from dynamo_tpu.http.service import AdmissionController
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.pipeline.router import RouterMode
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.testing import faults
+
+from tests.util import make_test_mdc
+
+
+def req(prompt, max_tokens=8, ignore_eos=False, **sampling):
+    return PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling=SamplingOptions(**sampling) if sampling else SamplingOptions(),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=ignore_eos),
+    )
+
+
+async def collect(engine, request, ctx):
+    toks, final = [], None
+    async for out in engine.generate(request, ctx):
+        toks.extend(out.token_ids)
+        if out.finish_reason is not None:
+            final = out
+    return toks, final
+
+
+# ------------------------------------------------------------- fault specs
+
+
+def test_fault_spec_parsing():
+    spec = faults.FaultSpec.parse(
+        "kill_after_tokens=12,delay_dispatch=0.25,every=4,"
+        "stall_transfer=1.5,drop_fabric_conn=3"
+    )
+    assert spec.kill_after_tokens == 12
+    assert spec.delay_dispatch_s == 0.25
+    assert spec.every == 4
+    assert spec.stall_transfer_s == 1.5
+    assert spec.drop_fabric_conn == 3
+    with pytest.raises(ValueError):
+        faults.FaultSpec.parse("frobnicate=1")
+
+
+def test_context_deadline_wire_roundtrip():
+    ctx = Context()
+    ctx.set_deadline_ms(5000, ttft_ms=1000)
+    assert not ctx.expired()
+    h = ctx.to_header()
+    back = Context.from_header(h)
+    assert back.deadline == ctx.deadline
+    assert back.ttft_deadline == ctx.ttft_deadline
+    # children inherit budgets
+    child = back.child()
+    assert child.deadline == back.deadline
+    expired = Context()
+    expired.set_deadline_ms(0.001)
+    time.sleep(0.002)
+    assert expired.expired()
+
+
+# --------------------------------------------------- deadlines (mock engine)
+
+
+async def test_mocker_deadline_expired_at_admission():
+    engine = MockEngine()
+    ctx = Context()
+    ctx.set_deadline_ms(0.001)
+    await asyncio.sleep(0.01)
+    toks, final = await collect(engine, req([1, 2, 3]), ctx)
+    assert toks == []
+    assert final.finish_reason is FinishReason.ERROR
+    assert final.error["code"] == "deadline_exceeded"
+    assert final.error["phase"] == "admission"
+    assert final.error["request_id"] == ctx.id
+    await engine.close()
+
+
+async def test_mocker_deadline_mid_generation():
+    # slow sim decode so a short deadline lapses mid-stream
+    engine = MockEngine(
+        MockEngineArgs(speedup_ratio=1.0, decode_per_token_s=0.02)
+    )
+    ctx = Context()
+    ctx.set_deadline_ms(120)
+    toks, final = await asyncio.wait_for(
+        collect(engine, req([1, 2, 3, 4], max_tokens=500), ctx), timeout=10
+    )
+    assert final.finish_reason is FinishReason.ERROR
+    assert final.error["code"] == "deadline_exceeded"
+    assert 0 < len(toks) < 500
+    assert engine.deadline_exceeded == 1
+    # the cancellation cascade fired (lane + KV freed, ctx killed)
+    assert ctx.is_killed()
+    assert engine.active == []
+    await engine.close()
+
+
+async def test_mocker_migration_replay_token_identical():
+    """The engines' resume contract: replaying prompt + already-emitted
+    tokens with resume_prompt_len yields exactly the unfaulted tail."""
+    engine = MockEngine()
+    prompt = [7, 3, 9, 4, 1]
+    baseline, final = await collect(engine, req(prompt, max_tokens=12), Context())
+    assert len(baseline) == 12
+    cut = 5  # tokens a "dead worker" streamed before crashing
+    resumed = req(prompt + baseline[:cut], max_tokens=12)
+    resumed.extra["resume_prompt_len"] = len(prompt)
+    tail, final2 = await collect(engine, resumed, Context())
+    assert tail == baseline[cut:]
+    assert final2.finish_reason is FinishReason.LENGTH
+    await engine.close()
+
+
+# ------------------------------------------------------- http frontend e2e
+
+
+async def _sse_events(resp):
+    """[(event_name, json_payload)] from an SSE response."""
+    events, current_event = [], None
+    async for raw in resp.content:
+        line = raw.decode().strip()
+        if line.startswith("event: "):
+            current_event = line[len("event: "):]
+        elif line.startswith("data: "):
+            data = line[len("data: "):]
+            if data != "[DONE]":
+                events.append((current_event, json.loads(data)))
+            current_event = None
+    return events
+
+
+async def test_http_deadline_streams_typed_error_and_metric():
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        mdc = make_test_mdc("lifeguard-echo")
+        config = EngineConfig.static_(EchoEngineCore(), mdc)
+        service = await run_http(drt, config, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as s:
+            payload = {
+                "model": "lifeguard-echo",
+                "messages": [
+                    {"role": "user", "content": " ".join(["w"] * 40)}
+                ],
+                "stream": True,
+                "max_tokens": 40,
+                # 80 ms budget against a ~10 ms/token echo: expires mid-way
+                "ext": {"timeout_ms": 80},
+            }
+            async with s.post(f"{base}/v1/chat/completions", json=payload) as r:
+                assert r.status == 200
+                events = await _sse_events(r)
+            # the stream terminated with a TYPED error event carrying the
+            # structured payload (not a silent hang, not a bare finish)
+            error_events = [e for name, e in events if name == "error"]
+            assert error_events, f"no typed error event in {events[-3:]}"
+            err = error_events[-1]["error"]
+            assert err["type"] == "deadline_exceeded"
+            assert err["request_id"]
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+            assert "dyn_llm_deadline_exceeded_total" in text
+    finally:
+        if service:
+            await service.close()
+        await drt.close()
+
+
+async def test_http_admission_control_sheds_with_429():
+    """Overload at 2x the watermark: excess requests get 429 +
+    Retry-After immediately (no unbounded queueing), admitted requests
+    complete, and dyn_llm_requests_shed_total counts the sheds."""
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        mdc = make_test_mdc("admit-echo")
+        config = EngineConfig.static_(EchoEngineCore(), mdc)
+        service = await run_http(drt, config, host="127.0.0.1", port=0)
+        # bounded watermark: 3 in-flight; drive 2x past it
+        service.admission.max_inflight = 3
+        service.admission._capacity_fns.clear()
+        base = f"http://127.0.0.1:{service.port}"
+        prompt = " ".join(f"w{i}" for i in range(30))
+        async with aiohttp.ClientSession() as s:
+            async def one():
+                async with s.post(
+                    f"{base}/v1/chat/completions",
+                    json={
+                        "model": "admit-echo",
+                        "messages": [{"role": "user", "content": prompt}],
+                        "stream": False,
+                        "max_tokens": 30,
+                    },
+                ) as r:
+                    body = await r.json()
+                    return r.status, dict(r.headers), body
+
+            results = await asyncio.gather(*[one() for _ in range(9)])
+        statuses = [st for st, _, _ in results]
+        shed = [(st, h) for st, h, _ in results if st == 429]
+        assert shed, f"no 429 under 3x overload: {statuses}"
+        assert statuses.count(200) >= 3
+        for st, headers in shed:
+            assert "Retry-After" in headers
+        ok_bodies = [b for st, _, b in results if st == 200]
+        assert all(b["choices"][0]["message"]["content"] for b in ok_bodies)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+        assert 'dyn_llm_requests_shed_total{model="admit-echo"}' in text
+        shed_line = [
+            ln for ln in text.splitlines()
+            if ln.startswith("dyn_llm_requests_shed_total{")
+        ][0]
+        assert float(shed_line.rsplit(" ", 1)[1]) == len(shed)
+        # after the wave drains, admission recovers
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": "admit-echo",
+                    "messages": [{"role": "user", "content": "w1 w2"}],
+                    "stream": False,
+                    "max_tokens": 4,
+                },
+            ) as r:
+                assert r.status == 200
+    finally:
+        if service:
+            await service.close()
+        await drt.close()
+
+
+async def test_http_drain_stops_admission_and_finishes_inflight():
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        mdc = make_test_mdc("drain-echo")
+        config = EngineConfig.static_(EchoEngineCore(), mdc)
+        service = await run_http(drt, config, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        prompt = " ".join(f"w{i}" for i in range(25))
+        async with aiohttp.ClientSession() as s:
+            inflight = asyncio.create_task(
+                s.post(
+                    f"{base}/v1/chat/completions",
+                    json={
+                        "model": "drain-echo",
+                        "messages": [{"role": "user", "content": prompt}],
+                        "stream": False,
+                        "max_tokens": 25,
+                    },
+                )
+            )
+            await asyncio.sleep(0.05)  # request is mid-stream
+            service.begin_drain()
+            # new admissions are refused with 503 + Retry-After
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                json={
+                    "model": "drain-echo",
+                    "messages": [{"role": "user", "content": "w1"}],
+                    "stream": False,
+                },
+            ) as r:
+                assert r.status == 503
+                assert "Retry-After" in r.headers
+            # the in-flight request still completes
+            resp = await inflight
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["choices"][0]["message"]["content"]
+            # drain() returns once in-flight work is gone
+            await asyncio.wait_for(service.drain(timeout_s=5.0), timeout=10)
+            assert service.admission.inflight() == 0
+        service = None  # drain() closed it
+    finally:
+        if service:
+            await service.close()
+        await drt.close()
+
+
+async def test_runtime_drain_runs_callbacks_bounded():
+    drt = await DistributedRuntime.detached()
+    try:
+        ran = []
+
+        async def quick():
+            ran.append("quick")
+
+        async def stuck():
+            await asyncio.sleep(60)
+
+        drt.on_drain(quick)
+        drt.on_drain(stuck)  # must not block exit past the budget
+        t0 = time.monotonic()
+        await drt.drain(timeout_s=0.2)
+        assert ran == ["quick"]
+        assert time.monotonic() - t0 < 5
+        # callbacks are consumed: a second drain is a no-op
+        await drt.drain(timeout_s=0.2)
+    finally:
+        await drt.close()
+
+
+# ------------------------------------------ in-flight migration (tentpole)
+
+
+class _DyingEngine:
+    """Echo engine whose stream breaks (like a SIGKILLed worker's TCP
+    response plane) after N tokens — every time it serves."""
+
+    def __init__(self, die_after: int) -> None:
+        self.die_after = die_after
+        self.inner = EchoEngineCore()
+        self.served = 0
+
+    async def generate(self, request, context):
+        self.served += 1
+        n = 0
+        async for out in self.inner.generate(request, context):
+            if out.finish_reason is None and n >= self.die_after:
+                raise ConnectionResetError("worker died mid-stream")
+            yield out
+            n += 1
+
+
+async def test_midstream_worker_death_migrates_token_identical():
+    """Kill a decode worker mid-stream: the router replays the request —
+    prompt + already-emitted tokens — onto the healthy worker and the
+    resumed SSE stream is token-identical to an unfaulted run, with
+    dyn_llm_request_migrations_total counting the failover."""
+    worker_a = await DistributedRuntime.detached()
+    worker_b = await DistributedRuntime.detached()
+    front = await DistributedRuntime.detached()
+    service = None
+    try:
+        mdc = make_test_mdc("migrate-echo")
+        dying = _DyingEngine(die_after=3)
+        healthy = EchoEngineCore()
+
+        def handler_for(engine):
+            async def handler(request, ctx):
+                pre = PreprocessedRequest.from_dict(request)
+                async for out in engine.generate(pre, ctx):
+                    yield out.to_dict()
+
+            return handler
+
+        ep_a = worker_a.namespace("mig").component("worker").endpoint("generate")
+        await ep_a.serve_endpoint(handler_for(dying))
+        await register_llm(worker_a, ep_a, mdc)
+        ep_b = worker_b.namespace("mig").component("worker").endpoint("generate")
+        await ep_b.serve_endpoint(handler_for(healthy))
+        await register_llm(worker_b, ep_b, mdc)
+
+        config = EngineConfig.dynamic(RouterMode.ROUND_ROBIN)
+        service = await run_http(front, config, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        # 12 distinct words from the test tokenizer's vocab
+        words = "the quick brown fox jumps over lazy dog one two three four".split()
+        prompt = " ".join(words)
+
+        async with aiohttp.ClientSession() as s:
+            for _ in range(50):
+                async with s.get(f"{base}/v1/models") as r:
+                    if (await r.json())["data"]:
+                        break
+                await asyncio.sleep(0.1)
+
+            async def stream_one():
+                async with s.post(
+                    f"{base}/v1/completions",
+                    json={
+                        "model": "migrate-echo",
+                        "prompt": prompt,
+                        "stream": True,
+                        "max_tokens": 12,
+                    },
+                ) as r:
+                    assert r.status == 200
+                    events = await _sse_events(r)
+                assert not [e for name, e in events if name == "error"], (
+                    f"stream errored: {events[-2:]}"
+                )
+                text = "".join(
+                    c["choices"][0].get("text") or ""
+                    for _, c in events
+                    if c.get("choices")
+                )
+                return text.split()
+
+            # round-robin over 2 workers: two requests guarantee at least
+            # one lands on the dying worker and must migrate mid-stream
+            out1 = await asyncio.wait_for(stream_one(), timeout=30)
+            out2 = await asyncio.wait_for(stream_one(), timeout=30)
+            served_faulty = dying.served
+            # unfaulted baseline: disable the fault and stream once more
+            dying.die_after = 10**9
+            baseline = await asyncio.wait_for(stream_one(), timeout=30)
+        # token-identical to the unfaulted run (no dupes, no gaps)
+        assert out1 == baseline == words[:12]
+        assert out2 == baseline
+        assert served_faulty >= 1, "fault never exercised"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+        mig_lines = [
+            ln for ln in text.splitlines()
+            if ln.startswith("dyn_llm_request_migrations_total{")
+        ]
+        assert mig_lines and float(mig_lines[0].rsplit(" ", 1)[1]) >= 1
+    finally:
+        if service:
+            await service.close()
+        for drt in (front, worker_a, worker_b):
+            await drt.close()
+
+
+# ------------------------------------------------- jax engine (tiny, CPU)
+
+
+def _make_jax_engine(**cfg_overrides):
+    import jax
+
+    from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+    from dynamo_tpu.models import llama as L
+
+    cfg = L.LlamaConfig.tiny(vocab_size=64)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    runner = ModelRunner(
+        cfg, params, num_blocks=64, block_size=4, max_batch=4,
+        max_model_len=64,
+    )
+    kw = dict(
+        max_batch=4, block_size=4, num_blocks=64, max_model_len=64,
+        watermark_blocks=2,
+    )
+    kw.update(cfg_overrides)
+    return JaxEngine(runner, JaxEngineConfig(**kw))
+
+
+async def test_jax_resume_bit_identical_seeded_and_greedy():
+    """The migration resume contract on the real engine: replaying prompt +
+    already-emitted tokens continues the stream bit-identically — for
+    greedy AND seeded temperature sampling (per-token threefry counters
+    line up because the replayed tail counts as generated)."""
+    engine = _make_jax_engine()
+    prompt = [5, 9, 17, 23, 2, 40]
+    for sampling in (
+        SamplingOptions(greedy=True),
+        SamplingOptions(temperature=0.9, top_k=8, seed=1234),
+    ):
+        base_req = PreprocessedRequest(
+            token_ids=prompt, sampling=sampling,
+            stop=StopConditions(max_tokens=10, ignore_eos=True),
+        )
+        baseline, final = await collect(engine, base_req, Context())
+        assert len(baseline) == 10, final
+        for cut in (1, 4, 9):
+            resumed = PreprocessedRequest(
+                token_ids=prompt + baseline[:cut], sampling=sampling,
+                stop=StopConditions(max_tokens=10, ignore_eos=True),
+                extra={"resume_prompt_len": len(prompt)},
+            )
+            tail, _ = await collect(engine, resumed, Context())
+            assert tail == baseline[cut:], (
+                f"resume at {cut} diverged ({sampling})"
+            )
+    await engine.close()
+
+
+async def test_jax_deadline_structured_error_and_stats():
+    engine = _make_jax_engine()
+    ctx = Context()
+    ctx.set_deadline_ms(0.001)
+    await asyncio.sleep(0.01)
+    toks, final = await collect(engine, req([1, 2, 3]), ctx)
+    assert final.finish_reason is FinishReason.ERROR
+    assert final.error["code"] == "deadline_exceeded"
+    assert engine.stats.deadline_exceeded == 1
+    # a live engine keeps serving after a shed
+    toks, final = await collect(engine, req([4, 5, 6], max_tokens=3), Context())
+    assert len(toks) == 3
+    await engine.close()
+
+
+async def test_jax_watchdog_trips_on_stuck_dispatch():
+    """A wedged decode dispatch (sleeping past budget) trips the
+    stuck-horizon watchdog: every stream gets a structured watchdog error
+    (no hang), on_watchdog_trip fires (discovery deregistration hook), the
+    engine refuses new work, and the trip is counted for /metrics."""
+    engine = _make_jax_engine(
+        watchdog_min_s=0.15, watchdog_cold_s=10.0, watchdog_mult=1.0
+    )
+    # warm the dispatch EMAs with a clean request: enough decode steps
+    # that the first-compile cost decays out of the EMA (0.8 folding), so
+    # the budget reflects steady-state step time even on a loaded box
+    toks, _ = await collect(
+        engine, req([3, 7, 11], max_tokens=14, ignore_eos=True), Context()
+    )
+    assert len(toks) == 14
+    tripped = asyncio.Event()
+    engine.on_watchdog_trip = tripped.set
+    real_decode = engine.runner.decode
+
+    def stuck_decode(*a, **k):
+        time.sleep(1.5)  # well past the warm budget
+        return real_decode(*a, **k)
+
+    engine.runner.decode = stuck_decode
+    toks, final = await asyncio.wait_for(
+        collect(engine, req([9, 2, 5], max_tokens=8), Context()), timeout=15
+    )
+    assert final.finish_reason is FinishReason.ERROR
+    assert final.error["code"] == "watchdog_stuck"
+    assert engine.stats.watchdog_trips == 1
+    assert tripped.is_set()
+    # tripped engine refuses new work with a structured error
+    toks, final = await collect(engine, req([1, 2], max_tokens=2), Context())
+    assert final.error["code"] == "worker_unavailable"
+    await engine.close()
+
+
+async def test_jax_engine_loop_crash_fails_sequences_structured():
+    """engine-loop crash path: every live sequence gets a structured,
+    per-sequence error (request id, phase, cause) and its KV blocks are
+    freed — not just a log line."""
+    engine = _make_jax_engine(watchdog_min_s=0)  # watchdog off
+
+    def boom(*a, **k):
+        raise RuntimeError("injected compile explosion")
+
+    engine.runner.decode = boom
+    engine.runner.decode_multi = boom
+    ctx = Context()
+    toks, final = await asyncio.wait_for(
+        collect(engine, req([6, 6, 6], max_tokens=8), ctx), timeout=15
+    )
+    assert final.finish_reason is FinishReason.ERROR
+    assert final.error["code"] == "engine_loop_crash"
+    assert final.error["request_id"] == ctx.id
+    assert "injected compile explosion" in final.error["cause"]
+    # KV blocks freed (allocator back to full minus the null block)
+    assert engine.allocator.free_count == engine.config.num_blocks - 1
+    await engine.close()
+
+
+async def test_jax_injected_abort_conserves_blocks():
+    """DYN_FAULT abort_after_tokens on the real engine: streams all
+    terminate with structured errors and every KV block is freed."""
+    faults.set_injector(
+        faults.FaultInjector(faults.FaultSpec(abort_after_tokens=5))
+    )
+    try:
+        engine = _make_jax_engine()
+        results = await asyncio.wait_for(
+            asyncio.gather(
+                *[
+                    collect(engine, req([i + 1, i + 2, i + 3], max_tokens=6),
+                            Context())
+                    for i in range(3)
+                ]
+            ),
+            timeout=30,
+        )
+        finals = [f for _, f in results]
+        assert all(f is not None for f in finals)
+        assert any(
+            f.error and f.error["code"] == "injected_fault" for f in finals
+        )
+        assert engine.allocator.free_count == engine.config.num_blocks - 1
+        await engine.close()
+    finally:
+        faults.set_injector(None)

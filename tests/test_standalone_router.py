@@ -95,3 +95,77 @@ async def ask_free(finder):
     )
     async for item in stream:
         return item.data if hasattr(item, "data") else item
+
+
+async def test_standalone_router_sheds_past_watermark():
+    """Load shedding at the routing brain: when aggregated worker
+    load_metrics show active+waiting past slots x queue_factor, find_best
+    answers {"shed": true, "retry_after_ms": ...} instead of a worker."""
+    import msgpack
+
+    from dynamo_tpu.kv_router.protocols import (
+        ForwardPassMetrics,
+        WorkerStats,
+    )
+    from dynamo_tpu.kv_router.publisher import stats_key
+
+    drt = await DistributedRuntime.detached()
+    try:
+        component = drt.namespace("shed").component("backend")
+        ep = component.endpoint("generate")
+
+        async def handler(request, context):
+            yield {}
+
+        svc = await ep.serve_endpoint(handler)
+        router = StandaloneRouter(
+            drt, namespace="shed", component="backend", endpoint="generate",
+            block_size=BS, queue_factor=2.0,
+        )
+        await router.start()
+        finder = await (
+            drt.namespace("shed").component("router").endpoint("find_best")
+        ).client()
+        await finder.wait_for_instances(2.0)
+
+        async def publish_load(active: int, waiting: int, slots: int):
+            m = ForwardPassMetrics(
+                worker_stats=WorkerStats(
+                    request_active_slots=active,
+                    request_total_slots=slots,
+                    num_requests_waiting=waiting,
+                )
+            )
+            await drt.fabric.kv_put(
+                stats_key(ep.id, svc.instance_id),
+                msgpack.packb(m.to_dict(), use_bin_type=True),
+            )
+            router._load = None  # drop the router's 1s snapshot cache
+
+        async def ask(tokens):
+            stream = await finder.direct(
+                {"token_ids": tokens}, finder.instance_ids()[0], Context()
+            )
+            async for item in stream:
+                return item.data if hasattr(item, "data") else item
+
+        # healthy fleet: 2/8 slots busy -> routed normally
+        await publish_load(active=2, waiting=0, slots=8)
+        decision = await ask([1, 2, 3])
+        assert "worker_id" in decision and not decision.get("shed")
+
+        # overloaded: 8 active + 10 queued >= 8 * 2.0 -> shed
+        await publish_load(active=8, waiting=10, slots=8)
+        decision = await ask([4, 5, 6])
+        assert decision.get("shed") is True
+        assert decision["retry_after_ms"] > 0
+        assert router.shed_total == 1
+
+        # load falls again -> admission recovers
+        await publish_load(active=1, waiting=0, slots=8)
+        decision = await ask([7, 8, 9])
+        assert "worker_id" in decision
+
+        await router.close()
+    finally:
+        await drt.close()
