@@ -256,7 +256,8 @@ def main() -> None:
     ap.add_argument("--decode-horizon", type=int, default=None)
     ap.add_argument("--context-length", type=int, default=None)
     ap.add_argument(
-        "--preset", choices=["canonical", "swa", "chaos"], default=None,
+        "--preset", choices=["canonical", "swa", "chaos", "disagg"],
+        default=None,
         help="canonical = the reference's genai-perf workload "
         "(examples/llm/benchmarks/README.md:41 — ISL 3000 / OSL 150, "
         "served at max_model_len 3328 = 3000 prompt + 150 output + "
@@ -267,10 +268,23 @@ def main() -> None:
         "serving hot path end to end. chaos = the sweep with fault "
         "injection ON (DYN_FAULT dispatch delays) and a bounded admission "
         "watermark, so the curve shows shed counts and the TTFT of "
-        "ADMITTED requests under overload instead of an unbounded queue",
+        "ADMITTED requests under overload instead of an unbounded queue. "
+        "disagg = delegates to benchmarks.disagg_stream_bench (streamed "
+        "vs monolithic P/D TTFT over a simulated wire; banked artifact "
+        "benchmarks/disagg_stream.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
+    if args.preset == "disagg":
+        # the disagg data-plane sweep has its own harness (two engines +
+        # throttled fabric instead of an HTTP frontend); keep one entry
+        # point so `perf_sweep --preset X` covers every banked curve
+        from benchmarks import disagg_stream_bench
+
+        disagg_stream_bench.main(
+            ["--json", args.json or "benchmarks/disagg_stream.json"]
+        )
+        return
     tiny_extra_cfg = None
     extra_env = None
     if args.preset == "canonical":

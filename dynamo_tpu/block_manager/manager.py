@@ -71,18 +71,29 @@ class TieredBlockManager:
         disk_dir: Optional[str] = None,
         disk_blocks: int = 0,
         on_event: Optional[Callable[[str, list[int], int], None]] = None,
+        wire_codec: str = "raw",
     ) -> None:
         self.layout = layout
         self.host_blocks = host_blocks
         self.disk_dir = disk_dir
         self.disk_blocks = disk_blocks
         self.on_event = on_event
-        wire = _NP_DTYPES[layout.dtype]
+        # DYN_KV_WIRE=int8: store the host/disk tiers quantized (per-
+        # (layer, head, block) f32 scales + int8 mantissas) — halves tier
+        # RAM/disk at a bounded dequant error on onboard. Default "raw"
+        # keeps the tiers bit-exact.
+        self.wire_codec = "int8" if wire_codec == "int8" else "raw"
+        wire = np.int8 if self.wire_codec == "int8" else _NP_DTYPES[layout.dtype]
         # blocks-first host arenas: [n, L, H, bs, D] so one block is one
         # contiguous slice (cheap memcpy in, cheap file write out)
         shape = (host_blocks, *layout.block_shape)
         self._k_arena = np.zeros(shape, wire)
         self._v_arena = np.zeros(shape, wire)
+        # per-block quant scales [n, L, H] (int8 mode only; tiny vs arenas)
+        if self.wire_codec == "int8":
+            sshape = (host_blocks, *layout.block_shape[:-2])
+            self._k_scales = np.zeros(sshape, np.float32)
+            self._v_scales = np.zeros(sshape, np.float32)
         self._free_slots = list(range(host_blocks - 1, -1, -1))
         # seq_hash -> handle; OrderedDict doubles as the LRU (move_to_end)
         self._host: OrderedDict[int, BlockHandle] = OrderedDict()
@@ -134,7 +145,13 @@ class TieredBlockManager:
         # on strided arrays; the only copies are the per-block arena writes
         kb = np.moveaxis(k_blocks, 2, 0)
         vb = np.moveaxis(v_blocks, 2, 0)
-        if kb.dtype.name == "bfloat16":
+        ks = vs = None
+        if self.wire_codec == "int8":
+            from dynamo_tpu.disagg.protocols import as_logical, kv_quantize_int8
+
+            kb, ks = kv_quantize_int8(as_logical(kb, self.layout.dtype))
+            vb, vs = kv_quantize_int8(as_logical(vb, self.layout.dtype))
+        elif kb.dtype.name == "bfloat16":
             kb, vb = kb.view(np.uint16), vb.view(np.uint16)
         stored = []
         with self._lock:
@@ -149,6 +166,9 @@ class TieredBlockManager:
                     break
                 self._k_arena[slot] = kb[i]
                 self._v_arena[slot] = vb[i]
+                if ks is not None:
+                    self._k_scales[slot] = ks[i]
+                    self._v_scales[slot] = vs[i]
                 self._host[h] = BlockHandle(h, tier=2, index=slot)
                 stored.append(h)
             if stored:
@@ -176,6 +196,9 @@ class TieredBlockManager:
         with open(path, "wb") as f:
             f.write(self._k_arena[slot].tobytes())
             f.write(self._v_arena[slot].tobytes())
+            if self.wire_codec == "int8":
+                f.write(self._k_scales[slot].tobytes())
+                f.write(self._v_scales[slot].tobytes())
         self._disk[seq_hash] = path
         self.stats.spilled_g3 += 1
         self.stats.disk_blocks_used = len(self._disk)
@@ -196,16 +219,22 @@ class TieredBlockManager:
     def load_blocks(
         self, seq_hashes: list[int]
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Fetch blocks for onboarding; returns [L, H, n, bs, D] pairs.
+        """Fetch blocks for onboarding; returns [L, H, n, bs, D] pairs in
+        the layout's WIRE dtype (bf16 as u16 words) regardless of the tier
+        codec — int8 tiers dequantize here, so callers never see scales.
 
         Disk blocks are promoted back into the host arena on read
         (offload.rs onboarding path G3->G2->G1).
         """
         L = self.layout
-        wire = _NP_DTYPES[L.dtype]
+        int8 = self.wire_codec == "int8"
+        store = np.int8 if int8 else _NP_DTYPES[L.dtype]
         n = len(seq_hashes)
-        k = np.zeros((n, *L.block_shape), wire)
-        v = np.zeros((n, *L.block_shape), wire)
+        sshape = L.block_shape[:-2]
+        k = np.zeros((n, *L.block_shape), store)
+        v = np.zeros((n, *L.block_shape), store)
+        ks = np.zeros((n, *sshape), np.float32) if int8 else None
+        vs = np.zeros((n, *sshape), np.float32) if int8 else None
         with self._lock:
             for i, h in enumerate(seq_hashes):
                 hnd = self._host.get(h)
@@ -213,24 +242,60 @@ class TieredBlockManager:
                     self._host.move_to_end(h)
                     k[i] = self._k_arena[hnd.index]
                     v[i] = self._v_arena[hnd.index]
+                    if int8:
+                        ks[i] = self._k_scales[hnd.index]
+                        vs[i] = self._v_scales[hnd.index]
                     continue
                 path = self._disk.get(h)
                 if path is None:
                     raise KeyError(f"block {h:#x} not cached")
-                raw = np.fromfile(path, dtype=wire)
-                half = L.block_numel
-                k[i] = raw[:half].reshape(L.block_shape)
-                v[i] = raw[half:].reshape(L.block_shape)
-                self._promote(h, k[i], v[i], path)
+                raw = np.fromfile(path, dtype=np.uint8)
+                half = L.block_numel * store().itemsize
+                k[i] = np.frombuffer(
+                    raw[:half].tobytes(), store
+                ).reshape(L.block_shape)
+                v[i] = np.frombuffer(
+                    raw[half : 2 * half].tobytes(), store
+                ).reshape(L.block_shape)
+                if int8:
+                    scales = np.frombuffer(
+                        raw[2 * half :].tobytes(), np.float32
+                    )
+                    snum = int(np.prod(sshape))
+                    ks[i] = scales[:snum].reshape(sshape)
+                    vs[i] = scales[snum:].reshape(sshape)
+                self._promote(
+                    h, k[i], v[i], path,
+                    k_scales=ks[i] if int8 else None,
+                    v_scales=vs[i] if int8 else None,
+                )
             self.stats.onboarded += n
+        if int8:
+            from dynamo_tpu.disagg.protocols import kv_dequantize_int8
+
+            k = kv_dequantize_int8(k, ks, L.dtype)
+            v = kv_dequantize_int8(v, vs, L.dtype)
+            if L.dtype == "bfloat16":
+                k, v = k.view(np.uint16), v.view(np.uint16)
         return np.moveaxis(k, 0, 2), np.moveaxis(v, 0, 2)
 
-    def _promote(self, h: int, kb: np.ndarray, vb: np.ndarray, path: str) -> None:
+    def _promote(
+        self,
+        h: int,
+        kb: np.ndarray,
+        vb: np.ndarray,
+        path: str,
+        k_scales: Optional[np.ndarray] = None,
+        v_scales: Optional[np.ndarray] = None,
+    ) -> None:
         slot = self._alloc_host_slot()
         if slot is None:
             return
         self._k_arena[slot] = kb
         self._v_arena[slot] = vb
+        if k_scales is not None:
+            self._k_scales[slot] = k_scales
+            self._v_scales[slot] = v_scales
         self._host[h] = BlockHandle(h, tier=2, index=slot)
         self._disk.pop(h, None)
         try:

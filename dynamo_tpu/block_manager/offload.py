@@ -45,6 +45,10 @@ class OffloadQueueStats:
     dropped_full: int = 0
     dropped_stale: int = 0
     dropped_dup: int = 0
+    # candidates dropped because their sequence was cancelled/killed —
+    # kept separate from staleness so chaos-soak accounting can tell
+    # teardown churn from ordinary scheduling races
+    dropped_cancelled: int = 0
 
 
 class OffloadQueue:
@@ -114,17 +118,25 @@ class OffloadQueue:
             out.append((seq, e.seq_hash, seq.block_ids[e.position]))
         return out
 
-    def forget_seq(self, seq: Any) -> None:
+    def forget_seq(self, seq: Any, cancelled: bool = False) -> int:
         """Drop queued candidates for a sequence whose device blocks are
         being recycled (free/preempt/cancel paths), so their hashes can
-        re-enqueue through another live holder."""
-        if not any(e.seq is seq for e in self._fifo):
-            return
+        re-enqueue through another live holder. One pass: drops are
+        counted while filtering, and the rebuilt deque is only swapped in
+        when something was actually dropped. `cancelled` attributes the
+        drops to requester cancellation rather than staleness."""
         kept: deque[_Entry] = deque()
+        dropped = 0
         for e in self._fifo:
             if e.seq is seq:
                 self._pending.discard(e.seq_hash)
-                self.stats.dropped_stale += 1
+                dropped += 1
             else:
                 kept.append(e)
-        self._fifo = kept
+        if dropped:
+            self._fifo = kept
+            if cancelled:
+                self.stats.dropped_cancelled += dropped
+            else:
+                self.stats.dropped_stale += dropped
+        return dropped

@@ -23,7 +23,6 @@ import asyncio
 from typing import Any, Optional
 
 import msgpack
-import numpy as np
 
 from dynamo_tpu.pipeline.context import Context
 from dynamo_tpu.runtime.logging import get_logger
@@ -103,22 +102,29 @@ class PeerBlockService:
             await asyncio.sleep(self.publish_interval_s)
 
     async def _handler(self, request: dict, ctx: Context):
+        from dynamo_tpu.disagg.protocols import (
+            KvBlockPayload,
+            as_logical,
+            wire_codec_from_env,
+        )
+
         hashes = [int(h) for h in request.get("hashes", [])]
         found = [h for h in hashes if h in self.manager]
         if not found:
-            yield {"hashes": [], "k": b"", "v": b"", "shape": [], "dtype": ""}
+            yield {"hashes": [], "payload": None}
             return
         loop = asyncio.get_running_loop()
         k, v = await loop.run_in_executor(
             None, self.manager.load_blocks, found
         )
-        yield {
-            "hashes": found,
-            "k": k.tobytes(),
-            "v": v.tobytes(),
-            "shape": list(k.shape),
-            "dtype": str(k.dtype.name),
-        }
+        # same self-describing codec container as the disagg data plane:
+        # DYN_KV_WIRE=int8 halves G4 pull bytes too
+        dtype = self.manager.layout.dtype
+        payload = KvBlockPayload.encode(
+            as_logical(k, dtype), as_logical(v, dtype),
+            wire_codec_from_env(),
+        )
+        yield {"hashes": found, "payload": payload.to_wire()}
 
 
 class PeerBlockClient:
@@ -134,6 +140,7 @@ class PeerBlockClient:
         self._client = None
         self.own_instance_id: Optional[int] = None  # skip self-pulls
         self.fetched_blocks = 0
+        self.fetched_bytes = 0  # wire bytes pulled (post-codec)
 
     async def _ensure_client(self):
         if self._client is None:
@@ -184,13 +191,15 @@ class PeerBlockClient:
             async for item in stream:
                 reply = item
             data = reply.data if hasattr(reply, "data") else reply
-            if not data or not data.get("hashes"):
+            if not data or not data.get("hashes") or not data.get("payload"):
                 return 0
-            k = np.frombuffer(data["k"], dtype=np.dtype(data["dtype"]))
-            v = np.frombuffer(data["v"], dtype=np.dtype(data["dtype"]))
-            shape = tuple(data["shape"])
-            k = k.reshape(shape)
-            v = v.reshape(shape)
+            from dynamo_tpu.disagg.protocols import KvBlockPayload
+
+            payload = KvBlockPayload.from_wire(data["payload"])
+            self.fetched_bytes += payload.wire_nbytes
+            # decode() dequantizes int8 pulls; the local manager re-encodes
+            # per its own tier codec in store_blocks
+            k, v = payload.decode()
             loop = asyncio.get_running_loop()
             stored = await loop.run_in_executor(
                 None, self.manager.store_blocks, list(data["hashes"]), k, v

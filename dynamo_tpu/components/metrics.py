@@ -90,6 +90,31 @@ class MetricsComponent:
             "spec_decode_acceptance_rate",
             "Accepted / proposed draft tokens",
         )
+        # KV data plane (streaming disagg): fleet-summed transfer counters
+        self.g_kv_wire_tx = g(
+            "kv_wire_tx_bytes", "KV wire bytes shipped (fleet sum)"
+        )
+        self.g_kv_wire_rx = g(
+            "kv_wire_rx_bytes", "KV wire bytes landed (fleet sum)"
+        )
+        self.g_kv_frames_tx = g(
+            "kv_frames_tx", "KV stream frames shipped (fleet sum)"
+        )
+        self.g_kv_frames_rx = g(
+            "kv_frames_rx", "KV stream frames landed (fleet sum)"
+        )
+        self.g_kv_frames_inflight = g(
+            "kv_frames_inflight",
+            "KV frames extracted but not yet on the wire (fleet sum)",
+        )
+        self.g_kv_overlap = g(
+            "kv_stream_overlap",
+            "Fraction of received KV bytes landed before the final frame",
+        )
+        self.g_prefill_dropped_expired = g(
+            "prefill_dropped_expired_total",
+            "Remote prefills dropped past their deadline (fleet sum)",
+        )
         self.c_hit_events = Counter(
             f"{PREFIX}_kv_hit_rate_events_total",
             "kv-hit-rate events seen",
@@ -151,6 +176,17 @@ class MetricsComponent:
                     self.g_spec_draft_tokens.set(spec.num_draft_tokens or 0)
                     self.g_spec_accepted.set(spec.num_accepted_tokens or 0)
                     self.g_spec_accept_rate.set(spec.acceptance_rate)
+                xfer = agg.kv_transfer_stats
+                if xfer is not None:
+                    self.g_kv_wire_tx.set(xfer.kv_wire_bytes_tx)
+                    self.g_kv_wire_rx.set(xfer.kv_wire_bytes_rx)
+                    self.g_kv_frames_tx.set(xfer.kv_frames_tx)
+                    self.g_kv_frames_rx.set(xfer.kv_frames_rx)
+                    self.g_kv_frames_inflight.set(xfer.kv_frames_inflight)
+                    self.g_kv_overlap.set(xfer.overlap_fraction)
+                    self.g_prefill_dropped_expired.set(
+                        xfer.prefill_dropped_expired
+                    )
             except Exception:  # noqa: BLE001 — scrape failures are transient
                 logger.exception("metrics poll failed")
             await asyncio.sleep(self.poll_interval)

@@ -5,13 +5,25 @@ through the NATS prefill queue (examples/llm/utils/prefill_queue.py,
 lib/runtime/src/transports/nats.rs:345) and of the NIXL serialized block
 descriptors (lib/llm/src/block_manager.rs:121-148).
 
-KV payloads move as raw bytes: bfloat16 has no numpy dtype, so device blocks
-are viewed as uint16 on the host and re-viewed on arrival — a pure
-reinterpret, no conversion pass.
+Two payload encodings share one self-describing container:
+
+  * ``raw``  — bit-exact logical dtype. bfloat16 has no numpy dtype, so
+    device blocks are viewed as uint16 on the host and re-viewed on
+    arrival — a pure reinterpret, no conversion pass.
+  * ``int8`` — per-(layer, head, block) absmax scales + int8 mantissas,
+    halving bytes on every KV movement path (``DYN_KV_WIRE=int8``).
+    Receivers dequantize back to the logical dtype before injection.
+
+The streaming data plane (``KvStreamFrame``) ships completed blocks per
+prefill chunk while later chunks are still computing — the TPU-native
+analogue of the reference's NIXL layer-wise transfer. Frames are keyed by
+(request_id, first_block) and idempotent: a redelivered frame overwrites
+the same decode-side blocks with identical content.
 """
 
 from __future__ import annotations
 
+import os
 from dataclasses import asdict, dataclass, field
 from typing import Any, Optional
 
@@ -26,39 +38,184 @@ _WIRE_DTYPES = {
 }
 
 
+def _logical_np_dtype(dtype: str):
+    """Numpy dtype carrying the LOGICAL values of `dtype` (ml_dtypes for
+    bf16 — import deferred so pure-wire users never pay it)."""
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        return ml_dtypes.bfloat16
+    return np.dtype(dtype)
+
+
+def wire_codec_from_env() -> str:
+    """KV wire codec knob: DYN_KV_WIRE=bf16|int8 (default bf16 = raw)."""
+    v = os.environ.get("DYN_KV_WIRE", "bf16").strip().lower()
+    return "int8" if v == "int8" else "raw"
+
+
+def as_logical(arr: np.ndarray, dtype: str) -> np.ndarray:
+    """Reinterpret a wire array (e.g. uint16 words) as its logical dtype."""
+    if dtype == "bfloat16" and arr.dtype == np.uint16:
+        return arr.view(_logical_np_dtype("bfloat16"))
+    return arr
+
+
+def kv_quantize_int8(x: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric int8 quantization over the trailing (tokens, head_dim)
+    axes: one f32 absmax scale per (..., block) slice. For the standard
+    blocks-dense [L, H, n, bs, D] layout that is a per-(layer, head, block)
+    scale — 4 bytes amortized over bs*D payload bytes."""
+    xf = np.ascontiguousarray(x, dtype=np.float32) if x.dtype != np.float32 \
+        else x
+    amax = np.max(np.abs(xf), axis=(-2, -1), keepdims=True)
+    scale = (amax / 127.0).astype(np.float32)
+    inv = np.where(scale > 0, 1.0 / np.maximum(scale, 1e-30), 0.0)
+    q = np.clip(np.rint(xf * inv), -127, 127).astype(np.int8)
+    return q, np.squeeze(scale, axis=(-2, -1))
+
+
+def kv_dequantize_int8(
+    q: np.ndarray, scales: np.ndarray, dtype: str
+) -> np.ndarray:
+    """Invert kv_quantize_int8 back to the logical dtype."""
+    xf = q.astype(np.float32) * scales[..., None, None]
+    return xf.astype(_logical_np_dtype(dtype))
+
+
 @dataclass
 class KvBlockPayload:
-    """Dense KV blocks for one sequence: k/v of shape [L, Hkv, n, bs, D]."""
+    """Dense KV blocks for one sequence: k/v of shape [L, Hkv, n, bs, D].
+
+    `codec` selects the byte encoding: "raw" (bit-exact logical dtype as
+    wire words) or "int8" (per-block-scale quantized; `k_scales`/`v_scales`
+    carry f32 scales of shape `shape[:-2]`)."""
 
     shape: tuple[int, ...]
     dtype: str  # logical dtype name ("bfloat16", ...)
     k_bytes: bytes
     v_bytes: bytes
+    codec: str = "raw"
+    k_scales: bytes = b""
+    v_scales: bytes = b""
+
+    # ------------------------------------------------------------- encode
+
+    @classmethod
+    def encode(
+        cls, k: np.ndarray, v: np.ndarray, codec: str = "raw"
+    ) -> "KvBlockPayload":
+        """Encode LOGICAL-dtype arrays (bf16 via ml_dtypes, f32, ...)."""
+        dtype = k.dtype.name
+        if codec == "int8" and dtype != "int8":
+            kq, ks = kv_quantize_int8(k)
+            vq, vs = kv_quantize_int8(v)
+            return cls(
+                shape=tuple(k.shape), dtype=dtype,
+                k_bytes=kq.tobytes(), v_bytes=vq.tobytes(),
+                codec="int8",
+                k_scales=ks.tobytes(), v_scales=vs.tobytes(),
+            )
+        wire_k = k.view(np.uint16) if dtype == "bfloat16" else k
+        wire_v = v.view(np.uint16) if dtype == "bfloat16" else v
+        return cls(shape=tuple(k.shape), dtype=dtype,
+                   k_bytes=wire_k.tobytes(), v_bytes=wire_v.tobytes())
 
     @classmethod
     def from_arrays(cls, k: np.ndarray, v: np.ndarray, dtype: str) -> "KvBlockPayload":
+        """Legacy raw-path constructor: arrays already in WIRE dtype."""
         return cls(shape=tuple(k.shape), dtype=dtype,
                    k_bytes=k.tobytes(), v_bytes=v.tobytes())
 
+    # ------------------------------------------------------------- decode
+
+    def decode(self) -> tuple[np.ndarray, np.ndarray]:
+        """Decode to LOGICAL-dtype arrays (dequantizing if int8)."""
+        if self.codec == "int8":
+            sshape = tuple(self.shape[:-2])
+            kq = np.frombuffer(self.k_bytes, np.int8).reshape(self.shape)
+            vq = np.frombuffer(self.v_bytes, np.int8).reshape(self.shape)
+            ks = np.frombuffer(self.k_scales, np.float32).reshape(sshape)
+            vs = np.frombuffer(self.v_scales, np.float32).reshape(sshape)
+            return (
+                kv_dequantize_int8(kq, ks, self.dtype),
+                kv_dequantize_int8(vq, vs, self.dtype),
+            )
+        k, v = self.to_arrays()
+        return as_logical(k, self.dtype), as_logical(v, self.dtype)
+
     def to_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """Raw-path WIRE-dtype view (legacy call sites; raw codec only)."""
         wire = _WIRE_DTYPES[self.dtype]
         k = np.frombuffer(self.k_bytes, dtype=wire).reshape(self.shape)
         v = np.frombuffer(self.v_bytes, dtype=wire).reshape(self.shape)
         return k, v
 
+    @property
+    def wire_nbytes(self) -> int:
+        """KV payload bytes actually crossing the wire."""
+        return (
+            len(self.k_bytes) + len(self.v_bytes)
+            + len(self.k_scales) + len(self.v_scales)
+        )
+
+    @property
+    def num_blocks(self) -> int:
+        return int(self.shape[2]) if len(self.shape) >= 3 else 0
+
     def to_wire(self) -> dict[str, Any]:
-        return {
+        d = {
             "shape": list(self.shape),
             "dtype": self.dtype,
             "k": self.k_bytes,
             "v": self.v_bytes,
         }
+        if self.codec != "raw":
+            d["codec"] = self.codec
+            d["ks"] = self.k_scales
+            d["vs"] = self.v_scales
+        return d
 
     @classmethod
     def from_wire(cls, d: dict[str, Any]) -> "KvBlockPayload":
         return cls(
             shape=tuple(d["shape"]), dtype=d["dtype"],
             k_bytes=d["k"], v_bytes=d["v"],
+            codec=d.get("codec", "raw"),
+            k_scales=d.get("ks", b""), v_scales=d.get("vs", b""),
+        )
+
+
+@dataclass
+class KvStreamFrame:
+    """One in-flight slice of a streaming remote prefill: the KV blocks
+    completed by one prefill chunk, shipped while later chunks compute.
+
+    Keyed by (request_id, first_block) and idempotent — queue redelivery
+    after a mid-stream prefill-worker death re-streams frames that simply
+    overwrite the decode-side blocks with identical content."""
+
+    request_id: str
+    seq: int  # frame ordinal within the stream (0-based)
+    first_block: int  # sequence-block index of payload block 0
+    payload: KvBlockPayload
+
+    def to_wire(self) -> dict[str, Any]:
+        return {
+            "kind": "frame",
+            "request_id": self.request_id,
+            "seq": self.seq,
+            "first_block": self.first_block,
+            "payload": self.payload.to_wire(),
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict[str, Any]) -> "KvStreamFrame":
+        return cls(
+            request_id=d["request_id"],
+            seq=int(d.get("seq", 0)),
+            first_block=int(d.get("first_block", 0)),
+            payload=KvBlockPayload.from_wire(d["payload"]),
         )
 
 
@@ -86,6 +243,13 @@ class RemotePrefillRequest:
     key_data: Optional[list[int]] = None  # [2] uint32 threefry row
     eos_ids: Optional[list[int]] = None
     eos_suppress: bool = False
+    # streaming data plane: ship KV frames per prefill chunk instead of one
+    # monolithic payload (workers that can't stream answer monolithically)
+    stream: bool = False
+    # absolute request deadline (epoch seconds): expired queue entries are
+    # dropped by prefill workers instead of computing KV nobody will read,
+    # and the decode-side wait is clamped to the remaining budget
+    deadline: Optional[float] = None
     # opaque routing/annotation extras
     extra: dict[str, Any] = field(default_factory=dict)
 
@@ -100,17 +264,26 @@ class RemotePrefillRequest:
 
 @dataclass
 class RemotePrefillResponse:
-    """Published by the prefill worker to the reply subject."""
+    """Published by the prefill worker to the reply subject.
+
+    On the streaming path this is the FINAL frame: earlier KV already
+    landed through KvStreamFrames, so `payload` carries only the blocks
+    not yet streamed (always at least the partial tail block) and
+    `streamed_blocks` records how many blocks the stream shipped."""
 
     request_id: str
     first_token: int
-    # dense blocks covering blocks [cached_blocks : ceil(T/bs)) — includes
-    # the partial tail block (its unused slots are whatever the prefill
-    # wrote there; decode attention masks by position, so they never read)
+    # dense blocks covering blocks [first_block : ...) — includes the
+    # partial tail block (its unused slots are whatever the prefill wrote
+    # there; decode attention masks by position, so they never read)
     payload: Optional[KvBlockPayload] = None
     # index (within the sequence) of the first block in the payload
     first_block: int = 0
     error: Optional[str] = None
+    # machine-readable error class ("deadline_exceeded", "cancelled", ...)
+    code: Optional[str] = None
+    # blocks already shipped via KvStreamFrames before this final frame
+    streamed_blocks: int = 0
     # logprob surface for the first sampled token (None when the requester
     # didn't ask — keeps the wire lean)
     first_logprob: Optional[float] = None
@@ -123,6 +296,8 @@ class RemotePrefillResponse:
             "payload": self.payload.to_wire() if self.payload else None,
             "first_block": self.first_block,
             "error": self.error,
+            "code": self.code,
+            "streamed_blocks": self.streamed_blocks,
             "first_logprob": self.first_logprob,
             "first_top": self.first_top,
         }
@@ -136,6 +311,8 @@ class RemotePrefillResponse:
             payload=KvBlockPayload.from_wire(p) if p else None,
             first_block=d.get("first_block", 0),
             error=d.get("error"),
+            code=d.get("code"),
+            streamed_blocks=d.get("streamed_blocks", 0),
             first_logprob=d.get("first_logprob"),
             first_top=d.get("first_top"),
         )

@@ -5,10 +5,14 @@ TPU-native replacement for the reference's NIXL RDMA data plane
 (lib/llm/src/kernels/block_copy.cu):
 
   * `RemotePrefillClient` — decode-worker side: subscribes a private reply
-    subject, enqueues work, resolves responses to futures (the reference's
-    completion-notify over NIXL metadata + NATS).
-  * `PrefillWorkerService` — prefill-worker side: pulls from the queue, runs
-    the engine's prefill, ships blocks back, acks.
+    subject, enqueues work, lands streamed KV frames as they arrive, and
+    resolves final responses to futures (the reference's completion-notify
+    over NIXL metadata + NATS).
+  * `PrefillWorkerService` — prefill-worker side: pulls from the queue,
+    runs the engine's prefill — STREAMING completed KV blocks per prefill
+    chunk when both sides support it (the reference's layer-wise NIXL
+    transfer, here chunk-wise), with a bounded in-flight frame window for
+    backpressure — ships the final frame, acks.
   * dtype helpers — bfloat16 crosses the host boundary as uint16 views
     (pure reinterpret; ml_dtypes restores the logical dtype on arrival).
 
@@ -25,8 +29,12 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import os
+import time
 import uuid
-from typing import Any, Optional
+from collections import deque
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable, Optional
 
 import msgpack
 import numpy as np
@@ -34,6 +42,7 @@ import numpy as np
 from dynamo_tpu.disagg.prefill_queue import PrefillQueue
 from dynamo_tpu.disagg.protocols import (
     KvBlockPayload,
+    KvStreamFrame,
     RemotePrefillRequest,
     RemotePrefillResponse,
 )
@@ -41,6 +50,40 @@ from dynamo_tpu.fabric.client import FabricClient
 from dynamo_tpu.runtime.logging import get_logger
 
 logger = get_logger("dynamo_tpu.disagg.transfer")
+
+
+def _cancel_subject(namespace: str) -> str:
+    return f"{namespace}.prefill_cancel"
+
+
+def frame_window_from_env() -> int:
+    """Bounded in-flight frames per stream (DYN_KV_FRAME_WINDOW, default 4):
+    the prefill worker computes at most this many frames ahead of the wire,
+    so a slow fabric backpressures chunk compute instead of buffering the
+    whole prompt's KV in host RAM."""
+    try:
+        return max(1, int(os.environ.get("DYN_KV_FRAME_WINDOW", "4") or 4))
+    except ValueError:
+        return 4
+
+
+class PrefillStreamCancelled(Exception):
+    """The requesting sequence was killed while its remote prefill was in
+    flight — distinct from transport failure so the engine tears the
+    sequence down instead of falling back to a local prefill."""
+
+
+@dataclass
+class TransferStats:
+    """One side's KV data-plane counters (monotonic unless noted)."""
+
+    frames_tx: int = 0
+    frames_rx: int = 0
+    bytes_tx: int = 0
+    bytes_rx: int = 0
+    frames_inflight: int = 0  # gauge: frames extracted but not yet on wire
+    dropped_expired: int = 0  # queue entries dropped past their deadline
+    streams_cancelled: int = 0  # streams torn down by requester cancel
 
 
 def to_wire_array(arr: np.ndarray) -> np.ndarray:
@@ -76,8 +119,13 @@ class RemotePrefillClient:
         self.queue = PrefillQueue(fabric, namespace)
         self.reply_subject = f"{namespace}.prefill_reply.{uuid.uuid4().hex[:12]}"
         self._pending: dict[str, asyncio.Future] = {}
+        # request_id -> async frame handler for in-flight streaming prefills
+        self._frame_handlers: dict[
+            str, Callable[[KvStreamFrame], Awaitable[None]]
+        ] = {}
         self._sub = None
         self._pump_task: Optional[asyncio.Task] = None
+        self.stats = TransferStats()
 
     async def start(self) -> None:
         self._sub = await self._fabric.subscribe(self.reply_subject)
@@ -86,12 +134,27 @@ class RemotePrefillClient:
             assert self._sub is not None
             async for _subject, payload in self._sub:
                 try:
-                    resp = RemotePrefillResponse.from_wire(
-                        msgpack.unpackb(payload, raw=False)
-                    )
-                except (ValueError, KeyError) as e:
+                    d = msgpack.unpackb(payload, raw=False)
+                    if isinstance(d, dict) and d.get("kind") == "frame":
+                        # Streamed KV frame: land it BEFORE consuming the
+                        # next message — the fabric delivers in publish
+                        # order, so when the final response resolves, every
+                        # frame sent before it has already been injected.
+                        frame = KvStreamFrame.from_wire(d)
+                        self.stats.frames_rx += 1
+                        self.stats.bytes_rx += frame.payload.wire_nbytes
+                        handler = self._frame_handlers.get(frame.request_id)
+                        if handler is not None:
+                            await handler(frame)
+                        continue
+                    resp = RemotePrefillResponse.from_wire(d)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — malformed wire data
                     logger.warning("bad prefill response dropped: %s", e)
                     continue
+                if resp.payload is not None:
+                    self.stats.bytes_rx += resp.payload.wire_nbytes
                 fut = self._pending.pop(resp.request_id, None)
                 if fut is not None and not fut.done():
                     fut.set_result(resp)
@@ -109,6 +172,16 @@ class RemotePrefillClient:
             if not fut.done():
                 fut.cancel()
         self._pending.clear()
+        self._frame_handlers.clear()
+
+    async def _send_cancel(self, request_id: str) -> None:
+        """Best-effort stream teardown: prefill workers drop/abort the
+        request so they stop computing and shipping KV nobody will read."""
+        with contextlib.suppress(Exception):
+            await self._fabric.publish(
+                _cancel_subject(self.namespace),
+                msgpack.packb({"request_id": request_id}, use_bin_type=True),
+            )
 
     async def prefill(
         self,
@@ -122,12 +195,27 @@ class RemotePrefillClient:
         key_data=None,
         eos_ids=None,
         eos_suppress: bool = False,
+        stream: bool = False,
+        on_frame: Optional[
+            Callable[[KvStreamFrame], Awaitable[None]]
+        ] = None,
+        deadline: Optional[float] = None,
+        ctx: Any = None,
         extra: Optional[dict[str, Any]] = None,
     ) -> RemotePrefillResponse:
-        """Enqueue a remote prefill and await its response."""
+        """Enqueue a remote prefill and await its final response.
+
+        With `stream=True` + `on_frame`, intermediate KV frames are handed
+        to `on_frame` as they arrive (in order, before the final response
+        resolves). The wait honors the per-request `deadline` (absolute
+        epoch seconds) instead of only the flat client timeout, and a
+        killed `ctx` tears the stream down on both sides
+        (PrefillStreamCancelled)."""
         rid = uuid.uuid4().hex
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
+        if stream and on_frame is not None:
+            self._frame_handlers[rid] = on_frame
         req = RemotePrefillRequest(
             request_id=rid,
             token_ids=list(token_ids),
@@ -141,24 +229,57 @@ class RemotePrefillClient:
             key_data=[int(x) for x in key_data] if key_data is not None else None,
             eos_ids=[int(x) for x in eos_ids] if eos_ids is not None else None,
             eos_suppress=bool(eos_suppress),
+            stream=bool(stream and on_frame is not None),
+            deadline=float(deadline) if deadline is not None else None,
             extra=extra or {},
         )
+        # the per-request budget wins over the flat client timeout: a
+        # request with 3 s left must not camp on the queue for 120 s
+        timeout = self.timeout
+        if deadline is not None:
+            timeout = max(0.05, min(timeout, deadline - time.time()))
         try:
             await self.queue.enqueue(req)
-            return await asyncio.wait_for(fut, timeout=self.timeout)
+            if ctx is None:
+                return await asyncio.wait_for(fut, timeout=timeout)
+            # poll the requester's cancellation while waiting so a killed
+            # sequence tears the stream down instead of riding out the
+            # full timeout (PR 3's deadline cascade reaches the data plane)
+            end = time.monotonic() + timeout
+            while True:
+                if ctx.is_killed() or ctx.is_stopped():
+                    await self._send_cancel(rid)
+                    self.stats.streams_cancelled += 1
+                    raise PrefillStreamCancelled(rid)
+                remaining = end - time.monotonic()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError(
+                        f"remote prefill {rid} timed out"
+                    )
+                try:
+                    return await asyncio.wait_for(
+                        asyncio.shield(fut), timeout=min(0.1, remaining)
+                    )
+                except asyncio.TimeoutError:
+                    continue
         except BaseException:
             self._pending.pop(rid, None)
             raise
+        finally:
+            self._frame_handlers.pop(rid, None)
 
 
 class PrefillWorkerService:
-    """Prefill-worker loop: dequeue -> engine.prefill_only -> reply -> ack.
+    """Prefill-worker loop: dequeue -> engine prefill -> reply -> ack.
 
     `engine` is anything exposing
         async prefill_only(req: RemotePrefillRequest) -> RemotePrefillResponse
-    (JaxEngine implements it; tests use fakes). Unacked work is redelivered
-    by the fabric queue if this worker dies mid-prefill — the elasticity
-    property the reference gets from JetStream.
+    and optionally
+        async prefill_only_stream(req, emit, cancelled) -> Response | None
+    (JaxEngine implements both; tests use fakes). Unacked work is
+    redelivered by the fabric queue if this worker dies mid-prefill — the
+    elasticity property the reference gets from JetStream; streamed frames
+    are idempotent so the re-served stream simply overwrites them.
     """
 
     def __init__(
@@ -167,17 +288,45 @@ class PrefillWorkerService:
         namespace: str,
         engine: Any,
         max_inflight: int = 2,
+        frame_window: Optional[int] = None,
     ) -> None:
         self._fabric = fabric
+        self.namespace = namespace
         self.queue = PrefillQueue(fabric, namespace)
         self.engine = engine
+        self.frame_window = frame_window or frame_window_from_env()
         self._sem = asyncio.Semaphore(max_inflight)
         self._task: Optional[asyncio.Task] = None
         self._inflight: set[asyncio.Task] = set()
         self._stopped = asyncio.Event()
         self.served = 0
+        self.stats = TransferStats()
+        # requester-side cancellations (bounded memory: old ids age out)
+        self._cancelled: set[str] = set()
+        self._cancel_order: deque[str] = deque()
+        self._cancel_sub = None
+        self._cancel_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
+        self._cancel_sub = await self._fabric.subscribe(
+            _cancel_subject(self.namespace)
+        )
+
+        async def cancel_pump() -> None:
+            assert self._cancel_sub is not None
+            async for _subject, payload in self._cancel_sub:
+                try:
+                    rid = msgpack.unpackb(payload, raw=False)["request_id"]
+                except Exception:  # noqa: BLE001 — malformed cancel
+                    continue
+                self._cancelled.add(rid)
+                self._cancel_order.append(rid)
+                while len(self._cancel_order) > 1024:
+                    self._cancelled.discard(self._cancel_order.popleft())
+
+        self._cancel_task = asyncio.get_running_loop().create_task(
+            cancel_pump()
+        )
         self._task = asyncio.get_running_loop().create_task(self._loop())
 
     async def _loop(self) -> None:
@@ -207,19 +356,109 @@ class PrefillWorkerService:
             self._inflight.add(t)
             t.add_done_callback(self._inflight.discard)
 
+    # ------------------------------------------------------------- serving
+
+    def _is_cancelled(self, req: RemotePrefillRequest) -> bool:
+        return req.request_id in self._cancelled or (
+            req.deadline is not None and time.time() > req.deadline
+        )
+
+    def _bump_engine_stat(self, attr: str, delta: int) -> None:
+        """Mirror data-plane counters onto the engine's stats object so
+        they ride the existing load_metrics plane to the aggregator."""
+        stats = getattr(self.engine, "stats", None)
+        if stats is not None and hasattr(stats, attr):
+            setattr(stats, attr, getattr(stats, attr) + delta)
+
+    def _make_emit(
+        self, req: RemotePrefillRequest
+    ) -> tuple[Callable[[KvStreamFrame], Awaitable[None]], Callable]:
+        """(emit, drain) pair for one stream. `emit` publishes a frame in
+        the background, bounded to `frame_window` unpublished frames (a
+        slow wire backpressures chunk compute instead of buffering the
+        whole prompt's KV); `drain` awaits every outstanding publish so
+        the final response is provably sent after the last frame."""
+        sem = asyncio.Semaphore(self.frame_window)
+        tasks: list[asyncio.Task] = []
+
+        async def emit(frame: KvStreamFrame) -> None:
+            await sem.acquire()
+            self.stats.frames_inflight += 1
+            self._bump_engine_stat("kv_frames_inflight", 1)
+            data = msgpack.packb(frame.to_wire(), use_bin_type=True)
+
+            async def publish() -> None:
+                try:
+                    await self._fabric.publish(req.reply_subject, data)
+                    self.stats.frames_tx += 1
+                    self.stats.bytes_tx += frame.payload.wire_nbytes
+                finally:
+                    self.stats.frames_inflight -= 1
+                    self._bump_engine_stat("kv_frames_inflight", -1)
+                    sem.release()
+
+            tasks.append(asyncio.get_running_loop().create_task(publish()))
+
+        async def drain() -> None:
+            if tasks:
+                await asyncio.gather(*tasks, return_exceptions=True)
+
+        return emit, drain
+
+    async def _run_prefill(
+        self, req: RemotePrefillRequest
+    ) -> Optional[RemotePrefillResponse]:
+        """Serve one request; None means the stream was torn down by a
+        requester cancel (nothing to publish)."""
+        if req.deadline is not None and time.time() > req.deadline:
+            # expired while queued: don't burn prefill compute on KV
+            # nobody will consume — tell the requester and move on
+            self.stats.dropped_expired += 1
+            self._bump_engine_stat("prefill_dropped_expired", 1)
+            return RemotePrefillResponse(
+                request_id=req.request_id, first_token=-1,
+                error="deadline expired in prefill queue",
+                code="deadline_exceeded",
+            )
+        if req.request_id in self._cancelled:
+            self.stats.streams_cancelled += 1
+            return RemotePrefillResponse(
+                request_id=req.request_id, first_token=-1,
+                error="cancelled by requester", code="cancelled",
+            )
+        streaming = bool(req.stream) and hasattr(
+            self.engine, "prefill_only_stream"
+        )
+        try:
+            if streaming:
+                emit, drain = self._make_emit(req)
+                try:
+                    resp = await self.engine.prefill_only_stream(
+                        req, emit, cancelled=lambda: self._is_cancelled(req)
+                    )
+                finally:
+                    # final response must hit the wire AFTER every frame
+                    await drain()
+                if resp is None:
+                    self.stats.streams_cancelled += 1
+                return resp
+            return await self.engine.prefill_only(req)
+        except Exception as e:  # noqa: BLE001 - error crosses the wire
+            logger.exception("remote prefill %s failed", req.request_id)
+            return RemotePrefillResponse(
+                request_id=req.request_id, first_token=-1, error=str(e)
+            )
+
     async def _serve_one(self, msg_id: int, req: RemotePrefillRequest) -> None:
         try:
-            try:
-                resp = await self.engine.prefill_only(req)
-            except Exception as e:  # noqa: BLE001 - error crosses the wire
-                logger.exception("remote prefill %s failed", req.request_id)
-                resp = RemotePrefillResponse(
-                    request_id=req.request_id, first_token=-1, error=str(e)
+            resp = await self._run_prefill(req)
+            if resp is not None:
+                if resp.payload is not None:
+                    self.stats.bytes_tx += resp.payload.wire_nbytes
+                await self._fabric.publish(
+                    req.reply_subject,
+                    msgpack.packb(resp.to_wire(), use_bin_type=True),
                 )
-            await self._fabric.publish(
-                req.reply_subject,
-                msgpack.packb(resp.to_wire(), use_bin_type=True),
-            )
             await self.queue.ack(msg_id)
             self.served += 1
         finally:
@@ -231,6 +470,12 @@ class PrefillWorkerService:
             self._task.cancel()
             with contextlib.suppress(asyncio.CancelledError):
                 await self._task
+        if self._cancel_sub is not None:
+            await self._cancel_sub.unsubscribe()
+        if self._cancel_task is not None:
+            self._cancel_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._cancel_task
         for t in list(self._inflight):
             t.cancel()
             with contextlib.suppress(asyncio.CancelledError):

@@ -134,10 +134,28 @@ class EngineStats:
     # stuck-horizon watchdog trips
     deadline_exceeded: int = 0
     watchdog_trips: int = 0
+    # KV data-plane counters (streaming disagg, PR 4): tx = this worker in
+    # its prefill role shipping frames; rx = this worker in its decode
+    # role landing them. kv_bytes_overlapped counts payload bytes that
+    # landed BEFORE the final frame — i.e. transfer hidden behind the
+    # prefill compute still running on the remote worker.
+    kv_frames_tx: int = 0
+    kv_frames_rx: int = 0
+    kv_wire_bytes_tx: int = 0
+    kv_wire_bytes_rx: int = 0
+    kv_bytes_overlapped: int = 0
+    kv_frames_inflight: int = 0  # gauge (prefill role, bounded window)
+    prefill_dropped_expired: int = 0  # queue entries dropped past deadline
 
     @property
     def kv_usage(self) -> float:
         return self.used_blocks / max(1, self.total_blocks)
+
+    @property
+    def kv_stream_overlap(self) -> float:
+        """Fraction of received KV wire bytes that landed before the final
+        frame (transfer overlapped behind remote prefill compute)."""
+        return self.kv_bytes_overlapped / max(1, self.kv_wire_bytes_rx)
 
     @property
     def draft_acceptance_rate(self) -> float:
@@ -656,7 +674,10 @@ class JaxEngine:
         if self._offload_queue is not None:
             # queued candidates now point at blocks about to be recycled;
             # drop them so their hashes can re-enqueue via another holder
-            self._offload_queue.forget_seq(seq)
+            self._offload_queue.forget_seq(
+                seq,
+                cancelled=seq.ctx.is_killed() or seq.ctx.is_stopped(),
+            )
         if seq.slot is not None:
             self.slots[seq.slot] = None
             seq.slot = None
@@ -1244,16 +1265,62 @@ class JaxEngine:
             top_lps = np.array([l for _, l in top], np.float32) if top else None
             self._append_token(seq, token, lp=lp, top_ids=top_ids, top_lps=top_lps)
 
+    def _kv_stream_enabled(self) -> bool:
+        """Streaming KV data plane default-on (DYN_KV_STREAM=0 reverts to
+        the monolithic single-response path)."""
+        return os.environ.get("DYN_KV_STREAM", "1") not in (
+            "0", "false", "no",
+        )
+
+    async def _land_stream_frame(
+        self, seq: _Sequence, frame, loop, landed: Optional[set] = None
+    ) -> None:
+        """Onboard one in-flight KV frame through the sharding-aware jitted
+        scatter while later prefill chunks still compute remotely. Frames
+        are keyed by (request_id, first_block) and idempotent: redelivered
+        frames overwrite the same blocks with identical content."""
+        if seq.slot is None or seq.ctx.is_killed() or seq.ctx.is_stopped():
+            return  # cancelled mid-stream: drop the frame on the floor
+        k, v = frame.payload.decode()
+        n = k.shape[2]
+        ids = seq.block_ids[frame.first_block : frame.first_block + n]
+        if not ids:
+            return
+        async with self._device_lock:
+            await loop.run_in_executor(
+                None, self.runner.inject_blocks, ids, k[:, :, : len(ids)],
+                v[:, :, : len(ids)],
+            )
+        if landed is not None:
+            landed.update(range(frame.first_block, frame.first_block + len(ids)))
+        self.stats.kv_frames_rx += 1
+        nbytes = frame.payload.wire_nbytes
+        self.stats.kv_wire_bytes_rx += nbytes
+        # landed while the remote prefill was still running: this
+        # transfer was hidden behind compute
+        self.stats.kv_bytes_overlapped += nbytes
+
     async def _remote_prefill_task(self, seq: _Sequence) -> None:
         """Await a remote prefill, land its KV, and enter the decode batch.
 
         Mirrors the decode-worker half of the reference's disagg flow
         (examples/llm/components/worker.py): enqueue -> prefill fleet runs ->
         computed blocks arrive -> request joins the in-flight decode batch.
-        Falls back to local prefill on any remote error.
+        KV arrives as chunk-granular frames landed incrementally while the
+        remote prefill computes (monolithic single-payload when either side
+        can't stream). Falls back to local prefill on any remote error;
+        a killed sequence tears the stream down on both sides instead.
         """
+        from dynamo_tpu.disagg.transfer import PrefillStreamCancelled
+
         loop = asyncio.get_running_loop()
         cached = await self._onboard_prefix(seq, loop)
+        stream = self._kv_stream_enabled()
+        landed_blocks: set[int] = set()
+
+        async def on_frame(frame) -> None:
+            await self._land_stream_frame(seq, frame, loop, landed_blocks)
+
         try:
             resp = await self.remote_prefill_client.prefill(
                 seq.token_ids,
@@ -1265,7 +1332,17 @@ class JaxEngine:
                 key_data=self._key_row(seq),
                 eos_ids=seq.eos_row,
                 eos_suppress=seq.needs_eos_suppress,
+                stream=stream,
+                on_frame=on_frame if stream else None,
+                deadline=seq.ctx.deadline,
+                ctx=seq.ctx,
             )
+        except PrefillStreamCancelled:
+            # requester cancelled (kill/deadline cascade): no local
+            # fallback — finish the sequence and free its blocks
+            self._landed.append((seq, None, FinishReason.CANCELLED))
+            self._wake.set()
+            return
         except asyncio.CancelledError:
             if self._closed:
                 raise  # engine shutdown cancelled us: propagate
@@ -1275,8 +1352,31 @@ class JaxEngine:
         except Exception as e:  # noqa: BLE001 — any transport failure
             logger.warning("remote prefill failed (%s); falling back local", e)
             resp = None
+        if resp is not None and resp.code == "deadline_exceeded":
+            # the prefill fleet dropped it as expired; don't burn local
+            # compute either — the reaper's structured error fires next tick
+            seq.ctx.kill()
+            self._landed.append((seq, None, FinishReason.CANCELLED))
+            self._wake.set()
+            return
         if seq.slot is None:  # cancelled/finished while in flight
             return
+        if seq.ctx.is_killed() or seq.ctx.is_stopped():
+            self._landed.append((seq, None, FinishReason.CANCELLED))
+            self._wake.set()
+            return
+        if resp is not None and resp.error is None and resp.streamed_blocks:
+            # the fabric's pub/sub is at-most-once: a frame lost in a
+            # failover window would leave a silent KV hole. The final
+            # frame declares the streamed span — verify coverage and fall
+            # back to a local prefill rather than decode against garbage.
+            missing = set(range(cached, resp.first_block)) - landed_blocks
+            if missing:
+                logger.warning(
+                    "seq %d: stream lost %d frame block(s); falling back "
+                    "to local prefill", seq.seq_id, len(missing),
+                )
+                resp = None
         if faults.active():
             inj = faults.get_injector()
             if inj is not None:
@@ -1322,8 +1422,6 @@ class JaxEngine:
         """Device-side landing only: inject blocks / fallback prefill.
         Returns (first_token, logprob | None, top | None); scheduler-visible
         completion happens later in _process_landed on the engine loop."""
-        from dynamo_tpu.disagg.transfer import from_wire_array
-
         if resp is not None and resp.error is None:
             if getattr(resp, "k_dev", None) is not None:
                 # device-native payload (colocated P/D): blocks move
@@ -1344,10 +1442,10 @@ class JaxEngine:
                 return (resp.first_token, resp.first_logprob, resp.first_top)
             if resp.payload is not None:
                 # payload may be absent when every shippable block was a
-                # prefix hit already sitting in this worker's cache
-                k, v = resp.payload.to_arrays()
-                k = from_wire_array(k, resp.payload.dtype)
-                v = from_wire_array(v, resp.payload.dtype)
+                # prefix hit already sitting in this worker's cache; on the
+                # streaming path this is only the not-yet-streamed tail
+                k, v = resp.payload.decode()
+                self.stats.kv_wire_bytes_rx += resp.payload.wire_nbytes
                 ids = seq.block_ids[
                     resp.first_block : resp.first_block + k.shape[2]
                 ]
@@ -1392,8 +1490,8 @@ class JaxEngine:
         from dynamo_tpu.disagg.protocols import (
             KvBlockPayload,
             RemotePrefillResponse,
+            wire_codec_from_env,
         )
-        from dynamo_tpu.disagg.transfer import to_wire_array
 
         loop = asyncio.get_running_loop()
         bs = self.config.block_size
@@ -1440,15 +1538,145 @@ class JaxEngine:
                     )
             payload = None
             if ship:
-                payload = KvBlockPayload.from_arrays(
-                    to_wire_array(k), to_wire_array(v), k.dtype.name
-                )
+                payload = KvBlockPayload.encode(k, v, wire_codec_from_env())
+                self.stats.kv_wire_bytes_tx += payload.wire_nbytes
             self.stats.generated_tokens += 1
             return RemotePrefillResponse(
                 request_id=req.request_id,
                 first_token=int(tok_arr),
                 payload=payload,
                 first_block=req.cached_blocks,
+                first_logprob=float(lp_arr),
+                first_top=[
+                    [int(t), float(l)] for t, l in zip(tids_arr, tlps_arr)
+                ],
+            )
+        finally:
+            self.allocator.free(block_ids)
+
+    async def prefill_only_stream(
+        self, req: Any, emit, cancelled: Optional[Callable[[], bool]] = None
+    ) -> Optional[Any]:
+        """Streaming prefill-worker role: run the prompt through the
+        chunked-prefill program and `emit` a KvStreamFrame of completed
+        blocks after each chunk, while the NEXT chunk's dispatch is already
+        queued on device — the publish (wire transfer) overlaps chunk
+        compute, so by the time the final frame (first token + tail blocks)
+        is published there is ~nothing left to transfer.
+
+        `emit` may await (bounded-window backpressure upstream). A truthy
+        `cancelled()` between chunks aborts the stream: scratch blocks are
+        freed and None is returned (nothing published, caller just acks).
+        Prompts that fit one chunk fall back to the monolithic
+        prefill_only — same wire contract, no frame overhead."""
+        from dynamo_tpu.disagg.protocols import (
+            KvBlockPayload,
+            KvStreamFrame,
+            RemotePrefillResponse,
+            wire_codec_from_env,
+        )
+
+        loop = asyncio.get_running_loop()
+        bs = self.config.block_size
+        T = len(req.token_ids)
+        chunk_c = getattr(self.runner, "prefill_chunk_tokens", 0)
+        if not chunk_c or T <= chunk_c:
+            return await self.prefill_only(req)
+        if T > self.config.max_model_len:
+            return RemotePrefillResponse(
+                request_id=req.request_id,
+                first_token=-1,
+                error=f"prompt {T} exceeds max_model_len",
+            )
+        codec = wire_codec_from_env()
+        extract = getattr(
+            self.runner, "extract_blocks_tight", self.runner.extract_blocks
+        )
+        key_data = (
+            np.asarray(req.key_data, np.uint32)
+            if getattr(req, "key_data", None) is not None
+            else None
+        )
+        eos_ids = (
+            np.asarray(req.eos_ids, np.int32)
+            if getattr(req, "eos_ids", None) is not None
+            else None
+        )
+        need = (T + bs - 1) // bs
+        block_ids = self.allocator.alloc(need)
+        # cached leading blocks already sit in the requester's cache and
+        # are never shipped; `shipped` is the block cursor on the wire
+        shipped = min(int(getattr(req, "cached_blocks", 0) or 0), need - 1)
+        streamed = 0
+        frame_seq = 0
+        try:
+            out = None
+            pos = 0
+            while pos < T:
+                if cancelled is not None and cancelled():
+                    return None
+                chunk = req.token_ids[pos : pos + chunk_c]
+                final = pos + len(chunk) >= T
+
+                async with self._device_lock:
+                    def run_chunk(chunk=chunk, start=pos):
+                        return self.runner.prefill_chunk(
+                            chunk, start, T, block_ids,
+                            req.temperature, req.top_p, req.top_k,
+                            rep_pen=getattr(req, "rep_pen", 1.0),
+                            key_data=key_data,
+                            eos_ids=eos_ids,
+                            eos_suppress=getattr(req, "eos_suppress", False),
+                        )
+
+                    out = await self._dispatch("prefill_chunk", run_chunk)
+                pos += len(chunk)
+                # ship the blocks this chunk completed (the partial tail
+                # stays for the final frame so the decode side has exactly
+                # one landing point per block) — the publish runs in the
+                # background while the next chunk computes
+                upto = pos // bs
+                if not final and upto > shipped:
+                    ids = block_ids[shipped:upto]
+                    async with self._device_lock:
+                        k, v = await loop.run_in_executor(None, extract, ids)
+                    payload = KvBlockPayload.encode(k, v, codec)
+                    frame = KvStreamFrame(
+                        request_id=req.request_id,
+                        seq=frame_seq,
+                        first_block=shipped,
+                        payload=payload,
+                    )
+                    frame_seq += 1
+                    streamed += len(ids)
+                    self.stats.kv_frames_tx += 1
+                    self.stats.kv_wire_bytes_tx += payload.wire_nbytes
+                    await emit(frame)
+                    shipped = upto
+            if cancelled is not None and cancelled():
+                return None
+            # final frame: first token (+ logprob surface) and every block
+            # not yet streamed — at minimum the partial tail block
+            async with self._device_lock:
+                sample = await loop.run_in_executor(
+                    None, lambda: self.runner.fetch_sample(out)
+                )
+                ship = block_ids[shipped:]
+                k = v = None
+                if ship:
+                    k, v = await loop.run_in_executor(None, extract, ship)
+            tok_arr, lp_arr, tids_arr, tlps_arr = sample
+            payload = None
+            if ship:
+                payload = KvBlockPayload.encode(k, v, codec)
+                self.stats.kv_wire_bytes_tx += payload.wire_nbytes
+            self.stats.generated_tokens += 1
+            return RemotePrefillResponse(
+                request_id=req.request_id,
+                first_token=int(tok_arr),
+                payload=payload,
+                first_block=shipped,
+                streamed_blocks=streamed,
                 first_logprob=float(lp_arr),
                 first_top=[
                     [int(t), float(l)] for t, l in zip(tids_arr, tlps_arr)

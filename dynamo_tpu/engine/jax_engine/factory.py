@@ -203,21 +203,30 @@ def _maybe_block_manager(config, kv_block_size: int):
         head_dim=config.head_dim,
         dtype="bfloat16",
     )
-    host_blocks = max(1, int(gb * 2**30 // layout.block_nbytes))
+    from dynamo_tpu.disagg.protocols import wire_codec_from_env
+
+    # DYN_KV_WIRE=int8 halves tier bytes (per-block-scale quantized
+    # storage), so the same GB budget holds twice the blocks
+    codec = wire_codec_from_env()
+    block_nbytes = layout.block_nbytes
+    if codec == "int8":
+        block_nbytes = block_nbytes // layout.itemsize  # int8 mantissas
+    host_blocks = max(1, int(gb * 2**30 // block_nbytes))
     disk_dir = os.environ.get("DYN_KV_DISK_DIR") or None
     disk_blocks = 0
     if disk_dir:
         disk_gb = float(os.environ.get("DYN_KV_DISK_GB", "0") or 0)
-        disk_blocks = int(disk_gb * 2**30 // layout.block_nbytes)
+        disk_blocks = int(disk_gb * 2**30 // block_nbytes)
     logger.info(
-        "KV offload tiers: host %d blocks (%.2f GiB)%s",
-        host_blocks, gb,
+        "KV offload tiers: host %d blocks (%.2f GiB, codec %s)%s",
+        host_blocks, gb, codec,
         f", disk at {disk_dir} ({disk_blocks or 'unbounded'} blocks)"
         if disk_dir else "",
     )
     return TieredBlockManager(
         layout, host_blocks=host_blocks,
         disk_dir=disk_dir, disk_blocks=disk_blocks,
+        wire_codec=codec,
     )
 
 

@@ -1161,6 +1161,29 @@ class ModelRunner:
         )
         return self._fetch(k)[:, :, :n], self._fetch(v)[:, :, :n]
 
+    def extract_blocks_tight(
+        self, block_ids: list[int]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """extract_blocks with tight padding for the streaming data plane.
+
+        Per-chunk frames gather only a handful of blocks; padding those to
+        the prompt's PREFILL bucket (what extract_blocks does — right for
+        whole-sequence ships) would make every small frame pay a
+        bucket-sized gather + fetch. Pad to the next power of two instead,
+        capped at the bucket pad: compiled-program count stays O(log n),
+        frame extracts stay O(frame)."""
+        n = len(block_ids)
+        pow2 = 1
+        while pow2 < n:
+            pow2 <<= 1
+        padded = min(pow2, self._pad_block_count(n))
+        ids = np.zeros(padded, np.int32)
+        ids[:n] = block_ids
+        k, v = self._extract_jit(
+            self.k_cache, self.v_cache, self._to_dev(ids)
+        )
+        return self._fetch(k)[:, :, :n], self._fetch(v)[:, :, :n]
+
     def extract_blocks_device(
         self, block_ids: list[int]
     ) -> tuple[jax.Array, jax.Array, int]:

@@ -126,6 +126,10 @@ async def run_http(
         stats = getattr(config.engine, "stats", None)
         if stats is not None and getattr(stats, "num_spec_tokens", 0):
             service.metrics.attach_spec_stats(stats)
+        # KV data-plane counters ride the same lazy-gauge path (the
+        # colocated engine may act as decode OR prefill worker)
+        if stats is not None and hasattr(stats, "kv_wire_bytes_rx"):
+            service.metrics.attach_kv_transfer_stats(stats)
         # admission watermark for the colocated engine follows its slot
         # count (dynamic mode gets this from the discovery capacity poller)
         if stats is not None:
@@ -319,6 +323,7 @@ async def run_endpoint(
     from dynamo_tpu.kv_router.protocols import (
         ForwardPassMetrics,
         KvStats,
+        KvTransferStats,
         SpecDecodeStats,
         WorkerStats,
     )
@@ -369,6 +374,27 @@ async def run_endpoint(
                     list(d.get("accepted_per_pos") or []) or None
                 ),
             )
+        xfer = None
+        if any(
+            d.get(f)
+            for f in (
+                "kv_frames_tx", "kv_frames_rx",
+                "kv_wire_bytes_tx", "kv_wire_bytes_rx",
+                "prefill_dropped_expired",
+            )
+        ):
+            # KV data plane live on this worker (prefill or decode role):
+            # ship the transfer counters so /metrics surfaces fleet-wide
+            # bytes shipped, frames in flight, and overlap fraction
+            xfer = KvTransferStats(
+                kv_frames_tx=d.get("kv_frames_tx", 0),
+                kv_frames_rx=d.get("kv_frames_rx", 0),
+                kv_wire_bytes_tx=d.get("kv_wire_bytes_tx", 0),
+                kv_wire_bytes_rx=d.get("kv_wire_bytes_rx", 0),
+                kv_bytes_overlapped=d.get("kv_bytes_overlapped", 0),
+                kv_frames_inflight=d.get("kv_frames_inflight", 0),
+                prefill_dropped_expired=d.get("prefill_dropped_expired", 0),
+            )
         return ForwardPassMetrics(
             worker_stats=WorkerStats(
                 request_active_slots=d.get("active_slots", 0),
@@ -383,6 +409,7 @@ async def run_endpoint(
                 gpu_cache_usage_perc=used / total,
             ),
             spec_decode_stats=spec,
+            kv_transfer_stats=xfer,
         )
 
     if stats_fn is not None:

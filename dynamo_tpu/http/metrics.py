@@ -137,6 +137,46 @@ class ServiceMetrics:
         )
         rate.set_function(read("num_accepted_tokens", "num_draft_tokens"))
 
+    def attach_kv_transfer_stats(self, stats_src) -> None:
+        """Surface a colocated engine's KV data-plane counters (streaming
+        disagg, PR 4): wire bytes shipped/landed, frames in flight, and
+        the fraction of transfer hidden behind remote prefill compute.
+        Same lazy-gauge contract as attach_spec_stats."""
+
+        def read(attr, denom_attr=None):
+            def _read() -> float:
+                s = stats_src() if callable(stats_src) else stats_src
+                d = s if isinstance(s, dict) else getattr(s, "__dict__", {})
+                v = float(d.get(attr, 0) or 0)
+                if denom_attr is not None:
+                    v /= max(1.0, float(d.get(denom_attr, 0) or 0))
+                return v
+
+            return _read
+
+        for attr, name, doc in (
+            ("kv_wire_bytes_tx", "kv_wire_tx_bytes",
+             "KV wire bytes shipped (prefill role)"),
+            ("kv_wire_bytes_rx", "kv_wire_rx_bytes",
+             "KV wire bytes landed (decode role)"),
+            ("kv_frames_tx", "kv_frames_tx", "KV stream frames shipped"),
+            ("kv_frames_rx", "kv_frames_rx", "KV stream frames landed"),
+            ("kv_frames_inflight", "kv_frames_inflight",
+             "KV frames extracted but not yet on the wire"),
+            ("prefill_dropped_expired", "prefill_dropped_expired",
+             "Remote prefills dropped past their deadline"),
+        ):
+            g = Gauge(f"{PREFIX}_{name}", doc, registry=self.registry)
+            g.set_function(read(attr))
+        overlap = Gauge(
+            f"{PREFIX}_kv_stream_overlap",
+            "Fraction of received KV bytes landed before the final frame",
+            registry=self.registry,
+        )
+        overlap.set_function(
+            read("kv_bytes_overlapped", "kv_wire_bytes_rx")
+        )
+
     @contextmanager
     def track(self, model: str, endpoint: str):
         """Track one request: inflight gauge + duration + status count."""

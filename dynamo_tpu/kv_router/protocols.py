@@ -172,10 +172,41 @@ class SpecDecodeStats:
 
 
 @dataclass
+class KvTransferStats:
+    """KV data-plane counters (streaming disagg / peer pulls): bytes and
+    frames crossing the wire per worker, plus the live frame window and
+    how much transfer was hidden behind remote prefill compute. Monotonic
+    except `kv_frames_inflight` (a gauge)."""
+
+    kv_frames_tx: int = 0
+    kv_frames_rx: int = 0
+    kv_wire_bytes_tx: int = 0
+    kv_wire_bytes_rx: int = 0
+    kv_bytes_overlapped: int = 0
+    kv_frames_inflight: int = 0
+    prefill_dropped_expired: int = 0
+
+    @property
+    def overlap_fraction(self) -> float:
+        """Received wire bytes landed before the final frame / total."""
+        return self.kv_bytes_overlapped / max(1, self.kv_wire_bytes_rx)
+
+    def merge(self, other: "KvTransferStats") -> None:
+        self.kv_frames_tx += other.kv_frames_tx
+        self.kv_frames_rx += other.kv_frames_rx
+        self.kv_wire_bytes_tx += other.kv_wire_bytes_tx
+        self.kv_wire_bytes_rx += other.kv_wire_bytes_rx
+        self.kv_bytes_overlapped += other.kv_bytes_overlapped
+        self.kv_frames_inflight += other.kv_frames_inflight
+        self.prefill_dropped_expired += other.prefill_dropped_expired
+
+
+@dataclass
 class ForwardPassMetrics:
     worker_stats: WorkerStats = field(default_factory=WorkerStats)
     kv_stats: KvStats = field(default_factory=KvStats)
     spec_decode_stats: Optional[SpecDecodeStats] = None
+    kv_transfer_stats: Optional[KvTransferStats] = None
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {
@@ -184,15 +215,19 @@ class ForwardPassMetrics:
         }
         if self.spec_decode_stats is not None:
             d["spec_decode_stats"] = self.spec_decode_stats.__dict__
+        if self.kv_transfer_stats is not None:
+            d["kv_transfer_stats"] = self.kv_transfer_stats.__dict__
         return d
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ForwardPassMetrics":
         spec = d.get("spec_decode_stats")
+        xfer = d.get("kv_transfer_stats")
         return cls(
             worker_stats=WorkerStats(**d.get("worker_stats", {})),
             kv_stats=KvStats(**d.get("kv_stats", {})),
             spec_decode_stats=SpecDecodeStats(**spec) if spec else None,
+            kv_transfer_stats=KvTransferStats(**xfer) if xfer else None,
         )
 
 

@@ -23,6 +23,7 @@ from dynamo_tpu.kv_router.protocols import (
     ForwardPassMetrics,
     KvCacheEvent,
     KvCacheStoredBlock,
+    KvTransferStats,
     RouterEvent,
     SpecDecodeStats,
 )
@@ -226,6 +227,10 @@ class KvMetricsAggregator:
                 if agg.spec_decode_stats is None:
                     agg.spec_decode_stats = SpecDecodeStats()
                 agg.spec_decode_stats.merge(m.spec_decode_stats)
+            if m.kv_transfer_stats is not None:
+                if agg.kv_transfer_stats is None:
+                    agg.kv_transfer_stats = KvTransferStats()
+                agg.kv_transfer_stats.merge(m.kv_transfer_stats)
         if n:
             agg.kv_stats.gpu_cache_usage_perc /= n
             agg.kv_stats.gpu_prefix_cache_hit_rate /= n
