@@ -256,7 +256,7 @@ def main() -> None:
     ap.add_argument("--decode-horizon", type=int, default=None)
     ap.add_argument("--context-length", type=int, default=None)
     ap.add_argument(
-        "--preset", choices=["canonical", "swa", "chaos", "disagg"],
+        "--preset", choices=["canonical", "swa", "chaos", "disagg", "trace"],
         default=None,
         help="canonical = the reference's genai-perf workload "
         "(examples/llm/benchmarks/README.md:41 — ISL 3000 / OSL 150, "
@@ -271,7 +271,9 @@ def main() -> None:
         "ADMITTED requests under overload instead of an unbounded queue. "
         "disagg = delegates to benchmarks.disagg_stream_bench (streamed "
         "vs monolithic P/D TTFT over a simulated wire; banked artifact "
-        "benchmarks/disagg_stream.json)",
+        "benchmarks/disagg_stream.json). trace = delegates to "
+        "benchmarks.trace_overhead_bench (token throughput DYN_TRACE off "
+        "vs on; banked artifact benchmarks/trace_overhead.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -283,6 +285,16 @@ def main() -> None:
 
         disagg_stream_bench.main(
             ["--json", args.json or "benchmarks/disagg_stream.json"]
+        )
+        return
+    if args.preset == "trace":
+        # tracer-overhead sweep runs on the mocker directly (no HTTP
+        # frontend): disabled-mode throughput must match the pre-tracing
+        # baseline, enabled-mode cost is banked alongside
+        from benchmarks import trace_overhead_bench
+
+        trace_overhead_bench.main(
+            ["--json", args.json or "benchmarks/trace_overhead.json"]
         )
         return
     tiny_extra_cfg = None

@@ -10,6 +10,7 @@ is disambiguated), recognizes hidden eos tokens, and enforces max_tokens.
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass
 from typing import Optional
 
@@ -190,9 +191,47 @@ class DetokenizeOperator(PipelineOperator):
         self._backend = backend
 
     async def generate(self, request, ctx, next):
+        from dynamo_tpu.telemetry import trace as dtrace
+
         decoder = self._backend.decoder(request.stop, request.eos_token_ids)
-        async for out in next.generate(request, ctx):
-            step = decoder.step(out)
-            yield step
-            if step.finish_reason is not None:
+        agen = next.generate(request, ctx)
+        try:
+            async for out in agen:
+                step = decoder.step(out)
+                if step.finish_reason is not None:
+                    if (
+                        dtrace.enabled()
+                        and out.finish_reason is None
+                        and step.finish_reason is FinishReason.LENGTH
+                    ):
+                        # max_tokens counted HERE, one frame before the
+                        # engine's own LENGTH final — with tracing on,
+                        # drain briefly toward that final so the worker's
+                        # completed spans (they ride it) are still
+                        # consumed. Bounded: engines enforce max_tokens
+                        # themselves, so the final is already in flight;
+                        # a stall never exceeds the timeout. Zero
+                        # behavior change with DYN_TRACE=0.
+                        await _drain_for_final(agen)
+                    yield step
+                    return
+                yield step
+        finally:
+            # deterministic teardown of the downstream chain (engine or
+            # RemoteEngine generator): GC-deferred asyncgen finalization
+            # would leave the worker stream open and drop any span whose
+            # `with` is still suspended at a yield
+            with contextlib.suppress(Exception):
+                await agen.aclose()
+
+
+async def _drain_for_final(agen, frames: int = 4, timeout_s: float = 0.25):
+    import asyncio
+
+    with contextlib.suppress(
+        StopAsyncIteration, asyncio.TimeoutError, Exception
+    ):
+        for _ in range(frames):
+            out = await asyncio.wait_for(agen.__anext__(), timeout_s)
+            if out.finish_reason is not None:
                 return

@@ -127,6 +127,18 @@ class MetricsComponent:
         self.g_cumulative_hit_rate = g(
             "kv_hit_rate_cumulative", "Cumulative router overlap / ISL"
         )
+        # KV-hit-rate event plane (reference plane 3): the router's
+        # per-decision overlap events aggregated into a fleet hit rate and
+        # a running matched-blocks counter (prefill compute saved)
+        self.g_kv_hit_rate = g(
+            "kv_hit_rate",
+            "Router KV hit rate: matched / required prefill blocks",
+        )
+        self.c_matched_blocks = Counter(
+            f"{PREFIX}_kv_matched_blocks_total",
+            "Prefill blocks served from a routed worker's cache",
+            registry=self.registry,
+        )
         self._isl_sum = 0
         self._overlap_sum = 0
         self._tasks: list[asyncio.Task] = []
@@ -200,14 +212,15 @@ class MetricsComponent:
             except (TypeError, AttributeError, ValueError):
                 continue
             self.c_hit_events.inc()
+            self.c_matched_blocks.inc(max(0, overlap))
             self.g_event_isl.set(isl)
             self.g_event_overlap.set(overlap)
             self._isl_sum += isl
             self._overlap_sum += overlap
             if self._isl_sum:
-                self.g_cumulative_hit_rate.set(
-                    self._overlap_sum / self._isl_sum
-                )
+                rate = self._overlap_sum / self._isl_sum
+                self.g_cumulative_hit_rate.set(rate)
+                self.g_kv_hit_rate.set(rate)
 
 
 class MockWorkerMetrics:

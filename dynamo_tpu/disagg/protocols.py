@@ -288,6 +288,8 @@ class RemotePrefillResponse:
     # didn't ask — keeps the wire lean)
     first_logprob: Optional[float] = None
     first_top: Optional[list] = None  # [[token_id, logprob], ...]
+    # completed telemetry spans from the prefill worker (trace assembly)
+    trace: Optional[list] = None
 
     def to_wire(self) -> dict[str, Any]:
         return {
@@ -300,6 +302,7 @@ class RemotePrefillResponse:
             "streamed_blocks": self.streamed_blocks,
             "first_logprob": self.first_logprob,
             "first_top": self.first_top,
+            "trace": self.trace,
         }
 
     @classmethod
@@ -315,4 +318,5 @@ class RemotePrefillResponse:
             streamed_blocks=d.get("streamed_blocks", 0),
             first_logprob=d.get("first_logprob"),
             first_top=d.get("first_top"),
+            trace=d.get("trace"),
         )

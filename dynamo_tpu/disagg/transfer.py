@@ -48,6 +48,7 @@ from dynamo_tpu.disagg.protocols import (
 )
 from dynamo_tpu.fabric.client import FabricClient
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.disagg.transfer")
 
@@ -153,6 +154,12 @@ class RemotePrefillClient:
                 except Exception as e:  # noqa: BLE001 — malformed wire data
                     logger.warning("bad prefill response dropped: %s", e)
                     continue
+                if resp.trace:
+                    # prefill worker shipped its spans on the final frame:
+                    # fold them into this process's ring (they ride onward
+                    # to the frontend on the decode stream's final frame)
+                    dtrace.ingest(resp.trace)
+                    resp.trace = None
                 if resp.payload is not None:
                     self.stats.bytes_rx += resp.payload.wire_nbytes
                 fut = self._pending.pop(resp.request_id, None)
@@ -389,7 +396,13 @@ class PrefillWorkerService:
 
             async def publish() -> None:
                 try:
-                    await self._fabric.publish(req.reply_subject, data)
+                    # the task inherits the serving span's context, so the
+                    # frame's wire time lands on the prefill worker's track
+                    with dtrace.wire_span(
+                        "kv_frame_tx", seq=frame.seq,
+                        nbytes=frame.payload.wire_nbytes,
+                    ):
+                        await self._fabric.publish(req.reply_subject, data)
                     self.stats.frames_tx += 1
                     self.stats.bytes_tx += frame.payload.wire_nbytes
                 finally:
@@ -451,8 +464,28 @@ class PrefillWorkerService:
 
     async def _serve_one(self, msg_id: int, req: RemotePrefillRequest) -> None:
         try:
-            resp = await self._run_prefill(req)
+            # trace context rides RemotePrefillRequest.extra["trace"]; the
+            # serving span closes BEFORE the final response is published so
+            # the shipped export includes it
+            tc = (req.extra or {}).get("trace")
+            with dtrace.span_from_wire(
+                "prefill_serve", tc,
+                proc=getattr(self.engine, "trace_proc", None),
+                request_id=req.request_id,
+                tokens=len(req.token_ids), stream=bool(req.stream),
+            ) as psp:
+                resp = await self._run_prefill(req)
+                if resp is not None and resp.code:
+                    psp.set(code=resp.code)
             if resp is not None:
+                if (
+                    dtrace.enabled()
+                    and isinstance(tc, dict)
+                    and tc.get("tid")
+                ):
+                    resp.trace = dtrace.export_for_trace(
+                        tc["tid"], include_remote=False
+                    )
                 if resp.payload is not None:
                     self.stats.bytes_tx += resp.payload.wire_nbytes
                 await self._fabric.publish(

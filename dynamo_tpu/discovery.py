@@ -32,6 +32,7 @@ from dynamo_tpu.runtime.component import Endpoint, NoInstancesError
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import MODEL_ROOT, EndpointId
+from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.discovery")
 
@@ -126,65 +127,87 @@ class RemoteEngine:
         can_replay = not any(
             k in request.extra for k in ("mm", "mm_images", "mm_videos")
         )
+        attempt = 0
         while True:
             # per-attempt child context: closing a dead attempt's stream
             # kills only the child, not the request
             attempt_ctx = ctx.child()
+            attempt += 1
             failure: Optional[str] = None
             progressed = False
             no_instances = False
             stream = None
-            try:
-                # bounded dispatch, raced against runtime shutdown: a dead
-                # fabric's failover hunt must not hang the replay past the
-                # frontend's own teardown
-                dispatch = self.router.generate(
-                    req_dict, attempt_ctx, exclude=exclude or None
-                )
-                if self.cancel_token is not None:
-                    stream = await asyncio.wait_for(
-                        self.cancel_token.run_until_cancelled(dispatch),
-                        self.dispatch_timeout_s,
-                    )
-                    if stream is None:
-                        failure = "frontend runtime shutting down"
-                else:
-                    stream = await asyncio.wait_for(
-                        dispatch, self.dispatch_timeout_s
-                    )
-            except asyncio.TimeoutError:
-                failure = (
-                    f"dispatch timed out after {self.dispatch_timeout_s:.1f}s"
-                )
-            except Exception as e:  # noqa: BLE001 — dispatch-time failure
-                failure = f"dispatch failed: {type(e).__name__}: {e}"
-                no_instances = isinstance(e, NoInstancesError)
-            if stream is not None:
-                finished = False
+            # per-attempt dispatch span: replays share the request's trace
+            # id (ctx carries it), so a migrated stream is ONE trace with
+            # one dispatch span per attempt, all parented to the root
+            with dtrace.span(
+                "dispatch", ctx=attempt_ctx, attach=True, attempt=attempt,
+                replayed_tokens=len(emitted),
+            ) as dsp:
                 try:
-                    async for item in stream:
-                        if item.is_error():
-                            failure = (
-                                item.error_message() or "worker stream error"
-                            )
-                            break
-                        if item.data is not None:
-                            out = LLMEngineOutput.from_dict(item.data)
-                            if out.token_ids:
-                                emitted.extend(out.token_ids)
-                                progressed = True
-                            yield out
-                            if out.finish_reason is not None:
-                                finished = True
-                                return
-                except (ConnectionError, OSError) as e:
-                    failure = f"stream broke: {e}"
-                finally:
-                    with contextlib.suppress(Exception):
-                        await stream.close()
-                if failure is None and not finished:
-                    # EOF with no final: the worker's response plane died
-                    failure = "stream ended without a finish reason"
+                    # bounded dispatch, raced against runtime shutdown: a
+                    # dead fabric's failover hunt must not hang the replay
+                    # past the frontend's own teardown
+                    dispatch = self.router.generate(
+                        req_dict, attempt_ctx, exclude=exclude or None
+                    )
+                    if self.cancel_token is not None:
+                        stream = await asyncio.wait_for(
+                            self.cancel_token.run_until_cancelled(dispatch),
+                            self.dispatch_timeout_s,
+                        )
+                        if stream is None:
+                            failure = "frontend runtime shutting down"
+                    else:
+                        stream = await asyncio.wait_for(
+                            dispatch, self.dispatch_timeout_s
+                        )
+                except asyncio.TimeoutError:
+                    failure = (
+                        f"dispatch timed out after "
+                        f"{self.dispatch_timeout_s:.1f}s"
+                    )
+                except Exception as e:  # noqa: BLE001 — dispatch failure
+                    failure = f"dispatch failed: {type(e).__name__}: {e}"
+                    no_instances = isinstance(e, NoInstancesError)
+                if stream is not None:
+                    wid = attempt_ctx.metadata.get("worker_instance_id")
+                    if wid is not None:
+                        dsp.set(worker=f"{wid:x}")
+                    finished = False
+                    try:
+                        async for item in stream:
+                            if item.is_error():
+                                failure = (
+                                    item.error_message()
+                                    or "worker stream error"
+                                )
+                                break
+                            if item.data is not None:
+                                out = LLMEngineOutput.from_dict(item.data)
+                                if out.trace:
+                                    # worker shipped its completed spans on
+                                    # the final frame: fold them into this
+                                    # process's ring for trace assembly
+                                    dtrace.ingest(out.trace)
+                                    out.trace = None
+                                if out.token_ids:
+                                    emitted.extend(out.token_ids)
+                                    progressed = True
+                                yield out
+                                if out.finish_reason is not None:
+                                    finished = True
+                                    return
+                    except (ConnectionError, OSError) as e:
+                        failure = f"stream broke: {e}"
+                    finally:
+                        with contextlib.suppress(Exception):
+                            await stream.close()
+                    if failure is None and not finished:
+                        # EOF with no final: the response plane died
+                        failure = "stream ended without a finish reason"
+                if failure is not None:
+                    dsp.set(failure=failure)
             # ---- the attempt failed; decide whether to migrate ----
             if ctx.is_killed() or ctx.is_stopped():
                 yield LLMEngineOutput.final(FinishReason.CANCELLED)
@@ -222,6 +245,11 @@ class RemoteEngine:
                 "%d emitted token(s) onto another worker (attempt %d/%d)",
                 ctx.id, bad, failure, len(emitted), failures,
                 self.max_retries,
+            )
+            dtrace.event(
+                "migration",
+                failed_worker=f"{bad:x}" if bad is not None else None,
+                emitted=len(emitted), cause=failure,
             )
             if emitted:
                 req_dict = dict(req_dict)
@@ -322,6 +350,10 @@ class ModelWatcher:
         self._key_to_model: dict[str, str] = {}
         self._kv_routers: dict[str, Any] = {}
         self._capacity_pollers: dict[str, WorkerCapacityPoller] = {}
+        # trace-export event-plane fallback: one ingest loop per worker
+        # namespace (spans a torn-down stream's final frame couldn't carry)
+        self._trace_subs: set[str] = set()
+        self._trace_tasks: list[asyncio.Task] = []
 
     async def start(self) -> None:
         self._watch = await self.drt.fabric.watch_prefix(MODEL_ROOT)
@@ -329,11 +361,39 @@ class ModelWatcher:
             await self._on_put(ev.key, ev.value)
         self._task = asyncio.get_running_loop().create_task(self._loop())
 
+    async def _ensure_trace_ingest(self, namespace: str) -> None:
+        """Subscribe (once per namespace) to the workers' trace-export
+        subject: the metrics-plane fallback for spans whose response
+        stream was torn down before the final frame could carry them."""
+        if not dtrace.enabled() or namespace in self._trace_subs:
+            return
+        self._trace_subs.add(namespace)
+        sub = await self.drt.namespace(namespace).subscribe_event(
+            dtrace.EXPORT_SUBJECT
+        )
+
+        async def ingest_loop() -> None:
+            import msgpack
+
+            async for _subject, payload in sub:
+                try:
+                    data = msgpack.unpackb(payload, raw=False)
+                    dtrace.ingest(data.get("trace") or [])
+                except Exception:  # noqa: BLE001 — malformed export
+                    continue
+
+        self._trace_tasks.append(
+            asyncio.get_running_loop().create_task(ingest_loop())
+        )
+
     async def stop(self) -> None:
         if self._watch is not None:
             await self._watch.cancel()
         if self._task is not None:
             self._task.cancel()
+        for t in self._trace_tasks:
+            t.cancel()
+        self._trace_tasks.clear()
         for kv_router in self._kv_routers.values():
             await kv_router.close()
         self._kv_routers.clear()
@@ -367,6 +427,7 @@ class ModelWatcher:
         endpoint = (
             self.drt.namespace(eid.namespace).component(eid.component).endpoint(eid.name)
         )
+        await self._ensure_trace_ingest(eid.namespace)
         client = self._clients.get(entry.endpoint)
         if client is None:
             client = await endpoint.client()
@@ -384,6 +445,10 @@ class ModelWatcher:
                 )
                 await kv_router.start()
                 self._kv_routers[entry.endpoint] = kv_router
+                if self.metrics is not None:
+                    # in-process router: its hit accounting scrapes straight
+                    # onto the frontend /metrics (dyn_llm_kv_hit_rate)
+                    self.metrics.attach_kv_hit_stats(kv_router.scheduler)
             router = PushRouter(
                 client, RouterMode.KV, selector=KvPushRouter(kv_router)
             )

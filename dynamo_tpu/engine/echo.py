@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import os
-from typing import AsyncIterator
+from typing import AsyncIterator, Optional
 
 from dynamo_tpu.pipeline.context import Context
 from dynamo_tpu.protocols.common import (
@@ -18,6 +18,7 @@ from dynamo_tpu.protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
+from dynamo_tpu.telemetry import trace as dtrace
 from dynamo_tpu.testing import faults
 
 
@@ -27,6 +28,8 @@ def _delay_s() -> float:
 
 class EchoEngineCore:
     """Echoes prompt token ids back as generation output."""
+
+    trace_proc: Optional[str] = None  # set by the worker host (run_endpoint)
 
     async def generate(
         self, request: PreprocessedRequest, context: Context
@@ -43,25 +46,32 @@ class EchoEngineCore:
             count = len(prompt) - resume
             prompt = prompt[:resume]
         limit = request.stop.max_tokens or len(prompt)
-        for tok in prompt[count:]:
-            if faults.active():
-                # DYN_FAULT kill_after_tokens: the worker process dies
-                # exactly as a crashed decode worker would, mid-stream
-                inj = faults.get_injector()
-                if inj is not None:
-                    inj.on_token()
-            if context.is_stopped() or count >= limit:
-                break
-            if context.expired():
-                context.kill()
-                yield LLMEngineOutput.final_error(
-                    context.id, "decode", "deadline exceeded mid-generation",
-                    "deadline_exceeded",
-                )
-                return
-            await asyncio.sleep(delay)
-            yield LLMEngineOutput(token_ids=[tok])
-            count += 1
+        with dtrace.span(
+            "decode", ctx=context, proc=self.trace_proc,
+            resumed_at=count or None,
+        ) as sp:
+            for tok in prompt[count:]:
+                if faults.active():
+                    # DYN_FAULT kill_after_tokens: the worker process dies
+                    # exactly as a crashed decode worker would, mid-stream
+                    inj = faults.get_injector()
+                    if inj is not None:
+                        inj.on_token()
+                if context.is_stopped() or count >= limit:
+                    break
+                if context.expired():
+                    context.kill()
+                    sp.event("deadline_exceeded", phase="decode")
+                    yield LLMEngineOutput.final_error(
+                        context.id, "decode",
+                        "deadline exceeded mid-generation",
+                        "deadline_exceeded",
+                    )
+                    return
+                await asyncio.sleep(delay)
+                yield LLMEngineOutput(token_ids=[tok])
+                count += 1
+            sp.set(tokens=count)
         reason = (
             FinishReason.CANCELLED
             if context.is_killed()

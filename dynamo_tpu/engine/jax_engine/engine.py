@@ -39,6 +39,8 @@ from dynamo_tpu.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import profile as dprofile
+from dynamo_tpu.telemetry import trace as dtrace
 from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = get_logger("dynamo_tpu.engine")
@@ -241,6 +243,8 @@ class _Sequence(SequenceState):
         # exponentially backs off drafting until a draft lands again
         self.spec_fail = 0
         self.spec_backoff = 0
+        # open telemetry phase spans (queue_wait / prefill / decode / ...)
+        self.spans: dict = {}
 
     @property
     def needs_eos_suppress(self) -> bool:
@@ -378,6 +382,36 @@ class JaxEngine:
         # unseeded sequences draw from (engine seed base + seq_id) streams:
         # deterministic per engine run AND stable across preemption replay
         self._seed_base = (self.config.rng_seed ^ 0x9E3779B9) & 0x7FFFFFFF
+        # trace process track (set by the worker host; None = process name)
+        self.trace_proc: Optional[str] = None
+
+    # ----------------------------------------------------------- telemetry
+
+    def _sp_begin(self, seq: _Sequence, name: str, **attrs) -> None:
+        sp = dtrace.begin(name, ctx=seq.ctx, proc=self.trace_proc, **attrs)
+        if sp is not None:
+            seq.spans[name] = sp
+
+    def _sp_finish(self, seq: _Sequence, name: str, **attrs) -> None:
+        dtrace.finish(seq.spans.pop(name, None), **attrs)
+
+    def _sp_event(self, seq: _Sequence, name: str, **attrs) -> None:
+        """Attach a point event to the sequence's (single) open span."""
+        for sp in seq.spans.values():
+            sp.event(name, **attrs)
+            return
+
+    def _sp_close_all(self, seq: _Sequence) -> None:
+        for name in list(seq.spans):
+            self._sp_finish(seq, name)
+
+    def _sp_batch_event(self, active: list, label: str, **attrs) -> None:
+        """Mark one batched device dispatch on every member's decode span
+        (bounded per span so long generations can't grow without limit)."""
+        for seq in active:
+            sp = seq.spans.get("decode")
+            if sp is not None and len(sp.events) < 64:
+                sp.event(label, **attrs)
 
     # --------------------------------------------------------------- api
 
@@ -408,6 +442,8 @@ class JaxEngine:
             )
             return
         seq = _Sequence(next(self._seq_ids), request, context)
+        if dtrace.enabled():
+            self._sp_begin(seq, "queue_wait", tokens=len(request.token_ids))
         self.waiting.append(seq)
         self._ensure_loop()
         self._wake.set()
@@ -452,6 +488,7 @@ class JaxEngine:
         cause = f"engine loop crashed: {type(exc).__name__}: {exc}"
         for seq in list(self.waiting):
             self.waiting.remove(seq)
+            self._sp_close_all(seq)
             seq.out.put_nowait(
                 LLMEngineOutput.final_error(
                     seq.ctx.id, "queue", cause, "engine_loop_crash"
@@ -488,11 +525,20 @@ class JaxEngine:
             inj = faults.get_injector()
             if inj is not None:
                 await inj.on_dispatch()
+        run = fn
+        if dprofile.active():
+            # a profile window is open: name this dispatch on the device
+            # timeline so jax.profiler traces carry the same phase labels
+            # as the request spans
+            def run():
+                with dprofile.annotate(label):
+                    return fn()
+
         loop = asyncio.get_running_loop()
         self._dispatch_info = (label, time.monotonic())
         t0 = self._dispatch_info[1]
         try:
-            return await loop.run_in_executor(None, fn)
+            return await loop.run_in_executor(None, run)
         finally:
             elapsed = time.monotonic() - t0
             self._dispatch_info = None
@@ -536,6 +582,8 @@ class JaxEngine:
         logger.error("%s — failing all lanes, marking worker unhealthy", cause)
         for seq in list(self.waiting):
             self.waiting.remove(seq)
+            self._sp_event(seq, "watchdog_trip", label=label)
+            self._sp_close_all(seq)
             seq.out.put_nowait(
                 LLMEngineOutput.final_error(
                     seq.ctx.id, "queue", cause, "watchdog_stuck"
@@ -546,6 +594,8 @@ class JaxEngine:
             # into them, and this engine is done serving anyway — the
             # supervisor recycles the whole process after deregistration
             seq.ctx.kill()
+            self._sp_event(seq, "watchdog_trip", label=label)
+            self._sp_close_all(seq)
             seq.out.put_nowait(
                 LLMEngineOutput.final_error(
                     seq.ctx.id, label, cause, "watchdog_stuck"
@@ -696,6 +746,9 @@ class JaxEngine:
     def _finish(self, seq: _Sequence, reason: FinishReason) -> None:
         self._maybe_offload(seq, reason)
         self._free_seq(seq)
+        if seq.spans:
+            self._sp_finish(seq, "decode", tokens=seq.num_generated)
+            self._sp_close_all(seq)
         seq.out.put_nowait(LLMEngineOutput.final(reason))
 
     def _finish_error(
@@ -704,6 +757,9 @@ class JaxEngine:
         """Fail one admitted sequence with a structured error: free its
         slot + KV blocks (publishing Removed) and send the typed final."""
         self._free_seq(seq)
+        if seq.spans:
+            self._sp_event(seq, "error", phase=phase, code=code)
+            self._sp_close_all(seq)
         seq.out.put_nowait(
             LLMEngineOutput.final_error(seq.ctx.id, phase, cause, code)
         )
@@ -850,6 +906,12 @@ class JaxEngine:
             victim.hash_seq = None
             victim.emitted_hashes = 0
             victim.offload_mark = 0
+            if victim.spans:
+                self._sp_event(victim, "preempted")
+                self._sp_close_all(victim)
+            if dtrace.enabled():
+                # re-queued: its wait for re-admission is a fresh phase
+                self._sp_begin(victim, "queue_wait", resumed=True)
             self.waiting.insert(0, victim)
             return True
         return False
@@ -950,6 +1012,7 @@ class JaxEngine:
         for seq in list(self.waiting):
             if seq.ctx.is_killed() or seq.ctx.is_stopped():
                 self.waiting.remove(seq)
+                self._sp_close_all(seq)
                 seq.out.put_nowait(LLMEngineOutput.final(FinishReason.CANCELLED))
             elif seq.ctx.expired() or seq.ctx.ttft_expired():
                 # queued past its deadline (or past the point where its
@@ -958,6 +1021,8 @@ class JaxEngine:
                 self.waiting.remove(seq)
                 self.stats.deadline_exceeded += 1
                 seq.ctx.kill()
+                self._sp_event(seq, "deadline_exceeded", phase="queue")
+                self._sp_close_all(seq)
                 seq.out.put_nowait(
                     LLMEngineOutput.final_error(
                         seq.ctx.id, "queue",
@@ -974,6 +1039,9 @@ class JaxEngine:
                     seq.deadline_fired = True
                     self.stats.deadline_exceeded += 1
                     seq.ctx.kill()  # cascade cancels the remote prefill
+                    self._sp_event(
+                        seq, "deadline_exceeded", phase="remote_prefill"
+                    )
                     seq.out.put_nowait(
                         LLMEngineOutput.final_error(
                             seq.ctx.id, "remote_prefill",
@@ -987,6 +1055,7 @@ class JaxEngine:
             ):
                 self.stats.deadline_exceeded += 1
                 seq.ctx.kill()  # cascade: frees child work, then the lane
+                self._sp_event(seq, "deadline_exceeded", phase="decode")
                 self._finish_error(
                     seq, "decode", "deadline exceeded mid-generation",
                     "deadline_exceeded",
@@ -1007,6 +1076,8 @@ class JaxEngine:
                 break
             self.waiting.pop(0)
             admitted = True
+            if seq.spans:
+                self._sp_finish(seq, "queue_wait")
             # multimodal sequences (vision embeddings in extra["mm"]):
             # token-hash prefix reuse would collide across DIFFERENT images
             # whose placeholder tokens are identical, so they skip the
@@ -1014,6 +1085,8 @@ class JaxEngine:
             # packing, and run the dedicated mm prefill program.
             mm = seq.request.extra.get("mm")
             if mm is not None:
+                if dtrace.enabled():
+                    self._sp_begin(seq, "prefill", path="mm")
                 await self._run_mm_prefill(loop, seq, mm)
                 continue
             hit_len = 0
@@ -1031,9 +1104,17 @@ class JaxEngine:
                     and seq.cached_prefix_blocks < len(seq.prefix_hashes)
                 ):
                     # G4-lite: a peer may hold the rest of the prefix
-                    fetched = await self.peer_block_client.fetch_remote_prefix(
-                        seq.prefix_hashes
-                    )
+                    with dtrace.span(
+                        "peer_fetch", ctx=seq.ctx, proc=self.trace_proc,
+                        blocks_missing=(
+                            len(seq.prefix_hashes) - seq.cached_prefix_blocks
+                        ),
+                    ):
+                        fetched = (
+                            await self.peer_block_client.fetch_remote_prefix(
+                                seq.prefix_hashes
+                            )
+                        )
                     if fetched:
                         seq.cached_prefix_blocks = (
                             self.block_manager.lookup_prefix(seq.prefix_hashes)
@@ -1054,8 +1135,20 @@ class JaxEngine:
                 # ship the prefill out; the sequence holds its slot+blocks
                 # and joins the decode batch when the KV lands
                 seq.pending_remote = True
+                if dtrace.enabled():
+                    self._sp_begin(
+                        seq, "remote_prefill",
+                        tokens=len(seq.token_ids),
+                        cached_blocks=seq.cached_prefix_blocks,
+                    )
                 self._spawn_tracked(self._remote_prefill_task(seq))
                 continue
+            if dtrace.enabled():
+                self._sp_begin(
+                    seq, "prefill",
+                    tokens=len(seq.token_ids),
+                    cached_blocks=seq.cached_prefix_blocks,
+                )
             # re-admission after preemption replays generated tokens too
             replay = seq.token_ids
             bs = self.config.block_size
@@ -1226,6 +1319,10 @@ class JaxEngine:
                 return self.runner.fetch_sample(out) if final else None
 
             sample = await self._dispatch("prefill_chunk", run_chunk)
+        if seq.spans:
+            sp = seq.spans.get("prefill")
+            if sp is not None and len(sp.events) < 64:
+                sp.event("prefill_chunk", pos=start, tokens=len(chunk))
         if seq.slot is None:  # cancelled during the device call
             return
         seq.prefill_pos = min(start + c, total)
@@ -1317,10 +1414,22 @@ class JaxEngine:
         cached = await self._onboard_prefix(seq, loop)
         stream = self._kv_stream_enabled()
         landed_blocks: set[int] = set()
+        rsp = seq.spans.get("remote_prefill")
 
         async def on_frame(frame) -> None:
-            await self._land_stream_frame(seq, frame, loop, landed_blocks)
+            with dtrace.span(
+                "kv_land", parent=rsp, proc=self.trace_proc,
+                seq=frame.seq, blocks=frame.payload.num_blocks,
+                nbytes=frame.payload.wire_nbytes,
+            ):
+                await self._land_stream_frame(seq, frame, loop, landed_blocks)
 
+        extra = None
+        if rsp is not None:
+            # the prefill worker parents its serving span under this one
+            # (RemotePrefillRequest.extra["trace"]), so the assembled trace
+            # shows prefill compute + frame wire time on the worker's track
+            extra = {"trace": {"tid": rsp.trace_id, "sid": rsp.span_id}}
         try:
             resp = await self.remote_prefill_client.prefill(
                 seq.token_ids,
@@ -1336,6 +1445,7 @@ class JaxEngine:
                 on_frame=on_frame if stream else None,
                 deadline=seq.ctx.deadline,
                 ctx=seq.ctx,
+                extra=extra,
             )
         except PrefillStreamCancelled:
             # requester cancelled (kill/deadline cascade): no local
@@ -1381,6 +1491,11 @@ class JaxEngine:
             inj = faults.get_injector()
             if inj is not None:
                 await inj.on_transfer()
+        if rsp is not None:
+            rsp.set(
+                blocks_landed=len(landed_blocks),
+                fallback_local=resp is None,
+            )
         try:
             sample = await self._land_prefill(seq, resp, loop)
             self._landed.append((seq, sample, None))
@@ -1922,6 +2037,8 @@ class JaxEngine:
                     )
                 ),
             )
+        if dtrace.enabled():
+            self._sp_batch_event(active, "decode_step", batch=len(active))
         toks, lps, tids, tlps = sample
         for seq in active:
             if seq.slot is None:
@@ -2074,6 +2191,10 @@ class JaxEngine:
                     )
                 ),
             )
+        if dtrace.enabled():
+            self._sp_batch_event(
+                active, "spec_verify", K=K, E=E, batch=len(active)
+            )
         K2 = (packed.shape[-1] - 2) // 2
         # verify rows: accept the longest prefix of drafts matching the
         # model's own tokens, then the bonus token
@@ -2217,6 +2338,10 @@ class JaxEngine:
                     if seq.slot is not None and not seq.pending_remote:
                         self._finish(seq, FinishReason.ERROR)
             return
+        if dtrace.enabled():
+            self._sp_batch_event(
+                active, "decode_horizon", H=H, batch=len(active)
+            )
         K = (packed.shape[-1] - 2) // 2
         for h in range(H):
             step = packed[h]
@@ -2255,6 +2380,11 @@ class JaxEngine:
     ) -> None:
         """Record a newly generated token: stream it, grow blocks, stop."""
         self.stats.generated_tokens += 1
+        if seq.spans and "decode" not in seq.spans:
+            # first token: the prefill phase (local or remote) is over
+            self._sp_finish(seq, "prefill")
+            self._sp_finish(seq, "remote_prefill")
+            self._sp_begin(seq, "decode")
         if faults.active():
             inj = faults.get_injector()
             if inj is not None and inj.on_token():
@@ -2323,6 +2453,7 @@ class JaxEngine:
         serving new requests (the chaos soak asserts conservation)."""
         for seq in list(self.waiting):
             self.waiting.remove(seq)
+            self._sp_close_all(seq)
             seq.out.put_nowait(
                 LLMEngineOutput.final_error(
                     seq.ctx.id, "queue", cause, "injected_fault"

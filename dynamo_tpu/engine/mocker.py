@@ -6,6 +6,16 @@ LRU evictor): real block bookkeeping with prefix reuse, LRU eviction, and
 genuine KV store/remove events — but fake compute, timed by a cost model
 (quadratic prefill + linear decode, scheduler.rs:28-43). Lets the KV router,
 disagg router, and planner run end-to-end with zero chips.
+
+Disaggregation: with a `remote_prefill_client` wired, prompts at or past
+`disagg_threshold` ship to the prefill fleet (`MockPrefillEngine` is the
+prefill-role twin, streaming KvStreamFrames chunk by chunk) — the zero-chip
+version of the streaming-disagg graph, so routing, the KV data plane, and
+the telemetry plane can be exercised end-to-end with fake compute.
+
+Telemetry: per-request phase spans (queue_wait, prefill, remote_prefill,
+kv_land per streamed frame, decode) plus deadline/preemption span events —
+all behind the `DYN_TRACE` flag, zero-cost when off.
 """
 
 from __future__ import annotations
@@ -13,7 +23,7 @@ from __future__ import annotations
 import asyncio
 import collections
 from dataclasses import dataclass, field
-from typing import AsyncIterator, Callable, Optional
+from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.pipeline.context import Context
 from dynamo_tpu.protocols.common import (
@@ -21,6 +31,7 @@ from dynamo_tpu.protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
+from dynamo_tpu.telemetry import trace as dtrace
 from dynamo_tpu.testing import faults
 from dynamo_tpu.tokens import TokenBlockSequence
 
@@ -171,6 +182,8 @@ class _MockSeq:
     prompt_len: int = 0  # original prompt length (< len(token_ids) on resume)
     acquired_hashes: list[int] = field(default_factory=list)
     unique_blocks: int = 1
+    remote_prefilled: bool = False  # KV arrived from the prefill fleet
+    spans: dict = field(default_factory=dict)  # open telemetry phase spans
 
     @property
     def prompt(self) -> list[int]:
@@ -186,6 +199,8 @@ class MockEngine:
         args: Optional[MockEngineArgs] = None,
         on_blocks_stored: Optional[Callable[[list[dict]], None]] = None,
         on_blocks_removed: Optional[Callable[[list[int]], None]] = None,
+        remote_prefill_client: Optional[Any] = None,
+        disagg_threshold: Optional[int] = None,
     ) -> None:
         self.args = args or MockEngineArgs()
         self.cache = _SimKvCache(self.args, on_blocks_stored, on_blocks_removed)
@@ -200,6 +215,13 @@ class MockEngine:
         # lifeguard counters (same names the JaxEngine stats carry)
         self.deadline_exceeded = 0
         self.injected_aborts = 0
+        # streaming-disagg: prompts >= threshold ship to the prefill fleet
+        self.remote_prefill_client = remote_prefill_client
+        self.disagg_threshold = disagg_threshold or 2 * self.args.block_size
+        self.remote_prefills = 0
+        self.kv_frames_rx = 0
+        # trace process track (set by the worker host; None = process name)
+        self.trace_proc: Optional[str] = None
 
     # Hook properties matching JaxEngine's surface so worker hosting can
     # attach a KvEventPublisher uniformly (entrypoint/inputs.py).
@@ -218,6 +240,25 @@ class MockEngine:
     @on_blocks_removed.setter
     def on_blocks_removed(self, fn) -> None:
         self.cache.on_removed = fn
+
+    # ----------------------------------------------------------- telemetry
+
+    def _sp_begin(self, seq: _MockSeq, name: str, **attrs) -> None:
+        sp = dtrace.begin(name, ctx=seq.context, proc=self.trace_proc, **attrs)
+        if sp is not None:
+            seq.spans[name] = sp
+
+    def _sp_finish(self, seq: _MockSeq, name: str, **attrs) -> None:
+        dtrace.finish(seq.spans.pop(name, None), **attrs)
+
+    def _sp_event(self, seq: _MockSeq, name: str, **attrs) -> None:
+        for sp in seq.spans.values():
+            sp.event(name, **attrs)
+            return
+
+    def _sp_close_all(self, seq: _MockSeq) -> None:
+        for name in list(seq.spans):
+            self._sp_finish(seq, name)
 
     # ------------------------------------------------------------- public
 
@@ -240,6 +281,16 @@ class MockEngine:
         resume = int(request.extra.get("resume_prompt_len") or 0)
         if 0 < resume < prompt_len:
             prompt_len = resume
+        first_remote: Optional[int] = None
+        if (
+            self.remote_prefill_client is not None
+            and resume == 0
+            and prompt_len >= self.disagg_threshold
+        ):
+            first_remote = await self._remote_prefill(request, ctx)
+            if first_remote is None and (ctx.is_killed() or ctx.is_stopped()):
+                yield LLMEngineOutput.final(FinishReason.CANCELLED)
+                return
         seq = _MockSeq(
             request=request,
             context=ctx,
@@ -251,6 +302,24 @@ class MockEngine:
                 tokens=list(request.token_ids),
             ),
         )
+        if first_remote is not None:
+            # the prefill worker sampled the first token (the same
+            # deterministic cycle value the local path would produce);
+            # count it against the budget and continue decode after it
+            self.remote_prefills += 1
+            seq.remote_prefilled = True
+            seq.generated += 1
+            self.generated_tokens += 1
+            max_tokens = request.stop.max_tokens or 64
+            if seq.generated >= max_tokens:
+                yield LLMEngineOutput(
+                    token_ids=[first_remote],
+                    finish_reason=FinishReason.LENGTH,
+                )
+                return
+            seq.out.put_nowait(LLMEngineOutput(token_ids=[first_remote]))
+        if dtrace.enabled():
+            self._sp_begin(seq, "queue_wait", tokens=prompt_len)
         self.waiting.append(seq)
         self._wake.set()
         self._ensure_loop()
@@ -266,6 +335,52 @@ class MockEngine:
             # a queue nobody reads (mirrors JaxEngine.generate)
             ctx.kill()
             self._wake.set()
+
+    async def _remote_prefill(
+        self, request: PreprocessedRequest, ctx: Context
+    ) -> Optional[int]:
+        """Ship the prompt to the prefill fleet over the streaming KV data
+        plane; returns the remotely-sampled first token, or None to fall
+        back to the local (simulated) prefill path."""
+        frames = 0
+        with dtrace.span(
+            "remote_prefill", ctx=ctx, proc=self.trace_proc,
+            tokens=len(request.token_ids),
+        ) as rsp:
+            async def on_frame(frame) -> None:
+                nonlocal frames
+                frames += 1
+                self.kv_frames_rx += 1
+                # sim engine: nothing to inject (the cache is hash-based);
+                # the span records when/that each frame landed
+                with dtrace.span(
+                    "kv_land", parent=rsp, proc=self.trace_proc,
+                    seq=frame.seq, blocks=frame.payload.num_blocks,
+                ):
+                    pass
+
+            extra = None
+            if rsp.trace_id:
+                extra = {"trace": {"tid": rsp.trace_id, "sid": rsp.span_id}}
+            try:
+                resp = await self.remote_prefill_client.prefill(
+                    list(request.token_ids),
+                    cached_blocks=0,
+                    stream=True,
+                    on_frame=on_frame,
+                    deadline=ctx.deadline,
+                    ctx=ctx,
+                    extra=extra,
+                )
+            except Exception:  # noqa: BLE001 — disagg is an optimization
+                rsp.set(fallback="transfer_failed")
+                return None
+            rsp.set(frames=frames)
+            if resp is None or resp.error or resp.first_token < 0:
+                rsp.set(fallback=resp.code if resp else "no_response")
+                return None
+            rsp.set(streamed_blocks=resp.streamed_blocks)
+            return int(resp.first_token)
 
     def stats(self) -> dict:
         return {
@@ -303,6 +418,7 @@ class MockEngine:
         # reap abandoned requests before they consume sim capacity
         for seq in [s for s in self.waiting if s.context.is_killed()]:
             self.waiting.remove(seq)
+            self._sp_close_all(seq)
             seq.out.put_nowait(LLMEngineOutput.final(FinishReason.CANCELLED))
         # shed queued requests past their deadline / TTFT budget
         for seq in [
@@ -312,6 +428,8 @@ class MockEngine:
             self.waiting.remove(seq)
             self.deadline_exceeded += 1
             seq.context.kill()
+            self._sp_event(seq, "deadline_exceeded", phase="queue")
+            self._sp_close_all(seq)
             seq.out.put_nowait(
                 LLMEngineOutput.final_error(
                     seq.context.id, "queue",
@@ -332,13 +450,26 @@ class MockEngine:
             self.waiting.popleft()
             seq.acquired_hashes = list(hashes)
             self.active.append(seq)
-            n_prefill = max(0, len(seq.request.token_ids)
-                            - cached * self.args.block_size)
+            if seq.remote_prefilled:
+                # KV already arrived over the streaming data plane — no
+                # local prefill compute to simulate
+                n_prefill = 0
+            else:
+                n_prefill = max(0, len(seq.request.token_ids)
+                                - cached * self.args.block_size)
             self.prefilled_tokens += n_prefill
             cost += (
                 self.args.prefill_linear_s * n_prefill
                 + self.args.prefill_quadratic_s * n_prefill * n_prefill
             )
+            if seq.spans:
+                self._sp_finish(
+                    seq, "queue_wait", cached_blocks=cached
+                )
+                if n_prefill:
+                    self._sp_begin(seq, "prefill", tokens=n_prefill)
+                else:
+                    self._sp_begin(seq, "decode")
         return cost
 
     async def _run(self) -> None:
@@ -349,6 +480,10 @@ class MockEngine:
             prefill_cost = self._admit()
             if prefill_cost:
                 await self._sim_sleep(prefill_cost)
+            for seq in self.active:
+                if "prefill" in seq.spans:
+                    self._sp_finish(seq, "prefill")
+                    self._sp_begin(seq, "decode")
             if not self.active:
                 # blocked: waiting head cannot be admitted yet
                 if self.waiting:
@@ -368,6 +503,8 @@ class MockEngine:
                 seq.context.kill()
                 self.active.remove(seq)
                 self.cache.release(seq.acquired_hashes, seq.unique_blocks)
+                self._sp_event(seq, "deadline_exceeded", phase="decode")
+                self._sp_close_all(seq)
                 seq.out.put_nowait(
                     LLMEngineOutput.final_error(
                         seq.context.id, "decode",
@@ -385,6 +522,7 @@ class MockEngine:
         self.injected_aborts += 1
         for seq in list(self.waiting):
             self.waiting.remove(seq)
+            self._sp_close_all(seq)
             seq.out.put_nowait(
                 LLMEngineOutput.final_error(
                     seq.context.id, "queue", cause, "injected_fault"
@@ -393,6 +531,7 @@ class MockEngine:
         for seq in list(self.active):
             self.active.remove(seq)
             self.cache.release(seq.acquired_hashes, seq.unique_blocks)
+            self._sp_close_all(seq)
             seq.out.put_nowait(
                 LLMEngineOutput.final_error(
                     seq.context.id, "decode", cause, "injected_fault"
@@ -442,10 +581,115 @@ class MockEngine:
         if finished:
             self.active.remove(seq)
             self.cache.release(seq.acquired_hashes, seq.unique_blocks)
+            if seq.spans:
+                self._sp_finish(seq, "decode", tokens=seq.generated)
+                self._sp_close_all(seq)
 
     def _preempt_for(self, seq: _MockSeq) -> None:
         if seq in self.active:
             self.active.remove(seq)
         self.cache.release(seq.acquired_hashes, seq.unique_blocks)
         seq.acquired_hashes = []
+        self._sp_event(seq, "preempted")
+        self._sp_finish(seq, "decode", preempted=True)
         self.waiting.appendleft(seq)
+
+
+class MockPrefillEngine:
+    """Prefill-role twin of MockEngine for the streaming-disagg mocker
+    graph: serves RemotePrefillRequests under the same cost model with
+    fake (but correctly-shaped, codec-exercising) KV payloads, streaming
+    one KvStreamFrame per chunk of completed blocks. First-token sampling
+    follows the mocker's deterministic cycle (prompt[0]), so a disagg
+    mocker stream is token-identical to the aggregated mocker."""
+
+    def __init__(
+        self,
+        args: Optional[MockEngineArgs] = None,
+        chunk_blocks: int = 2,
+    ) -> None:
+        self.args = args or MockEngineArgs()
+        self.chunk_blocks = max(1, chunk_blocks)
+        self.served = 0
+        self.frames_emitted = 0
+        self.trace_proc: Optional[str] = None
+
+    async def _sim_sleep(self, sim_s: float) -> None:
+        await asyncio.sleep(sim_s / self.args.speedup_ratio)
+
+    def _chunk_cost(self, n_tokens: int) -> float:
+        return (
+            self.args.prefill_linear_s * n_tokens
+            + self.args.prefill_quadratic_s * n_tokens * n_tokens
+        )
+
+    def _payload(self, nblocks: int, block_size: int):
+        import numpy as np
+
+        from dynamo_tpu.disagg.protocols import KvBlockPayload
+
+        k = np.zeros((1, 1, max(1, nblocks), block_size, 1), np.float32)
+        return KvBlockPayload.encode(k, k)
+
+    async def prefill_only(self, req: Any) -> Any:
+        """Monolithic path: one simulated prefill, one dense payload."""
+        from dynamo_tpu.disagg.protocols import RemotePrefillResponse
+
+        await self._sim_sleep(self._chunk_cost(len(req.token_ids)))
+        self.served += 1
+        bs = req.block_size or self.args.block_size
+        total = -(-len(req.token_ids) // bs)
+        return RemotePrefillResponse(
+            request_id=req.request_id,
+            first_token=int(req.token_ids[0]),
+            payload=self._payload(total, bs),
+            first_block=0,
+        )
+
+    async def prefill_only_stream(
+        self, req: Any, emit, cancelled=None
+    ) -> Optional[Any]:
+        """Streaming path: simulate chunked prefill, shipping each chunk's
+        completed blocks while the next chunk 'computes'. Returns None on
+        requester cancellation (PrefillWorkerService contract)."""
+        from dynamo_tpu.disagg.protocols import (
+            KvStreamFrame,
+            RemotePrefillResponse,
+        )
+
+        bs = req.block_size or self.args.block_size
+        tokens = list(req.token_ids)
+        full_blocks = len(tokens) // bs
+        streamed = 0
+        seqno = 0
+        while streamed < full_blocks:
+            if cancelled is not None and cancelled():
+                return None
+            n = min(self.chunk_blocks, full_blocks - streamed)
+            with dtrace.wire_span("prefill_chunk", blocks=n):
+                await self._sim_sleep(self._chunk_cost(n * bs))
+            await emit(
+                KvStreamFrame(
+                    request_id=req.request_id,
+                    seq=seqno,
+                    first_block=streamed,
+                    payload=self._payload(n, bs),
+                )
+            )
+            self.frames_emitted += 1
+            seqno += 1
+            streamed += n
+        # tail: the partial block (or the whole prompt when it fits in one)
+        tail_tokens = len(tokens) - full_blocks * bs
+        with dtrace.wire_span("prefill_chunk", blocks=1, tail=True):
+            await self._sim_sleep(self._chunk_cost(max(1, tail_tokens)))
+        if cancelled is not None and cancelled():
+            return None
+        self.served += 1
+        return RemotePrefillResponse(
+            request_id=req.request_id,
+            first_token=int(tokens[0]),
+            payload=self._payload(1, bs),
+            first_block=streamed,
+            streamed_blocks=streamed,
+        )

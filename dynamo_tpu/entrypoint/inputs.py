@@ -27,8 +27,74 @@ from dynamo_tpu.protocols.openai import ChatCompletionRequest, ChatMessage
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import EndpointId
+from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.entrypoint")
+
+
+def make_engine_handler(
+    engine: Any,
+    proc_label: Optional[str] = None,
+    namespace: Any = None,
+):
+    """Worker-side request handler hosting an engine on a dyn:// endpoint.
+
+    With tracing enabled, the serving scope runs under a `worker_generate`
+    span on the worker's own process track, and the request's completed
+    spans (this worker's plus any it ingested from a prefill worker) are
+    shipped back on the stream's FINAL frame so the frontend can assemble
+    the whole cross-process trace. When the consumer tears the stream down
+    before that frame (frontend stop sequences, max_tokens counted at the
+    decoder, client disconnects), the export is published on the
+    namespace's `trace-export` event subject instead — the metrics-plane
+    fallback the frontend's ModelWatcher subscribes to."""
+
+    async def handler(request: dict, ctx: Context) -> AsyncIterator[dict]:
+        pre = PreprocessedRequest.from_dict(request)
+        if not dtrace.enabled():
+            async for out in engine.generate(pre, ctx):
+                yield out.to_dict()
+            return
+        label = proc_label or getattr(engine, "trace_proc", None)
+        final_d: Optional[dict] = None
+        shipped = False
+        agen = engine.generate(pre, ctx)
+        try:
+            with dtrace.process_scope(label), dtrace.span(
+                "worker_generate", ctx=ctx, attach=True, request_id=ctx.id
+            ):
+                async for out in agen:
+                    d = out.to_dict()
+                    if out.finish_reason is not None:
+                        # hold the final frame until the worker span has
+                        # closed, so the shipped export includes it
+                        final_d = d
+                        break
+                    yield d
+            if final_d is not None:
+                tid = dtrace.ctx_trace_id(ctx)
+                if tid:
+                    final_d["trace"] = dtrace.export_for_trace(tid)
+                yield final_d
+                shipped = bool(final_d.get("trace"))
+        finally:
+            with contextlib.suppress(Exception):
+                await agen.aclose()
+            if not shipped and namespace is not None:
+                tid = dtrace.ctx_trace_id(ctx)
+                wire = dtrace.export_for_trace(tid) if tid else None
+                if wire:
+                    # stream gone (or never reached its final frame):
+                    # fire-and-forget the export onto the event plane
+                    async def _publish(w=wire):
+                        with contextlib.suppress(Exception):
+                            await namespace.publish_event(
+                                dtrace.EXPORT_SUBJECT, {"trace": w}
+                            )
+
+                    asyncio.get_running_loop().create_task(_publish())
+
+    return handler
 
 
 def _local_clear_fn(engine: Any) -> Optional[Any]:
@@ -290,10 +356,14 @@ async def run_endpoint(
     )
     engine = config.engine
 
-    async def handler(request: dict, ctx: Context) -> AsyncIterator[dict]:
-        pre = PreprocessedRequest.from_dict(request)
-        async for out in engine.generate(pre, ctx):
-            yield out.to_dict()
+    # worker identity on trace timelines: distinct tracks per instance so
+    # an assembled cross-process trace shows which worker served which hop
+    worker_label = f"{eid.component}:{drt.primary_lease & 0xFFFFFF:x}"
+    with contextlib.suppress(Exception):
+        engine.trace_proc = worker_label
+    handler = make_engine_handler(
+        engine, worker_label, namespace=endpoint.component.namespace
+    )
 
     if getattr(engine, "supports_images", False):
         config.mdc.extra["supports_images"] = True

@@ -15,6 +15,7 @@ from typing import Any, AsyncIterator, Optional
 from dynamo_tpu.fabric import wire
 from dynamo_tpu.fabric.state import FabricState, WatchEvent
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.fabric.client")
 
@@ -430,7 +431,13 @@ class FabricClient:
                 raise ConnectionError("fabric connection lost")
         if self._conn_lost and self._read_task and self._read_task.done():
             raise ConnectionError("fabric connection lost")
-        return await self._call_raw(op, **kwargs)
+        if not dtrace.enabled():
+            return await self._call_raw(op, **kwargs)
+        # pulls/publishes issued while a request span is active on this
+        # task show up as wire hops on its timeline; background fabric
+        # traffic (leases, watches) records nothing
+        with dtrace.wire_span("fabric:" + op):
+            return await self._call_raw(op, **kwargs)
 
     # ------------------------------------------------------------- leases
 

@@ -177,6 +177,31 @@ class ServiceMetrics:
             read("kv_bytes_overlapped", "kv_wire_bytes_rx")
         )
 
+    def attach_kv_hit_stats(self, scheduler) -> None:
+        """Surface an in-process KV router's per-decision hit accounting
+        (KvScheduler.hit_stats) on this frontend's /metrics: the fraction
+        of prefill blocks served from a routed worker's cache and the
+        running matched-blocks total. Lazy gauges — read at scrape time.
+        First router wins: one frontend registry can't carry the series
+        twice (a second discovered endpoint keeps its own /metrics)."""
+        if getattr(self, "_kv_hit_attached", False):
+            return
+        self._kv_hit_attached = True
+        g_rate = Gauge(
+            "dyn_llm_kv_hit_rate",
+            "Router KV hit rate: matched / required prefill blocks",
+            registry=self.registry,
+        )
+        g_rate.set_function(lambda: scheduler.hit_rate)
+        g_matched = Gauge(
+            "dyn_llm_kv_matched_blocks_total",
+            "Prefill blocks served from a routed worker's cache",
+            registry=self.registry,
+        )
+        g_matched.set_function(
+            lambda: scheduler.hit_stats["matched_blocks"]
+        )
+
     @contextmanager
     def track(self, model: str, endpoint: str):
         """Track one request: inflight gauge + duration + status count."""

@@ -13,6 +13,7 @@ import contextlib
 import json
 import math
 import os
+import re
 import time
 from typing import Any, AsyncIterator, Callable, Optional
 
@@ -42,8 +43,23 @@ from dynamo_tpu.protocols.openai import (
 )
 from dynamo_tpu.protocols.sse import encode_done, encode_json_event
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import profile as dprofile
+from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.http")
+
+# client-supplied x-request-id: sanitized to a safe charset and bounded so
+# it can serve as the Context id, a log field, and a trace filename
+_RID_BAD = re.compile(r"[^A-Za-z0-9._:-]")
+_RID_MAX = 128
+
+
+def client_request_id(request: web.Request) -> Optional[str]:
+    rid = request.headers.get("x-request-id")
+    if not rid:
+        return None
+    rid = _RID_BAD.sub("-", rid.strip())[:_RID_MAX]
+    return rid or None
 
 # engine_fn(PreprocessedRequest, Context) -> AsyncIterator[LLMEngineOutput]
 EngineFn = Callable[[PreprocessedRequest, Context], AsyncIterator[LLMEngineOutput]]
@@ -234,8 +250,15 @@ class ModelExecution:
 
         async def run_choice(i: int, pre_i: PreprocessedRequest) -> None:
             finish: Optional[FinishReason] = None
+            # per-choice CHILD context: engines kill their ctx when their
+            # generator is torn down (the consumer-went-away signal), and
+            # the pipeline now acloses deterministically below — a child
+            # confines that kill to this choice, so a finished choice
+            # can't cancel its siblings or suppress the request-level
+            # finish/usage chunks (parent kill still cascades down)
+            agen = self.pipeline.generate(pre_i, ctx.child())
             try:
-                async for step in self.pipeline.generate(pre_i, ctx):
+                async for step in agen:
                     counters["completion"] += step.tokens_emitted
                     if step.text or step.logprobs:
                         if timer:
@@ -257,6 +280,13 @@ class ModelExecution:
             except Exception as e:  # noqa: BLE001 — surface as SSE error
                 queue.put_nowait(("error", e))
             finally:
+                # close the pipeline chain NOW, not at GC: async-generator
+                # finalization is deferred to the loop's asyncgen hooks, so
+                # an abandoned chain would keep the worker stream open and
+                # lose every span still inside a `with` (their exits only
+                # run on aclose)
+                with contextlib.suppress(Exception):
+                    await agen.aclose()
                 queue.put_nowait(("done", i))
 
         loop = asyncio.get_running_loop()
@@ -348,11 +378,16 @@ class ModelExecution:
         if ctx.is_killed():
             return
         if request.stream_options and request.stream_options.get("include_usage"):
-            yield Annotated.from_data(
-                gen.usage_chunk(
-                    len(pre.token_ids), counters["completion"]
-                ).model_dump(exclude_none=True)
-            )
+            chunk = gen.usage_chunk(
+                len(pre.token_ids), counters["completion"]
+            ).model_dump(exclude_none=True)
+            if dtrace.enabled():
+                # final SSE chunk carries the per-request phase breakdown
+                # (worker spans arrived on the stream's final frame)
+                tb = dtrace.breakdown(dtrace.ctx_trace_id(ctx))
+                if tb and chunk.get("usage") is not None:
+                    chunk["usage"]["timing"] = tb
+            yield Annotated.from_data(chunk)
 
     async def completion_stream(
         self, request: CompletionRequest, ctx: Context, timer: Optional[TokenTimer] = None
@@ -386,6 +421,17 @@ class ModelExecution:
         except Exception as e:  # noqa: BLE001
             yield Annotated.from_error(f"engine error: {e}")
             return
+        if ctx.is_killed():
+            return
+        if request.stream_options and request.stream_options.get("include_usage"):
+            chunk = gen.usage_chunk(
+                len(pre.token_ids), counters["completion"]
+            ).model_dump(exclude_none=True)
+            if dtrace.enabled():
+                tb = dtrace.breakdown(dtrace.ctx_trace_id(ctx))
+                if tb and chunk.get("usage") is not None:
+                    chunk["usage"]["timing"] = tb
+            yield Annotated.from_data(chunk)
 
 
 class ModelManager:
@@ -454,6 +500,8 @@ class HttpService:
                 web.get("/health", self._health),
                 web.get("/live", self._health),
                 web.get("/metrics", self._metrics),
+                web.get("/debug/traces/{request_id}", self._debug_trace),
+                web.get("/debug/profile", self._debug_profile),
             ]
         )
         self._runner: Optional[web.AppRunner] = None
@@ -502,11 +550,15 @@ class HttpService:
             {"error": {"message": message, "type": typ}}, status=status
         )
 
-    def _structured_error(self, model: str, message: Optional[str]):
+    def _structured_error(
+        self, model: str, message: Optional[str], ctx: Optional[Context] = None
+    ):
         """Unary rendering of a structured engine error: the payload's
         machine-readable code picks the HTTP status."""
         payload = _error_payload(message)
         code = payload.get("code", "internal_error")
+        if ctx is not None:
+            payload.setdefault("request_id", ctx.id)
         if code == "deadline_exceeded":
             self.metrics.deadline_exceeded.labels(model).inc()
         status = _CODE_STATUS.get(code, 500)
@@ -515,10 +567,56 @@ class HttpService:
                        "type": code, **{k: v for k, v in payload.items()
                                         if k in ("request_id", "phase")}}},
             status=status,
+            headers=self._resp_headers(ctx) if ctx is not None else None,
         )
         if status == 429:
             resp.headers["Retry-After"] = "1"
         return resp
+
+    # ---------------------------------------------------------- telemetry
+
+    def _request_ctx(self, request: web.Request) -> Context:
+        """Context honoring a client-supplied x-request-id (sanitized and
+        bounded) so client logs, our logs, and traces share one id."""
+        rid = client_request_id(request)
+        return Context(id=rid) if rid else Context()
+
+    def _trace_root(self, request: web.Request, ctx: Context, endpoint: str):
+        """Open the request's trace root, honoring an inbound W3C
+        `traceparent` (minting a fresh trace id otherwise)."""
+        if not dtrace.enabled():
+            return dtrace.NULL_CM
+        tid = sid = None
+        tp = request.headers.get("traceparent")
+        if tp:
+            tid, sid = dtrace.parse_traceparent(tp)
+        return dtrace.root_span(
+            "http_request", ctx, trace_id=tid, parent_id=sid,
+            proc="frontend", endpoint=endpoint, request_id=ctx.id,
+        )
+
+    def _resp_headers(self, ctx: Context) -> dict[str, str]:
+        h = {"x-request-id": ctx.id}
+        tid = dtrace.ctx_trace_id(ctx)
+        if tid:
+            h["x-dyn-trace-id"] = tid
+        return h
+
+    @staticmethod
+    def _attach_timing(d: dict, ctx: Context) -> None:
+        """Per-request timing breakdown onto a unary response's usage."""
+        if not dtrace.enabled():
+            return
+        tb = dtrace.breakdown(dtrace.ctx_trace_id(ctx))
+        if tb:
+            usage = d.get("usage") or {}
+            usage["timing"] = tb
+            d["usage"] = usage
+
+    @staticmethod
+    def _finish_trace(ctx: Context) -> None:
+        if dtrace.enabled():
+            dtrace.maybe_write_trace(dtrace.ctx_trace_id(ctx), ctx.id)
 
     def _shed(self, model: str, retry_after_s: float) -> web.Response:
         resp = self._error(
@@ -560,6 +658,7 @@ class HttpService:
                 "Content-Type": "text/event-stream",
                 "Cache-Control": "no-cache",
                 "Connection": "keep-alive",
+                **self._resp_headers(ctx),
             },
         )
         await resp.prepare(request)
@@ -569,6 +668,7 @@ class HttpService:
                     # typed SSE error event: structured payloads (request
                     # id, phase, cause, code) ride through verbatim
                     err = _error_payload(item.error_message())
+                    err.setdefault("request_id", ctx.id)
                     if err.get("code") == "deadline_exceeded" and model:
                         self.metrics.deadline_exceeded.labels(model).inc()
                     payload = {
@@ -636,11 +736,13 @@ class HttpService:
         retry_after = self.admission.try_acquire(chat_req.model)
         if retry_after is not None:
             return self._shed(chat_req.model, retry_after)
+        ctx = self._request_ctx(request)
         try:
-            ctx = Context()
             self._arm_deadline(ctx, chat_req)
             timer = TokenTimer(self.metrics, chat_req.model)
-            with self.metrics.track(chat_req.model, "chat_completions"):
+            with self.metrics.track(chat_req.model, "chat_completions"), \
+                    self._trace_root(request, ctx, "chat_completions") as root:
+                root.set(model=chat_req.model, stream=bool(chat_req.stream))
                 self.metrics.prompt_tokens.labels(chat_req.model)  # touch label
                 stream = execution.chat_stream(chat_req, ctx, timer)
                 if chat_req.stream:
@@ -651,15 +753,16 @@ class HttpService:
                 async for item in stream:
                     if item.is_error():
                         return self._structured_error(
-                            chat_req.model, item.error_message()
+                            chat_req.model, item.error_message(), ctx
                         )
                     if item.data is not None:
                         agg.add(ChatCompletionChunk.model_validate(item.data))
-                return web.json_response(
-                    agg.finish().model_dump(exclude_none=True)
-                )
+                d = agg.finish().model_dump(exclude_none=True)
+                self._attach_timing(d, ctx)
+                return web.json_response(d, headers=self._resp_headers(ctx))
         finally:
             self.admission.release(chat_req.model)
+            self._finish_trace(ctx)
 
     async def _completions(self, request: web.Request) -> web.StreamResponse:
         if self._draining:
@@ -677,11 +780,13 @@ class HttpService:
         retry_after = self.admission.try_acquire(comp_req.model)
         if retry_after is not None:
             return self._shed(comp_req.model, retry_after)
+        ctx = self._request_ctx(request)
         try:
-            ctx = Context()
             self._arm_deadline(ctx, comp_req)
             timer = TokenTimer(self.metrics, comp_req.model)
-            with self.metrics.track(comp_req.model, "completions"):
+            with self.metrics.track(comp_req.model, "completions"), \
+                    self._trace_root(request, ctx, "completions") as root:
+                root.set(model=comp_req.model, stream=bool(comp_req.stream))
                 stream = execution.completion_stream(comp_req, ctx, timer)
                 if comp_req.stream:
                     return await self._stream_sse(
@@ -691,15 +796,16 @@ class HttpService:
                 async for item in stream:
                     if item.is_error():
                         return self._structured_error(
-                            comp_req.model, item.error_message()
+                            comp_req.model, item.error_message(), ctx
                         )
                     if item.data is not None:
                         agg.add(CompletionResponse.model_validate(item.data))
-                return web.json_response(
-                    agg.finish().model_dump(exclude_none=True)
-                )
+                d = agg.finish().model_dump(exclude_none=True)
+                self._attach_timing(d, ctx)
+                return web.json_response(d, headers=self._resp_headers(ctx))
         finally:
             self.admission.release(comp_req.model)
+            self._finish_trace(ctx)
 
     async def _embeddings(self, request: web.Request) -> web.Response:
         from dynamo_tpu.protocols.openai import EmbeddingRequest
@@ -810,27 +916,30 @@ class HttpService:
         retry_after = self.admission.try_acquire(chat_req.model)
         if retry_after is not None:
             return self._shed(chat_req.model, retry_after)
+        ctx = self._request_ctx(request)
         try:
-            ctx = Context()
             self._arm_deadline(ctx, chat_req)
             timer = TokenTimer(self.metrics, chat_req.model)
-            with self.metrics.track(chat_req.model, "responses"):
+            with self.metrics.track(chat_req.model, "responses"), \
+                    self._trace_root(request, ctx, "responses"):
                 agg = ChatDeltaAggregator()
                 async for item in execution.chat_stream(chat_req, ctx, timer):
                     if item.is_error():
                         return self._structured_error(
-                            chat_req.model, item.error_message()
+                            chat_req.model, item.error_message(), ctx
                         )
                     if item.data is not None:
                         agg.add(ChatCompletionChunk.model_validate(item.data))
                 chat_resp = agg.finish()
         finally:
             self.admission.release(chat_req.model)
+            self._finish_trace(ctx)
         content = ""
         if chat_resp.choices:
             content = chat_resp.choices[0].message.content or ""
         return web.json_response(
-            {
+            headers=self._resp_headers(ctx),
+            data={
                 "id": f"resp_{uuid.uuid4().hex}",
                 "object": "response",
                 "created_at": int(time.time()),
@@ -887,6 +996,40 @@ class HttpService:
         return web.json_response(
             {"cleared_worker_groups": cleared, "failed_worker_groups": failed}
         )
+
+    async def _debug_trace(self, request: web.Request) -> web.Response:
+        """Serve one request's assembled cross-process trace as Chrome
+        trace-event JSON (load in Perfetto / chrome://tracing). Accepts
+        the request id (x-request-id / Context id) or a raw trace id."""
+        if not dtrace.enabled():
+            return self._error(
+                404, "tracing is disabled (set DYN_TRACE=1)", "not_found_error"
+            )
+        rid = request.match_info["request_id"]
+        tid = dtrace.trace_for_request(rid) or rid
+        spans = dtrace.spans_for_trace(tid)
+        if not spans:
+            return self._error(
+                404, f"no trace for request {rid!r}", "not_found_error"
+            )
+        doc = dtrace.chrome_trace(tid)
+        doc["otherData"]["request_id"] = rid
+        doc["otherData"]["breakdown"] = dtrace.breakdown(tid)
+        return web.json_response(doc)
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        """Open an on-demand device profile window:
+        GET /debug/profile?seconds=N[&dir=PATH]. The window auto-closes;
+        artifacts land under DYN_PROFILE_DIR (TensorBoard/Perfetto)."""
+        try:
+            seconds = float(request.query.get("seconds", "5"))
+        except ValueError:
+            return self._error(400, "seconds must be a number")
+        info = dprofile.start(seconds, request.query.get("dir") or None)
+        status = 200
+        if "error" in info:
+            status = 409 if "already" in info["error"] else 501
+        return web.json_response(info, status=status)
 
     async def _models(self, request: web.Request) -> web.Response:
         listing = ModelList(

@@ -164,6 +164,21 @@ class KvScheduler:
         self.selector = selector or DefaultWorkerSelector()
         self.sequences = ActiveSequencesMultiWorker(block_size, [])
         self.on_hit_rate_event = on_hit_rate_event
+        # local per-decision aggregation (reference plane 3): every
+        # schedule() records how many of the request's blocks the chosen
+        # worker already held — the standalone router's /metrics and the
+        # frontend's lazy gauges read these without an event round trip
+        self.hit_stats: dict[str, int] = {
+            "decisions": 0,
+            "isl_blocks": 0,
+            "matched_blocks": 0,
+        }
+
+    @property
+    def hit_rate(self) -> float:
+        """Cumulative matched/ISL blocks over every routing decision."""
+        isl = self.hit_stats["isl_blocks"]
+        return self.hit_stats["matched_blocks"] / isl if isl else 0.0
 
     def update_workers(self, worker_ids: list[int]) -> None:
         self.sequences.update_workers(worker_ids)
@@ -196,6 +211,9 @@ class KvScheduler:
         self.sequences.add_request_chain(
             result.worker_id, chain, partial, request_id
         )
+        self.hit_stats["decisions"] += 1
+        self.hit_stats["isl_blocks"] += result.required_blocks
+        self.hit_stats["matched_blocks"] += result.overlap_blocks
         if self.on_hit_rate_event is not None:
             self.on_hit_rate_event(
                 KVHitRateEvent(

@@ -12,6 +12,7 @@ import enum
 from typing import TYPE_CHECKING, Any, Optional, Protocol
 
 from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.telemetry import trace as dtrace
 
 if TYPE_CHECKING:
     from dynamo_tpu.runtime.component import Client, ResponseStream
@@ -68,7 +69,11 @@ class PushRouter:
             request.get("token_ids", []) if isinstance(request, dict) else []
         )
         assert self.selector is not None
-        worker_id, overlap = await self.selector.select_worker(token_ids, ctx)
+        with dtrace.span("route", ctx=ctx, tokens=len(token_ids)) as rsp:
+            worker_id, overlap = await self.selector.select_worker(
+                token_ids, ctx
+            )
+            rsp.set(worker=f"{worker_id:x}", overlap_blocks=overlap)
         if exclude and worker_id in exclude:
             # the KV-preferred worker just died on this request: any other
             # live instance beats replaying into the same failure

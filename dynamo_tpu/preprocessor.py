@@ -257,6 +257,17 @@ class CompletionDeltaGenerator:
         # tokens 1:1 across streamed chunks
         self._char_off: dict[int, int] = {}
 
+    def usage_chunk(
+        self, prompt_tokens: int, completion_tokens: int
+    ) -> CompletionResponse:
+        return CompletionResponse(
+            id=self.id,
+            model=self.model,
+            created=self.created,
+            choices=[],
+            usage=usage_dict(prompt_tokens, completion_tokens),
+        )
+
     def note_echo(self, prompt: str, index: int = 0) -> None:
         """echo=true prepends the prompt to the returned text; legacy
         text_offset indexes into the FULL text, so offsets start after it."""

@@ -133,6 +133,9 @@ class LLMEngineOutput:
     # structured failure payload on ERROR finals: {"request_id", "phase",
     # "cause", "code"} — reaches the SSE stream as a typed error event
     error: Optional[dict[str, Any]] = None
+    # completed telemetry spans riding the FINAL frame back to the caller
+    # (worker -> frontend trace assembly; stripped before the HTTP layer)
+    trace: Optional[list] = None
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {"token_ids": self.token_ids, "index": self.index}
@@ -148,6 +151,8 @@ class LLMEngineOutput:
             out["top_logprobs"] = self.top_logprobs
         if self.error is not None:
             out["error"] = self.error
+        if self.trace is not None:
+            out["trace"] = self.trace
         return out
 
     @classmethod
@@ -162,6 +167,7 @@ class LLMEngineOutput:
             log_probs=d.get("log_probs"),
             top_logprobs=d.get("top_logprobs"),
             error=d.get("error"),
+            trace=d.get("trace"),
         )
 
     @classmethod

@@ -27,6 +27,7 @@ import time
 from typing import Any, Optional
 
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.router")
 
@@ -44,6 +45,7 @@ class StandaloneRouter:
         block_size: int = 16,
         kv_config: Optional[Any] = None,
         queue_factor: Optional[float] = None,
+        metrics_port: Optional[int] = None,
     ) -> None:
         self.drt = drt
         self.namespace = namespace
@@ -62,6 +64,11 @@ class StandaloneRouter:
         self._load: Optional[tuple[int, int]] = None  # (slots, active+wait)
         self._load_at = 0.0
         self.shed_total = 0
+        self.decisions_total = 0
+        # /metrics + /health for the routing brain itself (None disables):
+        # KV hit rate, matched blocks, shed + decision counters
+        self.metrics_port = metrics_port
+        self._status_server = None
 
     async def start(self) -> None:
         from dynamo_tpu.kv_router.publisher import KvMetricsAggregator
@@ -84,10 +91,45 @@ class StandaloneRouter:
             .endpoint("find_best")
         )
         self._service = await serve_ep.serve_endpoint(self._handler)
+        if self.metrics_port is not None:
+            await self._start_status_server()
         logger.info(
             "standalone router serving %s.router.find_best for %s",
             self.namespace, self.worker_endpoint.id,
         )
+
+    async def _start_status_server(self) -> int:
+        """Expose the router's own observability plane: Prometheus
+        `dyn_llm_kv_hit_rate` / `dyn_llm_kv_matched_blocks_total` from the
+        scheduler's per-decision accounting, plus shed/decision counters."""
+        from prometheus_client import CollectorRegistry, Gauge
+
+        from dynamo_tpu.runtime.http_server import SystemStatusServer
+
+        registry = CollectorRegistry()
+        scheduler = self.router.scheduler
+        for name, doc, read in (
+            ("dyn_llm_kv_hit_rate",
+             "Router KV hit rate: matched / required prefill blocks",
+             lambda: scheduler.hit_rate),
+            ("dyn_llm_kv_matched_blocks_total",
+             "Prefill blocks served from a routed worker's cache",
+             lambda: scheduler.hit_stats["matched_blocks"]),
+            ("dyn_llm_router_decisions_total",
+             "Routing decisions served",
+             lambda: self.decisions_total),
+            ("dyn_llm_requests_shed_total",
+             "Requests shed by the router's fleet-load watermark",
+             lambda: self.shed_total),
+        ):
+            g = Gauge(name, doc, registry=registry)
+            g.set_function(read)
+        self._status_server = SystemStatusServer(
+            port=self.metrics_port, registry=registry
+        )
+        port = await self._status_server.start()
+        logger.info("standalone router /metrics on :%d", port)
+        return port
 
     async def _overloaded(self) -> bool:
         """Fleet past the admission watermark? Uses a load snapshot cached
@@ -119,18 +161,35 @@ class StandaloneRouter:
             self.router.free(str(request.get("request_id", "")))
             yield {"ok": True}
             return
-        if await self._overloaded():
-            self.shed_total += 1
-            yield {"shed": True, "retry_after_ms": 1000}
-            return
-        tokens = request.get("token_ids") or request.get("tokens") or []
-        request_id = str(request.get("request_id", ""))
-        worker_id, overlap = await self.router.find_best_match(
-            list(tokens), request_id=request_id or None
-        )
-        yield {"worker_id": worker_id, "overlap_blocks": overlap}
+        # trace context rides Context.metadata over the find_best hop, so
+        # the routing decision lands on the request's assembled timeline
+        # (the span ships back in the reply — the router process has no
+        # response-plane final frame of its own)
+        with dtrace.span(
+            "route_decision", ctx=ctx, proc="router"
+        ) as rsp:
+            if await self._overloaded():
+                self.shed_total += 1
+                rsp.set(shed=True)
+                yield {"shed": True, "retry_after_ms": 1000}
+                return
+            tokens = request.get("token_ids") or request.get("tokens") or []
+            request_id = str(request.get("request_id", ""))
+            worker_id, overlap = await self.router.find_best_match(
+                list(tokens), request_id=request_id or None
+            )
+            self.decisions_total += 1
+            rsp.set(worker=f"{worker_id:x}", overlap_blocks=overlap)
+        out = {"worker_id": worker_id, "overlap_blocks": overlap}
+        if rsp.trace_id:
+            out["trace"] = dtrace.export_for_trace(
+                rsp.trace_id, include_remote=False
+            )
+        yield out
 
     async def close(self) -> None:
+        if self._status_server is not None:
+            await self._status_server.close()
         if self._service is not None:
             await self._service.stop()
         if self.router is not None:
@@ -152,6 +211,7 @@ async def _amain(args) -> None:
             overlap_score_weight=args.kv_overlap_score_weight,
             router_temperature=args.router_temperature,
         ),
+        metrics_port=args.metrics_port,
     )
     await router.start()
     stop = asyncio.Event()
@@ -171,6 +231,10 @@ def main() -> None:
     ap.add_argument("--block-size", type=int, default=16)
     ap.add_argument("--kv-overlap-score-weight", type=float, default=1.0)
     ap.add_argument("--router-temperature", type=float, default=0.0)
+    ap.add_argument(
+        "--metrics-port", type=int, default=None,
+        help="expose /metrics + /health for the router (0 = ephemeral)",
+    )
     asyncio.run(_amain(ap.parse_args()))
 
 

@@ -91,7 +91,10 @@ def parse_args(argv: Optional[list[str]] = None) -> argparse.Namespace:
 
 
 async def amain(args: argparse.Namespace) -> None:
-    dlog.init()
+    # force: this IS the process entrypoint — honor the child's DYN_LOG /
+    # DYN_LOGGING_JSONL even when an early import already initialized
+    # logging (serve.py children tighten per-service log levels this way)
+    dlog.init(force=True)
     drt = await DistributedRuntime.from_settings()
     try:
         name = args.model_name or (args.model_path or "echo-model")

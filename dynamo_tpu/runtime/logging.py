@@ -13,9 +13,21 @@ import logging
 import os
 import sys
 import time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 _INITIALIZED = False
+
+# Optional provider of ambient structured fields (trace_id/request_id):
+# registered by the telemetry layer so every with_fields line joins logs
+# to traces without call sites threading ids through (telemetry/trace.py
+# current_fields). Kept as a late-bound hook — logging must stay importable
+# before/without telemetry.
+_context_fields_fn: Optional[Callable[[], dict]] = None
+
+
+def set_context_fields_provider(fn: Optional[Callable[[], dict]]) -> None:
+    global _context_fields_fn
+    _context_fields_fn = fn
 
 _LEVELS = {
     "trace": 5,
@@ -80,10 +92,26 @@ def _parse_filter(spec: str) -> tuple[int, dict[str, int]]:
     return default, targets
 
 
-def init(level: Optional[str] = None, jsonl: Optional[bool] = None) -> None:
-    """Idempotent global logging init honoring DYN_LOG / DYN_LOGGING_JSONL."""
+def init(
+    level: Optional[str] = None,
+    jsonl: Optional[bool] = None,
+    force: bool = False,
+) -> None:
+    """Idempotent global logging init honoring DYN_LOG / DYN_LOGGING_JSONL.
+
+    A repeat call is a no-op UNLESS `force=True` — the explicit re-init
+    path for processes that need to tighten/retarget logging after an
+    early import already initialized it (serve.py children, tests).
+    Without `force`, explicit `level=`/`jsonl=` args on a repeat call are
+    rejected loudly instead of silently ignored."""
     global _INITIALIZED
-    if _INITIALIZED:
+    if _INITIALIZED and not force:
+        if level is not None or jsonl is not None:
+            logging.getLogger(__name__).warning(
+                "logging.init(level=%r, jsonl=%r) ignored: already "
+                "initialized (pass force=True to re-init)",
+                level, jsonl,
+            )
         return
     _INITIALIZED = True
     spec = level if level is not None else os.environ.get("DYN_LOG", "info")
@@ -107,5 +135,14 @@ def get_logger(name: str) -> logging.Logger:
 
 
 def with_fields(logger: logging.Logger, level: int, msg: str, **fields: Any) -> None:
-    """Log with structured span-style fields (rendered in both formats)."""
+    """Log with structured span-style fields (rendered in both formats).
+    Ambient trace identity (trace_id/request_id from the registered
+    provider) is merged in automatically so logs and traces join."""
+    if _context_fields_fn is not None:
+        try:
+            ambient = _context_fields_fn()
+        except Exception:  # noqa: BLE001 — logging must never throw
+            ambient = None
+        if ambient:
+            fields = {**ambient, **fields}
     logger.log(level, msg, extra={"fields": fields})

@@ -1,0 +1,41 @@
+"""Distributed request telemetry: spans, per-request timelines, profiling.
+
+Role-equivalent of the reference runtime's `tracing` spans (which are only
+lightly wired there) grown into a full plane: every hop of a request —
+HTTP ingress, router decision, worker dispatch, disaggregated prefill
+stream, migration replay — records lightweight spans into a bounded
+per-process ring buffer, stitched back together at the frontend into ONE
+trace per request (`/debug/traces/{request_id}`, Chrome trace-event JSON,
+and a timing breakdown on the final SSE `usage` block).
+
+Off by default (`DYN_TRACE=0`): every instrumentation point first checks a
+module flag and returns a shared no-op object, so the disabled fast path
+allocates nothing and costs one attribute load + branch.
+"""
+
+from dynamo_tpu.telemetry.trace import (  # noqa: F401
+    Span,
+    Tracer,
+    begin,
+    breakdown,
+    finish,
+    span_from_wire,
+    chrome_trace,
+    ctx_trace_id,
+    enabled,
+    event,
+    export_for_trace,
+    format_traceparent,
+    ingest,
+    maybe_write_trace,
+    parse_traceparent,
+    process_scope,
+    root_span,
+    set_enabled,
+    set_process,
+    span,
+    spans_for_trace,
+    trace_for_request,
+    tracer,
+    wire_span,
+)
