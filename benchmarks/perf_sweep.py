@@ -256,7 +256,8 @@ def main() -> None:
     ap.add_argument("--decode-horizon", type=int, default=None)
     ap.add_argument("--context-length", type=int, default=None)
     ap.add_argument(
-        "--preset", choices=["canonical", "swa", "chaos", "disagg", "trace"],
+        "--preset",
+        choices=["canonical", "swa", "chaos", "disagg", "trace", "slo"],
         default=None,
         help="canonical = the reference's genai-perf workload "
         "(examples/llm/benchmarks/README.md:41 — ISL 3000 / OSL 150, "
@@ -273,7 +274,10 @@ def main() -> None:
         "vs monolithic P/D TTFT over a simulated wire; banked artifact "
         "benchmarks/disagg_stream.json). trace = delegates to "
         "benchmarks.trace_overhead_bench (token throughput DYN_TRACE off "
-        "vs on; banked artifact benchmarks/trace_overhead.json)",
+        "vs on; banked artifact benchmarks/trace_overhead.json). "
+        "slo = delegates to benchmarks.slo_overhead_bench (always-on "
+        "phase histograms + DYN_TRACE=auto flight recorder vs the PR 5 "
+        "disabled baseline; banked artifact benchmarks/slo_overhead.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -295,6 +299,16 @@ def main() -> None:
 
         trace_overhead_bench.main(
             ["--json", args.json or "benchmarks/trace_overhead.json"]
+        )
+        return
+    if args.preset == "slo":
+        # SLO-plane overhead sweep runs on the mocker directly: always-on
+        # histogram recording must stay within a few percent of the PR 5
+        # disabled baseline, auto-mode cost banked alongside
+        from benchmarks import slo_overhead_bench
+
+        slo_overhead_bench.main(
+            ["--json", args.json or "benchmarks/slo_overhead.json"]
         )
         return
     tiny_extra_cfg = None

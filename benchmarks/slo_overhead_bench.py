@@ -1,0 +1,223 @@
+"""SLO-plane overhead bench: always-on phase histograms and DYN_TRACE=auto.
+
+ISSUE 6 makes two things unconditional that PR 5 kept behind flags:
+
+  * engines record phase histograms (queue_wait/prefill/ttft/inter_token/
+    e2e) on EVERY request — an `observe()` is a bisect + two adds;
+  * with `DYN_TRACE=auto`, spans are recorded for every request and a
+    retention decision runs at completion (kept only on breach/error/
+    sample — the flight recorder).
+
+This bench banks mocker token throughput for three modes so the cost of
+the always-on plane is known and bounded vs the PR 5 disabled baseline
+(`benchmarks/trace_overhead.json`):
+
+  * `off`   — DYN_TRACE=0: histograms on (they cannot be turned off);
+              this is the production default and must stay within a few
+              percent of the PR 5 disabled number;
+  * `auto`  — DYN_TRACE=auto with no retained traces (healthy traffic):
+              span recording + per-request retention decision;
+  * micro   — ns/op of `PhaseHistogram.observe()` and the retention
+              decision itself.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.slo_overhead_bench \
+        --json benchmarks/slo_overhead.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+
+def _make_engine():
+    from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+
+    return MockEngine(
+        MockEngineArgs(
+            block_size=16,
+            speedup_ratio=1e6,  # sims collapse: host work only
+            decode_per_token_s=0.001,
+        )
+    )
+
+
+async def _run_tokens(engine, requests: int, prompt: int, tokens: int,
+                      auto: bool):
+    from dynamo_tpu.pipeline.context import Context
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.telemetry import slo as dslo
+    from dynamo_tpu.telemetry import trace as dtrace
+
+    cfg = dslo.SloConfig(ttft_ms=10_000.0)  # healthy traffic never breaches
+
+    async def one(i: int) -> int:
+        req = PreprocessedRequest(
+            token_ids=[(i + j) % 512 + 3 for j in range(prompt)],
+            sampling=SamplingOptions(greedy=True),
+            stop=StopConditions(max_tokens=tokens, ignore_eos=True),
+        )
+        ctx = Context()
+        n = 0
+        if auto:
+            # what HTTP ingress does in auto mode: a trace root, then a
+            # retention decision at completion (dropped for fast traffic)
+            t0 = time.monotonic()
+            with dtrace.root_span("request", ctx, request_id=ctx.id):
+                async for out in engine.generate(req, ctx):
+                    n += len(out.token_ids)
+            reason = dslo.retention_reason(
+                cfg, ttft_ms=(time.monotonic() - t0) * 1e3, sample=0
+            )
+            if reason is not None:
+                dslo.recorder().retain(
+                    dtrace.ctx_trace_id(ctx), ctx.id, reason
+                )
+            else:
+                dslo.recorder().note_dropped()
+            return n
+        async for out in engine.generate(req, ctx):
+            n += len(out.token_ids)
+        return n
+
+    t0 = time.monotonic()
+    counts = await asyncio.gather(*(one(i) for i in range(requests)))
+    dt = time.monotonic() - t0
+    return sum(counts), dt
+
+
+def measure_mode(mode: str, requests: int, prompt: int, tokens: int):
+    from dynamo_tpu.telemetry import slo as dslo
+    from dynamo_tpu.telemetry import trace as dtrace
+
+    assert mode in ("off", "auto")
+    if mode == "auto":
+        dtrace.set_mode("auto")
+    else:
+        dtrace.set_enabled(False)
+    dtrace.reset(proc="bench")
+    dslo.reset_recorder(out_dir=None)
+    try:
+        engine = _make_engine()
+        total, dt = asyncio.run(
+            _run_tokens(engine, requests, prompt, tokens, auto=(mode == "auto"))
+        )
+        hist = engine.stats()["phase_histograms"]
+        return {
+            "mode": mode,
+            "tokens": total,
+            "seconds": round(dt, 4),
+            "tokens_per_s": round(total / dt, 1),
+            "ring_spans": dtrace.tracer().ring_len(),
+            "hist_observations": hist.total_count(),
+            "traces_retained": dslo.recorder().retained_total,
+        }
+    finally:
+        dtrace.set_enabled(False)
+        dtrace.reset()
+        dslo.reset_recorder()
+
+
+def measure_micro_ns(iters: int = 200_000) -> dict:
+    """ns/op of the always-on calls themselves."""
+    from dynamo_tpu.telemetry import slo as dslo
+    from dynamo_tpu.telemetry.histogram import PhaseHistogram
+
+    out = {}
+    h = PhaseHistogram()
+    t0 = time.perf_counter_ns()
+    for i in range(iters):
+        h.observe(0.1 + (i & 1023))
+    out["hist_observe"] = round((time.perf_counter_ns() - t0) / iters, 1)
+    cfg = dslo.SloConfig(ttft_ms=100.0, itl_ms=10.0)
+    t0 = time.perf_counter_ns()
+    for i in range(iters):
+        dslo.retention_reason(cfg, ttft_ms=5.0, max_itl_ms=1.0, sample=0)
+    out["retention_decision"] = round(
+        (time.perf_counter_ns() - t0) / iters, 1
+    )
+    return out
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--prompt-tokens", type=int, default=64)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    # interleave repeats and keep each mode's best (least-noisy) run
+    best = {}
+    for _ in range(args.repeats):
+        for mode in ("off", "auto"):
+            r = measure_mode(
+                mode, args.requests, args.prompt_tokens, args.max_tokens
+            )
+            if (
+                mode not in best
+                or r["tokens_per_s"] > best[mode]["tokens_per_s"]
+            ):
+                best[mode] = r
+    auto_overhead = 1.0 - best["auto"]["tokens_per_s"] / max(
+        1e-9, best["off"]["tokens_per_s"]
+    )
+    doc = {
+        "bench": "slo_overhead",
+        "requests": args.requests,
+        "prompt_tokens": args.prompt_tokens,
+        "max_tokens": args.max_tokens,
+        "off": best["off"],
+        "auto": best["auto"],
+        "auto_overhead_frac": round(auto_overhead, 4),
+        "micro_ns_per_op": measure_micro_ns(),
+    }
+    # The "within a few percent of the PR 5 disabled baseline" contract:
+    # rerun the PR 5 bench's disabled mode IN THIS PROCESS so the
+    # comparison is same-machine/same-load (the banked trace_overhead.json
+    # number may come from different hardware). Note both paths now carry
+    # the always-on histograms; the micro numbers above bound their cost
+    # (~0.5 us/observe, ~1% of mocker token work).
+    from benchmarks.trace_overhead_bench import measure_mode as _trace_mode
+
+    same_machine = max(
+        _trace_mode(
+            False, args.requests, args.prompt_tokens, args.max_tokens
+        )["tokens_per_s"]
+        for _ in range(args.repeats)
+    )
+    doc["trace_bench_disabled_tokens_per_s"] = same_machine
+    doc["off_vs_trace_disabled"] = round(
+        best["off"]["tokens_per_s"] / same_machine, 4
+    )
+    ref_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "trace_overhead.json"
+    )
+    try:
+        with open(ref_path) as f:
+            ref = json.load(f)
+        base = ref["disabled"]["tokens_per_s"]
+        doc["pr5_banked_disabled_tokens_per_s"] = base
+        doc["off_vs_pr5_banked"] = round(
+            best["off"]["tokens_per_s"] / base, 4
+        )
+    except (OSError, KeyError, ValueError):
+        pass
+    print(json.dumps(doc, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    return doc
+
+
+if __name__ == "__main__":
+    main()

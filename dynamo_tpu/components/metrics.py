@@ -3,10 +3,25 @@
 Role-equivalent of components/metrics/src/{main,lib}.rs: every second,
 collect `ForwardPassMetrics` from all workers of a target endpoint (their
 `load_metrics` stats endpoints on the fabric), aggregate, export Prometheus
-gauges, and subscribe to `kv-hit-rate` events from the KV router
+series, and subscribe to `kv-hit-rate` events from the KV router
 (lib.rs:96-597). `MockWorkerMetrics` mirrors bin/mock_worker.rs: a fake
 worker publishing synthetic stats so dashboards and the planner can be
 exercised with zero engines.
+
+ISSUE 6 additions:
+
+  * fleet-true latency distributions: per-worker `PhaseHistograms`
+    (fixed-log buckets) are merged by bucket ADDITION in the aggregator
+    and exported as a real Prometheus histogram
+    (`dyn_llm_phase_duration_seconds{phase=...}`) plus derived
+    p50/p95/p99 gauges — percentiles over the whole fleet's requests,
+    which the per-frontend `http/metrics.py` histograms cannot see;
+  * monotonic worker counters (deadline expiries, watchdog trips, KV
+    wire bytes/frames, dropped prefills) export with COUNTER semantics
+    (scrape-time counter families), not `_total`-named gauges;
+  * the SLO engine (`telemetry/slo.py`): multi-window burn rates over
+    the merged histograms, `dyn_llm_slo_*` gauges, `GET /debug/slo`,
+    and a `slo-status` fabric event on ok/burning/breached transitions.
 
 Run: python -m dynamo_tpu.components.metrics --namespace NS --component C \
          --endpoint E --port 9091
@@ -21,23 +36,160 @@ from typing import Optional
 
 import msgpack
 
+from aiohttp import web
 from prometheus_client import CollectorRegistry, Counter, Gauge
+from prometheus_client.core import (
+    CounterMetricFamily,
+    GaugeMetricFamily,
+    HistogramMetricFamily,
+)
 
 from dynamo_tpu.kv_router import KV_HIT_RATE_SUBJECT
-from dynamo_tpu.kv_router.protocols import ForwardPassMetrics, KvStats, WorkerStats
+from dynamo_tpu.kv_router.protocols import (
+    ForwardPassMetrics,
+    KvStats,
+    KvTransferStats,
+    SpecDecodeStats,
+    WorkerStats,
+)
 from dynamo_tpu.kv_router.publisher import KvMetricsAggregator, WorkerMetricsPublisher
 from dynamo_tpu.runtime.component import Component, Endpoint
 from dynamo_tpu.runtime.http_server import SystemStatusServer
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import EndpointId
+from dynamo_tpu.telemetry import slo as dslo
+from dynamo_tpu.telemetry.histogram import BOUNDS, NUM_BUCKETS, PhaseHistograms
 
 logger = get_logger("dynamo_tpu.components.metrics")
 
 PREFIX = "dyn_llm"
 
+# Downsampled export grid for the Prometheus histogram: every 4th internal
+# bound (GROWTH^4 = 2, so exported `le` bounds double), 28 buckets + +Inf
+# spanning ~0.08 ms to ~3 h. Cumulative counts at these bounds are exact
+# sums of the internal buckets, so no precision is invented — only
+# resolution traded for a sane exposition size.
+_EXPORT_IDX = tuple(range(3, NUM_BUCKETS, 4))
+
+_SLO_STATE_VALUE = {"ok": 0.0, "burning": 1.0, "breached": 2.0}
+
+
+class _FleetCollector:
+    """Scrape-time families derived from the latest aggregate: counter
+    semantics for the fleet-summed monotonic series, the merged phase
+    histogram, derived percentile gauges, and the SLO plane."""
+
+    _COUNTERS = (
+        # (family base name — exposition appends `_total`, doc, reader)
+        ("deadline_exceeded",
+         "Requests cancelled on deadline/TTFT expiry (fleet sum)",
+         lambda agg: agg.worker_stats.num_deadline_exceeded),
+        ("watchdog_trips",
+         "Stuck-horizon watchdog trips (fleet sum)",
+         lambda agg: agg.worker_stats.num_watchdog_trips),
+    )
+    _XFER_COUNTERS = (
+        ("kv_wire_tx_bytes", "KV wire bytes shipped (fleet sum)",
+         lambda x: x.kv_wire_bytes_tx),
+        ("kv_wire_rx_bytes", "KV wire bytes landed (fleet sum)",
+         lambda x: x.kv_wire_bytes_rx),
+        ("kv_frames_tx", "KV stream frames shipped (fleet sum)",
+         lambda x: x.kv_frames_tx),
+        ("kv_frames_rx", "KV stream frames landed (fleet sum)",
+         lambda x: x.kv_frames_rx),
+        ("prefill_dropped_expired",
+         "Remote prefills dropped past their deadline (fleet sum)",
+         lambda x: x.prefill_dropped_expired),
+    )
+
+    def __init__(self, component: "MetricsComponent") -> None:
+        self.component = component
+
+    def describe(self):
+        return []  # dynamic families; registry probes collect() instead
+
+    def collect(self):
+        agg = self.component.last
+        for name, doc, read in self._COUNTERS:
+            value = float(read(agg)) if agg is not None else 0.0
+            yield CounterMetricFamily(f"{PREFIX}_{name}", doc, value=value)
+        xfer = agg.kv_transfer_stats if agg is not None else None
+        for name, doc, read in self._XFER_COUNTERS:
+            value = float(read(xfer)) if xfer is not None else 0.0
+            yield CounterMetricFamily(f"{PREFIX}_{name}", doc, value=value)
+        ph = agg.phase_histograms if agg is not None else None
+        yield from self._phase_families(ph)
+        yield from self._slo_families()
+
+    def _phase_families(self, ph: Optional[PhaseHistograms]):
+        hist = HistogramMetricFamily(
+            f"{PREFIX}_phase_duration_seconds",
+            "Merged fleet latency distribution per request phase "
+            "(bucket-added per-worker fixed-log histograms)",
+            labels=["phase"],
+        )
+        quant = GaugeMetricFamily(
+            f"{PREFIX}_phase_latency_seconds",
+            "Fleet phase latency percentiles from the merged histograms",
+            labels=["phase", "quantile"],
+        )
+        if ph is not None:
+            for phase in sorted(ph.phases):
+                h = ph.phases[phase]
+                buckets = []
+                cum = 0
+                lo = 0
+                for idx in _EXPORT_IDX:
+                    cum += sum(h.counts[lo : idx + 1])
+                    lo = idx + 1
+                    buckets.append((f"{BOUNDS[idx] / 1e3:.9g}", float(cum)))
+                buckets.append(("+Inf", float(h.count)))
+                hist.add_metric(
+                    [phase], buckets=buckets, sum_value=h.sum_ms / 1e3
+                )
+                for q in (50, 95, 99):
+                    quant.add_metric(
+                        [phase, f"p{q}"], h.percentile(q) / 1e3
+                    )
+        yield hist
+        yield quant
+
+    def _slo_families(self):
+        slo = self.component.slo
+        status = slo.last_status
+        state = GaugeMetricFamily(
+            f"{PREFIX}_slo_state",
+            "SLO state machine: 0 ok, 1 burning, 2 breached",
+            value=_SLO_STATE_VALUE.get(status.get("state"), 0.0),
+        )
+        yield state
+        burn = GaugeMetricFamily(
+            f"{PREFIX}_slo_burn_rate",
+            "Error-budget burn rate (1.0 = budget consumed exactly as it "
+            "accrues) per signal and window",
+            labels=["signal", "window"],
+        )
+        target = GaugeMetricFamily(
+            f"{PREFIX}_slo_target_seconds",
+            "Configured SLO latency threshold per signal",
+            labels=["signal"],
+        )
+        for name, sig in (status.get("signals") or {}).items():
+            burn.add_metric([name, "fast"], sig.get("burn_fast", 0.0))
+            burn.add_metric([name, "slow"], sig.get("burn_slow", 0.0))
+            target.add_metric([name], (sig.get("target_ms") or 0.0) / 1e3)
+        yield burn
+        yield target
+        yield CounterMetricFamily(
+            f"{PREFIX}_slo_breaches",
+            "Transitions into the breached SLO state",
+            value=float(slo.breaches_total),
+        )
+
 
 class MetricsComponent:
-    """Scrape -> aggregate -> Prometheus, plus kv-hit-rate accounting."""
+    """Scrape -> aggregate -> Prometheus, plus kv-hit-rate accounting and
+    the fleet SLO engine."""
 
     def __init__(
         self,
@@ -52,6 +204,12 @@ class MetricsComponent:
         self.aggregator = KvMetricsAggregator(component, endpoint)
         self.registry = CollectorRegistry()
         self.server = SystemStatusServer(port=port, registry=self.registry)
+        self.server.add_route("/debug/slo", self._debug_slo)
+        # fleet SLO engine over the merged phase histograms; transitions
+        # publish `slo-status` on the namespace (the planner's SLA hook)
+        self.slo = dslo.SloEngine(
+            dslo.SloConfig.from_env(), on_transition=self._on_slo_transition
+        )
 
         def g(name: str, doc: str) -> Gauge:
             return Gauge(f"{PREFIX}_{name}", doc, registry=self.registry)
@@ -60,21 +218,12 @@ class MetricsComponent:
         self.g_total_slots = g("requests_total_slots", "Total request slots")
         self.g_waiting = g("requests_waiting", "Queued requests")
         self.g_kv_active = g("kv_blocks_active", "Active KV blocks")
-        self.g_kv_total = g("kv_blocks_total", "Total KV blocks")
+        self.g_kv_total = g("kv_blocks_capacity", "Total KV blocks")
         self.g_cache_usage = g("kv_cache_usage_percent", "Mean cache usage")
         self.g_hit_rate = g(
             "kv_prefix_cache_hit_rate", "Mean engine prefix hit rate"
         )
         self.g_workers = g("worker_count", "Workers reporting stats")
-        # request lifeguard (fleet-summed worker counters)
-        self.g_deadline_exceeded = g(
-            "deadline_exceeded_total",
-            "Requests cancelled on deadline/TTFT expiry (fleet sum)",
-        )
-        self.g_watchdog_trips = g(
-            "watchdog_trips_total",
-            "Stuck-horizon watchdog trips (fleet sum)",
-        )
         # speculative decoding (SpecDecodeStats): absent until a worker
         # reports spec counters, then summed across the fleet
         self.g_spec_drafts = g(
@@ -90,19 +239,8 @@ class MetricsComponent:
             "spec_decode_acceptance_rate",
             "Accepted / proposed draft tokens",
         )
-        # KV data plane (streaming disagg): fleet-summed transfer counters
-        self.g_kv_wire_tx = g(
-            "kv_wire_tx_bytes", "KV wire bytes shipped (fleet sum)"
-        )
-        self.g_kv_wire_rx = g(
-            "kv_wire_rx_bytes", "KV wire bytes landed (fleet sum)"
-        )
-        self.g_kv_frames_tx = g(
-            "kv_frames_tx", "KV stream frames shipped (fleet sum)"
-        )
-        self.g_kv_frames_rx = g(
-            "kv_frames_rx", "KV stream frames landed (fleet sum)"
-        )
+        # KV data plane gauges (the true gauges of the transfer plane;
+        # the monotonic byte/frame counters live in _FleetCollector)
         self.g_kv_frames_inflight = g(
             "kv_frames_inflight",
             "KV frames extracted but not yet on the wire (fleet sum)",
@@ -110,10 +248,6 @@ class MetricsComponent:
         self.g_kv_overlap = g(
             "kv_stream_overlap",
             "Fraction of received KV bytes landed before the final frame",
-        )
-        self.g_prefill_dropped_expired = g(
-            "prefill_dropped_expired_total",
-            "Remote prefills dropped past their deadline (fleet sum)",
         )
         self.c_hit_events = Counter(
             f"{PREFIX}_kv_hit_rate_events_total",
@@ -139,6 +273,8 @@ class MetricsComponent:
             "Prefill blocks served from a routed worker's cache",
             registry=self.registry,
         )
+        # counter-semantics + histogram + SLO families (scrape-time)
+        self.registry.register(_FleetCollector(self))
         self._isl_sum = 0
         self._overlap_sum = 0
         self._tasks: list[asyncio.Task] = []
@@ -162,6 +298,39 @@ class MetricsComponent:
                 await t
         await self.server.close()
 
+    # ---------------------------------------------------------------- slo
+
+    def _on_slo_transition(self, old: str, new: str, status: dict) -> None:
+        logger.warning("fleet SLO state: %s -> %s", old, new)
+        payload = {"old": old, "new": new, **status}
+
+        async def _publish() -> None:
+            with contextlib.suppress(Exception):
+                await self.component.namespace.publish_event(
+                    dslo.SLO_STATUS_SUBJECT, payload
+                )
+
+        with contextlib.suppress(RuntimeError):
+            asyncio.get_running_loop().create_task(_publish())
+
+    async def _debug_slo(self, request: web.Request) -> web.Response:
+        cfg = self.slo.config
+        if not cfg.enabled:
+            return web.json_response(
+                {
+                    "enabled": False,
+                    "hint": "set DYN_SLO_TTFT_MS / DYN_SLO_ITL_MS "
+                    "or DYN_SLO_CONFIG",
+                }
+            )
+        return web.json_response(
+            {
+                "enabled": True,
+                "scope": "fleet",
+                "status": self.slo.evaluate(),
+            }
+        )
+
     # -------------------------------------------------------------- loops
 
     async def _poll_loop(self) -> None:
@@ -176,10 +345,6 @@ class MetricsComponent:
                 self.g_waiting.set(agg.worker_stats.num_requests_waiting)
                 self.g_kv_active.set(agg.kv_stats.kv_active_blocks)
                 self.g_kv_total.set(agg.kv_stats.kv_total_blocks)
-                self.g_deadline_exceeded.set(
-                    agg.worker_stats.num_deadline_exceeded
-                )
-                self.g_watchdog_trips.set(agg.worker_stats.num_watchdog_trips)
                 self.g_cache_usage.set(agg.kv_stats.gpu_cache_usage_perc)
                 self.g_hit_rate.set(agg.kv_stats.gpu_prefix_cache_hit_rate)
                 spec = agg.spec_decode_stats
@@ -190,15 +355,15 @@ class MetricsComponent:
                     self.g_spec_accept_rate.set(spec.acceptance_rate)
                 xfer = agg.kv_transfer_stats
                 if xfer is not None:
-                    self.g_kv_wire_tx.set(xfer.kv_wire_bytes_tx)
-                    self.g_kv_wire_rx.set(xfer.kv_wire_bytes_rx)
-                    self.g_kv_frames_tx.set(xfer.kv_frames_tx)
-                    self.g_kv_frames_rx.set(xfer.kv_frames_rx)
                     self.g_kv_frames_inflight.set(xfer.kv_frames_inflight)
                     self.g_kv_overlap.set(xfer.overlap_fraction)
-                    self.g_prefill_dropped_expired.set(
-                        xfer.prefill_dropped_expired
-                    )
+                # burn-rate windows advance on every poll, with or without
+                # fresh phase data (recovery to ok needs empty ticks too)
+                self.slo.observe(
+                    agg.phase_histograms
+                    if agg.phase_histograms is not None
+                    else PhaseHistograms()
+                )
             except Exception:  # noqa: BLE001 — scrape failures are transient
                 logger.exception("metrics poll failed")
             await asyncio.sleep(self.poll_interval)
@@ -226,7 +391,12 @@ class MetricsComponent:
 class MockWorkerMetrics:
     """Synthetic stats publisher (components/metrics/src/bin/mock_worker.rs):
     registers on the endpoint and publishes a slow sine-wave load so the
-    metrics plane and planner can run with no engine at all."""
+    metrics plane, the SLO engine, and the planner can run with no engine
+    at all. Publishes the FULL modern stats surface: slots/blocks, the
+    request-lifeguard counters, spec-decode and KV-transfer counters, and
+    phase histograms whose latencies scale with the simulated load (set
+    `ttft_ms`/`itl_ms` above the configured SLO to exercise a breach
+    engine-free)."""
 
     def __init__(
         self,
@@ -235,6 +405,8 @@ class MockWorkerMetrics:
         period_s: float = 30.0,
         total_slots: int = 16,
         total_blocks: int = 512,
+        ttft_ms: float = 120.0,
+        itl_ms: float = 12.0,
     ) -> None:
         self.publisher = WorkerMetricsPublisher(
             endpoint.component, endpoint.id, instance_id
@@ -242,18 +414,67 @@ class MockWorkerMetrics:
         self.period_s = period_s
         self.total_slots = total_slots
         self.total_blocks = total_blocks
+        self.ttft_ms = ttft_ms
+        self.itl_ms = itl_ms
         self._t = 0.0
+        # monotonic counter state (worker lifetime)
+        self._deadline_exceeded = 0
+        self._watchdog_trips = 0
+        self._spec = SpecDecodeStats(
+            num_spec_tokens=4,
+            num_drafts=0,
+            num_draft_tokens=0,
+            num_accepted_tokens=0,
+            num_accepted_tokens_per_pos=[0, 0, 0, 0],
+        )
+        self._xfer = KvTransferStats()
+        self.hist = PhaseHistograms()
 
     def snapshot(self) -> ForwardPassMetrics:
         self._t += 1.0
         phase = (self._t % self.period_s) / self.period_s * 2 * math.pi
         load = (math.sin(phase) + 1) / 2  # 0..1
         active_blocks = int(self.total_blocks * load)
+        # a few synthetic requests this tick; latencies scale with load
+        # (deterministic — no RNG, so dashboards and tests are repeatable)
+        reqs = 1 + int(3 * load)
+        for i in range(reqs):
+            scale = 0.7 + 0.6 * load + 0.05 * i
+            self.hist.observe("queue_wait", 2.0 * scale)
+            self.hist.observe("prefill", 40.0 * scale)
+            self.hist.observe("ttft", self.ttft_ms * scale)
+            for _ in range(4):
+                self.hist.observe("inter_token", self.itl_ms * scale)
+            self.hist.observe(
+                "e2e", (self.ttft_ms + 4 * self.itl_ms) * scale
+            )
+        # spec decode: 4-token drafts at a steady ~75% acceptance
+        self._spec.num_drafts += reqs
+        self._spec.num_draft_tokens += 4 * reqs
+        self._spec.num_accepted_tokens += 3 * reqs
+        for pos in range(3):
+            self._spec.num_accepted_tokens_per_pos[pos] += reqs
+        # KV data plane: frames/bytes move with load, mostly overlapped
+        frames = 2 * reqs
+        frame_bytes = 8192
+        self._xfer.kv_frames_tx += frames
+        self._xfer.kv_frames_rx += frames
+        self._xfer.kv_wire_bytes_tx += frames * frame_bytes
+        self._xfer.kv_wire_bytes_rx += frames * frame_bytes
+        self._xfer.kv_bytes_overlapped += (frames - 1) * frame_bytes
+        self._xfer.kv_frames_inflight = 1 if load > 0.5 else 0
+        # lifeguard counters tick over at peak load
+        if load > 0.95:
+            self._deadline_exceeded += 1
+        if self._t % 300 == 0:
+            self._watchdog_trips += 1
         return ForwardPassMetrics(
             worker_stats=WorkerStats(
                 request_active_slots=int(self.total_slots * load),
                 request_total_slots=self.total_slots,
                 num_requests_waiting=int(4 * max(0.0, load - 0.75)),
+                num_deadline_exceeded=self._deadline_exceeded,
+                num_watchdog_trips=self._watchdog_trips,
             ),
             kv_stats=KvStats(
                 kv_active_blocks=active_blocks,
@@ -261,6 +482,9 @@ class MockWorkerMetrics:
                 gpu_cache_usage_perc=load,
                 gpu_prefix_cache_hit_rate=0.5,
             ),
+            spec_decode_stats=self._spec,
+            kv_transfer_stats=self._xfer,
+            phase_histograms=self.hist,
         )
 
     async def start(self) -> None:

@@ -41,6 +41,7 @@ from dynamo_tpu.protocols.common import (
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry import profile as dprofile
 from dynamo_tpu.telemetry import trace as dtrace
+from dynamo_tpu.telemetry.histogram import PhaseHistograms
 from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = get_logger("dynamo_tpu.engine")
@@ -148,6 +149,12 @@ class EngineStats:
     kv_bytes_overlapped: int = 0
     kv_frames_inflight: int = 0  # gauge (prefill role, bounded window)
     prefill_dropped_expired: int = 0  # queue entries dropped past deadline
+    # always-on per-phase latency distributions (queue_wait / prefill /
+    # ttft / inter_token / e2e) on the shared fixed-log bucket grid;
+    # shipped on ForwardPassMetrics and merged fleet-wide by bucket
+    # addition (telemetry/histogram.py). Unlike spans (DYN_TRACE-gated),
+    # an observe() is a bisect + two adds — cheap enough to never gate.
+    phase_histograms: PhaseHistograms = field(default_factory=PhaseHistograms)
 
     @property
     def kv_usage(self) -> float:
@@ -245,6 +252,11 @@ class _Sequence(SequenceState):
         self.spec_backoff = 0
         # open telemetry phase spans (queue_wait / prefill / decode / ...)
         self.spans: dict = {}
+        # always-on phase-timing marks (feed EngineStats.phase_histograms)
+        self.t_arrival = time.monotonic()
+        self.t_admitted: Optional[float] = None
+        self.t_first: Optional[float] = None
+        self.t_last: Optional[float] = None
 
     @property
     def needs_eos_suppress(self) -> bool:
@@ -413,6 +425,24 @@ class JaxEngine:
             if sp is not None and len(sp.events) < 64:
                 sp.event(label, **attrs)
 
+    def _observe_stream(self, seq: _Sequence, item: LLMEngineOutput) -> None:
+        """Always-on phase histogram recording at the stream edge (what a
+        consumer of this worker actually experiences): TTFT, prefill (the
+        admitted-to-first-token span), inter-token gaps, end-to-end."""
+        ph = self.stats.phase_histograms
+        now = time.monotonic()
+        if item.token_ids:
+            if seq.t_first is None:
+                seq.t_first = now
+                ph.observe("ttft", (now - seq.t_arrival) * 1e3)
+                if seq.t_admitted is not None:
+                    ph.observe("prefill", (now - seq.t_admitted) * 1e3)
+            elif seq.t_last is not None:
+                ph.observe("inter_token", (now - seq.t_last) * 1e3)
+            seq.t_last = now
+        if item.finish_reason is not None:
+            ph.observe("e2e", (now - seq.t_arrival) * 1e3)
+
     # --------------------------------------------------------------- api
 
     async def generate(
@@ -450,6 +480,7 @@ class JaxEngine:
         try:
             while True:
                 item = await seq.out.get()
+                self._observe_stream(seq, item)
                 yield item
                 if item.finish_reason is not None:
                     return
@@ -1076,6 +1107,11 @@ class JaxEngine:
                 break
             self.waiting.pop(0)
             admitted = True
+            if seq.t_admitted is None:  # first admission (not a resume)
+                seq.t_admitted = time.monotonic()
+                self.stats.phase_histograms.observe(
+                    "queue_wait", (seq.t_admitted - seq.t_arrival) * 1e3
+                )
             if seq.spans:
                 self._sp_finish(seq, "queue_wait")
             # multimodal sequences (vision embeddings in extra["mm"]):

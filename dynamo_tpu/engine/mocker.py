@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Optional
 
@@ -32,6 +33,7 @@ from dynamo_tpu.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.telemetry import trace as dtrace
+from dynamo_tpu.telemetry.histogram import PhaseHistograms
 from dynamo_tpu.testing import faults
 from dynamo_tpu.tokens import TokenBlockSequence
 
@@ -184,6 +186,11 @@ class _MockSeq:
     unique_blocks: int = 1
     remote_prefilled: bool = False  # KV arrived from the prefill fleet
     spans: dict = field(default_factory=dict)  # open telemetry phase spans
+    # always-on phase-timing marks (feed the engine's phase histograms)
+    t_arrival: float = 0.0
+    t_admitted: Optional[float] = None
+    t_first: Optional[float] = None
+    t_last: Optional[float] = None
 
     @property
     def prompt(self) -> list[int]:
@@ -220,6 +227,10 @@ class MockEngine:
         self.disagg_threshold = disagg_threshold or 2 * self.args.block_size
         self.remote_prefills = 0
         self.kv_frames_rx = 0
+        # always-on per-phase latency distributions (same instrumentation
+        # points as the DYN_TRACE spans, but distribution-valued and never
+        # gated) — ride stats() -> ForwardPassMetrics to the fleet planes
+        self.phase_hist = PhaseHistograms()
         # trace process track (set by the worker host; None = process name)
         self.trace_proc: Optional[str] = None
 
@@ -262,9 +273,27 @@ class MockEngine:
 
     # ------------------------------------------------------------- public
 
+    def _observe_stream(self, seq: _MockSeq, item: LLMEngineOutput) -> None:
+        """Always-on phase histogram recording at the stream edge (same
+        contract as JaxEngine._observe_stream)."""
+        ph = self.phase_hist
+        now = time.monotonic()
+        if item.token_ids:
+            if seq.t_first is None:
+                seq.t_first = now
+                ph.observe("ttft", (now - seq.t_arrival) * 1e3)
+                if seq.t_admitted is not None:
+                    ph.observe("prefill", (now - seq.t_admitted) * 1e3)
+            elif seq.t_last is not None:
+                ph.observe("inter_token", (now - seq.t_last) * 1e3)
+            seq.t_last = now
+        if item.finish_reason is not None:
+            ph.observe("e2e", (now - seq.t_arrival) * 1e3)
+
     async def generate(
         self, request: PreprocessedRequest, context: Optional[Context] = None
     ) -> AsyncIterator[LLMEngineOutput]:
+        t_arrival = time.monotonic()
         ctx = context or Context()
         if ctx.expired() or ctx.ttft_expired():
             self.deadline_exceeded += 1
@@ -301,6 +330,7 @@ class MockEngine:
                 block_size=self.args.block_size,
                 tokens=list(request.token_ids),
             ),
+            t_arrival=t_arrival,
         )
         if first_remote is not None:
             # the prefill worker sampled the first token (the same
@@ -326,6 +356,7 @@ class MockEngine:
         try:
             while True:
                 item = await seq.out.get()
+                self._observe_stream(seq, item)
                 yield item
                 if item.finish_reason is not None:
                     return
@@ -391,6 +422,7 @@ class MockEngine:
             "total_blocks": self.args.num_blocks,
             "cache_usage": self.cache.usage,
             "deadline_exceeded": self.deadline_exceeded,
+            "phase_histograms": self.phase_hist,
         }
 
     async def close(self) -> None:
@@ -448,6 +480,11 @@ class MockEngine:
             if not self.cache.try_allocate(hashes, extra_unique=1):
                 break
             self.waiting.popleft()
+            if seq.t_admitted is None:  # first admission (not a resume)
+                seq.t_admitted = time.monotonic()
+                self.phase_hist.observe(
+                    "queue_wait", (seq.t_admitted - seq.t_arrival) * 1e3
+                )
             seq.acquired_hashes = list(hashes)
             self.active.append(seq)
             if seq.remote_prefilled:

@@ -211,6 +211,22 @@ async def run_http(
             metrics=service.metrics, admission=service.admission,
         )
         await watcher.start()
+    # SLO plane: state transitions (ok -> burning -> breached) publish a
+    # `slo-status` event on the runtime namespace — the hook the planner's
+    # SLA mode consumes (telemetry/slo.py)
+    from dynamo_tpu.telemetry import slo as dslo
+
+    ns = drt.namespace(drt.config.namespace)
+
+    def _publish_slo(payload: dict) -> None:
+        async def _send() -> None:
+            with contextlib.suppress(Exception):
+                await ns.publish_event(dslo.SLO_STATUS_SUBJECT, payload)
+
+        with contextlib.suppress(RuntimeError):
+            asyncio.get_running_loop().create_task(_send())
+
+    service.slo_publisher = _publish_slo
     await service.start()
     # graceful drain on SIGTERM (sdk/runner -> drt.drain): stop admitting,
     # let in-flight streams finish bounded by DYN_DRAIN_TIMEOUT_S, close
@@ -465,6 +481,12 @@ async def run_endpoint(
                 kv_frames_inflight=d.get("kv_frames_inflight", 0),
                 prefill_dropped_expired=d.get("prefill_dropped_expired", 0),
             )
+        # always-on phase histograms (queue_wait/prefill/ttft/inter_token/
+        # e2e): shipped whenever the engine recorded anything, so the
+        # aggregator can merge fleet-true latency distributions
+        ph = d.get("phase_histograms")
+        if ph is not None and not getattr(ph, "total_count", lambda: 0)():
+            ph = None
         return ForwardPassMetrics(
             worker_stats=WorkerStats(
                 request_active_slots=d.get("active_slots", 0),
@@ -480,6 +502,7 @@ async def run_endpoint(
             ),
             spec_decode_stats=spec,
             kv_transfer_stats=xfer,
+            phase_histograms=ph,
         )
 
     if stats_fn is not None:

@@ -18,6 +18,9 @@ from prometheus_client import (
     generate_latest,
 )
 
+from dynamo_tpu.runtime.prom import CallbackCounter
+from dynamo_tpu.telemetry.histogram import PhaseHistograms
+
 PREFIX = "dyn_llm_http_service"
 
 _DURATION_BUCKETS = (
@@ -98,6 +101,18 @@ class ServiceMetrics:
             ["model"],
             registry=self.registry,
         )
+        # per-model phase histograms as THIS FRONTEND observed them
+        # (ttft / inter_token / e2e): feed the frontend's SLO engine and
+        # the DYN_TRACE=auto retention decisions. NOTE these see one
+        # process's requests only — fleet-true percentiles come from the
+        # metrics component's merged per-worker histograms.
+        self._phase_hist: dict[str, PhaseHistograms] = {}
+
+    def phase_hist_for(self, model: str) -> PhaseHistograms:
+        ph = self._phase_hist.get(model)
+        if ph is None:
+            ph = self._phase_hist[model] = PhaseHistograms()
+        return ph
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -193,13 +208,13 @@ class ServiceMetrics:
             registry=self.registry,
         )
         g_rate.set_function(lambda: scheduler.hit_rate)
-        g_matched = Gauge(
+        # monotonic series: a real counter family (scrape-time callback),
+        # not a Gauge wearing a `_total` name
+        CallbackCounter(
+            self.registry,
             "dyn_llm_kv_matched_blocks_total",
             "Prefill blocks served from a routed worker's cache",
-            registry=self.registry,
-        )
-        g_matched.set_function(
-            lambda: scheduler.hit_stats["matched_blocks"]
+            lambda: scheduler.hit_stats["matched_blocks"],
         )
 
     @contextmanager
@@ -214,31 +229,42 @@ class ServiceMetrics:
             status = "error"
             raise
         finally:
+            elapsed = time.monotonic() - start
             self.inflight.labels(model, endpoint).dec()
             self.requests_total.labels(model, endpoint, status).inc()
-            self.request_duration.labels(model, endpoint).observe(
-                time.monotonic() - start
-            )
+            self.request_duration.labels(model, endpoint).observe(elapsed)
+            self.phase_hist_for(model).observe("e2e", elapsed * 1e3)
 
 
 class TokenTimer:
-    """Per-request TTFT / inter-token latency observer."""
+    """Per-request TTFT / inter-token latency observer. Also keeps the
+    request's own ttft_ms / max_itl_ms so the DYN_TRACE=auto retention
+    decision can compare this request against its SLO at completion."""
 
     def __init__(self, metrics: ServiceMetrics, model: str) -> None:
         self.metrics = metrics
         self.model = model
         self.start = time.monotonic()
         self.last: float | None = None
+        self.ttft_ms: float | None = None
+        self.max_itl_ms: float | None = None
 
     def on_token(self, count: int = 1) -> None:
         now = time.monotonic()
+        phase_hist = self.metrics.phase_hist_for(self.model)
         if self.last is None:
+            self.ttft_ms = (now - self.start) * 1e3
             self.metrics.time_to_first_token.labels(self.model).observe(
                 now - self.start
             )
+            phase_hist.observe("ttft", self.ttft_ms)
         else:
+            gap_ms = (now - self.last) * 1e3
+            if self.max_itl_ms is None or gap_ms > self.max_itl_ms:
+                self.max_itl_ms = gap_ms
             self.metrics.inter_token_latency.labels(self.model).observe(
                 now - self.last
             )
+            phase_hist.observe("inter_token", gap_ms)
         self.last = now
         self.metrics.output_tokens.labels(self.model).inc(count)

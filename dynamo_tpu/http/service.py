@@ -44,6 +44,7 @@ from dynamo_tpu.protocols.openai import (
 from dynamo_tpu.protocols.sse import encode_done, encode_json_event
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry import profile as dprofile
+from dynamo_tpu.telemetry import slo as dslo
 from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.http")
@@ -500,11 +501,21 @@ class HttpService:
                 web.get("/health", self._health),
                 web.get("/live", self._health),
                 web.get("/metrics", self._metrics),
+                web.get("/debug/slo", self._debug_slo),
+                web.get("/debug/traces", self._debug_traces_list),
                 web.get("/debug/traces/{request_id}", self._debug_trace),
                 web.get("/debug/profile", self._debug_profile),
             ]
         )
         self._runner: Optional[web.AppRunner] = None
+        # SLO plane (telemetry/slo.py): one engine per model, fed from
+        # this frontend's own phase observations. State transitions
+        # publish a `slo-status` fabric event via slo_publisher (wired by
+        # run_http; None = log only).
+        self._slo_engines: dict[str, dslo.SloEngine] = {}
+        self._slo_task: Optional[asyncio.Task] = None
+        self._slo_tick_s = float(os.environ.get("DYN_SLO_TICK_S", "1.0"))
+        self.slo_publisher: Optional[Callable[[dict], None]] = None
 
     # ---------------------------------------------------------- lifecycle
 
@@ -515,9 +526,18 @@ class HttpService:
         await site.start()
         if self.port == 0:
             self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        if dslo.SloConfig.from_env().enabled and self._slo_task is None:
+            self._slo_task = asyncio.get_running_loop().create_task(
+                self._slo_loop()
+            )
         logger.info("openai http service on %s:%d", self.host, self.port)
 
     async def close(self) -> None:
+        if self._slo_task is not None:
+            self._slo_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._slo_task
+            self._slo_task = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
@@ -559,6 +579,8 @@ class HttpService:
         code = payload.get("code", "internal_error")
         if ctx is not None:
             payload.setdefault("request_id", ctx.id)
+            # DYN_TRACE=auto retention: an errored request keeps its trace
+            ctx.metadata["error_code"] = code
         if code == "deadline_exceeded":
             self.metrics.deadline_exceeded.labels(model).inc()
         status = _CODE_STATUS.get(code, 500)
@@ -613,10 +635,85 @@ class HttpService:
             usage["timing"] = tb
             d["usage"] = usage
 
+    # ------------------------------------------------------------- slo
+
+    def _slo_engine(self, model: str) -> dslo.SloEngine:
+        eng = self._slo_engines.get(model)
+        if eng is None:
+            def on_transition(old: str, new: str, status: dict) -> None:
+                logger.warning(
+                    "SLO state for %s: %s -> %s", model, old, new
+                )
+                payload = {"old": old, "new": new, **status}
+                if self.slo_publisher is not None:
+                    self.slo_publisher(payload)
+
+            eng = dslo.SloEngine(
+                dslo.SloConfig.from_env(model),
+                model=model,
+                on_transition=on_transition,
+            )
+            self._slo_engines[model] = eng
+        return eng
+
+    def _slo_observe_all(self) -> dict[str, dict]:
+        out = {}
+        for model in self.manager.list_models():
+            eng = self._slo_engine(model)
+            out[model] = eng.observe(self.metrics.phase_hist_for(model))
+        return out
+
+    async def _slo_loop(self) -> None:
+        while True:
+            try:
+                self._slo_observe_all()
+            except Exception:  # noqa: BLE001 — telemetry must not crash us
+                logger.exception("slo evaluation failed")
+            await asyncio.sleep(self._slo_tick_s)
+
     @staticmethod
-    def _finish_trace(ctx: Context) -> None:
-        if dtrace.enabled():
-            dtrace.maybe_write_trace(dtrace.ctx_trace_id(ctx), ctx.id)
+    def _trace_migrated(trace_id: Optional[str]) -> bool:
+        """Did any span of this trace record a migration event? (In auto
+        mode spans exist for every request, so this is reliable.)"""
+        if not trace_id:
+            return False
+        for s in dtrace.spans_for_trace(trace_id):
+            for ev in s.events:
+                if ev.get("name") == "migration":
+                    return True
+        return False
+
+    def _finish_trace(
+        self,
+        ctx: Context,
+        model: str = "",
+        timer: Optional[TokenTimer] = None,
+    ) -> None:
+        """Request-completion trace hook. DYN_TRACE=1: write the trace
+        when DYN_TRACE_DIR is set (pre-existing behavior). DYN_TRACE=auto:
+        flight-recorder retention — keep the trace only when the request
+        breached its SLO, errored / was deadline-killed, migrated across a
+        worker death, or hit the 1-in-N sample (DYN_TRACE_SAMPLE)."""
+        if not dtrace.enabled():
+            return
+        tid = dtrace.ctx_trace_id(ctx)
+        if not tid:
+            return
+        if not dtrace.auto():
+            dtrace.maybe_write_trace(tid, ctx.id)
+            return
+        reason = dslo.retention_reason(
+            dslo.SloConfig.from_env(model) if model else None,
+            error_code=ctx.metadata.get("error_code"),
+            ttft_ms=getattr(timer, "ttft_ms", None),
+            max_itl_ms=getattr(timer, "max_itl_ms", None),
+            migrated=self._trace_migrated(tid),
+        )
+        rec = dslo.recorder()
+        if reason is not None:
+            rec.retain(tid, ctx.id, reason)
+        else:
+            rec.note_dropped()
 
     def _shed(self, model: str, retry_after_s: float) -> web.Response:
         resp = self._error(
@@ -669,6 +766,10 @@ class HttpService:
                     # id, phase, cause, code) ride through verbatim
                     err = _error_payload(item.error_message())
                     err.setdefault("request_id", ctx.id)
+                    # DYN_TRACE=auto retention: errored streams keep traces
+                    ctx.metadata["error_code"] = err.get(
+                        "code", "internal_error"
+                    )
                     if err.get("code") == "deadline_exceeded" and model:
                         self.metrics.deadline_exceeded.labels(model).inc()
                     payload = {
@@ -762,7 +863,7 @@ class HttpService:
                 return web.json_response(d, headers=self._resp_headers(ctx))
         finally:
             self.admission.release(chat_req.model)
-            self._finish_trace(ctx)
+            self._finish_trace(ctx, model=chat_req.model, timer=timer)
 
     async def _completions(self, request: web.Request) -> web.StreamResponse:
         if self._draining:
@@ -805,7 +906,7 @@ class HttpService:
                 return web.json_response(d, headers=self._resp_headers(ctx))
         finally:
             self.admission.release(comp_req.model)
-            self._finish_trace(ctx)
+            self._finish_trace(ctx, model=comp_req.model, timer=timer)
 
     async def _embeddings(self, request: web.Request) -> web.Response:
         from dynamo_tpu.protocols.openai import EmbeddingRequest
@@ -933,7 +1034,7 @@ class HttpService:
                 chat_resp = agg.finish()
         finally:
             self.admission.release(chat_req.model)
-            self._finish_trace(ctx)
+            self._finish_trace(ctx, model=chat_req.model, timer=timer)
         content = ""
         if chat_resp.choices:
             content = chat_resp.choices[0].message.content or ""
@@ -995,6 +1096,44 @@ class HttpService:
                 )
         return web.json_response(
             {"cleared_worker_groups": cleared, "failed_worker_groups": failed}
+        )
+
+    async def _debug_slo(self, request: web.Request) -> web.Response:
+        """Frontend SLO status: per-model burn rates, window percentiles,
+        and the ok/burning/breached state (evaluated on demand from this
+        frontend's own phase observations)."""
+        cfg = dslo.SloConfig.from_env()
+        if not cfg.enabled:
+            return web.json_response(
+                {
+                    "enabled": False,
+                    "hint": "set DYN_SLO_TTFT_MS / DYN_SLO_ITL_MS "
+                    "or DYN_SLO_CONFIG",
+                }
+            )
+        return web.json_response(
+            {
+                "enabled": True,
+                "scope": "frontend",
+                "models": self._slo_observe_all(),
+            }
+        )
+
+    async def _debug_traces_list(self, request: web.Request) -> web.Response:
+        """List retained trace exemplars (DYN_TRACE=auto flight recorder)
+        with their breach reasons, newest last."""
+        if not dtrace.enabled():
+            return self._error(
+                404, "tracing is disabled (set DYN_TRACE=1 or auto)",
+                "not_found_error",
+            )
+        rec = dslo.recorder()
+        return web.json_response(
+            {
+                "mode": "auto" if dtrace.auto() else "always",
+                "stats": rec.stats(),
+                "traces": rec.entries(),
+            }
         )
 
     async def _debug_trace(self, request: web.Request) -> web.Response:

@@ -17,6 +17,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from dynamo_tpu.telemetry.histogram import PhaseHistograms
+
 
 @dataclass
 class KvCacheStoredBlock:
@@ -207,6 +209,10 @@ class ForwardPassMetrics:
     kv_stats: KvStats = field(default_factory=KvStats)
     spec_decode_stats: Optional[SpecDecodeStats] = None
     kv_transfer_stats: Optional[KvTransferStats] = None
+    # per-phase latency distributions on the shared fixed-log bucket grid
+    # (telemetry/histogram.py): merged across the fleet by bucket
+    # addition, the substrate for true fleet percentiles and SLO burn
+    phase_histograms: Optional[PhaseHistograms] = None
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {
@@ -217,17 +223,21 @@ class ForwardPassMetrics:
             d["spec_decode_stats"] = self.spec_decode_stats.__dict__
         if self.kv_transfer_stats is not None:
             d["kv_transfer_stats"] = self.kv_transfer_stats.__dict__
+        if self.phase_histograms is not None:
+            d["phase_histograms"] = self.phase_histograms.to_dict()
         return d
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "ForwardPassMetrics":
         spec = d.get("spec_decode_stats")
         xfer = d.get("kv_transfer_stats")
+        ph = d.get("phase_histograms")
         return cls(
             worker_stats=WorkerStats(**d.get("worker_stats", {})),
             kv_stats=KvStats(**d.get("kv_stats", {})),
             spec_decode_stats=SpecDecodeStats(**spec) if spec else None,
             kv_transfer_stats=KvTransferStats(**xfer) if xfer else None,
+            phase_histograms=PhaseHistograms.from_dict(ph) if ph else None,
         )
 
 

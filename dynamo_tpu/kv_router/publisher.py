@@ -27,6 +27,7 @@ from dynamo_tpu.kv_router.protocols import (
     RouterEvent,
     SpecDecodeStats,
 )
+from dynamo_tpu.telemetry.histogram import PhaseHistograms
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import EndpointId
@@ -231,6 +232,13 @@ class KvMetricsAggregator:
                 if agg.kv_transfer_stats is None:
                     agg.kv_transfer_stats = KvTransferStats()
                 agg.kv_transfer_stats.merge(m.kv_transfer_stats)
+            if m.phase_histograms is not None:
+                # bucket addition over the shared fixed-log grid: the
+                # merged distribution is exact, so fleet percentiles are
+                # true percentiles (unlike averaging per-worker p95s)
+                if agg.phase_histograms is None:
+                    agg.phase_histograms = PhaseHistograms()
+                agg.phase_histograms.merge(m.phase_histograms)
         if n:
             agg.kv_stats.gpu_cache_usage_perc /= n
             agg.kv_stats.gpu_prefix_cache_hit_rate /= n

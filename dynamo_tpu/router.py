@@ -32,6 +32,43 @@ from dynamo_tpu.telemetry import trace as dtrace
 logger = get_logger("dynamo_tpu.router")
 
 
+def build_router_registry(scheduler, decisions_fn, shed_fn):
+    """The standalone router's Prometheus registry: hit-rate gauge plus
+    monotonic counters with real counter semantics (scrape-time callback
+    families, not `_total`-named gauges). Factored out so the metrics-lint
+    suite can walk the registry without a live router."""
+    from prometheus_client import CollectorRegistry, Gauge
+
+    from dynamo_tpu.runtime.prom import CallbackCounter
+
+    registry = CollectorRegistry()
+    g = Gauge(
+        "dyn_llm_kv_hit_rate",
+        "Router KV hit rate: matched / required prefill blocks",
+        registry=registry,
+    )
+    g.set_function(lambda: scheduler.hit_rate)
+    CallbackCounter(
+        registry,
+        "dyn_llm_kv_matched_blocks_total",
+        "Prefill blocks served from a routed worker's cache",
+        lambda: scheduler.hit_stats["matched_blocks"],
+    )
+    CallbackCounter(
+        registry,
+        "dyn_llm_router_decisions_total",
+        "Routing decisions served",
+        decisions_fn,
+    )
+    CallbackCounter(
+        registry,
+        "dyn_llm_requests_shed_total",
+        "Requests shed by admission control (429)",
+        shed_fn,
+    )
+    return registry
+
+
 class StandaloneRouter:
     """Hosts a KvRouter and serves find_best decisions over the fabric,
     with fleet-level load shedding derived from aggregated load_metrics."""
@@ -102,28 +139,13 @@ class StandaloneRouter:
         """Expose the router's own observability plane: Prometheus
         `dyn_llm_kv_hit_rate` / `dyn_llm_kv_matched_blocks_total` from the
         scheduler's per-decision accounting, plus shed/decision counters."""
-        from prometheus_client import CollectorRegistry, Gauge
-
         from dynamo_tpu.runtime.http_server import SystemStatusServer
 
-        registry = CollectorRegistry()
-        scheduler = self.router.scheduler
-        for name, doc, read in (
-            ("dyn_llm_kv_hit_rate",
-             "Router KV hit rate: matched / required prefill blocks",
-             lambda: scheduler.hit_rate),
-            ("dyn_llm_kv_matched_blocks_total",
-             "Prefill blocks served from a routed worker's cache",
-             lambda: scheduler.hit_stats["matched_blocks"]),
-            ("dyn_llm_router_decisions_total",
-             "Routing decisions served",
-             lambda: self.decisions_total),
-            ("dyn_llm_requests_shed_total",
-             "Requests shed by the router's fleet-load watermark",
-             lambda: self.shed_total),
-        ):
-            g = Gauge(name, doc, registry=registry)
-            g.set_function(read)
+        registry = build_router_registry(
+            self.router.scheduler,
+            lambda: self.decisions_total,
+            lambda: self.shed_total,
+        )
         self._status_server = SystemStatusServer(
             port=self.metrics_port, registry=registry
         )

@@ -64,6 +64,11 @@ class SystemStatusServer:
     def add_health_check(self, name: str, check: HealthCheck) -> None:
         self._checks[name] = check
 
+    def add_route(self, path: str, handler, method: str = "GET") -> None:
+        """Register an extra route (call before start(); components use
+        this for debug surfaces like /debug/slo)."""
+        self.app.router.add_route(method, path, handler)
+
     async def start(self) -> int:
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()

@@ -1,0 +1,47 @@
+"""Prometheus exposition helpers.
+
+The fleet metrics planes export worker-lifetime monotonic counters that
+this process only *observes* (scraped absolute values, not events it can
+`inc()`), so `prometheus_client.Counter` doesn't fit — and exporting them
+as `Gauge`s with `_total` names (the pre-ISSUE-6 drift) breaks Prometheus
+semantics: `rate()` consumers see `# TYPE ... gauge`. `CallbackCounter`
+closes the gap: a custom collector that reads the absolute value from a
+callback at scrape time and exposes it as a real counter family (resets
+on worker restart are exactly the counter-reset semantics `rate()` and
+`increase()` already handle).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from prometheus_client import CollectorRegistry
+from prometheus_client.core import CounterMetricFamily
+
+
+class CallbackCounter:
+    """A counter family whose value comes from a zero-arg callback at
+    scrape time. `name` may be given with or without the `_total` suffix
+    (the exposition format appends it either way)."""
+
+    def __init__(
+        self,
+        registry: CollectorRegistry,
+        name: str,
+        documentation: str,
+        fn: Callable[[], float],
+    ) -> None:
+        self._name = name[: -len("_total")] if name.endswith("_total") else name
+        self._doc = documentation
+        self._fn = fn
+        registry.register(self)
+
+    def describe(self):
+        yield CounterMetricFamily(self._name, self._doc)
+
+    def collect(self):
+        try:
+            value = float(self._fn() or 0)
+        except Exception:  # noqa: BLE001 — a failing read scrapes as 0
+            value = 0.0
+        yield CounterMetricFamily(self._name, self._doc, value=value)

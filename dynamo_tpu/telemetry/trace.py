@@ -41,7 +41,12 @@ EXPORT_SUBJECT = "trace-export"
 
 _TRUTHY = ("1", "true", "on", "yes")
 
-_enabled: bool = os.environ.get("DYN_TRACE", "0").strip().lower() in _TRUTHY
+# DYN_TRACE modes: "0" off, truthy = always-retain, "auto" = record spans
+# for every request but decide RETENTION at completion (the flight-
+# recorder tail-sampling mode — see telemetry/slo.py).
+_mode: str = os.environ.get("DYN_TRACE", "0").strip().lower()
+_auto: bool = _mode == "auto"
+_enabled: bool = _auto or _mode in _TRUTHY
 
 # current span (for nesting + log-field injection) and current logical
 # process label (lets one OS process host several logical roles in tests
@@ -58,10 +63,25 @@ def enabled() -> bool:
     return _enabled
 
 
+def auto() -> bool:
+    """True when retention is decided per request (DYN_TRACE=auto)."""
+    return _auto
+
+
 def set_enabled(on: bool) -> None:
-    """Flip tracing at runtime (tests, benchmarks, debug endpoints)."""
-    global _enabled
+    """Flip tracing at runtime (tests, benchmarks, debug endpoints).
+    Clears auto mode: set_enabled(True) is the always-retain mode."""
+    global _enabled, _auto
     _enabled = bool(on)
+    _auto = False
+
+
+def set_mode(mode: str) -> None:
+    """Set the DYN_TRACE mode by name: '0'/'1'/'auto' (tests, runtime)."""
+    global _enabled, _auto
+    m = (mode or "0").strip().lower()
+    _auto = m == "auto"
+    _enabled = _auto or m in _TRUTHY
 
 
 def _new_trace_id() -> str:

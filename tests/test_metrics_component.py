@@ -74,11 +74,21 @@ async def test_metrics_component_scrapes_mock_worker():
         async with aiohttp.ClientSession() as s:
             async with s.get(f"http://127.0.0.1:{port}/metrics") as r:
                 text = await r.text()
-        assert "dyn_llm_kv_blocks_total 512.0" in text
+        # renamed from dyn_llm_kv_blocks_total (a Gauge must not wear a
+        # `_total` name — enforced by tests/test_metrics_lint.py)
+        assert "dyn_llm_kv_blocks_capacity 512.0" in text
         assert "dyn_llm_worker_count 1.0" in text
         assert "dyn_llm_kv_hit_rate_events_total 2.0" in text
         # cumulative hit rate = (2+4)/(8+8)
         assert "dyn_llm_kv_hit_rate_cumulative 0.375" in text
+        # the mock worker publishes the full modern stats surface: the
+        # lifeguard/KV-transfer counters export with counter semantics,
+        # and its phase histograms surface as the fleet-merged histogram
+        assert "# TYPE dyn_llm_deadline_exceeded_total counter" in text
+        assert "# TYPE dyn_llm_kv_wire_tx_bytes_total counter" in text
+        assert "dyn_llm_spec_decode_acceptance_rate 0.75" in text
+        assert 'dyn_llm_phase_duration_seconds_bucket{le="+Inf",phase="ttft"}' in text
+        assert 'dyn_llm_phase_latency_seconds{phase="ttft",quantile="p95"}' in text
 
         await metrics.close()
         await mock.stop()

@@ -1,0 +1,140 @@
+"""Metrics convention lint (ISSUE 6 satellite): walk every
+CollectorRegistry the codebase builds and fail on drift.
+
+Rules enforced:
+
+  * a family whose name ends in `_total` must actually be a counter
+    (the pre-ISSUE-6 drift: fleet-summed monotonic series exported as
+    Gauges wearing `_total` names — `rate()` consumers saw
+    `# TYPE ... gauge`);
+  * histogram families must carry a unit suffix (`_seconds` / `_bytes`
+    / `_ms`);
+  * a metric name appearing in more than one registry (frontend,
+    metrics component, standalone router, system status) must be an
+    INTENTIONALLY shared series — listed below with a matching type —
+    otherwise two processes are exporting colliding semantics.
+
+New registries/metrics must either follow the conventions or make a
+deliberate, reviewed entry in the shared-series allowlist.
+"""
+
+from prometheus_client import CollectorRegistry
+
+from dynamo_tpu.components.metrics import MetricsComponent
+from dynamo_tpu.http.metrics import ServiceMetrics
+from dynamo_tpu.router import build_router_registry
+from dynamo_tpu.runtime.http_server import SystemStatusServer
+from dynamo_tpu.runtime.protocols import EndpointId
+
+# Series deliberately exported by several roles (same meaning, different
+# process — normal Prometheus federation, distinguished by instance).
+INTENTIONALLY_SHARED = {
+    # per-process runtime health (every SystemStatusServer)
+    "dyn_runtime_uptime_seconds",
+    "dyn_runtime_health",
+    # KV routing quality: frontend (in-process router), metrics
+    # component (event plane), standalone router (own scheduler)
+    "dyn_llm_kv_hit_rate",
+    "dyn_llm_kv_matched_blocks",
+    # admission-control sheds: frontend and standalone router
+    "dyn_llm_requests_shed",
+    # deadline expiries: frontend observation vs fleet-summed worker count
+    "dyn_llm_deadline_exceeded",
+}
+
+UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
+
+
+class _StubScheduler:
+    hit_stats = {"decisions": 0, "isl_blocks": 0, "matched_blocks": 0}
+    hit_rate = 0.0
+
+
+class _StubComponent:
+    """MetricsComponent only touches the component at start(); registry
+    construction needs nothing from it."""
+
+
+def _all_registries() -> dict[str, CollectorRegistry]:
+    frontend = ServiceMetrics()
+    # include every lazily-attached family in the lint surface
+    frontend.attach_spec_stats({"num_drafts": 0, "num_draft_tokens": 0,
+                                "num_accepted_tokens": 0})
+    frontend.attach_kv_transfer_stats({})
+    frontend.attach_kv_hit_stats(_StubScheduler())
+    component = MetricsComponent(
+        _StubComponent(), EndpointId("lint", "backend", "generate")
+    )
+    return {
+        "frontend": frontend.registry,
+        "component": component.registry,
+        "router": build_router_registry(
+            _StubScheduler(), lambda: 0, lambda: 0
+        ),
+        "system": SystemStatusServer().registry,
+    }
+
+
+def _families(registry: CollectorRegistry):
+    return list(registry.collect())
+
+
+def test_total_suffix_implies_counter():
+    problems = []
+    for role, registry in _all_registries().items():
+        for fam in _families(registry):
+            if fam.name.endswith("_total") and fam.type != "counter":
+                problems.append(f"{role}: {fam.name} is {fam.type}")
+            # sample-level check too: a gauge sample must never be
+            # named like a counter
+            if fam.type != "counter":
+                for s in fam.samples:
+                    if s.name.endswith("_total"):
+                        problems.append(
+                            f"{role}: sample {s.name} on {fam.type} "
+                            f"family {fam.name}"
+                        )
+    assert not problems, problems
+
+
+def test_histograms_carry_unit_suffix():
+    problems = []
+    for role, registry in _all_registries().items():
+        for fam in _families(registry):
+            if fam.type == "histogram" and not fam.name.endswith(
+                UNIT_SUFFIXES
+            ):
+                problems.append(f"{role}: histogram {fam.name} has no unit")
+    assert not problems, problems
+
+
+def test_no_unreviewed_duplicates_across_registries():
+    seen: dict[str, tuple[str, str]] = {}  # name -> (role, type)
+    problems = []
+    for role, registry in _all_registries().items():
+        for fam in _families(registry):
+            prev = seen.get(fam.name)
+            if prev is None:
+                seen[fam.name] = (role, fam.type)
+                continue
+            prev_role, prev_type = prev
+            if fam.name not in INTENTIONALLY_SHARED:
+                problems.append(
+                    f"{fam.name} exported by both {prev_role} and {role} "
+                    "but not in INTENTIONALLY_SHARED"
+                )
+            elif fam.type != prev_type:
+                problems.append(
+                    f"{fam.name}: type drift {prev_role}={prev_type} "
+                    f"vs {role}={fam.type}"
+                )
+    assert not problems, problems
+
+
+def test_every_family_has_help_text():
+    problems = []
+    for role, registry in _all_registries().items():
+        for fam in _families(registry):
+            if not (fam.documentation or "").strip():
+                problems.append(f"{role}: {fam.name} has empty HELP")
+    assert not problems, problems

@@ -1,0 +1,554 @@
+"""Fleet SLO plane (ISSUE 6): mergeable phase histograms, burn-rate
+tracking, and tail-sampled trace retention.
+
+Gold checks:
+
+  * histogram bucket-merge is associative/commutative and percentile
+    estimates stay inside the documented relative error bound;
+  * burn-rate window math: a synthetic breach/recovery sequence drives
+    the state machine ok -> breached -> burning -> ok with transition
+    callbacks at each edge;
+  * a mocker fleet's per-worker histograms merge in the metrics
+    component and export fleet percentiles matching a direct computation
+    within bucket error;
+  * a forced SLO breach flips `/debug/slo`, emits the `slo-status`
+    fabric event, and (with DYN_TRACE=auto) retains the breaching
+    requests' traces;
+  * tail-sampling retention: breached/errored kept, fast successes
+    dropped, disk budget evicts oldest.
+"""
+
+import asyncio
+import json
+import math
+import random
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.components.metrics import MetricsComponent, MockWorkerMetrics
+from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.entrypoint.inputs import EngineConfig, run_http
+from dynamo_tpu.kv_router.protocols import ForwardPassMetrics
+from dynamo_tpu.kv_router.publisher import WorkerMetricsPublisher
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime.protocols import EndpointId
+from dynamo_tpu.telemetry import slo as dslo
+from dynamo_tpu.telemetry import trace as dtrace
+from dynamo_tpu.telemetry.histogram import (
+    QUANTILE_REL_ERROR,
+    PhaseHistogram,
+    PhaseHistograms,
+)
+
+from tests.util import make_test_mdc
+
+BS = 4
+
+
+@pytest.fixture
+def auto_traced(tmp_path):
+    """DYN_TRACE=auto with a fresh ring and a tmp-dir flight recorder."""
+    dtrace.set_mode("auto")
+    dtrace.reset(proc="frontend")
+    dslo.reset_recorder(out_dir=str(tmp_path), max_bytes=50_000_000)
+    yield tmp_path
+    dtrace.set_enabled(False)
+    dtrace.reset()
+    dslo.reset_recorder()
+
+
+# ------------------------------------------------------------- histogram
+
+
+def _random_hist(seed: int, n: int = 500) -> PhaseHistogram:
+    rng = random.Random(seed)
+    h = PhaseHistogram()
+    for _ in range(n):
+        h.observe(rng.lognormvariate(3.0, 1.5))
+    return h
+
+
+def test_bucket_merge_associative_and_commutative():
+    a, b, c = _random_hist(1), _random_hist(2), _random_hist(3)
+
+    def merged(*hs):
+        out = PhaseHistogram()
+        for h in hs:
+            out.merge(h)
+        return out
+
+    ab_c = merged(merged(a, b), c)
+    a_bc = merged(a, merged(b, c))
+    cba = merged(c, b, a)
+    assert ab_c.counts == a_bc.counts == cba.counts
+    assert ab_c.count == a.count + b.count + c.count
+    assert abs(ab_c.sum_ms - (a.sum_ms + b.sum_ms + c.sum_ms)) < 1e-6
+    # merging is exact: fleet percentile == percentile of pooled samples
+    assert ab_c.percentile(95) == merged(a, b, c).percentile(95)
+
+
+def test_percentile_error_bound():
+    rng = random.Random(7)
+    for dist in (
+        lambda: rng.lognormvariate(2.0, 1.0),
+        lambda: rng.uniform(1.0, 1000.0),
+        lambda: rng.expovariate(1 / 50.0),
+    ):
+        h = PhaseHistogram()
+        vals = sorted(dist() for _ in range(20_000))
+        for v in vals:
+            h.observe(v)
+        for q in (50, 90, 95, 99):
+            true = vals[min(len(vals) - 1, math.ceil(len(vals) * q / 100) - 1)]
+            est = h.percentile(q)
+            # documented bound plus a little sample-rank slack
+            assert abs(est - true) / true <= QUANTILE_REL_ERROR + 0.02, (
+                q, est, true,
+            )
+
+
+def test_histogram_wire_roundtrip_and_sub():
+    h = _random_hist(11)
+    back = PhaseHistogram.from_dict(h.to_dict())
+    assert back.counts == h.counts and back.count == h.count
+    # windowed delta: cumulative-now minus cumulative-then
+    later = back.copy()
+    later.observe(123.0)
+    later.observe(4.5)
+    delta = later.sub(h)
+    assert delta.count == 2
+    # clamped when the "older" snapshot is ahead (worker restart)
+    assert h.sub(later).count == 0
+    # bundle roundtrip
+    ph = PhaseHistograms()
+    ph.observe("ttft", 12.0)
+    ph.observe("inter_token", 3.0)
+    ph2 = PhaseHistograms.from_dict(ph.to_dict())
+    assert ph2.total_count() == 2 and ph2.get("ttft").count == 1
+
+
+def test_fraction_over_prorates_threshold():
+    h = PhaseHistogram()
+    for _ in range(100):
+        h.observe(10.0)
+    for _ in range(100):
+        h.observe(1000.0)
+    assert h.fraction_over(100.0) == pytest.approx(0.5, abs=0.01)
+    assert h.fraction_over(5000.0) == pytest.approx(0.0, abs=0.01)
+    assert h.fraction_over(1.0) == pytest.approx(1.0, abs=0.01)
+
+
+# ------------------------------------------------------------ slo config
+
+
+def test_slo_config_env_and_toml_precedence(tmp_path, monkeypatch):
+    for var in (
+        "DYN_SLO_TTFT_MS", "DYN_SLO_ITL_MS", "DYN_SLO_PERCENTILE",
+        "DYN_SLO_CONFIG",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    assert not dslo.SloConfig.from_env().enabled
+    cfg_file = tmp_path / "slo.toml"
+    cfg_file.write_text(
+        'ttft_ms = 2000\nitl_ms = 100\npercentile = 90\n'
+        '[models."special"]\nttft_ms = 500\n'
+    )
+    monkeypatch.setenv("DYN_SLO_CONFIG", str(cfg_file))
+    cfg = dslo.SloConfig.from_env()
+    assert cfg.ttft_ms == 2000 and cfg.itl_ms == 100 and cfg.percentile == 90
+    # model section overrides file defaults
+    assert dslo.SloConfig.from_env("special").ttft_ms == 500
+    # env beats both
+    monkeypatch.setenv("DYN_SLO_TTFT_MS", "250")
+    assert dslo.SloConfig.from_env("special").ttft_ms == 250
+    assert dslo.SloConfig.from_env("special").budget == pytest.approx(0.1)
+
+
+# ------------------------------------------------------------- burn rate
+
+
+def test_burn_rate_breach_and_recovery_sequence():
+    cfg = dslo.SloConfig(
+        ttft_ms=100.0, percentile=95.0,
+        fast_window_s=60.0, slow_window_s=600.0, breach_factor=6.0,
+    )
+    clock = {"t": 0.0}
+    events = []
+    eng = dslo.SloEngine(
+        cfg,
+        on_transition=lambda old, new, st: events.append((old, new)),
+        now_fn=lambda: clock["t"],
+    )
+
+    cum = PhaseHistograms()
+    status = eng.observe(cum)
+    assert status["state"] == "ok" and eng.state == "ok"
+
+    # t=10: 100 healthy requests (10 ms << 100 ms target)
+    clock["t"] = 10.0
+    for _ in range(100):
+        cum.observe("ttft", 10.0)
+    status = eng.observe(cum)
+    assert status["state"] == "ok"
+    assert status["signals"]["ttft"]["burn_fast"] == 0.0
+
+    # t=20: 50 violating requests land -> fast-window bad fraction 1/3,
+    # burn = 0.333/0.05 = 6.7 >= breach_factor -> breached
+    clock["t"] = 20.0
+    for _ in range(50):
+        cum.observe("ttft", 500.0)
+    status = eng.observe(cum)
+    assert status["state"] == "breached"
+    assert status["signals"]["ttft"]["burn_fast"] >= cfg.breach_factor
+    assert events == [("ok", "breached")]
+    assert eng.breaches_total == 1
+
+    # t=100: the bad burst left the fast window but still burns the slow
+    # one -> burning (sustained-budget warning, not a page)
+    clock["t"] = 100.0
+    for _ in range(100):
+        cum.observe("ttft", 10.0)
+    status = eng.observe(cum)
+    assert status["state"] == "burning"
+    assert status["signals"]["ttft"]["burn_fast"] < 1.0
+    assert status["signals"]["ttft"]["burn_slow"] >= 1.0
+
+    # healthy traffic while the burst ages out of the slow window too
+    for t in range(200, 800, 100):
+        clock["t"] = float(t)
+        for _ in range(50):
+            cum.observe("ttft", 10.0)
+        status = eng.observe(cum)
+    assert status["state"] == "ok"
+    assert events == [("ok", "breached"), ("breached", "burning"),
+                      ("burning", "ok")]
+    # window percentiles are reported for the planner
+    assert status["signals"]["ttft"]["window_fast_p95_ms"] < 100.0
+
+
+def test_burn_rate_itl_signal_and_empty_windows():
+    cfg = dslo.SloConfig(itl_ms=50.0, percentile=99.0)
+    eng = dslo.SloEngine(cfg, now_fn=lambda: 0.0)
+    st = eng.evaluate()
+    assert st["state"] == "ok" and "itl" in st["signals"]
+    cum = PhaseHistograms()
+    for _ in range(200):
+        cum.observe("inter_token", 500.0)
+    st = eng.observe(cum, now=1.0)
+    assert st["state"] == "breached"
+    assert st["signals"]["itl"]["burn_fast"] >= cfg.breach_factor
+
+
+# ------------------------------------------------- retention decisions
+
+
+def test_retention_decisions():
+    cfg = dslo.SloConfig(ttft_ms=100.0, itl_ms=50.0)
+    # hard failures always kept (deadline kills ride the error code)
+    assert dslo.retention_reason(
+        cfg, error_code="deadline_exceeded", sample=0
+    ) == "error:deadline_exceeded"
+    # migration survivors kept
+    assert dslo.retention_reason(cfg, migrated=True, sample=0) == "migrated"
+    # SLO breaches kept
+    assert dslo.retention_reason(cfg, ttft_ms=250.0, sample=0) == "slo_ttft"
+    assert dslo.retention_reason(
+        cfg, ttft_ms=50.0, max_itl_ms=80.0, sample=0
+    ) == "slo_itl"
+    # fast success dropped
+    assert dslo.retention_reason(
+        cfg, ttft_ms=50.0, max_itl_ms=10.0, sample=0
+    ) is None
+    # no SLO configured: only errors/migrations/samples keep traces
+    assert dslo.retention_reason(None, ttft_ms=10_000.0, sample=0) is None
+    # 1-in-N sampling keeps the occasional healthy exemplar
+    assert dslo.retention_reason(
+        cfg, ttft_ms=1.0, sample=2, rng=lambda: 0.1
+    ) == "sampled"
+    assert dslo.retention_reason(
+        cfg, ttft_ms=1.0, sample=2, rng=lambda: 0.9
+    ) is None
+
+
+def test_flight_recorder_budget_eviction(tmp_path):
+    dtrace.set_enabled(True)
+    dtrace.reset(proc="t")
+    try:
+        tids = []
+        for i in range(3):
+            ctx = Context(id=f"req-{i}")
+            with dtrace.root_span("http_request", ctx, request_id=ctx.id):
+                with dtrace.span("decode", ctx=ctx):
+                    pass
+            tids.append(dtrace.ctx_trace_id(ctx))
+        rec = dslo.FlightRecorder(out_dir=str(tmp_path), max_bytes=100_000)
+        for i, tid in enumerate(tids):
+            rec.retain(tid, f"req-{i}", "slo_ttft")
+        assert len(rec.entries()) == 3
+        # shrink the budget to roughly one trace: oldest evicted first
+        one = rec.entries()[0]["bytes"]
+        rec2 = dslo.FlightRecorder(
+            out_dir=str(tmp_path), max_bytes=int(one * 1.5)
+        )
+        for i, tid in enumerate(tids):
+            rec2.retain(tid, f"req-{i}", "slo_ttft")
+        kept = [e["request_id"] for e in rec2.entries()]
+        assert kept == ["req-2"], kept
+        assert rec2.evicted_total == 2
+        # evicted files are gone from disk; the kept one remains
+        files = {p.name for p in tmp_path.glob("trace-*.json")}
+        assert files == {"trace-req-2.json"}
+        doc = json.loads((tmp_path / "trace-req-2.json").read_text())
+        assert doc["otherData"]["retention_reason"] == "slo_ttft"
+    finally:
+        dtrace.set_enabled(False)
+        dtrace.reset()
+
+
+# ------------------------------------------------------ engine recording
+
+
+async def test_mocker_records_phase_histograms_always_on():
+    assert not dtrace.enabled()  # histograms must not depend on tracing
+    engine = MockEngine(MockEngineArgs(block_size=BS, speedup_ratio=1000.0))
+    for i in range(3):
+        req = PreprocessedRequest(
+            token_ids=[(i + j) % 50 + 3 for j in range(12)],
+            sampling=SamplingOptions(greedy=True),
+            stop=StopConditions(max_tokens=5, ignore_eos=True),
+        )
+        async for _ in engine.generate(req, Context()):
+            pass
+    ph = engine.stats()["phase_histograms"]
+    for phase in ("queue_wait", "ttft", "inter_token", "e2e"):
+        h = ph.get(phase)
+        assert h is not None and h.count > 0, phase
+    assert ph.get("e2e").count == 3
+    assert ph.get("inter_token").count == 3 * 4  # 5 tokens -> 4 gaps
+    await engine.close()
+
+
+# -------------------------------------------------------- fleet e2e
+
+
+async def test_fleet_percentiles_from_merged_worker_histograms():
+    """Three workers publish DIFFERENT latency distributions; the metrics
+    component's merged export must match the percentile of the pooled
+    samples within the histogram's documented bucket error."""
+    drt = await DistributedRuntime.from_settings()
+    try:
+        ns = drt.namespace("slo-fleet")
+        comp = ns.component("backend")
+        eid = EndpointId("slo-fleet", "backend", "generate")
+        rng = random.Random(42)
+        all_ttft: list[float] = []
+        all_itl: list[float] = []
+        pubs = []
+        for w in range(3):
+            ph = PhaseHistograms()
+            # distinct per-worker regimes: a fast, a mid, a slow worker
+            mu = (2.0, 3.0, 4.0)[w]
+            for _ in range(400):
+                t = rng.lognormvariate(mu, 0.5)
+                ph.observe("ttft", t)
+                all_ttft.append(t)
+                g = rng.lognormvariate(mu - 2.0, 0.4)
+                ph.observe("inter_token", g)
+                all_itl.append(g)
+            fpm = ForwardPassMetrics(phase_histograms=ph)
+            pub = WorkerMetricsPublisher(comp, eid, instance_id=w)
+            await pub.start(lambda m=fpm: m)
+            pubs.append(pub)
+
+        metrics = MetricsComponent(comp, eid, poll_interval=0.05, port=0)
+        port = await metrics.start()
+        total = len(all_ttft)
+        for _ in range(100):
+            last = metrics.last
+            if (
+                last is not None
+                and last.phase_histograms is not None
+                and last.phase_histograms.get("ttft") is not None
+                and last.phase_histograms.get("ttft").count == total
+            ):
+                break
+            await asyncio.sleep(0.05)
+        merged = metrics.last.phase_histograms
+        assert merged.get("ttft").count == total
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/metrics") as r:
+                text = await r.text()
+
+        def gauge_value(phase: str, q: str) -> float:
+            for line in text.splitlines():
+                if line.startswith(
+                    f'dyn_llm_phase_latency_seconds{{phase="{phase}"'
+                ) and f'quantile="{q}"' in line:
+                    return float(line.rsplit(" ", 1)[1])
+            raise AssertionError(f"no {phase}/{q} gauge in export")
+
+        for phase, samples in (("ttft", all_ttft), ("inter_token", all_itl)):
+            samples = sorted(samples)
+            for q in (50, 95, 99):
+                direct_ms = samples[
+                    min(len(samples) - 1, math.ceil(len(samples) * q / 100) - 1)
+                ]
+                exported_s = gauge_value(phase, f"p{q}")
+                assert abs(exported_s * 1e3 - direct_ms) / direct_ms <= (
+                    QUANTILE_REL_ERROR + 0.02
+                ), (phase, q, exported_s * 1e3, direct_ms)
+        # the real Prometheus histogram is exported with a terminal +Inf
+        assert (
+            f'dyn_llm_phase_duration_seconds_bucket{{le="+Inf",phase="ttft"}} '
+            f"{float(total)}" in text
+        )
+        await metrics.close()
+        for pub in pubs:
+            await pub.stop()
+    finally:
+        await drt.close()
+
+
+async def test_forced_breach_flips_debug_slo_and_emits_event(
+    auto_traced, monkeypatch
+):
+    """Acceptance: a forced SLO breach (threshold below any achievable
+    TTFT) flips /debug/slo to breached, publishes the slo-status event,
+    and — with DYN_TRACE=auto — retains the breaching requests' traces
+    with reason slo_ttft."""
+    monkeypatch.setenv("DYN_SLO_TTFT_MS", "0.0001")
+    monkeypatch.setenv("DYN_SLO_TICK_S", "0.05")
+    monkeypatch.delenv("DYN_SLO_CONFIG", raising=False)
+    monkeypatch.delenv("DYN_TRACE_SAMPLE", raising=False)
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        sub = await drt.namespace(drt.config.namespace).subscribe_event(
+            dslo.SLO_STATUS_SUBJECT
+        )
+        engine = MockEngine(MockEngineArgs(block_size=BS, speedup_ratio=1000.0))
+        config = EngineConfig.static_(engine, make_test_mdc("slo-mock"))
+        service = await run_http(drt, config, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as s:
+            for i in range(3):
+                async with s.post(
+                    f"{base}/v1/completions",
+                    headers={"x-request-id": f"slo-req-{i}"},
+                    json={
+                        "model": "slo-mock",
+                        "prompt": "one two three four five six seven eight",
+                        "stream": True,
+                        "max_tokens": 4,
+                    },
+                ) as r:
+                    assert r.status == 200
+                    async for _ in r.content:
+                        pass
+            state = None
+            for _ in range(100):
+                async with s.get(f"{base}/debug/slo") as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                state = doc["models"]["slo-mock"]["state"]
+                if state == "breached":
+                    break
+                await asyncio.sleep(0.05)
+            assert state == "breached", doc
+            sig = doc["models"]["slo-mock"]["signals"]["ttft"]
+            assert sig["state"] == "breached"
+            assert sig["burn_fast"] >= doc["models"]["slo-mock"]["config"][
+                "breach_factor"
+            ]
+
+            # the slo-status fabric event fired on the ok->breached edge
+            import msgpack
+
+            async def next_event():
+                async for _subj, payload in sub:
+                    return msgpack.unpackb(payload, raw=False)
+
+            ev = await asyncio.wait_for(next_event(), timeout=10)
+            assert ev["old"] == "ok" and ev["new"] == "breached"
+            assert ev["model"] == "slo-mock"
+
+            # DYN_TRACE=auto retained every breaching request's trace
+            async with s.get(f"{base}/debug/traces") as r:
+                listing = await r.json()
+        assert listing["mode"] == "auto"
+        kept = {e["request_id"]: e["reason"] for e in listing["traces"]}
+        assert set(kept) == {"slo-req-0", "slo-req-1", "slo-req-2"}
+        assert set(kept.values()) == {"slo_ttft"}
+        files = {p.name for p in auto_traced.glob("trace-*.json")}
+        assert files == {f"trace-slo-req-{i}.json" for i in range(3)}
+    finally:
+        if service is not None:
+            await service.close()
+        await drt.close()
+
+
+async def test_auto_mode_keeps_errored_drops_fast(auto_traced, monkeypatch):
+    """Acceptance: with DYN_TRACE=auto and no breach, only the errored
+    (deadline-killed) request's trace is retained; the fast success is
+    dropped."""
+    monkeypatch.delenv("DYN_SLO_TTFT_MS", raising=False)
+    monkeypatch.delenv("DYN_SLO_ITL_MS", raising=False)
+    monkeypatch.delenv("DYN_SLO_CONFIG", raising=False)
+    monkeypatch.delenv("DYN_TRACE_SAMPLE", raising=False)
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        engine = MockEngine(MockEngineArgs(block_size=BS, speedup_ratio=1000.0))
+        config = EngineConfig.static_(engine, make_test_mdc("auto-mock"))
+        service = await run_http(drt, config, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/completions",
+                headers={"x-request-id": "fast-ok"},
+                json={
+                    "model": "auto-mock",
+                    "prompt": "one two three four five six",
+                    "stream": True,
+                    "max_tokens": 3,
+                },
+            ) as r:
+                assert r.status == 200
+                async for _ in r.content:
+                    pass
+            # a 1 ms deadline expires before admission -> structured error
+            async with s.post(
+                f"{base}/v1/completions",
+                headers={"x-request-id": "doomed"},
+                json={
+                    "model": "auto-mock",
+                    "prompt": "one two three four five six",
+                    "stream": True,
+                    "max_tokens": 3,
+                    "ext": {"timeout_ms": 1},
+                },
+            ) as r:
+                body = (await r.read()).decode()
+                assert "deadline_exceeded" in body
+            async with s.get(f"{base}/debug/traces") as r:
+                listing = await r.json()
+        kept = {e["request_id"]: e["reason"] for e in listing["traces"]}
+        assert set(kept) == {"doomed"}, kept
+        assert kept["doomed"] == "error:deadline_exceeded"
+        assert listing["stats"]["dropped"] >= 1
+        files = {p.name for p in auto_traced.glob("trace-*.json")}
+        assert files == {"trace-doomed.json"}
+        doc = json.loads((auto_traced / "trace-doomed.json").read_text())
+        assert doc["otherData"]["retention_reason"] == "error:deadline_exceeded"
+    finally:
+        if service is not None:
+            await service.close()
+        await drt.close()
