@@ -257,7 +257,10 @@ def main() -> None:
     ap.add_argument("--context-length", type=int, default=None)
     ap.add_argument(
         "--preset",
-        choices=["canonical", "swa", "chaos", "disagg", "trace", "slo"],
+        choices=[
+            "canonical", "swa", "chaos", "disagg", "trace", "slo",
+            "priority",
+        ],
         default=None,
         help="canonical = the reference's genai-perf workload "
         "(examples/llm/benchmarks/README.md:41 — ISL 3000 / OSL 150, "
@@ -277,7 +280,11 @@ def main() -> None:
         "vs on; banked artifact benchmarks/trace_overhead.json). "
         "slo = delegates to benchmarks.slo_overhead_bench (always-on "
         "phase histograms + DYN_TRACE=auto flight recorder vs the PR 5 "
-        "disabled baseline; banked artifact benchmarks/slo_overhead.json)",
+        "disabled baseline; banked artifact benchmarks/slo_overhead.json). "
+        "priority = delegates to benchmarks.priority_sweep (4x-overload "
+        "1:4 interactive:bulk mix, class-blind vs QoS: per-class TTFT, "
+        "shed/preempt counts, brownout timeline; banked artifact "
+        "benchmarks/priority_sweep.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -299,6 +306,16 @@ def main() -> None:
 
         trace_overhead_bench.main(
             ["--json", args.json or "benchmarks/trace_overhead.json"]
+        )
+        return
+    if args.preset == "priority":
+        # QoS sweep has its own two-run harness (class-blind baseline vs
+        # priority-labelled at identical load) — one entry point for every
+        # banked curve stays `perf_sweep --preset X`
+        from benchmarks import priority_sweep
+
+        priority_sweep.main(
+            ["--json", args.json or "benchmarks/priority_sweep.json"]
         )
         return
     if args.preset == "slo":

@@ -1,0 +1,348 @@
+"""QoS priority sweep: mixed-class overload against the real frontend.
+
+Drives a 4x-overload 1:4 interactive:bulk mix (ISSUE 7 acceptance
+workload) through a served deployment TWICE:
+
+  * `class_blind` — no priority labels, flat admission fractions: the
+    pre-QoS behavior (one watermark, FIFO engine queue) every request
+    degrades under equally;
+  * `qos`         — `x-dyn-priority` headers + the default per-class
+    watermarks and the priority-ordered engine queue.
+
+Per run it reports per-class TTFT percentiles, shed counts (by class and
+status), engine preemption counts by class, and a sampled timeline of the
+brownout level (`/debug/slo` polled during the wave — the SLO objective is
+set tight enough that sustained overload steps the ladder). The headline
+number is the interactive-class p99 TTFT ratio between the two runs —
+the acceptance bar is >= 5x.
+
+    python -m benchmarks.priority_sweep --json benchmarks/priority_sweep.json
+
+The default engine is the tiny random JAX model on CPU (real scheduler,
+real queue dynamics, ~40 s compile per server boot); pass
+`--model-path` for a real checkpoint (TPU when available) or
+`--out mocker` for a seconds-fast zero-compile smoke of the same policy
+surface.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+from dynamo_tpu.serve import _free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MODEL = "qos-sweep"
+
+
+def _pct(xs, p):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return round(xs[min(len(xs) - 1, int(p * len(xs)))] * 1e3, 2)
+
+
+async def _one(session, base, priority, labelled, prompt, max_tokens):
+    """One streamed request; returns (class, ttft_s | None, status)."""
+    import aiohttp
+
+    headers = {}
+    if labelled:
+        headers["x-dyn-priority"] = priority
+    body = {
+        "model": MODEL, "prompt": prompt, "max_tokens": max_tokens,
+        "stream": True, "ext": {"ignore_eos": True},
+    }
+    t0 = time.perf_counter()
+    ttft = None
+    try:
+        async with session.post(
+            f"{base}/v1/completions", json=body, headers=headers
+        ) as resp:
+            if resp.status == 429:
+                return priority, None, "shed"
+            if resp.status != 200:
+                return priority, None, "error"
+            async for line in resp.content:
+                if not line.startswith(b"data: ") or line.startswith(
+                    b"data: [DONE]"
+                ):
+                    continue
+                if ttft is None:
+                    ttft = time.perf_counter() - t0
+            return priority, ttft, "ok"
+    except (aiohttp.ClientError, asyncio.TimeoutError):
+        return priority, ttft, "error"
+
+
+async def _sample_slo(session, base, timeline, stop):
+    """Poll /debug/slo during the wave: brownout level over time."""
+    t0 = time.perf_counter()
+    while not stop.is_set():
+        try:
+            async with session.get(f"{base}/debug/slo") as r:
+                doc = await r.json()
+            b = doc.get("brownout") or {}
+            timeline.append(
+                {
+                    "t_s": round(time.perf_counter() - t0, 2),
+                    "level": b.get("level", 0),
+                    "rung": b.get("rung", "ok"),
+                }
+            )
+        except Exception:  # noqa: BLE001 — sampling is best-effort
+            pass
+        await asyncio.sleep(0.2)
+
+
+def _scrape_qos(text: str) -> dict:
+    """Pull the QoS counters off the frontend /metrics exposition."""
+    out: dict = {"preemptions": {}}
+    for line in text.splitlines():
+        if line.startswith("dyn_llm_preemptions_total{"):
+            cls = line.split('priority="')[1].split('"')[0]
+            out["preemptions"][cls] = float(line.rsplit(" ", 1)[1])
+        elif line.startswith("dyn_llm_preempted_too_often_total "):
+            out["preempted_too_often"] = float(line.rsplit(" ", 1)[1])
+        elif line.startswith("dyn_llm_brownout_sheds_total "):
+            out["engine_brownout_sheds"] = float(line.rsplit(" ", 1)[1])
+    return out
+
+
+async def _wave(base, labelled, duration_s, concurrency, prompt,
+                max_tokens_by_class, interactive_every=5):
+    """One CLOSED-LOOP overload wave: `concurrency` worker loops (1-in-5
+    interactive) each re-issue their class's request for `duration_s`,
+    retrying shortly after a shed — sustained 4x pressure, not a burst
+    that sheds itself empty in one round trip."""
+    import aiohttp
+
+    results = []
+    timeline: list[dict] = []
+    stop = asyncio.Event()
+
+    async def worker(i):
+        cls = "interactive" if i % interactive_every == 0 else "bulk"
+        end = time.perf_counter() + duration_s
+        while time.perf_counter() < end:
+            r = await _one(
+                session, base, cls, labelled, prompt,
+                max_tokens_by_class[cls],
+            )
+            results.append(r)
+            if r[2] != "ok":
+                # brief backoff on shed/error; capped so the offered load
+                # stays at the configured overload factor
+                await asyncio.sleep(0.1)
+
+    conn = aiohttp.TCPConnector(limit=concurrency + 8)
+    async with aiohttp.ClientSession(
+        connector=conn, timeout=aiohttp.ClientTimeout(total=600)
+    ) as session:
+        sampler = asyncio.ensure_future(
+            _sample_slo(session, base, timeline, stop)
+        )
+        t0 = time.perf_counter()
+        await asyncio.gather(*[worker(i) for i in range(concurrency)])
+        wall = time.perf_counter() - t0
+        stop.set()
+        await sampler
+        async with session.get(f"{base}/metrics") as r:
+            qos_counts = _scrape_qos(await r.text())
+    out = {"wall_s": round(wall, 2), "requests": len(results)}
+    for cls in ("interactive", "bulk"):
+        rows = [r for r in results if r[0] == cls]
+        ttfts = [t for _, t, st in rows if st == "ok" and t is not None]
+        out[cls] = {
+            "sent": len(rows),
+            "ok": sum(1 for r in rows if r[2] == "ok"),
+            "shed": sum(1 for r in rows if r[2] == "shed"),
+            "error": sum(1 for r in rows if r[2] == "error"),
+            "ttft_p50_ms": _pct(ttfts, 0.50),
+            "ttft_p99_ms": _pct(ttfts, 0.99),
+        }
+    out["engine_qos"] = qos_counts
+    out["brownout_timeline"] = timeline
+    out["brownout_peak"] = max((p["level"] for p in timeline), default=0)
+    return out
+
+
+async def _serve_and_run(args, labelled, model_path):
+    port = _free_port()
+    env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        DYN_ADMISSION_MAX_INFLIGHT=str(args.watermark),
+        # tight objective so sustained overload provably steps the ladder
+        DYN_SLO_TTFT_MS=str(args.slo_ttft_ms),
+        DYN_SLO_FAST_WINDOW_S="2",
+        DYN_SLO_SLOW_WINDOW_S="6",
+        DYN_SLO_TICK_S="0.2",
+        DYN_BROWNOUT_STEP_UP_S="0.5",
+        DYN_BROWNOUT_STEP_DOWN_S="2",
+    )
+    if args.model_path is None:
+        env["JAX_PLATFORMS"] = "cpu"  # tiny-model mode is the CPU harness
+    if not labelled:
+        # class-blind baseline: flat fractions, nobody labelled — the
+        # pre-QoS single-watermark behavior at identical total load
+        env["DYN_ADMISSION_CLASS_FRACTIONS"] = (
+            "bulk=1.0,standard=1.0,interactive=1.0"
+        )
+        env["DYN_BROWNOUT"] = "0"
+    cmd = [
+        sys.executable, "-m", "dynamo_tpu.run",
+        "in=http", f"out={args.out}",
+        "--model-name", MODEL,
+        "--http-port", str(port),
+        "--max-batch", str(args.max_batch),
+    ]
+    if model_path:
+        cmd += ["--model-path", model_path]
+    if args.num_blocks:
+        cmd += ["--num-blocks", str(args.num_blocks)]
+    errlog = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=".priority-sweep.log", delete=False
+    )
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.DEVNULL, stderr=errlog, cwd="/tmp"
+    )
+    base = f"http://127.0.0.1:{port}"
+    try:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            for _ in range(600):
+                if proc.poll() is not None:
+                    errlog.flush()
+                    with open(errlog.name) as f:
+                        tail = "".join(f.readlines()[-15:])
+                    raise RuntimeError(
+                        f"server exited rc={proc.returncode}:\n{tail}"
+                    )
+                try:
+                    async with s.get(f"{base}/health") as r:
+                        if r.status == 200:
+                            break
+                except aiohttp.ClientError:
+                    pass
+                await asyncio.sleep(0.2)
+            else:
+                raise RuntimeError("server never became healthy")
+        prompt = " ".join(f"w{i % 50}" for i in range(args.prompt_tokens))
+        max_toks = {
+            "interactive": args.interactive_max_tokens,
+            "bulk": args.bulk_max_tokens,
+        }
+        # warmup (compiles on out=jax; no-op cost on the mocker), then
+        # wait out the SLO windows so compile-time TTFTs don't pre-engage
+        # the brownout ladder before the measured wave
+        await _wave(
+            base, labelled, 2.0, 2, prompt, {"interactive": 8, "bulk": 8}
+        )
+        async with aiohttp.ClientSession() as s:
+            for _ in range(60):
+                try:
+                    async with s.get(f"{base}/debug/slo") as r:
+                        doc = await r.json()
+                    b = doc.get("brownout") or {}
+                    models = doc.get("models") or {}
+                    states = [
+                        m.get("state", "ok") for m in models.values()
+                    ]
+                    if b.get("level", 0) == 0 and all(
+                        st == "ok" for st in states
+                    ):
+                        break
+                except Exception:  # noqa: BLE001
+                    pass
+                await asyncio.sleep(0.5)
+        return await _wave(
+            base, labelled, args.duration_s,
+            args.watermark * args.overload, prompt, max_toks,
+        )
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default="jax", choices=["mocker", "jax"])
+    ap.add_argument("--model-path", default=None,
+                    help="HF model dir; default = tiny random model (CPU)")
+    ap.add_argument("--watermark", type=int, default=32,
+                    help="DYN_ADMISSION_MAX_INFLIGHT; load = overload x this")
+    ap.add_argument("--overload", type=int, default=4)
+    ap.add_argument("--duration-s", type=float, default=25.0)
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=None,
+                    help="KV pool; tiny-model default 96 forces pressure")
+    ap.add_argument("--prompt-tokens", type=int, default=48)
+    ap.add_argument("--interactive-max-tokens", type=int, default=8)
+    ap.add_argument("--bulk-max-tokens", type=int, default=128)
+    ap.add_argument("--slo-ttft-ms", type=float, default=250.0)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    model_path = args.model_path
+    own_dir = None
+    if model_path is None and args.out == "jax":
+        from benchmarks.perf_sweep import make_tiny_model_dir
+
+        own_dir = tempfile.mkdtemp(prefix="priority-sweep-model-")
+        make_tiny_model_dir(own_dir)
+        model_path = own_dir
+        if args.num_blocks is None:
+            # pool sized so concurrent bulk growth actually hits the
+            # preemption path (16 slots x ~11 blocks each >> 95 usable)
+            args.num_blocks = 96
+
+    blind = asyncio.run(_serve_and_run(args, False, model_path))
+    qos = asyncio.run(_serve_and_run(args, True, model_path))
+    ratio = None
+    if blind["interactive"]["ttft_p99_ms"] and qos["interactive"]["ttft_p99_ms"]:
+        ratio = round(
+            blind["interactive"]["ttft_p99_ms"]
+            / qos["interactive"]["ttft_p99_ms"],
+            2,
+        )
+    doc = {
+        "bench": "priority_sweep",
+        "engine": args.out,
+        "overload": args.overload,
+        "watermark": args.watermark,
+        "mix": "1:4 interactive:bulk",
+        "class_blind": blind,
+        "qos": qos,
+        "interactive_p99_improvement_x": ratio,
+    }
+    print(json.dumps(
+        {
+            "interactive_p99_improvement_x": ratio,
+            "qos_interactive_p99_ms": qos["interactive"]["ttft_p99_ms"],
+            "blind_interactive_p99_ms": blind["interactive"]["ttft_p99_ms"],
+            "qos_bulk_shed": qos["bulk"]["shed"],
+            "qos_interactive_shed": qos["interactive"]["shed"],
+            "brownout_peak": qos["brownout_peak"],
+        }
+    ))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

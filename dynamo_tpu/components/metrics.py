@@ -87,6 +87,13 @@ class _FleetCollector:
         ("watchdog_trips",
          "Stuck-horizon watchdog trips (fleet sum)",
          lambda agg: agg.worker_stats.num_watchdog_trips),
+        ("preempted_too_often",
+         "Sequences failed by the preemption-storm guard (fleet sum)",
+         lambda agg: agg.worker_stats.num_preempted_too_often),
+        ("brownout_sheds",
+         "Requests shed at engine admission by the brownout ladder "
+         "(fleet sum)",
+         lambda agg: agg.worker_stats.num_shed_brownout),
     )
     _XFER_COUNTERS = (
         ("kv_wire_tx_bytes", "KV wire bytes shipped (fleet sum)",
@@ -117,6 +124,28 @@ class _FleetCollector:
         for name, doc, read in self._XFER_COUNTERS:
             value = float(read(xfer)) if xfer is not None else 0.0
             yield CounterMetricFamily(f"{PREFIX}_{name}", doc, value=value)
+        # class-aware preemption counts (the QoS acceptance signal: under
+        # overload every preemption should land on bulk first)
+        preempt = CounterMetricFamily(
+            f"{PREFIX}_preemptions",
+            "KV-preserving preemptions by victim priority class "
+            "(fleet sum)",
+            labels=["priority"],
+        )
+        by_class = (
+            agg.worker_stats.preemptions_by_class if agg is not None else None
+        ) or {}
+        for cls, v in sorted(by_class.items()):
+            preempt.add_metric([str(cls)], float(v))
+        yield preempt
+        yield GaugeMetricFamily(
+            f"{PREFIX}_brownout_level",
+            "Worst worker brownout rung in the fleet "
+            "(0 ok, 1 shed_bulk, 2 spec_off, 3 chunk_cap, 4 shed_standard)",
+            value=float(
+                agg.worker_stats.brownout_level if agg is not None else 0
+            ),
+        )
         ph = agg.phase_histograms if agg is not None else None
         yield from self._phase_families(ph)
         yield from self._slo_families()
@@ -420,6 +449,10 @@ class MockWorkerMetrics:
         # monotonic counter state (worker lifetime)
         self._deadline_exceeded = 0
         self._watchdog_trips = 0
+        self._preemptions_by_class: dict[str, int] = {}
+        self._preempted_too_often = 0
+        self._shed_brownout = 0
+        self.brownout_level = 0  # settable knob (exercise the gauge)
         self._spec = SpecDecodeStats(
             num_spec_tokens=4,
             num_drafts=0,
@@ -468,6 +501,21 @@ class MockWorkerMetrics:
             self._deadline_exceeded += 1
         if self._t % 300 == 0:
             self._watchdog_trips += 1
+        # QoS plane: under high load the class-aware scheduler preempts
+        # bulk work (and occasionally standard); the storm guard trips
+        # rarely — deterministic, like everything else here
+        if load > 0.8:
+            self._preemptions_by_class["bulk"] = (
+                self._preemptions_by_class.get("bulk", 0) + 2
+            )
+        if load > 0.97:
+            self._preemptions_by_class["standard"] = (
+                self._preemptions_by_class.get("standard", 0) + 1
+            )
+        if self._t % 500 == 0:
+            self._preempted_too_often += 1
+        if self.brownout_level >= 1 and load > 0.5:
+            self._shed_brownout += 1
         return ForwardPassMetrics(
             worker_stats=WorkerStats(
                 request_active_slots=int(self.total_slots * load),
@@ -475,6 +523,10 @@ class MockWorkerMetrics:
                 num_requests_waiting=int(4 * max(0.0, load - 0.75)),
                 num_deadline_exceeded=self._deadline_exceeded,
                 num_watchdog_trips=self._watchdog_trips,
+                preemptions_by_class=dict(self._preemptions_by_class) or None,
+                num_preempted_too_often=self._preempted_too_often,
+                num_shed_brownout=self._shed_brownout,
+                brownout_level=self.brownout_level,
             ),
             kv_stats=KvStats(
                 kv_active_blocks=active_blocks,

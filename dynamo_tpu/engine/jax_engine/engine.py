@@ -15,6 +15,7 @@ hash-chain identity the router indexes — the engine IS the KV event source
 from __future__ import annotations
 
 import asyncio
+import bisect
 import contextlib
 import itertools
 import os
@@ -24,6 +25,8 @@ from typing import Any, AsyncIterator, Callable, Optional
 
 import numpy as np
 
+from dynamo_tpu import qos
+from dynamo_tpu.telemetry import brownout as dbrownout
 from dynamo_tpu.testing import faults
 
 from dynamo_tpu.engine.jax_engine.kv_cache import (
@@ -112,6 +115,20 @@ class JaxEngineConfig:
             os.environ.get("DYN_WATCHDOG_COLD_S", "300")
         )
     )
+    # Preemption-storm guard: a sequence preempted more than
+    # max_preemptions times fails with a structured `preempted_too_often`
+    # error instead of thrashing the cache forever; each re-queue also
+    # waits out an exponential re-admission backoff (base
+    # preempt_backoff_ms, doubled per preemption, capped at 2 s) so a
+    # sustained-pressure victim stops ping-ponging with its preemptor.
+    max_preemptions: int = field(
+        default_factory=lambda: int(os.environ.get("DYN_MAX_PREEMPTIONS", "8"))
+    )
+    preempt_backoff_ms: float = field(
+        default_factory=lambda: float(
+            os.environ.get("DYN_PREEMPT_BACKOFF_MS", "25")
+        )
+    )
 
 
 @dataclass
@@ -149,6 +166,13 @@ class EngineStats:
     kv_bytes_overlapped: int = 0
     kv_frames_inflight: int = 0  # gauge (prefill role, bounded window)
     prefill_dropped_expired: int = 0  # queue entries dropped past deadline
+    # QoS plane (ISSUE 7): per-class preemption counts (class-aware
+    # KV-preserving preemption — bulk absorbs pressure first), storm-guard
+    # kills, engine-side brownout sheds, and the live brownout rung
+    preemptions_by_class: dict = field(default_factory=dict)
+    preempted_too_often: int = 0
+    shed_brownout: int = 0
+    brownout_level: int = 0  # gauge
     # always-on per-phase latency distributions (queue_wait / prefill /
     # ttft / inter_token / e2e) on the shared fixed-log bucket grid;
     # shipped on ForwardPassMetrics and merged fleet-wide by bucket
@@ -190,6 +214,15 @@ class _Sequence(SequenceState):
             self.num_prompt = resume
         self.request = request
         self.ctx = ctx
+        # QoS class resolved at the edge (qos.stamp_priority): rides
+        # Context.metadata across the wire, PreprocessedRequest.extra as
+        # the transport-less fallback. Orders the waiting queue and picks
+        # preemption victims (bulk first).
+        self.priority = qos.priority_of(ctx, request)
+        self.rank = qos.rank_of(self.priority)
+        self.arrival_order = 0  # engine-assigned FIFO tiebreak
+        self.preemptions = 0  # storm guard: count + re-admission backoff
+        self.requeue_after = 0.0  # monotonic; 0 = admissible now
         self.deadline_fired = False  # structured deadline error sent once
         self.pending_remote = False  # admitted, awaiting remote prefill KV
         self.prefilling = False  # admitted, chunked prefill in progress
@@ -303,7 +336,16 @@ class JaxEngine:
         )
         self.allocator = BlockAllocator(self.config.num_blocks)
         self.slots: list[Optional[_Sequence]] = [None] * self.config.max_batch
+        # priority-then-deadline ordered admission queue (kept sorted by
+        # _enqueue): (class rank, deadline, arrival) — interactive overtakes
+        # bulk, and within a class the tightest deadline goes first
         self.waiting: list[_Sequence] = []
+        self._arrivals = itertools.count(1)
+        # brownout ladder rung applied by the host wiring (apply_brownout):
+        # >=1 sheds bulk arrivals, >=2 pauses spec decode, >=3 caps the
+        # prefill-chunk budget, >=4 sheds standard arrivals too
+        self._brownout_level = 0
+        self._spec_paused = False
         # long prompts being prefilled one chunk at a time; the loop runs
         # one chunk then a decode step so decode never stalls > one chunk
         self._prefilling: list[_Sequence] = []
@@ -471,10 +513,27 @@ class JaxEngine:
                 "prompt_too_long",
             )
             return
+        if self._brownout_level:
+            # engine-side brownout shed (direct-engine deployments; a
+            # fronted fleet sheds at the HTTP AdmissionController first)
+            prio = qos.priority_of(context, request)
+            if prio in dbrownout.shed_classes_for(self._brownout_level):
+                self.stats.shed_brownout += 1
+                yield LLMEngineOutput.final_error(
+                    context.id, "admission",
+                    f"brownout level {self._brownout_level} "
+                    f"({dbrownout.LADDER[self._brownout_level]}) sheds "
+                    f"{prio}-class requests",
+                    "brownout_shed",
+                )
+                return
         seq = _Sequence(next(self._seq_ids), request, context)
         if dtrace.enabled():
-            self._sp_begin(seq, "queue_wait", tokens=len(request.token_ids))
-        self.waiting.append(seq)
+            self._sp_begin(
+                seq, "queue_wait",
+                tokens=len(request.token_ids), priority=seq.priority,
+            )
+        self._enqueue(seq)
         self._ensure_loop()
         self._wake.set()
         try:
@@ -751,6 +810,43 @@ class JaxEngine:
 
     # ----------------------------------------------------------- schedule
 
+    @staticmethod
+    def _queue_key(seq: _Sequence) -> tuple:
+        """Priority-then-deadline admission order: class rank, then the
+        request deadline (unbounded last), then arrival. A preempted
+        sequence keeps its original arrival number, so it re-queues at the
+        HEAD of its class — ahead of younger same-class work — without any
+        special-casing."""
+        dl = seq.ctx.deadline
+        return (seq.rank, dl if dl is not None else float("inf"),
+                seq.arrival_order)
+
+    def _enqueue(self, seq: _Sequence) -> None:
+        if not seq.arrival_order:
+            seq.arrival_order = next(self._arrivals)
+        bisect.insort(self.waiting, seq, key=self._queue_key)
+
+    # ------------------------------------------------------------ brownout
+
+    def apply_brownout(self, level: int) -> None:
+        """Apply one brownout-ladder rung (telemetry/brownout.py; wired by
+        the worker host from `slo-status` events + local burn rates):
+        level >= 1 sheds bulk arrivals, >= 2 pauses speculative decoding,
+        >= 3 halves the prefill-chunk budget per step, >= 4 sheds standard
+        arrivals too. Idempotent; lowering the level restores everything."""
+        self._brownout_level = max(0, int(level))
+        self._spec_paused = self._brownout_level >= 2
+        self.stats.brownout_level = self._brownout_level
+
+    def _chunk_budget(self) -> int:
+        """Prefill-chunk tokens per engine step; halved under brownout
+        chunk-cap (>= level 3) so decode lanes get the chip back — new
+        prompts' TTFT is sacrificed for admitted requests' ITL."""
+        c = getattr(self.runner, "prefill_chunk_tokens", 0)
+        if c and self._brownout_level >= 3:
+            c = max(self.config.block_size, c // 2)
+        return c
+
     def _free_seq(self, seq: _Sequence, emit_remove: bool = True) -> None:
         if self._offload_queue is not None:
             # queued candidates now point at blocks about to be recycled;
@@ -924,28 +1020,71 @@ class JaxEngine:
             stream, seq.num_generated + (seq.eos_drops << 16)
         )
 
-    def _preempt_youngest(self, exclude: _Sequence) -> bool:
-        for victim in reversed(self._admit_order):
-            if victim is exclude or victim.slot is None or victim.pending_remote:
-                continue
-            logger.debug("preempting seq %d", victim.seq_id)
-            # spill completed blocks to the host tier before the device
-            # copies are recycled; re-admission then onboards them instead
-            # of recomputing (reference offload.rs eviction-time offload)
-            self._spill_preempted(victim)
-            self._free_seq(victim)
-            victim.hash_seq = None
-            victim.emitted_hashes = 0
-            victim.offload_mark = 0
-            if victim.spans:
-                self._sp_event(victim, "preempted")
-                self._sp_close_all(victim)
-            if dtrace.enabled():
-                # re-queued: its wait for re-admission is a fresh phase
-                self._sp_begin(victim, "queue_wait", resumed=True)
-            self.waiting.insert(0, victim)
-            return True
+    def _preempt_victim(self, exclude: _Sequence) -> bool:
+        """Class-aware LIFO victim choice: lowest class first (bulk absorbs
+        pressure before standard before interactive), youngest within a
+        class — and never a victim whose class strictly outranks the
+        preemptor's (bulk growth must not evict interactive work; the
+        grower self-preempts instead, see _append_token)."""
+        worst = max(qos.CLASS_RANK.values())
+        for rank in range(worst, exclude.rank - 1, -1):
+            for victim in reversed(self._admit_order):
+                if (
+                    victim is exclude
+                    or victim.slot is None
+                    or victim.pending_remote
+                    or victim.rank != rank
+                ):
+                    continue
+                self._preempt_seq(victim)
+                return True
         return False
+
+    def _preempt_seq(self, victim: _Sequence) -> None:
+        """Preempt one admitted sequence, KV-preserving: spill completed
+        blocks to the host tier before the device copies are recycled so
+        re-admission onboards them instead of re-prefilling (reference
+        offload.rs eviction-time offload). Guarded against preemption
+        storms: past max_preemptions the sequence fails with a structured
+        `preempted_too_often` error, and every re-queue waits out an
+        exponential re-admission backoff."""
+        victim.preemptions += 1
+        by_class = self.stats.preemptions_by_class
+        by_class[victim.priority] = by_class.get(victim.priority, 0) + 1
+        if victim.preemptions > self.config.max_preemptions:
+            self.stats.preempted_too_often += 1
+            self._sp_event(victim, "preempted_too_often")
+            self._finish_error(
+                victim, "preemption",
+                f"preempted {victim.preemptions} times under sustained "
+                f"pressure (DYN_MAX_PREEMPTIONS="
+                f"{self.config.max_preemptions}); giving up",
+                "preempted_too_often",
+            )
+            return
+        logger.debug(
+            "preempting seq %d (%s, preemption #%d)",
+            victim.seq_id, victim.priority, victim.preemptions,
+        )
+        self._spill_preempted(victim)
+        self._free_seq(victim)
+        victim.hash_seq = None
+        victim.emitted_hashes = 0
+        victim.offload_mark = 0
+        if victim.spans:
+            self._sp_event(victim, "preempted", count=victim.preemptions)
+            self._sp_close_all(victim)
+        if dtrace.enabled():
+            # re-queued: its wait for re-admission is a fresh phase
+            self._sp_begin(victim, "queue_wait", resumed=True)
+        backoff_s = min(
+            2.0,
+            self.config.preempt_backoff_ms
+            / 1e3
+            * (1 << (victim.preemptions - 1)),
+        )
+        victim.requeue_after = time.monotonic() + backoff_s
+        self._enqueue(victim)
 
     def _spill_preempted(self, victim: _Sequence) -> None:
         """Move ownership of the victim's not-yet-offloaded full blocks to
@@ -1097,15 +1236,21 @@ class JaxEngine:
     async def _admit_phase(self, loop) -> bool:
         admitted = False
         to_pack: list[_Sequence] = []
-        chunk_c = getattr(self.runner, "prefill_chunk_tokens", 0)
+        chunk_c = self._chunk_budget()
         can_pack = bool(chunk_c) and hasattr(
             self.runner, "prefill_packed_arrays"
         )
-        while self.waiting:
-            seq = self.waiting[0]
+        idx = 0
+        while idx < len(self.waiting):
+            seq = self.waiting[idx]
+            if seq.requeue_after and time.monotonic() < seq.requeue_after:
+                # re-admission backoff after preemption: let same-or-lower
+                # priority work behind it through instead of head-blocking
+                idx += 1
+                continue
             if not self._try_admit(seq):
                 break
-            self.waiting.pop(0)
+            self.waiting.pop(idx)
             admitted = True
             if seq.t_admitted is None:  # first admission (not a resume)
                 seq.t_admitted = time.monotonic()
@@ -1331,7 +1476,7 @@ class JaxEngine:
             if seq in self._prefilling:
                 self._prefilling.remove(seq)
             return
-        c = self.runner.prefill_chunk_tokens
+        c = self._chunk_budget()
         start = seq.prefill_pos
         total = len(seq.token_ids)
         chunk = seq.token_ids[start : start + c]
@@ -1985,7 +2130,9 @@ class JaxEngine:
         return H
 
     async def _decode_phase(self, loop, active: list[_Sequence]) -> None:
-        if self.drafter is not None:
+        # brownout >= spec_off pauses drafting: the verify premium and
+        # drafter host time go back to real tokens while the SLO burns
+        if self.drafter is not None and not self._spec_paused:
             drafts = self._collect_drafts(active)
             if drafts is not None:
                 await self._spec_decode_phase(loop, active, drafts)
@@ -2473,8 +2620,18 @@ class JaxEngine:
             try:
                 seq.block_ids.extend(self.allocator.alloc(1))
             except OutOfBlocks:
-                if self._preempt_youngest(exclude=seq):
+                if self._preempt_victim(exclude=seq):
                     seq.block_ids.extend(self.allocator.alloc(1))
+                elif any(
+                    v is not seq and v.slot is not None
+                    and not v.pending_remote
+                    for v in self._admit_order
+                ):
+                    # every other lane outranks this one (class-aware
+                    # victim choice refused them all): the lower-class
+                    # sequence yields ITSELF — KV spills to the host tier
+                    # and it resumes via onboard when pressure clears
+                    self._preempt_seq(seq)
                 else:
                     logger.error("seq %d: out of KV blocks", seq.seq_id)
                     self._finish_error(

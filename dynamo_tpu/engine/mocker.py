@@ -21,12 +21,17 @@ all behind the `DYN_TRACE` flag, zero-cost when off.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import collections
+import itertools
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Optional
 
+from dynamo_tpu import qos
 from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.telemetry import brownout as dbrownout
 from dynamo_tpu.protocols.common import (
     FinishReason,
     LLMEngineOutput,
@@ -52,6 +57,15 @@ class MockEngineArgs:
     prefill_quadratic_s: float = 1e-8
     decode_per_token_s: float = 0.01
     dp_rank: Optional[int] = None
+    # preemption-storm guard (parity with JaxEngineConfig)
+    max_preemptions: int = field(
+        default_factory=lambda: int(os.environ.get("DYN_MAX_PREEMPTIONS", "8"))
+    )
+    preempt_backoff_ms: float = field(
+        default_factory=lambda: float(
+            os.environ.get("DYN_PREEMPT_BACKOFF_MS", "25")
+        )
+    )
 
 
 class _SimKvCache:
@@ -186,6 +200,12 @@ class _MockSeq:
     unique_blocks: int = 1
     remote_prefilled: bool = False  # KV arrived from the prefill fleet
     spans: dict = field(default_factory=dict)  # open telemetry phase spans
+    # QoS plane (parity with JaxEngine._Sequence)
+    priority: str = qos.DEFAULT_CLASS
+    rank: int = qos.CLASS_RANK[qos.DEFAULT_CLASS]
+    arrival_order: int = 0
+    preemptions: int = 0
+    requeue_after: float = 0.0
     # always-on phase-timing marks (feed the engine's phase histograms)
     t_arrival: float = 0.0
     t_admitted: Optional[float] = None
@@ -212,7 +232,10 @@ class MockEngine:
         self.args = args or MockEngineArgs()
         self.cache = _SimKvCache(self.args, on_blocks_stored, on_blocks_removed)
         self.active: list[_MockSeq] = []
-        self.waiting: collections.deque[_MockSeq] = collections.deque()
+        # priority-then-deadline ordered admission queue (kept sorted by
+        # _enqueue — parity with JaxEngine.waiting)
+        self.waiting: list[_MockSeq] = []
+        self._arrivals = itertools.count(1)
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self.generated_tokens = 0
@@ -222,6 +245,12 @@ class MockEngine:
         # lifeguard counters (same names the JaxEngine stats carry)
         self.deadline_exceeded = 0
         self.injected_aborts = 0
+        # QoS counters + brownout rung (parity with EngineStats)
+        self.preemptions_by_class: dict[str, int] = {}
+        self.preempted_too_often = 0
+        self.shed_brownout = 0
+        self.brownout_level = 0
+        self.spec_paused = False  # recorded for parity (mocker has no spec)
         # streaming-disagg: prompts >= threshold ship to the prefill fleet
         self.remote_prefill_client = remote_prefill_client
         self.disagg_threshold = disagg_threshold or 2 * self.args.block_size
@@ -302,6 +331,19 @@ class MockEngine:
                 "deadline_exceeded",
             )
             return
+        priority = qos.priority_of(ctx, request)
+        if self.brownout_level and priority in dbrownout.shed_classes_for(
+            self.brownout_level
+        ):
+            self.shed_brownout += 1
+            yield LLMEngineOutput.final_error(
+                ctx.id, "admission",
+                f"brownout level {self.brownout_level} "
+                f"({dbrownout.LADDER[self.brownout_level]}) sheds "
+                f"{priority}-class requests",
+                "brownout_shed",
+            )
+            return
         # in-flight migration replay (see JaxEngine._Sequence): the tail of
         # token_ids past resume_prompt_len was already streamed by a dead
         # worker; counting it as generated keeps the deterministic token
@@ -331,6 +373,8 @@ class MockEngine:
                 tokens=list(request.token_ids),
             ),
             t_arrival=t_arrival,
+            priority=priority,
+            rank=qos.rank_of(priority),
         )
         if first_remote is not None:
             # the prefill worker sampled the first token (the same
@@ -349,8 +393,10 @@ class MockEngine:
                 return
             seq.out.put_nowait(LLMEngineOutput(token_ids=[first_remote]))
         if dtrace.enabled():
-            self._sp_begin(seq, "queue_wait", tokens=prompt_len)
-        self.waiting.append(seq)
+            self._sp_begin(
+                seq, "queue_wait", tokens=prompt_len, priority=seq.priority
+            )
+        self._enqueue(seq)
         self._wake.set()
         self._ensure_loop()
         try:
@@ -423,7 +469,19 @@ class MockEngine:
             "cache_usage": self.cache.usage,
             "deadline_exceeded": self.deadline_exceeded,
             "phase_histograms": self.phase_hist,
+            "preemptions_by_class": dict(self.preemptions_by_class),
+            "preempted_too_often": self.preempted_too_often,
+            "shed_brownout": self.shed_brownout,
+            "brownout_level": self.brownout_level,
         }
+
+    def apply_brownout(self, level: int) -> None:
+        """Brownout-ladder rung (parity with JaxEngine.apply_brownout):
+        >= 1 sheds bulk arrivals, >= 2 records spec pause (the mocker has
+        no drafter — the flag exists so the policy is testable
+        engine-free), >= 4 sheds standard arrivals too."""
+        self.brownout_level = max(0, int(level))
+        self.spec_paused = self.brownout_level >= 2
 
     async def close(self) -> None:
         if self._loop_task is not None:
@@ -439,6 +497,17 @@ class MockEngine:
     def _ensure_loop(self) -> None:
         if self._loop_task is None or self._loop_task.done():
             self._loop_task = asyncio.create_task(self._run())
+
+    @staticmethod
+    def _queue_key(seq: _MockSeq) -> tuple:
+        dl = seq.context.deadline
+        return (seq.rank, dl if dl is not None else float("inf"),
+                seq.arrival_order)
+
+    def _enqueue(self, seq: _MockSeq) -> None:
+        if not seq.arrival_order:
+            seq.arrival_order = next(self._arrivals)
+        bisect.insort(self.waiting, seq, key=self._queue_key)
 
     async def _sim_sleep(self, sim_s: float) -> None:
         await asyncio.sleep(sim_s / self.args.speedup_ratio)
@@ -468,8 +537,13 @@ class MockEngine:
                     "deadline exceeded while queued", "deadline_exceeded",
                 )
             )
-        while self.waiting and len(self.active) < self.args.max_batch:
-            seq = self.waiting[0]
+        idx = 0
+        while idx < len(self.waiting) and len(self.active) < self.args.max_batch:
+            seq = self.waiting[idx]
+            if seq.requeue_after and time.monotonic() < seq.requeue_after:
+                # preemption re-admission backoff: don't head-block others
+                idx += 1
+                continue
             hashes = [b.block_hash for b in seq.hash_seq.blocks]
             cached = self.cache.cached_prefix_blocks(hashes)
             if (
@@ -479,7 +553,7 @@ class MockEngine:
                 break
             if not self.cache.try_allocate(hashes, extra_unique=1):
                 break
-            self.waiting.popleft()
+            self.waiting.pop(idx)
             if seq.t_admitted is None:  # first admission (not a resume)
                 seq.t_admitted = time.monotonic()
                 self.phase_hist.observe(
@@ -623,13 +697,56 @@ class MockEngine:
                 self._sp_close_all(seq)
 
     def _preempt_for(self, seq: _MockSeq) -> None:
-        if seq in self.active:
-            self.active.remove(seq)
-        self.cache.release(seq.acquired_hashes, seq.unique_blocks)
-        seq.acquired_hashes = []
-        self._sp_event(seq, "preempted")
-        self._sp_finish(seq, "decode", preempted=True)
-        self.waiting.appendleft(seq)
+        """Class-aware victim choice (parity with JaxEngine._preempt_victim):
+        lowest class first, youngest within a class, never a victim whose
+        class strictly outranks the grower's — the grower yields itself
+        when everyone else is more important."""
+        victim = None
+        worst = max(qos.CLASS_RANK.values())
+        for rank in range(worst, seq.rank - 1, -1):
+            for cand in reversed(self.active):
+                if cand is seq or cand.rank != rank:
+                    continue
+                victim = cand
+                break
+            if victim is not None:
+                break
+        self._preempt_seq(victim if victim is not None else seq)
+
+    def _preempt_seq(self, victim: _MockSeq) -> None:
+        if victim in self.active:
+            self.active.remove(victim)
+        self.cache.release(victim.acquired_hashes, victim.unique_blocks)
+        victim.acquired_hashes = []
+        victim.preemptions += 1
+        self.preemptions_by_class[victim.priority] = (
+            self.preemptions_by_class.get(victim.priority, 0) + 1
+        )
+        if victim.preemptions > self.args.max_preemptions:
+            # preemption-storm guard (parity with JaxEngine._preempt_seq)
+            self.preempted_too_often += 1
+            self._sp_event(victim, "preempted_too_often")
+            self._sp_close_all(victim)
+            victim.out.put_nowait(
+                LLMEngineOutput.final_error(
+                    victim.context.id, "preemption",
+                    f"preempted {victim.preemptions} times under sustained "
+                    f"pressure (DYN_MAX_PREEMPTIONS="
+                    f"{self.args.max_preemptions}); giving up",
+                    "preempted_too_often",
+                )
+            )
+            return
+        self._sp_event(victim, "preempted", count=victim.preemptions)
+        self._sp_finish(victim, "decode", preempted=True)
+        backoff_s = min(
+            2.0,
+            self.args.preempt_backoff_ms
+            / 1e3
+            * (1 << (victim.preemptions - 1)),
+        )
+        victim.requeue_after = time.monotonic() + backoff_s
+        self._enqueue(victim)
 
 
 class MockPrefillEngine:

@@ -196,6 +196,11 @@ async def run_http(
         # colocated engine may act as decode OR prefill worker)
         if stats is not None and hasattr(stats, "kv_wire_bytes_rx"):
             service.metrics.attach_kv_transfer_stats(stats)
+        # QoS counters (per-class preemptions, storm guard, brownout
+        # sheds) for the colocated engine — both JaxEngine (stats object)
+        # and MockEngine (stats() dict) carry the keys
+        if stats is not None:
+            service.metrics.attach_engine_qos(stats)
         # admission watermark for the colocated engine follows its slot
         # count (dynamic mode gets this from the discovery capacity poller)
         if stats is not None:
@@ -205,6 +210,19 @@ async def run_http(
                 return d.get("total_slots") or None
 
             service.admission.set_capacity_fn(config.mdc.name, _local_slots)
+        # colocated engine rides the frontend's brownout ladder too: the
+        # engine-side rungs (spec pause, prefill-chunk cap) apply in the
+        # same process — chain onto the service's admission hook
+        if hasattr(config.engine, "apply_brownout"):
+            local_engine = config.engine
+            base_change = service.brownout.on_change
+
+            def _chained_change(old: int, new: int, rung: str) -> None:
+                if base_change is not None:
+                    base_change(old, new, rung)
+                local_engine.apply_brownout(new)
+
+            service.brownout.on_change = _chained_change
     else:
         watcher = ModelWatcher(
             drt, manager, config.router_mode, config.kv_router_config,
@@ -227,7 +245,39 @@ async def run_http(
             asyncio.get_running_loop().create_task(_send())
 
     service.slo_publisher = _publish_slo
+
+    # Brownout plane (ISSUE 7): ladder transitions publish on
+    # `brownout-status`, and fleet `slo-status` events (metrics component,
+    # other frontends) feed this frontend's ladder so admission sheds
+    # bulk/standard even when the breach was observed elsewhere.
+    from dynamo_tpu.telemetry import brownout as dbrownout
+
+    def _publish_brownout(payload: dict) -> None:
+        async def _send() -> None:
+            with contextlib.suppress(Exception):
+                await ns.publish_event(dbrownout.BROWNOUT_SUBJECT, payload)
+
+        with contextlib.suppress(RuntimeError):
+            asyncio.get_running_loop().create_task(_send())
+
+    service.brownout_publisher = _publish_brownout
     await service.start()
+
+    async def _slo_event_loop() -> None:
+        import msgpack
+
+        with contextlib.suppress(asyncio.CancelledError, Exception):
+            sub = await ns.subscribe_event(dslo.SLO_STATUS_SUBJECT)
+            async for _subject, payload in sub:
+                try:
+                    data = msgpack.unpackb(payload, raw=False)
+                except Exception:  # noqa: BLE001 — malformed event
+                    continue
+                service.note_remote_slo(data.get("new"))
+
+    service.add_background_task(
+        asyncio.get_running_loop().create_task(_slo_event_loop())
+    )
     # graceful drain on SIGTERM (sdk/runner -> drt.drain): stop admitting,
     # let in-flight streams finish bounded by DYN_DRAIN_TIMEOUT_S, close
     drain_timeout = float(os.environ.get("DYN_DRAIN_TIMEOUT_S", "10"))
@@ -494,6 +544,12 @@ async def run_endpoint(
                 num_requests_waiting=d.get("waiting", 0),
                 num_deadline_exceeded=d.get("deadline_exceeded", 0),
                 num_watchdog_trips=d.get("watchdog_trips", 0),
+                preemptions_by_class=(
+                    dict(d.get("preemptions_by_class") or {}) or None
+                ),
+                num_preempted_too_often=d.get("preempted_too_often", 0),
+                num_shed_brownout=d.get("shed_brownout", 0),
+                brownout_level=d.get("brownout_level", 0),
             ),
             kv_stats=KvStats(
                 kv_active_blocks=used,
@@ -508,10 +564,80 @@ async def run_endpoint(
     if stats_fn is not None:
         await metrics_pub.start(snapshot)
 
+    # SLO-driven brownout (ISSUE 7): the worker runs its own degradation
+    # ladder fed by fleet `slo-status` events AND local burn rates over
+    # the engine's own phase histograms; rungs apply through
+    # engine.apply_brownout (spec pause, prefill-chunk cap, bulk shed).
+    brownout_tasks: list[asyncio.Task] = []
+    if hasattr(engine, "apply_brownout"):
+        from dynamo_tpu.telemetry import brownout as dbrownout
+        from dynamo_tpu.telemetry import slo as dslo
+        from dynamo_tpu.telemetry.histogram import PhaseHistograms
+
+        controller = dbrownout.BrownoutController(
+            scope=worker_label,
+            on_change=lambda old, new, rung: engine.apply_brownout(new),
+        )
+        slo_states = {"remote": "ok", "local": "ok"}
+
+        def _feed(source: str, state: Any) -> None:
+            if state in dslo._SEVERITY:
+                slo_states[source] = state
+            controller.observe(
+                max(slo_states.values(), key=lambda s: dslo._SEVERITY[s])
+            )
+
+        loop_b = asyncio.get_running_loop()
+
+        async def _slo_events() -> None:
+            import msgpack
+
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                sub = await endpoint.component.namespace.subscribe_event(
+                    dslo.SLO_STATUS_SUBJECT
+                )
+                async for _subject, payload in sub:
+                    try:
+                        data = msgpack.unpackb(payload, raw=False)
+                    except Exception:  # noqa: BLE001 — malformed event
+                        continue
+                    _feed("remote", data.get("new"))
+
+        brownout_tasks.append(loop_b.create_task(_slo_events()))
+
+        slo_cfg = dslo.SloConfig.from_env(config.mdc.name)
+        if slo_cfg.enabled and stats_fn is not None:
+            local_slo = dslo.SloEngine(slo_cfg, model=config.mdc.name)
+            tick_s = float(os.environ.get("DYN_SLO_TICK_S", "1.0"))
+
+            async def _local_burn() -> None:
+                with contextlib.suppress(asyncio.CancelledError):
+                    while True:
+                        await asyncio.sleep(tick_s)
+                        try:
+                            s = stats_fn() if callable(stats_fn) else stats_fn
+                            d = (
+                                s if isinstance(s, dict)
+                                else getattr(s, "__dict__", {})
+                            )
+                            ph = d.get("phase_histograms")
+                            status = local_slo.observe(
+                                ph if ph is not None else PhaseHistograms()
+                            )
+                            _feed("local", status.get("state"))
+                        except Exception:  # noqa: BLE001 — telemetry only
+                            logger.exception("local SLO tick failed")
+
+            brownout_tasks.append(loop_b.create_task(_local_burn()))
+
     logger.info("worker serving %s (model %s)", eid, config.mdc.name)
     try:
         await service.wait()
     finally:
+        for t in brownout_tasks:
+            t.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await t
         await metrics_pub.stop()
         if clear_service is not None:
             await clear_service.stop(drain=False)

@@ -17,6 +17,7 @@ from prometheus_client import (
     Histogram,
     generate_latest,
 )
+from prometheus_client.core import CounterMetricFamily
 
 from dynamo_tpu.runtime.prom import CallbackCounter
 from dynamo_tpu.telemetry.histogram import PhaseHistograms
@@ -99,6 +100,15 @@ class ServiceMetrics:
             "dyn_llm_deadline_exceeded_total",
             "Requests cancelled on deadline/TTFT expiry",
             ["model"],
+            registry=self.registry,
+        )
+        # QoS plane: class-aware sheds (reason = watermark | brownout).
+        # The class-blind dyn_llm_requests_shed_total above stays for
+        # dashboard continuity; this series carries the per-class story.
+        self.class_shed = Counter(
+            "dyn_llm_class_requests_shed",
+            "Requests shed by class-aware admission control",
+            ["model", "priority", "reason"],
             registry=self.registry,
         )
         # per-model phase histograms as THIS FRONTEND observed them
@@ -190,6 +200,72 @@ class ServiceMetrics:
         )
         overlap.set_function(
             read("kv_bytes_overlapped", "kv_wire_bytes_rx")
+        )
+
+    def attach_engine_qos(self, stats_src) -> None:
+        """Surface a colocated engine's QoS counters on this registry:
+        per-class preemptions (class-aware preemption lands on bulk
+        first), storm-guard kills, and engine-side brownout sheds. Same
+        lazy scrape-time contract as the other attach_* hooks; the metrics
+        COMPONENT exports the same families for a fabric-scraped fleet."""
+        if getattr(self, "_engine_qos_attached", False):
+            return
+        self._engine_qos_attached = True
+
+        def read() -> dict:
+            s = stats_src() if callable(stats_src) else stats_src
+            return s if isinstance(s, dict) else getattr(s, "__dict__", {})
+
+        class _QosCollector:
+            def describe(self):
+                return []
+
+            def collect(self):
+                d = read()
+                fam = CounterMetricFamily(
+                    "dyn_llm_preemptions",
+                    "KV-preserving preemptions by victim priority class",
+                    labels=["priority"],
+                )
+                for cls, v in sorted(
+                    (d.get("preemptions_by_class") or {}).items()
+                ):
+                    fam.add_metric([str(cls)], float(v))
+                yield fam
+                yield CounterMetricFamily(
+                    "dyn_llm_preempted_too_often",
+                    "Sequences failed by the preemption-storm guard",
+                    value=float(d.get("preempted_too_often", 0) or 0),
+                )
+                yield CounterMetricFamily(
+                    "dyn_llm_brownout_sheds",
+                    "Requests shed at engine admission by the brownout "
+                    "ladder",
+                    value=float(d.get("shed_brownout", 0) or 0),
+                )
+
+        self.registry.register(_QosCollector())
+
+    def attach_brownout(self, controller) -> None:
+        """Surface the brownout ladder on /metrics: the live rung as a
+        gauge (0 ok .. 4 shed_standard) and the transition count as a real
+        counter. Lazy reads at scrape time; attach-once guarded so a
+        service rebuild can't double-register."""
+        if getattr(self, "_brownout_attached", False):
+            return
+        self._brownout_attached = True
+        g = Gauge(
+            "dyn_llm_brownout_level",
+            "Brownout degradation ladder rung "
+            "(0 ok, 1 shed_bulk, 2 spec_off, 3 chunk_cap, 4 shed_standard)",
+            registry=self.registry,
+        )
+        g.set_function(lambda: controller.level)
+        CallbackCounter(
+            self.registry,
+            "dyn_llm_brownout_transitions_total",
+            "Brownout ladder transitions (steps up + steps down)",
+            lambda: controller.transitions,
         )
 
     def attach_kv_hit_stats(self, scheduler) -> None:

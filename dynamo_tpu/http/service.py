@@ -42,7 +42,9 @@ from dynamo_tpu.protocols.openai import (
     usage_dict,
 )
 from dynamo_tpu.protocols.sse import encode_done, encode_json_event
+from dynamo_tpu import qos
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import brownout as dbrownout
 from dynamo_tpu.telemetry import profile as dprofile
 from dynamo_tpu.telemetry import slo as dslo
 from dynamo_tpu.telemetry import trace as dtrace
@@ -81,6 +83,8 @@ _CODE_STATUS = {
     "deadline_exceeded": 504,
     "worker_unavailable": 503,
     "overloaded": 429,
+    "brownout_shed": 429,
+    "preempted_too_often": 503,
     "prompt_too_long": 400,
 }
 
@@ -98,14 +102,42 @@ def _error_payload(message: Optional[str]) -> dict:
     return {"cause": message or "engine error", "code": "internal_error"}
 
 
+def _parse_class_fractions(raw: Optional[str]) -> dict[str, float]:
+    """DYN_ADMISSION_CLASS_FRACTIONS: `class=frac,...` — the fraction of
+    the model watermark at which that class starts shedding. Defaults give
+    bulk half the queue, standard 80%, interactive the full watermark."""
+    out = {"bulk": 0.5, "standard": 0.8, "interactive": 1.0}
+    for entry in (raw or "").split(","):
+        entry = entry.strip()
+        if not entry or "=" not in entry:
+            continue
+        cls, _, frac = entry.partition("=")
+        cls = qos.normalize_priority(cls)
+        if cls is None:
+            continue
+        try:
+            out[cls] = max(0.0, min(1.0, float(frac)))
+        except ValueError:
+            continue
+    return out
+
+
 class AdmissionController:
     """Frontend admission control and load shedding (reference: Dynamo's
     serving fabric owns graceful backpressure; Llumnix-style bounded
     queues). Per-model inflight is bounded by a high watermark derived
     from the aggregated worker slot count (`load_metrics` via a capacity
     fn) times DYN_ADMISSION_QUEUE_FACTOR, optionally capped by the static
-    DYN_ADMISSION_MAX_INFLIGHT. Past the watermark, requests are shed with
-    429 + Retry-After instead of queueing forever."""
+    DYN_ADMISSION_MAX_INFLIGHT.
+
+    Class-aware (ISSUE 7): each priority class sheds at its own fraction
+    of the watermark (bulk first at 50%, standard at 80%, interactive only
+    at the hard cap — DYN_ADMISSION_CLASS_FRACTIONS), and the brownout
+    ladder can force whole classes shed regardless of load. The 429
+    Retry-After hint is derived from the measured completion (drain) rate
+    — how long the backlog above this class's threshold actually takes to
+    clear — falling back to the DYN_ADMISSION_RETRY_AFTER_S constant when
+    there is no drain signal yet."""
 
     def __init__(
         self,
@@ -124,11 +156,19 @@ class AdmissionController:
             else float(env.get("DYN_ADMISSION_QUEUE_FACTOR", "2.0"))
         )
         self.retry_after_s = float(env.get("DYN_ADMISSION_RETRY_AFTER_S", "1"))
+        self.class_fractions = _parse_class_fractions(
+            env.get("DYN_ADMISSION_CLASS_FRACTIONS")
+        )
+        # classes force-shed by the brownout ladder (set by the service's
+        # BrownoutController on_change hook)
+        self.brownout_shed: frozenset[str] = frozenset()
+        self.drain = qos.DrainRateEstimator()
         self._inflight: dict[str, int] = {}
         # model -> zero-arg fn returning the fleet's total request slots
         # (None = unknown); installed by the model watcher / static wiring
         self._capacity_fns: dict[str, Callable[[], Optional[int]]] = {}
         self.shed_total = 0
+        self.shed_by_class: dict[str, int] = {}
 
     def set_capacity_fn(
         self, model: str, fn: Callable[[], Optional[int]]
@@ -153,21 +193,45 @@ class AdmissionController:
             return wm
         return self.max_inflight
 
-    def try_acquire(self, model: str) -> Optional[float]:
-        """None = admitted (caller must release()); else shed — the value
-        is the Retry-After hint in seconds."""
+    def class_watermark(self, model: str, priority: str) -> Optional[int]:
+        """The inflight count at which `priority`-class requests shed."""
         wm = self.watermark(model)
+        if wm is None:
+            return None
+        frac = self.class_fractions.get(priority, 1.0)
+        return max(1, int(math.ceil(wm * frac)))
+
+    def _shed_one(
+        self, model: str, priority: str, reason: str, excess: int
+    ) -> float:
+        self.shed_total += 1
+        self.shed_by_class[priority] = self.shed_by_class.get(priority, 0) + 1
+        if self.metrics is not None:
+            self.metrics.requests_shed.labels(model).inc()
+            self.metrics.class_shed.labels(model, priority, reason).inc()
+        return self.drain.retry_after_s(max(1, excess), self.retry_after_s)
+
+    def try_acquire(
+        self, model: str, priority: str = qos.DEFAULT_CLASS
+    ) -> Optional[float]:
+        """None = admitted (caller must release()); else shed — the value
+        is the Retry-After hint in seconds (drain-rate derived)."""
+        priority = qos.normalize_priority(priority) or qos.DEFAULT_CLASS
+        if priority in self.brownout_shed:
+            return self._shed_one(model, priority, "brownout", 1)
+        wm = self.class_watermark(model, priority)
         cur = self._inflight.get(model, 0)
         if wm is not None and cur >= wm:
-            self.shed_total += 1
-            if self.metrics is not None:
-                self.metrics.requests_shed.labels(model).inc()
-            return self.retry_after_s
+            return self._shed_one(
+                model, priority, "watermark", cur - wm + 1
+            )
         self._inflight[model] = cur + 1
         return None
 
     def release(self, model: str) -> None:
         self._inflight[model] = max(0, self._inflight.get(model, 1) - 1)
+        # completion = one queue slot drained: feeds the Retry-After hint
+        self.drain.note()
 
     def inflight(self, model: Optional[str] = None) -> int:
         if model is not None:
@@ -313,6 +377,7 @@ class ModelExecution:
     ) -> AsyncIterator[Annotated]:
         pre, prompt = self.preprocessor.preprocess_chat(request)
         pre.extra["echo_text"] = prompt  # feeds echo_full test engines
+        qos.stamp_priority(pre, ctx)  # QoS class onto every wire hop
         for ann in self.preprocessor.requested_annotations(pre, prompt):
             yield ann
         gen = ChatDeltaGenerator(request.model)
@@ -395,6 +460,7 @@ class ModelExecution:
     ) -> AsyncIterator[Annotated]:
         pre, prompt = self.preprocessor.preprocess_completion(request)
         pre.extra["echo_text"] = prompt
+        qos.stamp_priority(pre, ctx)  # QoS class onto every wire hop
         gen = CompletionDeltaGenerator(request.model)
         choices = self._fanout(pre)
         if request.echo and prompt:
@@ -516,6 +582,21 @@ class HttpService:
         self._slo_task: Optional[asyncio.Task] = None
         self._slo_tick_s = float(os.environ.get("DYN_SLO_TICK_S", "1.0"))
         self.slo_publisher: Optional[Callable[[dict], None]] = None
+        # Brownout ladder (telemetry/brownout.py): fed by this frontend's
+        # own SLO evaluation AND remote `slo-status` events (wired by
+        # run_http via note_remote_slo). Rungs 1/4 force-shed whole classes
+        # at this AdmissionController; transitions publish on the
+        # `brownout-status` subject via brownout_publisher.
+        self.brownout = dbrownout.BrownoutController(
+            scope="frontend", on_change=self._on_brownout_change
+        )
+        self.brownout_publisher: Optional[Callable[[dict], None]] = None
+        self._local_slo_state = "ok"
+        self._remote_slo_state = "ok"
+        self.metrics.attach_brownout(self.brownout)
+        # auxiliary background tasks (event subscriptions etc.) cancelled
+        # on close; registered by the entrypoint wiring
+        self._aux_tasks: list[asyncio.Task] = []
 
     # ---------------------------------------------------------- lifecycle
 
@@ -538,9 +619,18 @@ class HttpService:
             with contextlib.suppress(asyncio.CancelledError):
                 await self._slo_task
             self._slo_task = None
+        for t in self._aux_tasks:
+            t.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await t
+        self._aux_tasks.clear()
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+
+    def add_background_task(self, task: asyncio.Task) -> None:
+        """Track an auxiliary task (event subscription loop) for close()."""
+        self._aux_tasks.append(task)
 
     def begin_drain(self) -> None:
         """Stop admitting: every new request is answered 503 + Retry-After.
@@ -661,15 +751,57 @@ class HttpService:
         for model in self.manager.list_models():
             eng = self._slo_engine(model)
             out[model] = eng.observe(self.metrics.phase_hist_for(model))
+        worst = "ok"
+        for status in out.values():
+            s = status.get("state", "ok")
+            if dslo._SEVERITY.get(s, 0) > dslo._SEVERITY.get(worst, 0):
+                worst = s
+        self._local_slo_state = worst
         return out
 
     async def _slo_loop(self) -> None:
         while True:
             try:
                 self._slo_observe_all()
+                self._observe_brownout()
             except Exception:  # noqa: BLE001 — telemetry must not crash us
                 logger.exception("slo evaluation failed")
             await asyncio.sleep(self._slo_tick_s)
+
+    # -------------------------------------------------------------- brownout
+
+    def note_remote_slo(self, state: Optional[str]) -> None:
+        """Feed a fleet `slo-status` transition (metrics component / other
+        frontends) into the brownout ladder. Events fire on transitions
+        only, so the last remote state stays authoritative until the next
+        event flips it back."""
+        if state in dslo._SEVERITY:
+            self._remote_slo_state = state
+            self._observe_brownout()
+
+    def _observe_brownout(self) -> None:
+        """Reduce local + remote SLO states to the WORST and step the
+        ladder (the controller's dwell timers assume one coherent feed)."""
+        local, remote = self._local_slo_state, self._remote_slo_state
+        worst = (
+            local
+            if dslo._SEVERITY.get(local, 0) >= dslo._SEVERITY.get(remote, 0)
+            else remote
+        )
+        self.brownout.observe(worst)
+
+    def _on_brownout_change(self, old: int, new: int, rung: str) -> None:
+        self.admission.brownout_shed = dbrownout.shed_classes_for(new)
+        if self.brownout_publisher is not None:
+            self.brownout_publisher(
+                {
+                    "scope": "frontend",
+                    "old_level": old,
+                    "level": new,
+                    "rung": rung,
+                    **self.brownout.actions(),
+                }
+            )
 
     @staticmethod
     def _trace_migrated(trace_id: Optional[str]) -> bool:
@@ -834,16 +966,25 @@ class HttpService:
                 501, "this model does not accept image input",
                 "not_implemented",
             )
-        retry_after = self.admission.try_acquire(chat_req.model)
+        prio = qos.resolve_priority(
+            request.headers.get("x-dyn-priority"),
+            chat_req.ext.priority if chat_req.ext else None,
+            chat_req.model,
+        )
+        retry_after = self.admission.try_acquire(chat_req.model, prio)
         if retry_after is not None:
             return self._shed(chat_req.model, retry_after)
         ctx = self._request_ctx(request)
+        ctx.metadata["priority"] = prio
         try:
             self._arm_deadline(ctx, chat_req)
             timer = TokenTimer(self.metrics, chat_req.model)
             with self.metrics.track(chat_req.model, "chat_completions"), \
                     self._trace_root(request, ctx, "chat_completions") as root:
-                root.set(model=chat_req.model, stream=bool(chat_req.stream))
+                root.set(
+                    model=chat_req.model, stream=bool(chat_req.stream),
+                    priority=prio,
+                )
                 self.metrics.prompt_tokens.labels(chat_req.model)  # touch label
                 stream = execution.chat_stream(chat_req, ctx, timer)
                 if chat_req.stream:
@@ -878,10 +1019,16 @@ class HttpService:
         execution = self.manager.get(comp_req.model)
         if execution is None:
             return self._error(404, f"model {comp_req.model!r} not found", "not_found_error")
-        retry_after = self.admission.try_acquire(comp_req.model)
+        prio = qos.resolve_priority(
+            request.headers.get("x-dyn-priority"),
+            comp_req.ext.priority if comp_req.ext else None,
+            comp_req.model,
+        )
+        retry_after = self.admission.try_acquire(comp_req.model, prio)
         if retry_after is not None:
             return self._shed(comp_req.model, retry_after)
         ctx = self._request_ctx(request)
+        ctx.metadata["priority"] = prio
         try:
             self._arm_deadline(ctx, comp_req)
             timer = TokenTimer(self.metrics, comp_req.model)
@@ -1014,10 +1161,16 @@ class HttpService:
             return self._error(
                 404, f"model {chat_req.model!r} not found", "not_found_error"
             )
-        retry_after = self.admission.try_acquire(chat_req.model)
+        prio = qos.resolve_priority(
+            request.headers.get("x-dyn-priority"),
+            chat_req.ext.priority if chat_req.ext else None,
+            chat_req.model,
+        )
+        retry_after = self.admission.try_acquire(chat_req.model, prio)
         if retry_after is not None:
             return self._shed(chat_req.model, retry_after)
         ctx = self._request_ctx(request)
+        ctx.metadata["priority"] = prio
         try:
             self._arm_deadline(ctx, chat_req)
             timer = TokenTimer(self.metrics, chat_req.model)
@@ -1109,6 +1262,8 @@ class HttpService:
                     "enabled": False,
                     "hint": "set DYN_SLO_TTFT_MS / DYN_SLO_ITL_MS "
                     "or DYN_SLO_CONFIG",
+                    # brownout can still step off remote slo-status events
+                    "brownout": self.brownout.status(),
                 }
             )
         return web.json_response(
@@ -1116,6 +1271,7 @@ class HttpService:
                 "enabled": True,
                 "scope": "frontend",
                 "models": self._slo_observe_all(),
+                "brownout": self.brownout.status(),
             }
         )
 

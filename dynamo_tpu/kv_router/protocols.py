@@ -122,6 +122,13 @@ class WorkerStats:
     # watchdog trips
     num_deadline_exceeded: int = 0
     num_watchdog_trips: int = 0
+    # QoS plane (ISSUE 7): per-class preemption counts (class-aware
+    # KV-preserving preemption), storm-guard kills, engine-side brownout
+    # sheds (all monotonic) and the worker's live brownout rung (gauge)
+    preemptions_by_class: Optional[dict[str, int]] = None
+    num_preempted_too_often: int = 0
+    num_shed_brownout: int = 0
+    brownout_level: int = 0
 
 
 @dataclass

@@ -218,6 +218,25 @@ class KvMetricsAggregator:
             agg.worker_stats.num_watchdog_trips += (
                 m.worker_stats.num_watchdog_trips
             )
+            agg.worker_stats.num_preempted_too_often += (
+                m.worker_stats.num_preempted_too_often
+            )
+            agg.worker_stats.num_shed_brownout += (
+                m.worker_stats.num_shed_brownout
+            )
+            # brownout rung is a gauge: the fleet's WORST rung tells the
+            # operator how degraded service currently is anywhere
+            agg.worker_stats.brownout_level = max(
+                agg.worker_stats.brownout_level,
+                m.worker_stats.brownout_level,
+            )
+            if m.worker_stats.preemptions_by_class:
+                if agg.worker_stats.preemptions_by_class is None:
+                    agg.worker_stats.preemptions_by_class = {}
+                for cls, v in m.worker_stats.preemptions_by_class.items():
+                    agg.worker_stats.preemptions_by_class[cls] = (
+                        agg.worker_stats.preemptions_by_class.get(cls, 0) + v
+                    )
             agg.kv_stats.kv_active_blocks += m.kv_stats.kv_active_blocks
             agg.kv_stats.kv_total_blocks += m.kv_stats.kv_total_blocks
             agg.kv_stats.gpu_cache_usage_perc += m.kv_stats.gpu_cache_usage_perc

@@ -139,7 +139,7 @@ class OpenAIPreprocessor:
             min_tokens=request.min_tokens,
             ignore_eos=bool(ext and ext.ignore_eos),
         )
-        return PreprocessedRequest(
+        pre = PreprocessedRequest(
             token_ids=token_ids,
             model=request.model,
             sampling=sampling,
@@ -147,6 +147,11 @@ class OpenAIPreprocessor:
             eos_token_ids=self.tokenizer.eos_token_ids,
             annotations=list(ext.annotations) if ext else [],
         )
+        if ext is not None and ext.priority is not None:
+            # raw ext stamp; the HTTP edge resolves the final class
+            # (header > ext > DYN_PRIORITY_DEFAULT) via qos.stamp_priority
+            pre.extra["priority"] = ext.priority
+        return pre
 
     def requested_annotations(
         self, preprocessed: PreprocessedRequest, prompt: str

@@ -30,6 +30,11 @@ class Ext(BaseModel):
     # Unset fields fall back to DYN_DEFAULT_DEADLINE_MS / unbounded.
     timeout_ms: Optional[float] = Field(default=None, gt=0)
     ttft_timeout_ms: Optional[float] = Field(default=None, gt=0)
+    # QoS class: interactive | standard | bulk (qos.py normalizes spelling
+    # aliases, including the 0/1/2 rank shorthand). The x-dyn-priority
+    # header beats this; DYN_PRIORITY_DEFAULT supplies the per-model
+    # default when neither is present.
+    priority: Optional[Union[str, int]] = None
 
 
 class ChatMessage(BaseModel):

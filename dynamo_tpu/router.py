@@ -26,6 +26,7 @@ import signal
 import time
 from typing import Any, Optional
 
+from dynamo_tpu import qos
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry import trace as dtrace
 
@@ -102,6 +103,10 @@ class StandaloneRouter:
         self._load_at = 0.0
         self.shed_total = 0
         self.decisions_total = 0
+        # completion (`op=free`) timestamps feed the Retry-After hint in
+        # shed replies: backlog above the watermark over the measured
+        # drain rate, instead of a constant (qos.DrainRateEstimator)
+        self._drain = qos.DrainRateEstimator()
         # /metrics + /health for the routing brain itself (None disables):
         # KV hit rate, matched blocks, shed + decision counters
         self.metrics_port = metrics_port
@@ -178,9 +183,20 @@ class StandaloneRouter:
         slots, load = self._load
         return bool(slots) and load >= slots * self.queue_factor
 
+    def _retry_after_ms(self) -> int:
+        """Shed hint from the measured drain rate: how long the backlog
+        above the watermark takes to clear at the rate requests are
+        actually completing (1 s fallback with no signal)."""
+        excess = 1
+        if self._load is not None:
+            slots, load = self._load
+            excess = max(1, load - int(slots * self.queue_factor) + 1)
+        return int(self._drain.retry_after_s(excess, 1.0) * 1e3)
+
     async def _handler(self, request: dict, ctx):
         if request.get("op") == "free":
             self.router.free(str(request.get("request_id", "")))
+            self._drain.note()
             yield {"ok": True}
             return
         # trace context rides Context.metadata over the find_best hop, so
@@ -192,8 +208,9 @@ class StandaloneRouter:
         ) as rsp:
             if await self._overloaded():
                 self.shed_total += 1
-                rsp.set(shed=True)
-                yield {"shed": True, "retry_after_ms": 1000}
+                retry_ms = self._retry_after_ms()
+                rsp.set(shed=True, retry_after_ms=retry_ms)
+                yield {"shed": True, "retry_after_ms": retry_ms}
                 return
             tokens = request.get("token_ids") or request.get("tokens") or []
             request_id = str(request.get("request_id", ""))

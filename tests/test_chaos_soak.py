@@ -8,6 +8,7 @@ ZERO stuck streams (every consumer saw a final), and conserved KV blocks
 
 import asyncio
 import random
+import time
 
 import pytest
 
@@ -100,4 +101,87 @@ async def test_mocker_chaos_soak_random_fault_schedules():
         toks.extend(out.token_ids)
         final = out.finish_reason
     assert toks == [9, 8, 7, 9, 8, 7]
+    await engine.close()
+
+
+async def test_mocker_chaos_mixed_priority_wave():
+    """ISSUE 7 satellite: interactive + bulk (1:4) under DYN_FAULT churn.
+    Invariants: interactive p99 TTFT stays bounded (and under bulk's),
+    every preemption lands on bulk, zero stuck streams, and KV blocks are
+    conserved through every preempt/fault/cancel path."""
+    rng = random.Random(20260804)
+    engine = MockEngine(
+        MockEngineArgs(
+            num_blocks=64, block_size=4, max_batch=8, speedup_ratio=500.0,
+            preempt_backoff_ms=1.0,
+        )
+    )
+    ttfts = {"interactive": [], "bulk": []}
+    outcomes = {"ok": 0, "error": 0, "cancel": 0}
+
+    async def one(i: int) -> None:
+        cls = "interactive" if i % 5 == 0 else "bulk"
+        prompt = [rng.randint(1, 63) for _ in range(rng.randint(2, 28))]
+        # interactive requests are short and latency-sensitive; bulk work
+        # is long — the mix the QoS plane exists for
+        r = _req(prompt, rng.randint(1, 6) if cls == "interactive"
+                 else rng.randint(8, 40))
+        r.extra["priority"] = cls
+        ctx = Context()
+        t0 = time.monotonic()
+        first = None
+        try:
+            async for out in engine.generate(r, ctx):
+                if out.token_ids and first is None:
+                    first = time.monotonic() - t0
+                if out.finish_reason is not None:
+                    if out.error is not None:
+                        outcomes["error"] += 1
+                    elif out.finish_reason.value == "cancelled":
+                        outcomes["cancel"] += 1
+                    else:
+                        outcomes["ok"] += 1
+                        if first is not None:
+                            ttfts[cls].append(first)
+                    return
+        finally:
+            ctx.kill()
+
+    for wave in range(5):
+        spec = faults.FaultSpec(
+            abort_after_tokens=rng.choice([0, 0, 0, 80, 200]),
+            delay_dispatch_s=rng.choice([0.0, 0.001, 0.002]),
+            every=rng.randint(1, 5),
+        )
+        faults.set_injector(faults.FaultInjector(spec))
+        try:
+            # zero stuck streams: every consumer must see a final
+            await asyncio.wait_for(
+                asyncio.gather(*[one(wave * 50 + i) for i in range(50)]),
+                timeout=60,
+            )
+        finally:
+            faults.set_injector(None)
+    assert sum(outcomes.values()) == 250, outcomes
+    assert outcomes["ok"] > 0
+    # all preemption pressure landed on bulk, none on interactive
+    assert engine.preemptions_by_class.get("interactive", 0) == 0, (
+        engine.preemptions_by_class
+    )
+    # interactive latency held: bounded p99, and no worse than bulk's
+    inter = sorted(ttfts["interactive"])
+    bulk = sorted(ttfts["bulk"])
+    assert inter, "no interactive request completed"
+    p99_i = inter[min(len(inter) - 1, int(0.99 * len(inter)))]
+    assert p99_i < 1.0, f"interactive p99 TTFT {p99_i:.3f}s"
+    if bulk:
+        p99_b = bulk[min(len(bulk) - 1, int(0.99 * len(bulk)))]
+        assert p99_i <= p99_b + 0.05, (p99_i, p99_b)
+    # KV conservation per class: no live refs anywhere
+    assert engine.active == [] and len(engine.waiting) == 0
+    assert all(n == 0 for n in engine.cache.refs.values()), (
+        "leaked KV refs through a preempt/fault path"
+    )
+    cached = len(engine.cache.refs)
+    assert engine.cache.free_blocks + cached == engine.args.num_blocks
     await engine.close()

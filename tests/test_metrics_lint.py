@@ -40,6 +40,13 @@ INTENTIONALLY_SHARED = {
     "dyn_llm_requests_shed",
     # deadline expiries: frontend observation vs fleet-summed worker count
     "dyn_llm_deadline_exceeded",
+    # brownout rung: frontend ladder vs fleet-worst worker rung
+    "dyn_llm_brownout_level",
+    # QoS counters: colocated-engine attach on the frontend vs the
+    # fabric-scraped fleet sums on the metrics component
+    "dyn_llm_preemptions",
+    "dyn_llm_preempted_too_often",
+    "dyn_llm_brownout_sheds",
 }
 
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
@@ -48,6 +55,11 @@ UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
 class _StubScheduler:
     hit_stats = {"decisions": 0, "isl_blocks": 0, "matched_blocks": 0}
     hit_rate = 0.0
+
+
+class _StubBrownout:
+    level = 0
+    transitions = 0
 
 
 class _StubComponent:
@@ -62,6 +74,11 @@ def _all_registries() -> dict[str, CollectorRegistry]:
                                 "num_accepted_tokens": 0})
     frontend.attach_kv_transfer_stats({})
     frontend.attach_kv_hit_stats(_StubScheduler())
+    frontend.attach_brownout(_StubBrownout())
+    frontend.attach_engine_qos(
+        {"preemptions_by_class": {}, "preempted_too_often": 0,
+         "shed_brownout": 0}
+    )
     component = MetricsComponent(
         _StubComponent(), EndpointId("lint", "backend", "generate")
     )
@@ -129,6 +146,34 @@ def test_no_unreviewed_duplicates_across_registries():
                     f"vs {role}={fam.type}"
                 )
     assert not problems, problems
+
+
+def test_qos_families_present_with_correct_types():
+    """ISSUE 7: the per-class `_total` counters and the brownout gauge
+    must exist with the right semantics on their home registries."""
+    regs = _all_registries()
+    by_role = {
+        role: {f.name: f for f in _families(reg)}
+        for role, reg in regs.items()
+    }
+    # frontend: per-class shed counter + ladder gauge + transition counter
+    fam = by_role["frontend"].get("dyn_llm_class_requests_shed")
+    assert fam is not None and fam.type == "counter"
+    fam = by_role["frontend"].get("dyn_llm_brownout_level")
+    assert fam is not None and fam.type == "gauge"
+    fam = by_role["frontend"].get("dyn_llm_brownout_transitions")
+    assert fam is not None and fam.type == "counter"
+    # metrics component: per-class preemption counter (priority label),
+    # storm-guard counter, engine brownout sheds, fleet-worst rung gauge
+    for name in (
+        "dyn_llm_preemptions",
+        "dyn_llm_preempted_too_often",
+        "dyn_llm_brownout_sheds",
+    ):
+        fam = by_role["component"].get(name)
+        assert fam is not None and fam.type == "counter", name
+    fam = by_role["component"].get("dyn_llm_brownout_level")
+    assert fam is not None and fam.type == "gauge"
 
 
 def test_every_family_has_help_text():

@@ -1,0 +1,644 @@
+"""QoS under overload (ISSUE 7): priority classes end-to-end, class-aware
+KV-preserving preemption with the storm guard, per-class admission
+watermarks with drain-derived Retry-After, and the SLO-driven brownout
+ladder (engage AND disengage, local and fleet-event driven)."""
+
+import asyncio
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu import qos
+from dynamo_tpu.engine.echo import EchoEngineCore
+from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.entrypoint.inputs import EngineConfig, run_http
+from dynamo_tpu.http.service import AdmissionController
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.telemetry import brownout as dbrownout
+
+from tests.util import make_test_mdc
+
+
+def req(prompt, max_tokens=8, priority=None, ignore_eos=False, **sampling):
+    pre = PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling=SamplingOptions(**sampling) if sampling else SamplingOptions(),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=ignore_eos),
+    )
+    if priority is not None:
+        pre.extra["priority"] = priority
+    return pre
+
+
+async def collect(engine, request, ctx=None):
+    toks, final = [], None
+    async for out in engine.generate(request, ctx or Context()):
+        toks.extend(out.token_ids)
+        if out.finish_reason is not None:
+            final = out
+    return toks, final
+
+
+# ------------------------------------------------------------ resolution
+
+
+def test_priority_resolution_precedence(monkeypatch):
+    monkeypatch.delenv("DYN_PRIORITY_DEFAULT", raising=False)
+    # default of defaults
+    assert qos.resolve_priority() == "standard"
+    # aliases + rank shorthand
+    assert qos.normalize_priority("BATCH") == "bulk"
+    assert qos.normalize_priority(0) == "interactive"
+    assert qos.normalize_priority("frobnicate") is None
+    # ext beats env default; header beats ext
+    monkeypatch.setenv("DYN_PRIORITY_DEFAULT", "bulk")
+    assert qos.resolve_priority() == "bulk"
+    assert qos.resolve_priority(ext_value="standard") == "standard"
+    assert qos.resolve_priority(header="interactive", ext_value="bulk") == (
+        "interactive"
+    )
+    # per-model entries with a bare fallback
+    monkeypatch.setenv(
+        "DYN_PRIORITY_DEFAULT", "evals-8b=bulk, chat-70b=interactive, standard"
+    )
+    assert qos.default_priority("evals-8b") == "bulk"
+    assert qos.default_priority("chat-70b") == "interactive"
+    assert qos.default_priority("other") == "standard"
+    # stamp mirrors the resolved class onto ctx + wire request
+    ctx = Context()
+    pre = req([1, 2, 3], priority="batch")
+    assert qos.stamp_priority(pre, ctx) == "bulk"
+    assert ctx.metadata["priority"] == "bulk"
+    assert pre.extra["priority"] == "bulk"
+    # an already-resolved ctx wins over the request stamp
+    ctx2 = Context(metadata={"priority": "interactive"})
+    pre2 = req([1], priority="bulk")
+    assert qos.stamp_priority(pre2, ctx2) == "interactive"
+    assert pre2.extra["priority"] == "interactive"
+
+
+def test_drain_rate_estimator():
+    est = qos.DrainRateEstimator(window_s=10.0)
+    # no signal -> fallback
+    assert est.retry_after_s(4, fallback_s=1.5, now=100.0) == 1.5
+    for i in range(21):
+        est.note(now=90.0 + 0.5 * i)  # 2 completions/s over the window
+    r = est.rate(now=100.0)
+    assert r is not None and 1.8 < r < 2.3
+    # 6 excess requests at ~2/s drain ≈ 3 s (clamped into [lo, hi])
+    assert 2.4 < est.retry_after_s(6, fallback_s=1.0, now=100.0) < 3.5
+    # stale events age out of the window -> fallback again
+    assert est.retry_after_s(6, fallback_s=1.0, now=500.0) == 1.0
+
+
+# ------------------------------------------------------------- admission
+
+
+def test_admission_class_watermarks():
+    adm = AdmissionController(max_inflight=10)
+    # bulk sheds at half the watermark, standard at 80%, interactive at cap
+    assert adm.class_watermark("m", "bulk") == 5
+    assert adm.class_watermark("m", "standard") == 8
+    assert adm.class_watermark("m", "interactive") == 10
+    for _ in range(5):
+        assert adm.try_acquire("m", "bulk") is None
+    # 5 in flight: bulk sheds, standard + interactive still admitted
+    assert adm.try_acquire("m", "bulk") is not None
+    for _ in range(3):
+        assert adm.try_acquire("m", "standard") is None
+    assert adm.try_acquire("m", "standard") is not None  # at 8
+    assert adm.try_acquire("m", "interactive") is None  # 9
+    assert adm.try_acquire("m", "interactive") is None  # 10 = hard cap
+    assert adm.try_acquire("m", "interactive") is not None
+    assert adm.shed_by_class == {"bulk": 1, "standard": 1, "interactive": 1}
+    # brownout ladder force-sheds whole classes regardless of load
+    adm2 = AdmissionController(max_inflight=10)
+    adm2.brownout_shed = dbrownout.shed_classes_for(1)
+    assert adm2.try_acquire("m", "bulk") is not None
+    assert adm2.try_acquire("m", "standard") is None
+    adm2.brownout_shed = dbrownout.shed_classes_for(4)
+    assert adm2.try_acquire("m", "standard") is not None
+    assert adm2.try_acquire("m", "interactive") is None
+
+
+def test_admission_retry_after_uses_drain_rate():
+    adm = AdmissionController(max_inflight=2)
+    adm.retry_after_s = 7.0  # the no-signal fallback
+    assert adm.try_acquire("m") is None
+    assert adm.try_acquire("m") is None
+    assert adm.try_acquire("m") == 7.0  # cold: constant fallback
+    # completions feed the estimator; the hint becomes excess / drain rate
+    now = time.monotonic()
+    for i in range(40):
+        adm.drain.note(now=now - 4.0 + 0.1 * i)  # ~10 completions/s
+    hint = adm.try_acquire("m")
+    assert hint is not None and hint < 7.0
+
+
+# ----------------------------------------------- mocker: queue + preemption
+
+
+async def test_mocker_priority_then_deadline_queue_order():
+    """With one slot busy, a later-arriving interactive request overtakes
+    queued bulk work; within a class the tighter deadline goes first."""
+    engine = MockEngine(
+        MockEngineArgs(max_batch=1, speedup_ratio=10.0,
+                       decode_per_token_s=0.05)
+    )
+    order: list[str] = []
+
+    async def run(name, request, ctx=None):
+        await collect(engine, request, ctx)
+        order.append(name)
+
+    # ~5 ms of sim time per token: the warm request holds the single slot
+    # for ~300 ms while the contenders below enqueue behind it
+    first = asyncio.ensure_future(run("warm", req([5, 6, 7], max_tokens=60)))
+    await asyncio.sleep(0.02)  # warm request holds the only slot
+    bulk = asyncio.ensure_future(
+        run("bulk", req([1, 2], max_tokens=2, priority="bulk"))
+    )
+    await asyncio.sleep(0.005)
+    std_loose = Context()
+    std_tight = Context()
+    std_tight.set_deadline_ms(60_000)  # tight-deadline standard
+    loose = asyncio.ensure_future(
+        run("std-loose", req([3, 4], max_tokens=2, priority="standard"),
+            std_loose)
+    )
+    await asyncio.sleep(0.005)
+    tight = asyncio.ensure_future(
+        run("std-tight", req([3, 9], max_tokens=2, priority="standard"),
+            std_tight)
+    )
+    await asyncio.sleep(0.005)
+    inter = asyncio.ensure_future(
+        run("interactive", req([8, 9], max_tokens=2, priority="interactive"))
+    )
+    await asyncio.wait_for(
+        asyncio.gather(first, bulk, loose, tight, inter), timeout=30
+    )
+    assert order[0] == "warm"
+    assert order[1] == "interactive"  # class overtakes arrival order
+    assert order[2] == "std-tight"  # deadline orders within a class
+    assert order[3] == "std-loose"
+    assert order[4] == "bulk"  # bulk drains last
+    await engine.close()
+
+
+async def test_mocker_preemption_lands_on_bulk():
+    """Cache pressure with mixed classes: every preemption must land on
+    the bulk sequence even when the interactive one is younger (the old
+    policy preempted LIFO-youngest, class-blind)."""
+    engine = MockEngine(
+        MockEngineArgs(
+            num_blocks=12, block_size=4, max_batch=4, speedup_ratio=500.0,
+            watermark=0.0, preempt_backoff_ms=1.0,
+        )
+    )
+    bulk_task = asyncio.ensure_future(
+        collect(engine, req(list(range(1, 9)), max_tokens=30,
+                            priority="bulk"))
+    )
+    await asyncio.sleep(0.02)  # bulk admitted first (it is OLDER)
+    inter_task = asyncio.ensure_future(
+        collect(engine, req(list(range(40, 48)), max_tokens=30,
+                            priority="interactive"))
+    )
+    (b_toks, b_final), (i_toks, i_final) = await asyncio.wait_for(
+        asyncio.gather(bulk_task, inter_task), timeout=30
+    )
+    assert i_final.finish_reason is FinishReason.LENGTH
+    assert "interactive" not in engine.preemptions_by_class
+    assert engine.preemptions_by_class.get("bulk", 0) >= 1
+    # the bulk stream still terminated (resumed or storm-guarded)
+    assert b_final is not None
+    await engine.close()
+
+
+async def test_mocker_preemption_storm_guard():
+    """A sequence preempted past DYN_MAX_PREEMPTIONS fails with the
+    structured `preempted_too_often` error instead of thrashing."""
+    engine = MockEngine(
+        MockEngineArgs(max_preemptions=2, preempt_backoff_ms=1.0)
+    )
+    victim_req = req([1, 2, 3], max_tokens=50, priority="bulk")
+    task = asyncio.ensure_future(collect(engine, victim_req))
+    await asyncio.sleep(0.05)  # admitted, decoding
+    seq = next(s for s in engine.active if s.priority == "bulk")
+    for _ in range(3):  # one over the limit
+        engine._preempt_seq(seq)
+        engine.waiting.remove(seq) if seq in engine.waiting else None
+    toks, final = await asyncio.wait_for(task, timeout=10)
+    assert final.finish_reason is FinishReason.ERROR
+    assert final.error["code"] == "preempted_too_often"
+    assert engine.preempted_too_often == 1
+    assert engine.preemptions_by_class["bulk"] == 3
+    await engine.close()
+
+
+async def test_mocker_brownout_hooks():
+    engine = MockEngine()
+    engine.apply_brownout(1)
+    toks, final = await collect(
+        engine, req([1, 2, 3], max_tokens=4, priority="bulk")
+    )
+    assert final.error["code"] == "brownout_shed"
+    assert engine.shed_brownout == 1
+    # standard still served at level 1
+    toks, final = await collect(
+        engine, req([1, 2, 3], max_tokens=4, priority="standard")
+    )
+    assert final.finish_reason is FinishReason.LENGTH
+    engine.apply_brownout(2)
+    assert engine.spec_paused
+    engine.apply_brownout(4)
+    toks, final = await collect(
+        engine, req([1, 2, 3], max_tokens=4, priority="standard")
+    )
+    assert final.error["code"] == "brownout_shed"
+    # interactive is NEVER shed by the ladder
+    toks, final = await collect(
+        engine, req([1, 2, 3], max_tokens=4, priority="interactive")
+    )
+    assert final.finish_reason is FinishReason.LENGTH
+    engine.apply_brownout(0)
+    assert not engine.spec_paused
+    toks, final = await collect(
+        engine, req([1, 2, 3], max_tokens=4, priority="bulk")
+    )
+    assert final.finish_reason is FinishReason.LENGTH
+    assert engine.stats()["brownout_level"] == 0
+    await engine.close()
+
+
+# --------------------------------------------------- jax engine (tiny, CPU)
+
+
+def _make_jax_engine(num_blocks=64, **cfg_overrides):
+    import jax
+
+    from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+    from dynamo_tpu.models import llama as L
+
+    cfg = L.LlamaConfig.tiny(vocab_size=64)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    runner = ModelRunner(
+        cfg, params, num_blocks=num_blocks, block_size=4, max_batch=4,
+        max_model_len=64,
+    )
+    kw = dict(
+        max_batch=4, block_size=4, num_blocks=num_blocks, max_model_len=64,
+        watermark_blocks=2,
+    )
+    kw.update(cfg_overrides)
+    return JaxEngine(runner, JaxEngineConfig(**kw))
+
+
+async def test_jax_preemption_class_aware_and_token_identical():
+    """The acceptance contract: under block pressure every preemption
+    lands on the bulk sequence, and the preempted-then-resumed bulk stream
+    is token-identical to an unpressured run — greedy AND seeded."""
+    for sampling in (
+        SamplingOptions(greedy=True),
+        SamplingOptions(temperature=0.9, top_k=8, seed=424242),
+    ):
+        def mk(prompt, priority):
+            return PreprocessedRequest(
+                token_ids=prompt, sampling=sampling,
+                stop=StopConditions(max_tokens=20, ignore_eos=True),
+                extra={"priority": priority},
+            )
+
+        pb = [5, 9, 17, 23]
+        pi = [40, 41, 42, 43]
+        ref = _make_jax_engine(num_blocks=64)
+        ref_bulk, _ = await collect(ref, mk(pb, "bulk"))
+        ref_inter, _ = await collect(ref, mk(pi, "interactive"))
+        await ref.close()
+        assert len(ref_bulk) == 20
+
+        # 9 usable blocks, each sequence wants 6 -> guaranteed pressure
+        engine = _make_jax_engine(
+            num_blocks=10, preempt_backoff_ms=1.0
+        )
+        (b_toks, b_final), (i_toks, i_final) = await asyncio.wait_for(
+            asyncio.gather(
+                collect(engine, mk(pb, "bulk")),
+                collect(engine, mk(pi, "interactive")),
+            ),
+            timeout=60,
+        )
+        by_class = engine.stats.preemptions_by_class
+        assert by_class.get("bulk", 0) >= 1, by_class
+        assert "interactive" not in by_class
+        # interactive never preempted: completed untouched
+        assert i_toks == ref_inter
+        # bulk was preempted and resumed token-identically
+        assert b_toks == ref_bulk, f"bulk diverged after preemption ({sampling})"
+        await engine.close()
+
+
+async def test_jax_brownout_rungs():
+    engine = _make_jax_engine()
+    engine.apply_brownout(1)
+    assert engine.stats.brownout_level == 1
+    toks, final = await collect(engine, req([1, 2], max_tokens=2,
+                                            priority="bulk"))
+    assert final.error["code"] == "brownout_shed"
+    assert engine.stats.shed_brownout == 1
+    toks, final = await collect(engine, req([1, 2], max_tokens=2))
+    assert final.finish_reason is not FinishReason.ERROR
+    full_budget = engine._chunk_budget()
+    engine.apply_brownout(2)
+    assert engine._spec_paused
+    assert engine._chunk_budget() == full_budget
+    engine.apply_brownout(3)
+    assert engine._chunk_budget() == max(4, full_budget // 2)
+    engine.apply_brownout(0)
+    assert not engine._spec_paused
+    assert engine._chunk_budget() == full_budget
+    toks, final = await collect(engine, req([1, 2], max_tokens=2,
+                                            priority="bulk"))
+    assert final.finish_reason is not FinishReason.ERROR
+    await engine.close()
+
+
+# ---------------------------------------------------------- ladder (unit)
+
+
+def test_brownout_controller_ladder():
+    t = [0.0]
+    ctrl = dbrownout.BrownoutController(
+        dbrownout.BrownoutConfig(step_up_s=1.0, step_down_s=3.0),
+        now_fn=lambda: t[0],
+    )
+    changes: list[tuple[int, int, str]] = []
+    ctrl.on_change = lambda old, new, rung: changes.append((old, new, rung))
+    # a fresh breach engages immediately (dwell skipped at level 0)
+    assert ctrl.observe("breached") == 1
+    assert ctrl.actions()["shed_classes"] == ["bulk"]
+    # dwell-gated stepping: still 1 until step_up_s elapses
+    t[0] = 0.5
+    assert ctrl.observe("breached") == 1
+    t[0] = 1.1
+    assert ctrl.observe("burning") == 2
+    assert ctrl.actions()["spec_off"]
+    t[0] = 2.2
+    assert ctrl.observe("burning") == 3
+    assert ctrl.actions()["chunk_cap"]
+    t[0] = 3.3
+    assert ctrl.observe("breached") == 4
+    assert ctrl.actions()["shed_classes"] == ["bulk", "standard"]
+    t[0] = 4.4
+    assert ctrl.observe("breached") == 4  # capped
+    # recovery walks back one rung per step_down_s of clean ok
+    t[0] = 5.0
+    assert ctrl.observe("ok") == 4
+    t[0] = 8.1
+    assert ctrl.observe("ok") == 3
+    t[0] = 11.2
+    assert ctrl.observe("ok") == 2
+    # a relapse interrupts the walk-down (dwell-gated like any step up)
+    t[0] = 12.0
+    assert ctrl.observe("burning") == 2  # within step_up_s of last change
+    t[0] = 12.3
+    assert ctrl.observe("burning") == 3
+    t[0] = 20.0
+    assert ctrl.observe("ok") == 3  # ok-dwell restarted at the relapse
+    t[0] = 23.1
+    assert ctrl.observe("ok") == 2
+    assert [c[:2] for c in changes] == [
+        (0, 1), (1, 2), (2, 3), (3, 4), (4, 3), (3, 2), (2, 3), (3, 2)
+    ]
+    assert ctrl.transitions == len(changes)
+    assert ctrl.status()["rung"] == "spec_off"
+    # disabled controller never steps
+    off = dbrownout.BrownoutController(
+        dbrownout.BrownoutConfig(enabled=False), now_fn=lambda: t[0]
+    )
+    assert off.observe("breached") == 0
+
+
+# ------------------------------------------------------- http frontend e2e
+
+
+async def test_http_priority_header_and_class_sheds():
+    """2x bulk overload against the per-class watermarks: bulk sheds at
+    half the watermark with Retry-After, interactive rides to the hard
+    cap; the per-class shed counter tells the story on /metrics."""
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        mdc = make_test_mdc("qos-echo")
+        config = EngineConfig.static_(EchoEngineCore(), mdc)
+        service = await run_http(drt, config, host="127.0.0.1", port=0)
+        service.admission.max_inflight = 4  # bulk cap 2, interactive cap 4
+        service.admission._capacity_fns.clear()
+        base = f"http://127.0.0.1:{service.port}"
+        prompt = " ".join(f"w{i}" for i in range(30))
+
+        async def one(s, priority, via_header=True):
+            kw = {"json": {
+                "model": "qos-echo",
+                "messages": [{"role": "user", "content": prompt}],
+                "stream": False, "max_tokens": 30,
+            }}
+            if via_header:
+                kw["headers"] = {"x-dyn-priority": priority}
+            else:
+                kw["json"]["nvext"] = {"priority": priority}
+            async with s.post(f"{base}/v1/chat/completions", **kw) as r:
+                return r.status, dict(r.headers)
+
+        async with aiohttp.ClientSession() as s:
+            results = await asyncio.gather(
+                *[one(s, "bulk", via_header=(i % 2 == 0)) for i in range(8)]
+            )
+            statuses = [st for st, _ in results]
+            assert statuses.count(429) >= 4, statuses  # bulk cap is 2
+            assert all(
+                "Retry-After" in h for st, h in results if st == 429
+            )
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+        assert (
+            'dyn_llm_class_requests_shed_total{model="qos-echo",'
+            'priority="bulk",reason="watermark"}' in text
+        )
+        # interactive traffic is untouched by a bulk-only backlog
+        async with aiohttp.ClientSession() as s:
+            st, _ = await one(s, "interactive")
+            assert st == 200
+    finally:
+        if service:
+            await service.close()
+        await drt.close()
+
+
+async def test_http_brownout_engages_and_disengages(monkeypatch):
+    """Acceptance: a forced SLO breach steps the ladder (shed ->
+    spec-off -> chunk-cap), visible on /debug/slo, /metrics, and the
+    brownout event stream; recovery walks it back to 0."""
+    monkeypatch.setenv("DYN_SLO_TTFT_MS", "10")
+    monkeypatch.setenv("DYN_SLO_FAST_WINDOW_S", "0.6")
+    monkeypatch.setenv("DYN_SLO_SLOW_WINDOW_S", "1.2")
+    monkeypatch.setenv("DYN_SLO_TICK_S", "0.05")
+    monkeypatch.setenv("DYN_BROWNOUT_STEP_UP_S", "0.05")
+    monkeypatch.setenv("DYN_BROWNOUT_STEP_DOWN_S", "0.2")
+    drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        mdc = make_test_mdc("brownout-echo")
+        config = EngineConfig.static_(EchoEngineCore(), mdc)
+        service = await run_http(drt, config, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+        events: list[dict] = []
+        inner_pub = service.brownout_publisher
+
+        def capture(payload):
+            events.append(payload)
+            if inner_pub:
+                inner_pub(payload)
+
+        service.brownout_publisher = capture
+        # force the breach: every observed TTFT is 50x the objective
+        hist = service.metrics.phase_hist_for("brownout-echo")
+
+        async def wait_level(target, timeout=10.0):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if service.brownout.level >= target:
+                    return
+                hist.observe("ttft", 500.0)
+                await asyncio.sleep(0.05)
+            raise AssertionError(
+                f"brownout never reached {target} "
+                f"(level={service.brownout.level})"
+            )
+
+        await wait_level(3)
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/debug/slo") as r:
+                slo = await r.json()
+            assert slo["brownout"]["level"] >= 3
+            assert slo["brownout"]["spec_off"] and slo["brownout"]["chunk_cap"]
+            # bulk is force-shed while the ladder is engaged
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                headers={"x-dyn-priority": "bulk"},
+                json={
+                    "model": "brownout-echo",
+                    "messages": [{"role": "user", "content": "w1 w2"}],
+                    "stream": False, "max_tokens": 2,
+                },
+            ) as r:
+                assert r.status == 429
+                assert "Retry-After" in r.headers
+            async with s.get(f"{base}/metrics") as r:
+                text = await r.text()
+            assert "dyn_llm_brownout_level 3.0" in text or (
+                "dyn_llm_brownout_level 4.0" in text
+            )
+            assert 'reason="brownout"' in text
+        # the ladder was stepped one rung at a time, in order
+        ups = [e for e in events if e["level"] > e["old_level"]]
+        assert [e["rung"] for e in ups[:3]] == [
+            "shed_bulk", "spec_off", "chunk_cap"
+        ]
+        # recovery: stop observing bad TTFTs; the short windows drain, the
+        # SLO returns to ok, and the ladder walks back down to 0
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and service.brownout.level > 0:
+            await asyncio.sleep(0.1)
+        assert service.brownout.level == 0, service.brownout.status()
+        downs = [e for e in events if e["level"] < e["old_level"]]
+        assert len(downs) >= 3
+        # admission is open for bulk again
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                headers={"x-dyn-priority": "bulk"},
+                json={
+                    "model": "brownout-echo",
+                    "messages": [{"role": "user", "content": "w1 w2"}],
+                    "stream": False, "max_tokens": 2,
+                },
+            ) as r:
+                assert r.status == 200
+    finally:
+        if service:
+            await service.close()
+        await drt.close()
+
+
+async def test_fleet_slo_event_drives_frontend_brownout(monkeypatch):
+    """The fleet path: MockWorkerMetrics forces a breach at the metrics
+    component (its ttft knob), the component publishes `slo-status`, and
+    the FRONTEND's ladder engages off the event — no local traffic at all.
+    Recovery flows the same way."""
+    from dynamo_tpu.components.metrics import (
+        MetricsComponent,
+        MockWorkerMetrics,
+    )
+    from dynamo_tpu.runtime.protocols import EndpointId
+
+    monkeypatch.setenv("DYN_SLO_TTFT_MS", "50")
+    monkeypatch.setenv("DYN_SLO_FAST_WINDOW_S", "0.6")
+    monkeypatch.setenv("DYN_SLO_SLOW_WINDOW_S", "1.2")
+    monkeypatch.setenv("DYN_SLO_TICK_S", "0.05")
+    monkeypatch.setenv("DYN_BROWNOUT_STEP_UP_S", "0.05")
+    monkeypatch.setenv("DYN_BROWNOUT_STEP_DOWN_S", "0.2")
+    drt = await DistributedRuntime.detached()
+    service = None
+    metrics_comp = None
+    mock = None
+    try:
+        ns_name = drt.config.namespace
+        comp = drt.namespace(ns_name).component("backend")
+        eid = EndpointId(ns_name, "backend", "generate")
+        # every synthetic TTFT is ~100x the 50 ms objective
+        mock = MockWorkerMetrics(
+            comp.endpoint("generate"), instance_id=3, ttft_ms=5000.0
+        )
+        await mock.start()
+        metrics_comp = MetricsComponent(comp, eid, poll_interval=0.05, port=0)
+        await metrics_comp.start()
+
+        mdc = make_test_mdc("fleet-echo")
+        service = await run_http(
+            drt, EngineConfig.static_(EchoEngineCore(), mdc),
+            host="127.0.0.1", port=0,
+        )
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and service.brownout.level < 1:
+            await asyncio.sleep(0.05)
+        assert service.brownout.level >= 1, (
+            service.brownout.status(), metrics_comp.slo.last_status
+        )
+        assert service._remote_slo_state in ("burning", "breached")
+        # recovery: the mock worker's TTFTs drop well under the objective,
+        # the component's windows drain, it publishes the ok transition,
+        # and the frontend ladder walks back
+        mock.ttft_ms = 1.0
+        deadline = time.monotonic() + 25
+        while time.monotonic() < deadline and service.brownout.level > 0:
+            await asyncio.sleep(0.1)
+        assert service.brownout.level == 0, service.brownout.status()
+    finally:
+        if service:
+            await service.close()
+        if metrics_comp:
+            await metrics_comp.close()
+        if mock:
+            await mock.stop()
+        await drt.close()
