@@ -1,0 +1,229 @@
+"""Integrity-plane sweep: checksum overhead + corruption/zombie proof.
+
+Three sections, one banked artifact (benchmarks/integrity_sweep.json,
+also reachable as `perf_sweep.py --preset integrity`):
+
+1. **codec microbench** — encode+verify throughput of the KV payload
+   container at production-ish block sizes (an 8B model ships ~2 MB of
+   KV per 16-token block), checksums on vs off: the per-payload overhead
+   the wire pays for end-to-end integrity.
+2. **streamed-disagg TTFT** — the PR 4 streaming harness (tiny JAX
+   engines, simulated wire) run with DYN_KV_CHECKSUM on vs off; the
+   acceptance bar is <= 3% TTFT overhead on the streamed path.
+3. **fault proof** — with DYN_FAULT=corrupt_kv active across the disagg
+   stream, no corrupted block is ever consumed (streams token-identical
+   to a fault-free run, failures counted); with zombie_partition, the
+   fenced worker's post-fence frames are rejected.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.integrity_sweep \
+        --json benchmarks/integrity_sweep.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+import numpy as np
+
+
+def codec_microbench(repeats: int = 20) -> dict:
+    """Encode+decode(+verify) throughput at an 8B-ish block shape."""
+    from dynamo_tpu import integrity
+    from dynamo_tpu.disagg.protocols import KvBlockPayload
+
+    import ml_dtypes
+
+    # [L, H, n, bs, D] = llama3-8B-ish: 32 layers, 8 kv heads, 4 blocks
+    # of 16 tokens, head_dim 128 -> ~2 MB K + 2 MB V per payload
+    rng = np.random.default_rng(0)
+    shape = (32, 8, 4, 16, 128)
+    k = rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
+    v = rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
+    out: dict = {"payload_mb": round(2 * k.nbytes / 1e6, 2),
+                 "algo": integrity.ALGO}
+    for label, env in (("checksum_on", "1"), ("checksum_off", "0")):
+        os.environ["DYN_KV_CHECKSUM"] = env
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            p = KvBlockPayload.encode(k, v)
+            p.decode()
+        dt = (time.perf_counter() - t0) / repeats
+        out[f"{label}_ms_per_payload"] = round(dt * 1e3, 3)
+    os.environ["DYN_KV_CHECKSUM"] = "1"
+    on, off = out["checksum_on_ms_per_payload"], out[
+        "checksum_off_ms_per_payload"]
+    out["codec_overhead_pct"] = round(100.0 * (on - off) / max(1e-9, off), 2)
+    # hash throughput alone (the added work, isolated)
+    blob = k.tobytes()
+    t0 = time.perf_counter()
+    n = 50
+    for _ in range(n):
+        integrity.checksum(blob)
+    gbps = len(blob) * n / (time.perf_counter() - t0) / 1e9
+    out["hash_gb_per_s"] = round(gbps, 2)
+    return out
+
+
+async def ttft_ab(isl: int, osl: int, repeats: int, wire_mbps: float) -> dict:
+    """Streamed-disagg TTFT with checksums on vs off (same harness as
+    benchmarks.disagg_stream_bench; production code path end to end)."""
+    from benchmarks.disagg_stream_bench import build_pair, one_request
+
+    max_len = isl + osl + 64
+    prefill_engine, service, client, decode = build_pair(
+        wire_mbps, 64, max_len
+    )
+    await service.start()
+    await client.start()
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(2, 250, size=isl).tolist()
+    os.environ["DYN_KV_STREAM"] = "1"
+    os.environ["DYN_KV_WIRE"] = "bf16"
+    await one_request(decode, prompt, 2)  # warm compiles
+    row: dict = {"isl": isl, "osl": osl, "repeats": repeats}
+    toks_by_mode = {}
+    for label, env in (("checksum_on", "1"), ("checksum_off", "0")):
+        os.environ["DYN_KV_CHECKSUM"] = env
+        ttfts = []
+        toks = None
+        for _ in range(repeats):
+            toks, ttft = await one_request(decode, prompt, osl)
+            ttfts.append(ttft)
+        toks_by_mode[label] = toks
+        row[f"{label}_ttft_ms"] = round(1e3 * float(np.median(ttfts)), 2)
+    os.environ["DYN_KV_CHECKSUM"] = "1"
+    on, off = row["checksum_on_ttft_ms"], row["checksum_off_ttft_ms"]
+    row["ttft_overhead_pct"] = round(100.0 * (on - off) / max(1e-9, off), 2)
+    row["parity"] = toks_by_mode["checksum_on"] == toks_by_mode[
+        "checksum_off"]
+    await decode.close()
+    await client.close()
+    await service.close()
+    await prefill_engine.close()
+    return row
+
+
+async def fault_proof() -> dict:
+    """Corrupt the stream, then run a zombie: both must be contained."""
+    from dynamo_tpu import integrity
+    from dynamo_tpu.disagg.transfer import (
+        PrefillWorkerService,
+        RemotePrefillClient,
+    )
+    from dynamo_tpu.engine.mocker import (
+        MockEngine,
+        MockEngineArgs,
+        MockPrefillEngine,
+    )
+    from dynamo_tpu.fabric.client import FabricClient
+    from dynamo_tpu.fabric.state import FabricState
+    from dynamo_tpu.pipeline.context import Context
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.runtime.fencing import FenceRegistry, make_stamp
+    from dynamo_tpu.testing import faults
+
+    def req(prompt, max_tokens):
+        return PreprocessedRequest(
+            token_ids=list(prompt), sampling=SamplingOptions(),
+            stop=StopConditions(max_tokens=max_tokens),
+        )
+
+    out: dict = {}
+    integrity.COUNTERS.reset()
+    BS = 4
+    fabric = FabricClient.in_process(FabricState())
+    prefill = MockPrefillEngine(
+        MockEngineArgs(block_size=BS, speedup_ratio=1000.0), chunk_blocks=1
+    )
+    service = PrefillWorkerService(fabric, "integ-bench", prefill)
+    client = RemotePrefillClient(fabric, "integ-bench", block_size=BS,
+                                 timeout=20)
+    engine = MockEngine(
+        MockEngineArgs(block_size=BS, speedup_ratio=1000.0),
+        remote_prefill_client=client, disagg_threshold=2 * BS,
+    )
+    await service.start()
+    await client.start()
+    prompt = list(range(2, 2 + 4 * BS))
+    expected = [prompt[j % len(prompt)] for j in range(8)]
+    faults.set_injector(
+        faults.FaultInjector(faults.FaultSpec(corrupt_kv="bits", every=1))
+    )
+    try:
+        got = []
+        async for o in engine.generate(req(prompt, 8), Context()):
+            got.extend(o.token_ids)
+        out["corrupt_streams_identical"] = got == expected
+        out["corrupt_frames_refused"] = integrity.COUNTERS.failures.get(
+            "disagg_frame", 0
+        )
+        out["corrupt_blocks_decoded"] = engine.kv_frames_rx
+    finally:
+        faults.set_injector(None)
+    # zombie: frames stamped with a fenced epoch are refused outright
+    fences = FenceRegistry(fabric)
+    await fences.start()
+    await fences.fence(0xDEAD)
+    service.stamp = make_stamp(0xDEAD, 0xDEAD)
+    client.fences = fences
+    got = []
+    async for o in engine.generate(req(prompt, 8), Context()):
+        got.extend(o.token_ids)
+    out["zombie_stream_identical"] = got == expected
+    out["zombie_post_fence_rejects"] = integrity.COUNTERS.fenced_rejects.get(
+        "kv_stream", 0
+    )
+    integrity.COUNTERS.reset()
+    await engine.close()
+    await client.close()
+    await service.close()
+    await fences.close()
+    await fabric.close()
+    return out
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--isl", type=int, default=512)
+    ap.add_argument("--osl", type=int, default=4)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--wire-mbps", type=float, default=25.0)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    doc = {
+        "bench": "integrity_sweep",
+        "model": "tiny-random",
+        "codec": codec_microbench(),
+        "streamed_disagg": asyncio.run(
+            ttft_ab(args.isl, args.osl, args.repeats, args.wire_mbps)
+        ),
+        "fault_proof": asyncio.run(fault_proof()),
+    }
+    print(json.dumps(
+        {
+            "codec_overhead_pct": doc["codec"]["codec_overhead_pct"],
+            "hash_gb_per_s": doc["codec"]["hash_gb_per_s"],
+            "ttft_overhead_pct":
+                doc["streamed_disagg"]["ttft_overhead_pct"],
+            "parity": doc["streamed_disagg"]["parity"],
+            "fault_proof": doc["fault_proof"],
+        },
+        indent=1,
+    ))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

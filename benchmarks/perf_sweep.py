@@ -259,7 +259,7 @@ def main() -> None:
         "--preset",
         choices=[
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
-            "priority",
+            "priority", "integrity",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -284,7 +284,11 @@ def main() -> None:
         "priority = delegates to benchmarks.priority_sweep (4x-overload "
         "1:4 interactive:bulk mix, class-blind vs QoS: per-class TTFT, "
         "shed/preempt counts, brownout timeline; banked artifact "
-        "benchmarks/priority_sweep.json)",
+        "benchmarks/priority_sweep.json). "
+        "integrity = delegates to benchmarks.integrity_sweep (checksum "
+        "codec overhead, streamed-disagg TTFT checksums on vs off with "
+        "a <=3% bar, and the corrupt_kv/zombie fault proof; banked "
+        "artifact benchmarks/integrity_sweep.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -316,6 +320,16 @@ def main() -> None:
 
         priority_sweep.main(
             ["--json", args.json or "benchmarks/priority_sweep.json"]
+        )
+        return
+    if args.preset == "integrity":
+        # integrity-plane sweep has its own harness (codec microbench +
+        # streamed-disagg A/B + fault proof) — one entry point for every
+        # banked curve stays `perf_sweep --preset X`
+        from benchmarks import integrity_sweep
+
+        integrity_sweep.main(
+            ["--json", args.json or "benchmarks/integrity_sweep.json"]
         )
         return
     if args.preset == "slo":

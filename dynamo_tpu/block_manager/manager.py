@@ -16,6 +16,7 @@ data copies are numpy slice assignments (host) and single-file IO (disk).
 from __future__ import annotations
 
 import os
+import struct
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -23,8 +24,10 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from dynamo_tpu import integrity
 from dynamo_tpu.block_manager.layout import LayoutConfig
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.testing import faults
 
 logger = get_logger("dynamo_tpu.block_manager")
 
@@ -34,12 +37,22 @@ _NP_DTYPES = {
     "float32": np.float32,
 }
 
+# G3 spill page header: magic || k_sum || v_sum (64-bit checksums over the
+# page's k-half and v-half, scales included). Self-describing: pages
+# written by a DYN_KV_CHECKSUM=0 build carry no header and load unverified.
+_PAGE_MAGIC = b"KVB2"
+_PAGE_HDR = struct.Struct(">4sQQ")
+
 
 @dataclass
 class BlockHandle:
     seq_hash: int
     tier: int  # 2=host, 3=disk
     index: int  # host arena slot (tier 2) or -1 (disk)
+    # content checksums over the arena slot (+ scale plane) at store time;
+    # 0 = unchecksummed (DYN_KV_CHECKSUM=0)
+    k_sum: int = 0
+    v_sum: int = 0
 
 
 @dataclass
@@ -52,6 +65,12 @@ class BlockManagerStats:
     onboarded: int = 0
     hits: int = 0
     misses: int = 0
+    # integrity plane: checksum verification failures at load/promote
+    # time, hashes quarantined (repeat offenders, never re-admitted), and
+    # stores refused because the hash is quarantined
+    integrity_failures: int = 0
+    quarantined: int = 0
+    quarantine_refused: int = 0
 
 
 class TieredBlockManager:
@@ -101,6 +120,16 @@ class TieredBlockManager:
         if disk_dir:
             os.makedirs(disk_dir, exist_ok=True)
         self.stats = BlockManagerStats(host_blocks_total=host_blocks)
+        # poison-block quarantine: per-hash verification-failure counts;
+        # a hash that fails DYN_QUARANTINE_AFTER times is permanently
+        # refused (never re-stored, never offered for prefix reuse) —
+        # the content-chain hash names the same prefix forever, so a
+        # quarantined hash costs reuse for that prefix, never correctness
+        self._fail_counts: dict[int, int] = {}
+        self._quarantined: set[int] = set()
+        self.quarantine_after = max(
+            1, int(os.environ.get("DYN_QUARANTINE_AFTER", "2") or 2)
+        )
         # engine calls arrive from run_in_executor threads; all tier state
         # (arenas, LRU dicts, free list) is guarded by one coarse lock —
         # the hot paths are short and the big copies stay outside jit
@@ -111,6 +140,9 @@ class TieredBlockManager:
     def __contains__(self, seq_hash: int) -> bool:
         with self._lock:
             return seq_hash in self._host or seq_hash in self._disk
+
+    def is_quarantined(self, seq_hash: int) -> bool:
+        return seq_hash in self._quarantined
 
     def lookup_prefix(self, seq_hashes: list[int]) -> int:
         """Longest prefix (in blocks) of the hash chain present in any tier
@@ -153,9 +185,17 @@ class TieredBlockManager:
             vb, vs = kv_quantize_int8(as_logical(vb, self.layout.dtype))
         elif kb.dtype.name == "bfloat16":
             kb, vb = kb.view(np.uint16), vb.view(np.uint16)
+        checks = integrity.enabled()
+        inj = faults.get_injector() if faults.active() else None
         stored = []
         with self._lock:
             for i, h in enumerate(seq_hashes):
+                if h in self._quarantined:
+                    # poison block: permanently refused — resurrecting it
+                    # through an offload round-trip would re-offer a hash
+                    # with a corruption history for prefix reuse
+                    self.stats.quarantine_refused += 1
+                    continue
                 if h in self._host:
                     self._host.move_to_end(h)
                     continue
@@ -169,7 +209,16 @@ class TieredBlockManager:
                 if ks is not None:
                     self._k_scales[slot] = ks[i]
                     self._v_scales[slot] = vs[i]
-                self._host[h] = BlockHandle(h, tier=2, index=slot)
+                k_sum = v_sum = 0
+                if checks:
+                    k_sum, v_sum = self._slot_sums(slot)
+                self._host[h] = BlockHandle(
+                    h, tier=2, index=slot, k_sum=k_sum, v_sum=v_sum
+                )
+                if inj is not None:
+                    # corrupt_kv fault point (host-RAM bit flip): AFTER
+                    # the checksums — load-time verification must catch it
+                    inj.corrupt_array(self._k_arena[slot])
                 stored.append(h)
             if stored:
                 self.stats.offloaded_g2 += len(stored)
@@ -186,19 +235,49 @@ class TieredBlockManager:
             return None
         old_hash, old = self._host.popitem(last=False)
         if self.disk_dir:
-            self._spill_to_disk(old_hash, old.index)
+            self._spill_to_disk(old_hash, old)
         elif self.on_event:
             self.on_event("removed", [old_hash], 2)
         return old.index
 
-    def _spill_to_disk(self, seq_hash: int, slot: int) -> None:
+    def _slot_sums(self, slot: int) -> tuple[int, int]:
+        """Content checksums over one arena slot (+ its scale plane)."""
+        if self.wire_codec == "int8":
+            return (
+                integrity.checksum(
+                    self._k_arena[slot].tobytes(),
+                    self._k_scales[slot].tobytes(),
+                ),
+                integrity.checksum(
+                    self._v_arena[slot].tobytes(),
+                    self._v_scales[slot].tobytes(),
+                ),
+            )
+        return (
+            integrity.checksum(self._k_arena[slot].tobytes()),
+            integrity.checksum(self._v_arena[slot].tobytes()),
+        )
+
+    def _spill_to_disk(self, seq_hash: int, handle: BlockHandle) -> None:
+        slot = handle.index
         path = os.path.join(self.disk_dir, f"{seq_hash:#x}.kvb")
         with open(path, "wb") as f:
+            if handle.k_sum or handle.v_sum:
+                # self-describing page header: checksums travel WITH the
+                # page, so a torn write is caught at promote time even
+                # after a process restart loses the in-memory handles
+                f.write(_PAGE_HDR.pack(_PAGE_MAGIC, handle.k_sum,
+                                       handle.v_sum))
             f.write(self._k_arena[slot].tobytes())
             f.write(self._v_arena[slot].tobytes())
             if self.wire_codec == "int8":
                 f.write(self._k_scales[slot].tobytes())
                 f.write(self._v_scales[slot].tobytes())
+        if faults.active():
+            inj = faults.get_injector()
+            if inj is not None:
+                # corrupt_kv fault point: tear the just-written G3 page
+                inj.corrupt_file(path)
         self._disk[seq_hash] = path
         self.stats.spilled_g3 += 1
         self.stats.disk_blocks_used = len(self._disk)
@@ -239,6 +318,17 @@ class TieredBlockManager:
             for i, h in enumerate(seq_hashes):
                 hnd = self._host.get(h)
                 if hnd is not None:
+                    if hnd.k_sum or hnd.v_sum:
+                        got_k, got_v = self._slot_sums(hnd.index)
+                        if got_k != hnd.k_sum or got_v != hnd.v_sum:
+                            # host-RAM corruption: free the slot (exactly
+                            # once), note the failure, and refuse the load
+                            # so the caller recomputes the prefix
+                            self._integrity_fail(h, "tier_host")
+                            raise integrity.IntegrityError(
+                                f"host block {h:#x} failed checksum",
+                                path="tier_host",
+                            )
                     self._host.move_to_end(h)
                     k[i] = self._k_arena[hnd.index]
                     v[i] = self._v_arena[hnd.index]
@@ -251,23 +341,51 @@ class TieredBlockManager:
                     raise KeyError(f"block {h:#x} not cached")
                 raw = np.fromfile(path, dtype=np.uint8)
                 half = L.block_numel * store().itemsize
-                k[i] = np.frombuffer(
-                    raw[:half].tobytes(), store
-                ).reshape(L.block_shape)
-                v[i] = np.frombuffer(
-                    raw[half : 2 * half].tobytes(), store
-                ).reshape(L.block_shape)
-                if int8:
-                    scales = np.frombuffer(
-                        raw[2 * half :].tobytes(), np.float32
+                snum = int(np.prod(sshape)) if int8 else 0
+                k_sum = v_sum = 0
+                if (
+                    len(raw) >= _PAGE_HDR.size
+                    and raw[: len(_PAGE_MAGIC)].tobytes() == _PAGE_MAGIC
+                ):
+                    _, k_sum, v_sum = _PAGE_HDR.unpack(
+                        raw[: _PAGE_HDR.size].tobytes()
                     )
-                    snum = int(np.prod(sshape))
-                    ks[i] = scales[:snum].reshape(sshape)
-                    vs[i] = scales[snum:].reshape(sshape)
+                    raw = raw[_PAGE_HDR.size:]
+                body = 2 * half + (2 * snum * 4 if int8 else 0)
+                if len(raw) < body:
+                    # torn page (truncated write / corrupt_kv=truncate)
+                    self._integrity_fail(h, "tier_disk")
+                    raise integrity.IntegrityError(
+                        f"disk page {h:#x} truncated "
+                        f"({len(raw)} < {body} bytes)",
+                        path="tier_disk",
+                    )
+                kb_ = raw[:half].tobytes()
+                vb_ = raw[half: 2 * half].tobytes()
+                ksb = raw[2 * half: 2 * half + snum * 4].tobytes()
+                vsb = raw[2 * half + snum * 4: body].tobytes()
+                if k_sum or v_sum:
+                    if (
+                        integrity.checksum(kb_, ksb) != k_sum
+                        or integrity.checksum(vb_, vsb) != v_sum
+                    ):
+                        # bit rot on disk: promotion FAILS — the page is
+                        # deleted, the failure noted, the prefix recomputes
+                        self._integrity_fail(h, "tier_disk")
+                        raise integrity.IntegrityError(
+                            f"disk page {h:#x} failed checksum",
+                            path="tier_disk",
+                        )
+                k[i] = np.frombuffer(kb_, store).reshape(L.block_shape)
+                v[i] = np.frombuffer(vb_, store).reshape(L.block_shape)
+                if int8:
+                    ks[i] = np.frombuffer(ksb, np.float32).reshape(sshape)
+                    vs[i] = np.frombuffer(vsb, np.float32).reshape(sshape)
                 self._promote(
                     h, k[i], v[i], path,
                     k_scales=ks[i] if int8 else None,
                     v_scales=vs[i] if int8 else None,
+                    k_sum=k_sum, v_sum=v_sum,
                 )
             self.stats.onboarded += n
         if int8:
@@ -279,6 +397,39 @@ class TieredBlockManager:
                 k, v = k.view(np.uint16), v.view(np.uint16)
         return np.moveaxis(k, 0, 2), np.moveaxis(v, 0, 2)
 
+    def _integrity_fail(self, h: int, path_label: str) -> None:
+        """One block failed verification: free it exactly once (host slot
+        returned / disk page unlinked), count, and quarantine the hash
+        when it has failed `quarantine_after` times."""
+        self.stats.integrity_failures += 1
+        integrity.COUNTERS.integrity_failure(path_label, f"block {h:#x}")
+        hnd = self._host.pop(h, None)
+        if hnd is not None:
+            self._free_slots.append(hnd.index)
+            self.stats.host_blocks_used = len(self._host)
+        p = self._disk.pop(h, None)
+        if p is not None:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+            self.stats.disk_blocks_used = len(self._disk)
+        self._fail_counts[h] = self._fail_counts.get(h, 0) + 1
+        if (
+            h not in self._quarantined
+            and self._fail_counts[h] >= self.quarantine_after
+        ):
+            self._quarantined.add(h)
+            self.stats.quarantined += 1
+            integrity.COUNTERS.quarantine()
+            logger.error(
+                "block %#x quarantined after %d integrity failures",
+                h, self._fail_counts[h],
+            )
+        if self.on_event:
+            # routers/indexers drop the block from prefix-reuse offers
+            self.on_event("removed", [h], 3 if p is not None else 2)
+
     def _promote(
         self,
         h: int,
@@ -287,6 +438,8 @@ class TieredBlockManager:
         path: str,
         k_scales: Optional[np.ndarray] = None,
         v_scales: Optional[np.ndarray] = None,
+        k_sum: int = 0,
+        v_sum: int = 0,
     ) -> None:
         slot = self._alloc_host_slot()
         if slot is None:
@@ -296,7 +449,9 @@ class TieredBlockManager:
         if k_scales is not None:
             self._k_scales[slot] = k_scales
             self._v_scales[slot] = v_scales
-        self._host[h] = BlockHandle(h, tier=2, index=slot)
+        self._host[h] = BlockHandle(
+            h, tier=2, index=slot, k_sum=k_sum, v_sum=v_sum
+        )
         self._disk.pop(h, None)
         try:
             os.unlink(path)

@@ -24,8 +24,10 @@ from typing import Any, Optional
 
 import msgpack
 
+from dynamo_tpu import integrity
 from dynamo_tpu.pipeline.context import Context
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.testing import faults
 
 logger = get_logger("dynamo_tpu.block_manager.peer")
 
@@ -86,10 +88,19 @@ class PeerBlockService:
         with m._lock:
             return list(m._host.keys()) + list(m._disk.keys())
 
+    def _stamp(self) -> dict:
+        from dynamo_tpu.runtime.fencing import make_stamp
+
+        return make_stamp(self.instance_id, self.drt.fencing_epoch)
+
     async def _publish_loop(self) -> None:
         while True:
             try:
-                advert = msgpack.packb(self._inventory())
+                # epoch-stamped advert container (legacy plain-list adverts
+                # are still parsed by older clients' lookup)
+                advert = msgpack.packb(
+                    {"stamp": self._stamp(), "h": self._inventory()}
+                )
                 if advert != self._last_advert:
                     await self.drt.fabric.kv_put(
                         _advert_key(self.namespace, self.instance_id),
@@ -118,13 +129,21 @@ class PeerBlockService:
             None, self.manager.load_blocks, found
         )
         # same self-describing codec container as the disagg data plane:
-        # DYN_KV_WIRE=int8 halves G4 pull bytes too
+        # DYN_KV_WIRE=int8 halves G4 pull bytes too, and the integrity
+        # header rides along so the puller verifies before landing
         dtype = self.manager.layout.dtype
         payload = KvBlockPayload.encode(
             as_logical(k, dtype), as_logical(v, dtype),
             wire_codec_from_env(),
         )
-        yield {"hashes": found, "payload": payload.to_wire()}
+        wire_d = payload.to_wire()
+        if faults.active():
+            inj = faults.get_injector()
+            if inj is not None:
+                bad = inj.corrupt_bytes(wire_d["k"])
+                if bad is not None:
+                    wire_d["k"] = bad
+        yield {"hashes": found, "payload": wire_d, "stamp": self._stamp()}
 
 
 class PeerBlockClient:
@@ -147,18 +166,38 @@ class PeerBlockClient:
             self._client = await self.endpoint.client()
         return self._client
 
+    async def _fences(self):
+        fences_fn = getattr(self.drt, "fences", None)
+        if fences_fn is None:
+            return None
+        try:
+            return await fences_fn()
+        except Exception:  # noqa: BLE001 — fencing is an upgrade, not a gate
+            return None
+
     async def lookup(self, seq_hashes: list[int]) -> tuple[Optional[int], int]:
         """(best peer instance, longest advertised prefix length)."""
         adverts = await self.drt.fabric.kv_get_prefix(
             f"{_ADVERT_PREFIX}/{self.namespace}/"
         )
+        fences = await self._fences()
         best, best_n = None, 0
         for key, raw in adverts.items():
             iid = int(key.rsplit("/", 1)[1])
             if iid == self.own_instance_id:
                 continue
             try:
-                held = set(msgpack.unpackb(raw))
+                d = msgpack.unpackb(raw)
+                if isinstance(d, dict):
+                    if fences is not None and fences.check_stamp(
+                        d.get("stamp"), "peer"
+                    ):
+                        # advert from a fenced epoch (zombie worker whose
+                        # lease-bound key hasn't aged out yet): skip it
+                        continue
+                    held = set(d.get("h", []))
+                else:
+                    held = set(d)  # legacy plain-list advert
             except Exception:  # noqa: BLE001 — skip malformed advert
                 continue
             n = 0
@@ -182,6 +221,16 @@ class PeerBlockClient:
         if peer is None or n <= missing_from:
             return 0
         pull = seq_hashes[missing_from:n]
+        # never pull a quarantined hash back in: cap the span at the
+        # first poisoned block (store_blocks would refuse it anyway)
+        is_q = getattr(self.manager, "is_quarantined", None)
+        if is_q is not None:
+            for i, h in enumerate(pull):
+                if is_q(h):
+                    pull = pull[:i]
+                    break
+        if not pull:
+            return 0
         try:
             client = await self._ensure_client()
             stream = await client.direct(
@@ -193,13 +242,23 @@ class PeerBlockClient:
             data = reply.data if hasattr(reply, "data") else reply
             if not data or not data.get("hashes") or not data.get("payload"):
                 return 0
+            fences = await self._fences()
+            if fences is not None and fences.check_stamp(
+                data.get("stamp"), "peer"
+            ):
+                return 0  # pulled from a zombie: refuse, recompute
             from dynamo_tpu.disagg.protocols import KvBlockPayload
 
             payload = KvBlockPayload.from_wire(data["payload"])
             self.fetched_bytes += payload.wire_nbytes
-            # decode() dequantizes int8 pulls; the local manager re-encodes
-            # per its own tier codec in store_blocks
-            k, v = payload.decode()
+            # decode() verifies the integrity header (a corrupt pull
+            # raises and we recompute) and dequantizes int8 pulls; the
+            # local manager re-encodes per its own tier codec
+            try:
+                k, v = payload.decode()
+            except integrity.IntegrityError as e:
+                integrity.COUNTERS.integrity_failure("peer_pull", str(e))
+                return 0
             loop = asyncio.get_running_loop()
             stored = await loop.run_in_executor(
                 None, self.manager.store_blocks, list(data["hashes"]), k, v

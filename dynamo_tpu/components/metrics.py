@@ -138,6 +138,43 @@ class _FleetCollector:
         for cls, v in sorted(by_class.items()):
             preempt.add_metric([str(cls)], float(v))
         yield preempt
+        # integrity plane (ISSUE 8): checksum failures by data-plane path,
+        # quarantined poison blocks, epoch-fencing rejects by plane
+        integ = CounterMetricFamily(
+            f"{PREFIX}_kv_integrity_failures",
+            "KV payloads that failed their content checksum, by "
+            "data-plane path (fleet sum)",
+            labels=["path"],
+        )
+        by_path = (
+            agg.worker_stats.integrity_failures_by_path
+            if agg is not None else None
+        ) or {}
+        for path, v in sorted(by_path.items()):
+            integ.add_metric([str(path)], float(v))
+        yield integ
+        yield CounterMetricFamily(
+            f"{PREFIX}_blocks_quarantined",
+            "KV blocks quarantined after repeated integrity failures "
+            "(fleet sum; never re-offered for prefix reuse)",
+            value=float(
+                agg.worker_stats.num_blocks_quarantined
+                if agg is not None else 0
+            ),
+        )
+        fenced = CounterMetricFamily(
+            f"{PREFIX}_fenced_rejects",
+            "Frames/adverts/publishes rejected because their epoch-fencing "
+            "stamp names a dead worker incarnation, by plane (fleet sum)",
+            labels=["plane"],
+        )
+        by_plane = (
+            agg.worker_stats.fenced_rejects_by_plane
+            if agg is not None else None
+        ) or {}
+        for plane, v in sorted(by_plane.items()):
+            fenced.add_metric([str(plane)], float(v))
+        yield fenced
         yield GaugeMetricFamily(
             f"{PREFIX}_brownout_level",
             "Worst worker brownout rung in the fleet "
@@ -453,6 +490,11 @@ class MockWorkerMetrics:
         self._preempted_too_often = 0
         self._shed_brownout = 0
         self.brownout_level = 0  # settable knob (exercise the gauge)
+        # integrity plane: rare deterministic corruption/fence events so
+        # the new families render engine-free
+        self._integrity_failures: dict[str, int] = {}
+        self._blocks_quarantined = 0
+        self._fenced_rejects: dict[str, int] = {}
         self._spec = SpecDecodeStats(
             num_spec_tokens=4,
             num_drafts=0,
@@ -516,6 +558,19 @@ class MockWorkerMetrics:
             self._preempted_too_often += 1
         if self.brownout_level >= 1 and load > 0.5:
             self._shed_brownout += 1
+        # integrity plane: a corrupt tier page every ~200 ticks (every
+        # second one tips the block into quarantine at the default
+        # fail-twice threshold), a fenced dispatch reject every ~400
+        if self._t % 200 == 0:
+            self._integrity_failures["tier_disk"] = (
+                self._integrity_failures.get("tier_disk", 0) + 1
+            )
+            if self._t % 400 == 0:
+                self._blocks_quarantined += 1
+        if self._t % 400 == 100:
+            self._fenced_rejects["dispatch"] = (
+                self._fenced_rejects.get("dispatch", 0) + 1
+            )
         return ForwardPassMetrics(
             worker_stats=WorkerStats(
                 request_active_slots=int(self.total_slots * load),
@@ -527,6 +582,11 @@ class MockWorkerMetrics:
                 num_preempted_too_often=self._preempted_too_often,
                 num_shed_brownout=self._shed_brownout,
                 brownout_level=self.brownout_level,
+                integrity_failures_by_path=(
+                    dict(self._integrity_failures) or None
+                ),
+                num_blocks_quarantined=self._blocks_quarantined,
+                fenced_rejects_by_plane=dict(self._fenced_rejects) or None,
             ),
             kv_stats=KvStats(
                 kv_active_blocks=active_blocks,

@@ -89,7 +89,15 @@ class KvBlockPayload:
 
     `codec` selects the byte encoding: "raw" (bit-exact logical dtype as
     wire words) or "int8" (per-block-scale quantized; `k_scales`/`v_scales`
-    carry f32 scales of shape `shape[:-2]`)."""
+    carry f32 scales of shape `shape[:-2]`).
+
+    The self-describing integrity header (`sum_algo`, `k_sum`, `v_sum` —
+    64-bit content checksums over payload+scale bytes, dynamo_tpu.integrity)
+    is computed at encode time and verified at land time: a bit flip or a
+    truncated frame anywhere on the wire raises `IntegrityError` instead
+    of decoding a corrupt block into the KV cache. `DYN_KV_CHECKSUM=0`
+    skips computing sums; untagged payloads are accepted unverified
+    (mixed-fleet forward compatibility)."""
 
     shape: tuple[int, ...]
     dtype: str  # logical dtype name ("bfloat16", ...)
@@ -98,8 +106,21 @@ class KvBlockPayload:
     codec: str = "raw"
     k_scales: bytes = b""
     v_scales: bytes = b""
+    # integrity header ("" = unchecksummed payload, accepted unverified)
+    sum_algo: str = ""
+    k_sum: int = 0
+    v_sum: int = 0
 
     # ------------------------------------------------------------- encode
+
+    def _stamp_sums(self) -> "KvBlockPayload":
+        from dynamo_tpu import integrity
+
+        if integrity.enabled():
+            self.sum_algo = integrity.ALGO
+            self.k_sum = integrity.checksum(self.k_bytes, self.k_scales)
+            self.v_sum = integrity.checksum(self.v_bytes, self.v_scales)
+        return self
 
     @classmethod
     def encode(
@@ -115,11 +136,12 @@ class KvBlockPayload:
                 k_bytes=kq.tobytes(), v_bytes=vq.tobytes(),
                 codec="int8",
                 k_scales=ks.tobytes(), v_scales=vs.tobytes(),
-            )
+            )._stamp_sums()
         wire_k = k.view(np.uint16) if dtype == "bfloat16" else k
         wire_v = v.view(np.uint16) if dtype == "bfloat16" else v
         return cls(shape=tuple(k.shape), dtype=dtype,
-                   k_bytes=wire_k.tobytes(), v_bytes=wire_v.tobytes())
+                   k_bytes=wire_k.tobytes(),
+                   v_bytes=wire_v.tobytes())._stamp_sums()
 
     @classmethod
     def from_arrays(cls, k: np.ndarray, v: np.ndarray, dtype: str) -> "KvBlockPayload":
@@ -129,8 +151,36 @@ class KvBlockPayload:
 
     # ------------------------------------------------------------- decode
 
-    def decode(self) -> tuple[np.ndarray, np.ndarray]:
-        """Decode to LOGICAL-dtype arrays (dequantizing if int8)."""
+    def verify(self) -> None:
+        """Raise `integrity.IntegrityError` when the payload bytes do not
+        match the checksums the sender stamped. Length changes (truncated
+        frames) fail too — the checksum covers the exact byte string.
+        Untagged payloads and unknown algorithms pass unverified."""
+        if not self.sum_algo:
+            return
+        from dynamo_tpu import integrity
+
+        ks = integrity.checksum_with(
+            self.sum_algo, self.k_bytes, self.k_scales
+        )
+        if ks is None:  # unknown algo on this build: can't verify
+            return
+        vs = integrity.checksum_with(
+            self.sum_algo, self.v_bytes, self.v_scales
+        )
+        if ks != self.k_sum or vs != self.v_sum:
+            raise integrity.IntegrityError(
+                f"KV payload failed {self.sum_algo} checksum "
+                f"(k {'ok' if ks == self.k_sum else 'BAD'}, "
+                f"v {'ok' if vs == self.v_sum else 'BAD'}, "
+                f"{self.wire_nbytes} wire bytes)"
+            )
+
+    def decode(self, verify: bool = True) -> tuple[np.ndarray, np.ndarray]:
+        """Decode to LOGICAL-dtype arrays (dequantizing if int8).
+        Verifies the integrity header first (see `verify`)."""
+        if verify:
+            self.verify()
         if self.codec == "int8":
             sshape = tuple(self.shape[:-2])
             kq = np.frombuffer(self.k_bytes, np.int8).reshape(self.shape)
@@ -174,6 +224,10 @@ class KvBlockPayload:
             d["codec"] = self.codec
             d["ks"] = self.k_scales
             d["vs"] = self.v_scales
+        if self.sum_algo:
+            d["alg"] = self.sum_algo
+            d["ksm"] = self.k_sum
+            d["vsm"] = self.v_sum
         return d
 
     @classmethod
@@ -183,6 +237,8 @@ class KvBlockPayload:
             k_bytes=d["k"], v_bytes=d["v"],
             codec=d.get("codec", "raw"),
             k_scales=d.get("ks", b""), v_scales=d.get("vs", b""),
+            sum_algo=d.get("alg", ""),
+            k_sum=d.get("ksm", 0), v_sum=d.get("vsm", 0),
         )
 
 
@@ -199,15 +255,21 @@ class KvStreamFrame:
     seq: int  # frame ordinal within the stream (0-based)
     first_block: int  # sequence-block index of payload block 0
     payload: KvBlockPayload
+    # epoch-fencing stamp {"iid", "ep"} (runtime/fencing.py): decode-side
+    # clients drop frames from a fenced prefill worker's epoch
+    stamp: Optional[dict] = None
 
     def to_wire(self) -> dict[str, Any]:
-        return {
+        d = {
             "kind": "frame",
             "request_id": self.request_id,
             "seq": self.seq,
             "first_block": self.first_block,
             "payload": self.payload.to_wire(),
         }
+        if self.stamp:
+            d["stamp"] = self.stamp
+        return d
 
     @classmethod
     def from_wire(cls, d: dict[str, Any]) -> "KvStreamFrame":
@@ -216,6 +278,7 @@ class KvStreamFrame:
             seq=int(d.get("seq", 0)),
             first_block=int(d.get("first_block", 0)),
             payload=KvBlockPayload.from_wire(d["payload"]),
+            stamp=d.get("stamp"),
         )
 
 
@@ -290,6 +353,8 @@ class RemotePrefillResponse:
     first_top: Optional[list] = None  # [[token_id, logprob], ...]
     # completed telemetry spans from the prefill worker (trace assembly)
     trace: Optional[list] = None
+    # epoch-fencing stamp of the serving prefill worker
+    stamp: Optional[dict] = None
 
     def to_wire(self) -> dict[str, Any]:
         return {
@@ -303,6 +368,7 @@ class RemotePrefillResponse:
             "first_logprob": self.first_logprob,
             "first_top": self.first_top,
             "trace": self.trace,
+            "stamp": self.stamp,
         }
 
     @classmethod
@@ -319,4 +385,5 @@ class RemotePrefillResponse:
             first_logprob=d.get("first_logprob"),
             first_top=d.get("first_top"),
             trace=d.get("trace"),
+            stamp=d.get("stamp"),
         )

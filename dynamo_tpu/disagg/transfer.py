@@ -39,6 +39,7 @@ from typing import Any, Awaitable, Callable, Optional
 import msgpack
 import numpy as np
 
+from dynamo_tpu import integrity
 from dynamo_tpu.disagg.prefill_queue import PrefillQueue
 from dynamo_tpu.disagg.protocols import (
     KvBlockPayload,
@@ -47,8 +48,10 @@ from dynamo_tpu.disagg.protocols import (
     RemotePrefillResponse,
 )
 from dynamo_tpu.fabric.client import FabricClient
+from dynamo_tpu.runtime.backoff import Backoff
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry import trace as dtrace
+from dynamo_tpu.testing import faults
 
 logger = get_logger("dynamo_tpu.disagg.transfer")
 
@@ -112,11 +115,13 @@ class RemotePrefillClient:
         namespace: str,
         block_size: int = 16,
         timeout: float = 120.0,
+        fences: Optional[Any] = None,  # runtime.fencing.FenceRegistry
     ) -> None:
         self._fabric = fabric
         self.namespace = namespace
         self.block_size = block_size
         self.timeout = timeout
+        self.fences = fences
         self.queue = PrefillQueue(fabric, namespace)
         self.reply_subject = f"{namespace}.prefill_reply.{uuid.uuid4().hex[:12]}"
         self._pending: dict[str, asyncio.Future] = {}
@@ -144,6 +149,24 @@ class RemotePrefillClient:
                         frame = KvStreamFrame.from_wire(d)
                         self.stats.frames_rx += 1
                         self.stats.bytes_rx += frame.payload.wire_nbytes
+                        if self.fences is not None and self.fences.check_stamp(
+                            frame.stamp, "kv_stream"
+                        ):
+                            # zombie prefill worker: its epoch is fenced —
+                            # the dropped frame leaves a coverage hole the
+                            # streamed_blocks guard converts into a local
+                            # recompute instead of a silent KV hole
+                            continue
+                        try:
+                            # verify HERE, at land time, so a corrupt
+                            # frame never reaches the inject path; the
+                            # coverage guard then recomputes locally
+                            frame.payload.verify()
+                        except integrity.IntegrityError as e:
+                            integrity.COUNTERS.integrity_failure(
+                                "disagg_frame", str(e)
+                            )
+                            continue
                         handler = self._frame_handlers.get(frame.request_id)
                         if handler is not None:
                             await handler(frame)
@@ -154,6 +177,27 @@ class RemotePrefillClient:
                 except Exception as e:  # noqa: BLE001 — malformed wire data
                     logger.warning("bad prefill response dropped: %s", e)
                     continue
+                if self.fences is not None and self.fences.check_stamp(
+                    resp.stamp, "kv_stream"
+                ):
+                    # final frame from a fenced epoch: refuse it whole —
+                    # the requester falls back to a local prefill
+                    resp.payload = None
+                    resp.error = "prefill worker epoch is fenced"
+                    resp.code = "fenced"
+                elif resp.payload is not None:
+                    try:
+                        resp.payload.verify()
+                    except integrity.IntegrityError as e:
+                        integrity.COUNTERS.integrity_failure(
+                            "disagg_final", str(e)
+                        )
+                        # strip the corrupt payload and surface a
+                        # structured error: the engine falls back to a
+                        # local prefill instead of decoding garbage
+                        resp.payload = None
+                        resp.error = str(e)
+                        resp.code = "integrity"
                 if resp.trace:
                     # prefill worker shipped its spans on the final frame:
                     # fold them into this process's ring (they ride onward
@@ -296,12 +340,14 @@ class PrefillWorkerService:
         engine: Any,
         max_inflight: int = 2,
         frame_window: Optional[int] = None,
+        stamp: Optional[dict] = None,  # fencing (instance_id, epoch) stamp
     ) -> None:
         self._fabric = fabric
         self.namespace = namespace
         self.queue = PrefillQueue(fabric, namespace)
         self.engine = engine
         self.frame_window = frame_window or frame_window_from_env()
+        self.stamp = stamp
         self._sem = asyncio.Semaphore(max_inflight)
         self._task: Optional[asyncio.Task] = None
         self._inflight: set[asyncio.Task] = set()
@@ -337,10 +383,15 @@ class PrefillWorkerService:
         self._task = asyncio.get_running_loop().create_task(self._loop())
 
     async def _loop(self) -> None:
+        # shared retry policy: repeated dequeue failures back off with
+        # full jitter instead of the old flat 0.5 s hammer; any success
+        # resets the ladder
+        backoff = Backoff(base_s=0.2, cap_s=5.0)
         while not self._stopped.is_set():
             await self._sem.acquire()
             try:
                 got = await self.queue.dequeue(timeout=0.2)
+                backoff.reset()
             except asyncio.CancelledError:
                 self._sem.release()
                 raise
@@ -349,7 +400,7 @@ class PrefillWorkerService:
                 # fleet; log, back off, keep serving
                 logger.warning("prefill dequeue failed (%s); retrying", e)
                 self._sem.release()
-                await asyncio.sleep(0.5)
+                await backoff.sleep()
                 continue
             if got is None:
                 self._sem.release()
@@ -392,7 +443,18 @@ class PrefillWorkerService:
             await sem.acquire()
             self.stats.frames_inflight += 1
             self._bump_engine_stat("kv_frames_inflight", 1)
-            data = msgpack.packb(frame.to_wire(), use_bin_type=True)
+            if self.stamp is not None:
+                frame.stamp = self.stamp
+            wire_d = frame.to_wire()
+            if faults.active():
+                # corrupt_kv fault point: flip/truncate the payload bytes
+                # AFTER checksumming — the decode-side verify must catch it
+                inj = faults.get_injector()
+                if inj is not None:
+                    bad = inj.corrupt_bytes(wire_d["payload"]["k"])
+                    if bad is not None:
+                        wire_d["payload"]["k"] = bad
+            data = msgpack.packb(wire_d, use_bin_type=True)
 
             async def publish() -> None:
                 try:
@@ -488,9 +550,18 @@ class PrefillWorkerService:
                     )
                 if resp.payload is not None:
                     self.stats.bytes_tx += resp.payload.wire_nbytes
+                if self.stamp is not None:
+                    resp.stamp = self.stamp
+                wire_d = resp.to_wire()
+                if faults.active() and wire_d.get("payload"):
+                    inj = faults.get_injector()
+                    if inj is not None:
+                        bad = inj.corrupt_bytes(wire_d["payload"]["k"])
+                        if bad is not None:
+                            wire_d["payload"]["k"] = bad
                 await self._fabric.publish(
                     req.reply_subject,
-                    msgpack.packb(resp.to_wire(), use_bin_type=True),
+                    msgpack.packb(wire_d, use_bin_type=True),
                 )
             await self.queue.ack(msg_id)
             self.served += 1

@@ -14,7 +14,6 @@ import asyncio
 import contextlib
 import json
 import os
-import random
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Optional
 
@@ -28,6 +27,7 @@ from dynamo_tpu.protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
+from dynamo_tpu.runtime.backoff import full_jitter_delay
 from dynamo_tpu.runtime.component import Endpoint, NoInstancesError
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging import get_logger
@@ -96,6 +96,8 @@ class RemoteEngine:
         router: PushRouter,
         on_migration: Optional[Callable[[], None]] = None,
         cancel_token: Optional[Any] = None,
+        fences: Optional[Any] = None,  # runtime.fencing.FenceRegistry
+        on_fenced_reject: Optional[Callable[[], None]] = None,
     ) -> None:
         self.router = router
         self.on_migration = on_migration
@@ -103,6 +105,11 @@ class RemoteEngine:
         # is dying (fabric/lease loss), replays must abort IMMEDIATELY so
         # the structured error still reaches the client before teardown
         self.cancel_token = cancel_token
+        # epoch fencing: reply frames stamped with a fenced epoch (a
+        # partitioned zombie still streaming after the cluster declared it
+        # dead) are rejected and the request replays onto a live worker
+        self.fences = fences
+        self.on_fenced_reject = on_fenced_reject
         self.max_retries = int(os.environ.get("DYN_MIGRATION_MAX_RETRIES", "4"))
         self.backoff_base_s = float(
             os.environ.get("DYN_MIGRATION_BACKOFF_S", "0.05")
@@ -184,6 +191,27 @@ class RemoteEngine:
                                 )
                                 break
                             if item.data is not None:
+                                stamp = (
+                                    item.data.get("stamp")
+                                    if isinstance(item.data, dict)
+                                    else None
+                                )
+                                if (
+                                    self.fences is not None
+                                    and self.fences.check_stamp(
+                                        stamp, "dispatch"
+                                    )
+                                ):
+                                    # zombie worker: the cluster fenced its
+                                    # epoch — refuse the frame and migrate
+                                    failure = (
+                                        "worker epoch "
+                                        f"{stamp.get('ep', 0):x} is fenced"
+                                    )
+                                    if self.on_fenced_reject is not None:
+                                        with contextlib.suppress(Exception):
+                                            self.on_fenced_reject()
+                                    break
                                 out = LLMEngineOutput.from_dict(item.data)
                                 if out.trace:
                                     # worker shipped its completed spans on
@@ -272,12 +300,12 @@ class RemoteEngine:
                 )
                 if waiter is not None:
                     await waiter(2.0)
-            delay = (
-                self.backoff_base_s
-                * (2 ** (failures - 1))
-                * (0.5 + random.random())
+            # shared retry policy (runtime/backoff.py): exponential with
+            # FULL jitter off the consecutive-failure count (progress
+            # resets it above), capped at 2 s
+            await asyncio.sleep(
+                full_jitter_delay(failures, self.backoff_base_s, cap_s=2.0)
             )
-            await asyncio.sleep(min(delay, 2.0))
 
 
 class WorkerCapacityPoller:
@@ -489,12 +517,19 @@ class ModelWatcher:
             def on_migration() -> None:
                 self.metrics.request_migrations.labels(model_name).inc()
 
+        # epoch fencing: the frontend's registry of cluster-declared-dead
+        # epochs (fence/ tombstones) — dispatch frames from a fenced
+        # worker are refused and the stream migrates
+        fences = None
+        with contextlib.suppress(Exception):
+            fences = await self.drt.fences()
         execution = ModelExecution(
             mdc,
             RemoteEngine(
                 router,
                 on_migration=on_migration,
                 cancel_token=self.drt.token,
+                fences=fences,
             ),
             clear_fn=clear_fn,
         )

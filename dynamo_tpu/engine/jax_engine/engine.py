@@ -354,6 +354,7 @@ class JaxEngine:
         self._loop_task: Optional[asyncio.Task] = None
         self._wake = asyncio.Event()
         self._closed = False
+        self._fenced = False  # self-fenced on primary-lease loss
         self.stats = EngineStats(
             total_blocks=self.config.num_blocks - 1,
             total_slots=self.config.max_batch,
@@ -490,6 +491,14 @@ class JaxEngine:
     async def generate(
         self, request: PreprocessedRequest, context: Context
     ) -> AsyncIterator[LLMEngineOutput]:
+        if self._fenced:
+            yield LLMEngineOutput.final_error(
+                context.id, "admission",
+                "worker is fenced (primary lease lost); request must be "
+                "served elsewhere",
+                "worker_fenced",
+            )
+            return
         if self._closed:
             yield LLMEngineOutput.final_error(
                 context.id, "admission",
@@ -774,12 +783,23 @@ class JaxEngine:
                         (b.block_hash, b.position)
                         for b in seq.hash_seq.blocks[seq.offload_mark:ready]
                         if b.block_hash not in self.block_manager
+                        and not self.block_manager.is_quarantined(
+                            b.block_hash
+                        )
                     ],
                 )
                 seq.offload_mark = ready
         if not new or self.on_blocks_stored is None:
             seq.emitted_hashes = len(seq.hash_seq.blocks)
             return
+        # quarantined hashes are never re-offered for prefix reuse: a
+        # poison block must not re-enter the fleet's radix trees through
+        # a fresh store event
+        quarantined = (
+            self.block_manager.is_quarantined
+            if self.block_manager is not None
+            else (lambda h: False)
+        )
         events = [
             {
                 "block_hash": b.block_hash,
@@ -790,6 +810,7 @@ class JaxEngine:
                 else -1,
             }
             for b in new
+            if not quarantined(b.block_hash)
         ]
         seq.emitted_hashes = len(seq.hash_seq.blocks)
         self.on_blocks_stored(events)
@@ -2639,17 +2660,18 @@ class JaxEngine:
                         "preemptable sequence", "out_of_kv_blocks",
                     )
 
-    def _abort_all(self, cause: str) -> None:
-        """In-process crash injection (faults.abort_after_tokens): fail
-        every live sequence with a structured error, freeing slots + KV
-        blocks, exactly as the engine-loop crash path does — but keep
-        serving new requests (the chaos soak asserts conservation)."""
+    def _abort_all(self, cause: str, code: str = "injected_fault") -> None:
+        """In-process crash injection (faults.abort_after_tokens) and the
+        self-fence path: fail every live sequence with a structured error,
+        freeing slots + KV blocks, exactly as the engine-loop crash path
+        does — but keep serving new requests (the chaos soak asserts
+        conservation) unless the caller also closed the engine."""
         for seq in list(self.waiting):
             self.waiting.remove(seq)
             self._sp_close_all(seq)
             seq.out.put_nowait(
                 LLMEngineOutput.final_error(
-                    seq.ctx.id, "queue", cause, "injected_fault"
+                    seq.ctx.id, "queue", cause, code
                 )
             )
         for seq in list(self._admit_order):
@@ -2657,11 +2679,28 @@ class JaxEngine:
                 seq.ctx.kill()
                 seq.out.put_nowait(
                     LLMEngineOutput.final_error(
-                        seq.ctx.id, "remote_prefill", cause, "injected_fault"
+                        seq.ctx.id, "remote_prefill", cause, code
                     )
                 )
             else:
-                self._finish_error(seq, "decode", cause, "injected_fault")
+                self._finish_error(seq, "decode", cause, code)
+
+    def fence(self, reason: str) -> None:
+        """Worker self-fence (DistributedRuntime.on_fence): the primary
+        lease is gone, so the cluster has already declared this worker
+        dead and is migrating its streams. Take effect BETWEEN dispatches:
+        stop admitting, fail every lane with a structured `worker_fenced`
+        error (consumers replay onto a live worker), and never decode
+        another token — a partitioned zombie must not double-serve
+        alongside its replacement for the rest of the lease TTL."""
+        if self._fenced:
+            return
+        self._fenced = True
+        self._closed = True  # loop exits after the in-flight dispatch
+        logger.error("engine fenced: %s — failing all lanes", reason)
+        dtrace.event("worker_fenced", reason=reason)
+        self._abort_all(f"worker fenced: {reason}", code="worker_fenced")
+        self._wake.set()
 
     def _update_stats(self) -> None:
         self.stats.active_slots = sum(1 for s in self.slots if s is not None)

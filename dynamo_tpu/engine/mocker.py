@@ -251,6 +251,7 @@ class MockEngine:
         self.shed_brownout = 0
         self.brownout_level = 0
         self.spec_paused = False  # recorded for parity (mocker has no spec)
+        self.fenced = False  # self-fenced on primary-lease loss
         # streaming-disagg: prompts >= threshold ship to the prefill fleet
         self.remote_prefill_client = remote_prefill_client
         self.disagg_threshold = disagg_threshold or 2 * self.args.block_size
@@ -324,6 +325,14 @@ class MockEngine:
     ) -> AsyncIterator[LLMEngineOutput]:
         t_arrival = time.monotonic()
         ctx = context or Context()
+        if self.fenced:
+            yield LLMEngineOutput.final_error(
+                ctx.id, "admission",
+                "worker is fenced (primary lease lost); request must be "
+                "served elsewhere",
+                "worker_fenced",
+            )
+            return
         if ctx.expired() or ctx.ttft_expired():
             self.deadline_exceeded += 1
             yield LLMEngineOutput.final_error(
@@ -626,17 +635,19 @@ class MockEngine:
             for seq in list(self.active):
                 self._step_seq(seq)
 
-    def _abort_all(self, cause: str) -> None:
-        """Injected crash (faults.abort_after_tokens): fail every live
-        sequence with a structured error and release every cache ref —
-        the simulated twin of a worker process dying mid-stream."""
-        self.injected_aborts += 1
+    def _abort_all(self, cause: str, code: str = "injected_fault") -> None:
+        """Injected crash (faults.abort_after_tokens) or self-fence: fail
+        every live sequence with a structured error and release every
+        cache ref — the simulated twin of a worker process dying (or
+        being fenced) mid-stream."""
+        if code == "injected_fault":
+            self.injected_aborts += 1
         for seq in list(self.waiting):
             self.waiting.remove(seq)
             self._sp_close_all(seq)
             seq.out.put_nowait(
                 LLMEngineOutput.final_error(
-                    seq.context.id, "queue", cause, "injected_fault"
+                    seq.context.id, "queue", cause, code
                 )
             )
         for seq in list(self.active):
@@ -645,9 +656,22 @@ class MockEngine:
             self._sp_close_all(seq)
             seq.out.put_nowait(
                 LLMEngineOutput.final_error(
-                    seq.context.id, "decode", cause, "injected_fault"
+                    seq.context.id, "decode", cause, code
                 )
             )
+
+    def fence(self, reason: str) -> None:
+        """Worker self-fence (parity with JaxEngine.fence): the primary
+        lease is gone — stop admitting, fail every lane with a structured
+        `worker_fenced` error between simulated steps, and decode no more."""
+        if self.fenced:
+            return
+        self.fenced = True
+        dtrace.event("worker_fenced", reason=reason)
+        self._abort_all(f"worker fenced: {reason}", code="worker_fenced")
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            self._loop_task = None
 
     def _step_seq(self, seq: _MockSeq) -> None:
         if seq not in self.active:

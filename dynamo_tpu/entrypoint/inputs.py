@@ -36,8 +36,14 @@ def make_engine_handler(
     engine: Any,
     proc_label: Optional[str] = None,
     namespace: Any = None,
+    stamp: Optional[dict] = None,
 ):
     """Worker-side request handler hosting an engine on a dyn:// endpoint.
+
+    Every yielded frame carries the worker's epoch-fencing `stamp`
+    (`(instance_id, epoch)`, runtime/fencing.py): the frontend's
+    RemoteEngine rejects frames whose epoch the cluster has fenced, so a
+    partitioned zombie's tokens never reach a client stream.
 
     With tracing enabled, the serving scope runs under a `worker_generate`
     span on the worker's own process track, and the request's completed
@@ -53,7 +59,10 @@ def make_engine_handler(
         pre = PreprocessedRequest.from_dict(request)
         if not dtrace.enabled():
             async for out in engine.generate(pre, ctx):
-                yield out.to_dict()
+                d = out.to_dict()
+                if stamp is not None:
+                    d["stamp"] = stamp
+                yield d
             return
         label = proc_label or getattr(engine, "trace_proc", None)
         final_d: Optional[dict] = None
@@ -65,6 +74,8 @@ def make_engine_handler(
             ):
                 async for out in agen:
                     d = out.to_dict()
+                    if stamp is not None:
+                        d["stamp"] = stamp
                     if out.finish_reason is not None:
                         # hold the final frame until the worker span has
                         # closed, so the shipped export includes it
@@ -427,14 +438,35 @@ async def run_endpoint(
     worker_label = f"{eid.component}:{drt.primary_lease & 0xFFFFFF:x}"
     with contextlib.suppress(Exception):
         engine.trace_proc = worker_label
+    # epoch-fencing stamp: (instance_id, epoch) rides every reply frame
+    # so frontends can reject a fenced incarnation's tokens
+    from dynamo_tpu.runtime.fencing import make_stamp
+
+    stamp = make_stamp(drt.primary_lease, drt.fencing_epoch)
     handler = make_engine_handler(
-        engine, worker_label, namespace=endpoint.component.namespace
+        engine, worker_label, namespace=endpoint.component.namespace,
+        stamp=stamp,
     )
 
     if getattr(engine, "supports_images", False):
         config.mdc.extra["supports_images"] = True
     service = await endpoint.serve_endpoint(handler)
     await register_llm(drt, endpoint, config.mdc)
+
+    # self-fence: the moment a lease keepalive reports the lease gone
+    # (the cluster declared us dead — possibly seconds ago, during a
+    # partition), the engine fails every lane with a structured
+    # `worker_fenced` error BETWEEN dispatches and the worker leaves
+    # discovery — closing the up-to-TTL window where a zombie would
+    # double-serve alongside its migrated replacement.
+    if hasattr(engine, "fence"):
+        fence_loop = asyncio.get_running_loop()
+
+        def _on_fence(reason: str) -> None:
+            engine.fence(reason)
+            fence_loop.create_task(service.stop(drain=False))
+
+        drt.on_fence(_on_fence)
 
     # stuck-horizon watchdog: a tripped engine pulls this worker out of
     # discovery immediately (routers stop sending; leases would take a
@@ -488,7 +520,7 @@ async def run_endpoint(
         ).serve_endpoint(clear_handler)
 
     metrics_pub = WorkerMetricsPublisher(
-        endpoint.component, endpoint.id, service.instance_id
+        endpoint.component, endpoint.id, service.instance_id, stamp=stamp
     )
     stats_fn = getattr(engine, "stats", None)
 
@@ -537,6 +569,12 @@ async def run_endpoint(
         ph = d.get("phase_histograms")
         if ph is not None and not getattr(ph, "total_count", lambda: 0)():
             ph = None
+        # integrity plane: the process-wide counters (data-plane checksum
+        # failures, quarantines, fence-stamp rejects) ride WorkerStats to
+        # the aggregator and the metrics component
+        from dynamo_tpu.integrity import COUNTERS as _icounters
+
+        integ = _icounters.snapshot()
         return ForwardPassMetrics(
             worker_stats=WorkerStats(
                 request_active_slots=d.get("active_slots", 0),
@@ -550,6 +588,13 @@ async def run_endpoint(
                 num_preempted_too_often=d.get("preempted_too_often", 0),
                 num_shed_brownout=d.get("shed_brownout", 0),
                 brownout_level=d.get("brownout_level", 0),
+                integrity_failures_by_path=(
+                    integ["integrity_failures_by_path"] or None
+                ),
+                num_blocks_quarantined=integ["blocks_quarantined"],
+                fenced_rejects_by_plane=(
+                    integ["fenced_rejects_by_plane"] or None
+                ),
             ),
             kv_stats=KvStats(
                 kv_active_blocks=used,

@@ -314,6 +314,18 @@ class FabricClient:
             # probe connection) — never a reason to fail over; the
             # canceller owns the cleanup
             return
+        except wire.WireVersionError as e:
+            # version-skewed fabric peer: hunting other addresses would
+            # hit the same skew — fail fast and loudly with the
+            # structured mismatch so the operator sees the real cause
+            logger.error("fabric connection rejected: %s", e)
+            self._conn_ready.clear()
+            self._conn_lost = True
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(e)
+            self._pending.clear()
+            self._fail_streams()
         except (asyncio.IncompleteReadError, ConnectionError):
             self._conn_ready.clear()
             self._conn_lost = True
@@ -348,9 +360,16 @@ class FabricClient:
         were replicated), watches replayed level-consistently, pub/sub
         re-subscribed (messages during the gap are lost — core-NATS
         at-most-once semantics, same as the reference)."""
-        deadline = asyncio.get_event_loop().time() + self._failover_s
+        from dynamo_tpu.runtime.backoff import Backoff
+
         logger.warning(
             "fabric connection lost; failing over among %s", self._addrs
+        )
+        # shared retry policy (runtime/backoff.py): exp + full jitter from
+        # 100 ms up to 1 s, budgeted by the failover window — replaces the
+        # old flat 250 ms spin that synchronized every client's hunt
+        backoff = Backoff(
+            base_s=0.1, cap_s=1.0, budget_s=self._failover_s
         )
         while not self._closed:
             for a in self._addrs:
@@ -361,9 +380,8 @@ class FabricClient:
                     return
                 except (OSError, RuntimeError, ConnectionError):
                     continue
-            if asyncio.get_event_loop().time() >= deadline:
+            if not await backoff.sleep():
                 break
-            await asyncio.sleep(0.25)
         logger.error(
             "fabric failover FAILED after %.0fs; streams closed",
             self._failover_s,
@@ -448,6 +466,15 @@ class FabricClient:
         return await self._call("lease_grant", ttl=ttl)
 
     async def lease_keepalive(self, lease_id: int) -> bool:
+        from dynamo_tpu.testing import faults
+
+        if faults.active():
+            inj = faults.get_injector()
+            if inj is not None and inj.keepalive_swallowed():
+                # zombie_partition fault: the refresh is silently lost.
+                # Returning True keeps the worker oblivious while the
+                # fabric's janitor expires the lease and fences the epoch.
+                return True
         if self._state is not None:
             return self._state.lease_keepalive(lease_id)
         return await self._call("lease_keepalive", lease_id=lease_id)

@@ -296,6 +296,12 @@ class FabricServer:
                 try:
                     msg = await wire.read_frame(reader)
                     req_id, op, kwargs = msg
+                except wire.WireVersionError as e:
+                    # version-skewed peer: fail loudly with the structured
+                    # mismatch (rolling upgrade caught at handshake) rather
+                    # than mis-parsing its framing as garbage lengths
+                    logger.error("rejecting version-skewed peer: %s", e)
+                    break
                 except (
                     asyncio.IncompleteReadError,
                     ConnectionResetError,

@@ -202,8 +202,8 @@ class FabricState:
                 for lease in [
                     l for l in self.leases.values() if l.deadline < now
                 ]:
-                    logger.info("lease %d expired; revoking", lease.id)
-                    self.lease_revoke(lease.id)
+                    logger.info("lease %d expired; fencing + revoking", lease.id)
+                    self.lease_expire(lease.id)
                 for q in self.queues.values():
                     expired = [
                         mid
@@ -242,6 +242,22 @@ class FabricState:
             return
         for key in list(lease.keys):
             self._delete_key(key)
+
+    @_replicated
+    def lease_expire(self, lease_id: int) -> None:
+        """Expiry (as opposed to graceful revoke) is the cluster's
+        declaration that the holder is DEAD: write a permanent fencing
+        tombstone under ``fence/{lease:x}`` before revoking, so every
+        consumer watching the fence prefix rejects data-plane frames the
+        (possibly partitioned, still-running) holder keeps emitting —
+        the role etcd lease fencing plays for the reference
+        (transports/etcd.rs:51-166). Tombstones are unleased and never
+        deleted: un-fencing an epoch would reopen the zombie window."""
+        from dynamo_tpu.runtime.fencing import fence_key
+
+        if lease_id in self.leases:
+            self.kv_put(fence_key(lease_id), b"lease_expired")
+        self.lease_revoke(lease_id)
 
     # ----------------------------------------------------------------- kv
 
@@ -541,6 +557,8 @@ class FabricState:
             self.lease_keepalive(a["lease_id"])
         elif op == "lease_revoke":
             self.lease_revoke(a["lease_id"])
+        elif op == "lease_expire":
+            self.lease_expire(a["lease_id"])
         elif op == "kv_put":
             # pin the revision so replica mod_revs match the primary's
             self.revision = result - 1

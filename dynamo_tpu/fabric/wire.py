@@ -1,9 +1,15 @@
 """Framed msgpack wire protocol shared by the fabric server and client.
 
-Frame = 4-byte big-endian length || msgpack body.
+Frame = 1-byte protocol version || 4-byte big-endian length || msgpack body.
 Request  body: [req_id, op, kwargs]
 Response body: [req_id, "ok", result] | [req_id, "err", message]
 Push     body: [0, "push", stream_id, payload]   (watch events / sub messages)
+
+The version byte is checked on every frame read (the first read on a fresh
+connection is the de-facto handshake): a rolling upgrade that skews fabric
+peers fails LOUDLY with a structured `WireVersionError` naming both
+versions, instead of mis-parsing the other side's framing into garbage
+lengths and msgpack noise.
 """
 
 from __future__ import annotations
@@ -14,18 +20,43 @@ from typing import Any
 
 import msgpack
 
+# Bump on any framing/body change. v1 was the unversioned 4-byte-length
+# framing; v2 added this leading version byte.
+WIRE_VERSION = 2
+
 MAX_FRAME = 512 * 1024 * 1024  # object store payloads (model cards) can be big
 _LEN = struct.Struct(">I")
 
 
-def pack(msg: Any) -> bytes:
+class WireVersionError(ConnectionError):
+    """Peer speaks a different fabric wire protocol version.
+
+    Subclasses ConnectionError so transport plumbing treats it as a dead
+    connection, but carries the structured versions so operators see a
+    friendly upgrade-skew message rather than a framing parse error."""
+
+    def __init__(self, got: int, want: int = WIRE_VERSION) -> None:
+        self.got = got
+        self.want = want
+        super().__init__(
+            f"fabric wire protocol mismatch: peer speaks v{got}, this "
+            f"build speaks v{want} — fabric server and clients must be "
+            f"upgraded/downgraded together (rolling upgrades of the "
+            f"serving fleet are fine; the fabric plane is not skew-safe)"
+        )
+
+
+def pack(msg: Any, version: int = WIRE_VERSION) -> bytes:
     body = msgpack.packb(msg, use_bin_type=True)
-    return _LEN.pack(len(body)) + body
+    return bytes([version]) + _LEN.pack(len(body)) + body
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Any:
-    header = await reader.readexactly(4)
-    (length,) = _LEN.unpack(header)
+    header = await reader.readexactly(5)
+    version = header[0]
+    if version != WIRE_VERSION:
+        raise WireVersionError(version)
+    (length,) = _LEN.unpack(header[1:])
     if length > MAX_FRAME:
         raise ValueError(f"frame too large: {length}")
     body = await reader.readexactly(length)

@@ -35,9 +35,13 @@ async def _build_engine():
 class PrefillWorker:
     async def serve(self, runtime) -> None:
         from dynamo_tpu.disagg.transfer import PrefillWorkerService
+        from dynamo_tpu.runtime.fencing import make_stamp
 
         engine, _mdc = await _build_engine()
-        svc = PrefillWorkerService(runtime.fabric, _ns(), engine)
+        svc = PrefillWorkerService(
+            runtime.fabric, _ns(), engine,
+            stamp=make_stamp(runtime.primary_lease, runtime.fencing_epoch),
+        )
         await svc.start()
         try:
             await runtime.token.cancelled()  # exits on fabric loss too
@@ -59,6 +63,7 @@ class DecodeWorker:
             runtime.fabric, _ns(),
             block_size=engine.config.block_size,
             timeout=float(os.environ.get("DYN_PREFILL_TIMEOUT_S", "30")),
+            fences=await runtime.fences(),
         )
         await client.start()
         router = DisaggregatedRouter(

@@ -246,6 +246,62 @@ class ServiceMetrics:
 
         self.registry.register(_QosCollector())
 
+    def attach_integrity(self, counters_src) -> None:
+        """Surface the process-wide integrity/fence counters
+        (dynamo_tpu.integrity.COUNTERS) on this registry: KV payloads that
+        failed their content checksum per data-plane path, poison blocks
+        quarantined, and epoch-fencing stamp rejects per plane (for a
+        frontend that's chiefly the `dispatch` plane — a zombie worker's
+        frames refused mid-stream). Scrape-time counter families; same
+        names the metrics component exports for the fabric-scraped fleet."""
+        if getattr(self, "_integrity_attached", False):
+            return
+        self._integrity_attached = True
+
+        def read() -> dict:
+            c = counters_src() if callable(counters_src) else counters_src
+            if hasattr(c, "snapshot"):
+                return c.snapshot()
+            return c if isinstance(c, dict) else {}
+
+        class _IntegrityCollector:
+            def describe(self):
+                return []
+
+            def collect(self):
+                d = read()
+                fam = CounterMetricFamily(
+                    "dyn_llm_kv_integrity_failures",
+                    "KV payloads that failed their content checksum, by "
+                    "data-plane path",
+                    labels=["path"],
+                )
+                for path, v in sorted(
+                    (d.get("integrity_failures_by_path") or {}).items()
+                ):
+                    fam.add_metric([str(path)], float(v))
+                yield fam
+                yield CounterMetricFamily(
+                    "dyn_llm_blocks_quarantined",
+                    "KV blocks quarantined after repeated integrity "
+                    "failures (never re-offered for prefix reuse)",
+                    value=float(d.get("blocks_quarantined", 0) or 0),
+                )
+                fam = CounterMetricFamily(
+                    "dyn_llm_fenced_rejects",
+                    "Frames/adverts/publishes rejected because their "
+                    "epoch-fencing stamp names a dead worker incarnation, "
+                    "by plane",
+                    labels=["plane"],
+                )
+                for plane, v in sorted(
+                    (d.get("fenced_rejects_by_plane") or {}).items()
+                ):
+                    fam.add_metric([str(plane)], float(v))
+                yield fam
+
+        self.registry.register(_IntegrityCollector())
+
     def attach_brownout(self, controller) -> None:
         """Surface the brownout ladder on /metrics: the live rung as a
         gauge (0 ok .. 4 shed_standard) and the transition count as a real

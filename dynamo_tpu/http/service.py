@@ -552,6 +552,11 @@ class HttpService:
         self.host = host
         self.port = port
         self.metrics = metrics or ServiceMetrics()
+        # integrity/fence counters (process-wide): a frontend's share is
+        # chiefly dispatch-plane fenced rejects from zombie workers
+        from dynamo_tpu.integrity import COUNTERS as _icounters
+
+        self.metrics.attach_integrity(_icounters)
         self.template = template
         self.admission = admission or AdmissionController(self.metrics)
         self._draining = False

@@ -1,0 +1,148 @@
+"""Content-integrity plane: fast checksums over every KV byte at rest and
+in flight, plus the process-wide failure/quarantine/fence-reject counters.
+
+The reference gets data-plane integrity for free from battle-tested
+infrastructure (NIXL/UCX checksummed transports, etcd lease fencing);
+our fabric/wire stack is homegrown, so a flipped bit in an int8 frame or
+a torn G3 disk page would otherwise decode silently into a user-visible
+token stream. Every KV payload container (disagg `KvStreamFrame`s, peer
+G4 pulls, G2 host arenas, G3 spill pages) now carries a self-describing
+checksum header computed here and verified at land/promote time:
+
+  * a corrupt disagg frame is dropped, so the lost-frame coverage guard
+    (`streamed_blocks`) triggers the recompute-local fallback;
+  * a corrupt tier page fails promotion and the prefix recomputes;
+  * a block that fails verification repeatedly is quarantined — never
+    re-offered for prefix reuse, counted, freed exactly once.
+
+`checksum()` is xxh3-64 when the xxhash wheel is present (GB/s-class),
+else BLAKE2b-8 from the stdlib — the algorithm tag travels with the
+payload so mixed fleets verify what they can and skip what they can't.
+
+`COUNTERS` is the process-wide sink every layer bumps (data-plane
+verifiers, the tier manager's quarantine path, fence-stamp rejects); the
+worker host snapshots it into `WorkerStats` so the counts ride the
+load-metrics plane to the aggregator and the metrics component
+(`dyn_llm_kv_integrity_failures_total{path}`,
+`dyn_llm_blocks_quarantined_total`,
+`dyn_llm_fenced_rejects_total{plane}`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import trace as dtrace
+
+logger = get_logger("dynamo_tpu.integrity")
+
+try:  # GB/s-class non-cryptographic hash when the wheel is around
+    import xxhash as _xxhash
+
+    ALGO = "xxh3"
+except ImportError:  # pragma: no cover - container always ships xxhash
+    _xxhash = None
+    ALGO = "b2b8"
+
+
+class IntegrityError(Exception):
+    """A KV payload failed its content checksum (bit flip, torn page,
+    truncated frame). Callers drop/refuse the data and recompute."""
+
+    def __init__(self, message: str, path: str = "") -> None:
+        super().__init__(message)
+        self.path = path
+
+
+def enabled() -> bool:
+    """Checksumming knob: DYN_KV_CHECKSUM=0 disables computing checksums
+    on the send/store side (receivers verify whatever arrives tagged)."""
+    return os.environ.get("DYN_KV_CHECKSUM", "1") not in ("0", "false", "no")
+
+
+def checksum(*chunks: bytes) -> int:
+    """64-bit content checksum over the concatenation of `chunks`
+    (memoryviews welcome — nothing is copied)."""
+    if _xxhash is not None:
+        h = _xxhash.xxh3_64()
+        for c in chunks:
+            h.update(c)
+        return h.intdigest()
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=8)
+    for c in chunks:
+        h.update(c)
+    return int.from_bytes(h.digest(), "big")
+
+
+def checksum_with(algo: str, *chunks: bytes) -> Optional[int]:
+    """Checksum using a specific algorithm tag; None when this build
+    can't compute `algo` (mixed-fleet forward compatibility: skip
+    verification rather than false-alarm)."""
+    if algo == ALGO:
+        return checksum(*chunks)
+    if algo == "b2b8":
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=8)
+        for c in chunks:
+            h.update(c)
+        return int.from_bytes(h.digest(), "big")
+    if algo == "xxh3" and _xxhash is not None:
+        h = _xxhash.xxh3_64()
+        for c in chunks:
+            h.update(c)
+        return h.intdigest()
+    return None
+
+
+class IntegrityCounters:
+    """Process-wide integrity/fence counters (all monotonic). One
+    instance per process (`COUNTERS`); the worker host snapshots it into
+    WorkerStats, the frontend exports it via ServiceMetrics."""
+
+    def __init__(self) -> None:
+        self.failures: dict[str, int] = {}
+        self.blocks_quarantined = 0
+        self.fenced_rejects: dict[str, int] = {}
+
+    def integrity_failure(self, path: str, detail: str = "") -> None:
+        """One payload failed verification on `path` (disagg_frame,
+        disagg_final, peer_pull, tier_host, tier_disk)."""
+        self.failures[path] = self.failures.get(path, 0) + 1
+        logger.warning(
+            "KV integrity failure on %s%s", path,
+            f": {detail}" if detail else "",
+        )
+        dtrace.event("integrity_failure", path=path, detail=detail or None)
+
+    def quarantine(self, n: int = 1) -> None:
+        self.blocks_quarantined += n
+
+    def fenced_reject(self, plane: str, epoch: int = 0) -> None:
+        """A frame/advert/publish stamped with a fenced epoch was
+        rejected on `plane` (dispatch, kv_stream, peer, metrics)."""
+        self.fenced_rejects[plane] = self.fenced_rejects.get(plane, 0) + 1
+        dtrace.event(
+            "fenced_reject", plane=plane,
+            epoch=f"{epoch:x}" if epoch else None,
+        )
+
+    def snapshot(self) -> dict:
+        return {
+            "integrity_failures_by_path": dict(self.failures),
+            "blocks_quarantined": self.blocks_quarantined,
+            "fenced_rejects_by_plane": dict(self.fenced_rejects),
+        }
+
+    def reset(self) -> None:
+        """Test hook: zero every counter."""
+        self.failures.clear()
+        self.blocks_quarantined = 0
+        self.fenced_rejects.clear()
+
+
+COUNTERS = IntegrityCounters()

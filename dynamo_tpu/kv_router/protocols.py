@@ -129,6 +129,14 @@ class WorkerStats:
     num_preempted_too_often: int = 0
     num_shed_brownout: int = 0
     brownout_level: int = 0
+    # integrity plane (ISSUE 8): KV payloads that failed their content
+    # checksum per data-plane path (disagg_frame / disagg_final /
+    # peer_pull / tier_host / tier_disk), poison blocks quarantined, and
+    # epoch-fencing stamp rejects per plane (dispatch / kv_stream / peer /
+    # metrics) — all monotonic over the worker's lifetime
+    integrity_failures_by_path: Optional[dict[str, int]] = None
+    num_blocks_quarantined: int = 0
+    fenced_rejects_by_plane: Optional[dict[str, int]] = None
 
 
 @dataclass

@@ -122,11 +122,13 @@ class WorkerMetricsPublisher:
         endpoint: EndpointId,
         instance_id: int,
         interval_s: float = 1.0,
+        stamp: Optional[dict] = None,  # fencing (instance_id, epoch) stamp
     ) -> None:
         self.component = component
         self.endpoint = endpoint
         self.instance_id = instance_id
         self.interval_s = interval_s
+        self.stamp = stamp
         self._task: Optional[asyncio.Task] = None
         self._latest: Optional[ForwardPassMetrics] = None
 
@@ -146,9 +148,15 @@ class WorkerMetricsPublisher:
                 m = metrics_fn() if metrics_fn is not None else self._latest
                 if m is not None:
                     with contextlib.suppress(Exception):
+                        d = m.to_dict()
+                        if self.stamp is not None:
+                            # epoch stamp: aggregators drop publishes from
+                            # a fenced incarnation (the key is lease-bound,
+                            # but a zombie may republish before noticing)
+                            d["stamp"] = self.stamp
                         await drt.fabric.kv_put(
                             key,
-                            msgpack.packb(m.to_dict(), use_bin_type=True),
+                            msgpack.packb(d, use_bin_type=True),
                             lease_id=drt.primary_lease,
                         )
                 await asyncio.sleep(self.interval_s)
@@ -170,6 +178,18 @@ class KvMetricsAggregator:
     def __init__(self, component: Component, endpoint: EndpointId) -> None:
         self.component = component
         self.endpoint = endpoint
+        self._fences = None
+
+    async def _fence_registry(self):
+        if self._fences is None:
+            drt = getattr(self.component, "drt", None)
+            fences_fn = getattr(drt, "fences", None)
+            if fences_fn is not None:
+                try:
+                    self._fences = await fences_fn()
+                except Exception:  # noqa: BLE001 — fencing is best-effort
+                    pass
+        return self._fences
 
     async def collect(self) -> dict[int, ForwardPassMetrics]:
         prefix = (
@@ -177,13 +197,19 @@ class KvMetricsAggregator:
             f"{self.endpoint.component}/{self.endpoint.name}:"
         )
         raw = await self.component.drt.fabric.kv_get_prefix(prefix)
+        fences = await self._fence_registry()
         out: dict[int, ForwardPassMetrics] = {}
         for key, value in raw.items():
             try:
                 instance_id = int(key.rsplit(":", 1)[1], 16)
-                out[instance_id] = ForwardPassMetrics.from_dict(
-                    msgpack.unpackb(value, raw=False)
-                )
+                d = msgpack.unpackb(value, raw=False)
+                if fences is not None and fences.check_stamp(
+                    d.get("stamp"), "metrics"
+                ):
+                    # load metrics published by a fenced incarnation:
+                    # scoring a zombie's slots would route work at it
+                    continue
+                out[instance_id] = ForwardPassMetrics.from_dict(d)
             except Exception:
                 logger.exception("bad stats entry at %s", key)
         return out
@@ -236,6 +262,27 @@ class KvMetricsAggregator:
                 for cls, v in m.worker_stats.preemptions_by_class.items():
                     agg.worker_stats.preemptions_by_class[cls] = (
                         agg.worker_stats.preemptions_by_class.get(cls, 0) + v
+                    )
+            # integrity plane: per-path/plane dict counters merge by key
+            # addition, quarantine count is a fleet sum
+            agg.worker_stats.num_blocks_quarantined += (
+                m.worker_stats.num_blocks_quarantined
+            )
+            if m.worker_stats.integrity_failures_by_path:
+                if agg.worker_stats.integrity_failures_by_path is None:
+                    agg.worker_stats.integrity_failures_by_path = {}
+                for p, v in m.worker_stats.integrity_failures_by_path.items():
+                    agg.worker_stats.integrity_failures_by_path[p] = (
+                        agg.worker_stats.integrity_failures_by_path.get(p, 0)
+                        + v
+                    )
+            if m.worker_stats.fenced_rejects_by_plane:
+                if agg.worker_stats.fenced_rejects_by_plane is None:
+                    agg.worker_stats.fenced_rejects_by_plane = {}
+                for p, v in m.worker_stats.fenced_rejects_by_plane.items():
+                    agg.worker_stats.fenced_rejects_by_plane[p] = (
+                        agg.worker_stats.fenced_rejects_by_plane.get(p, 0)
+                        + v
                     )
             agg.kv_stats.kv_active_blocks += m.kv_stats.kv_active_blocks
             agg.kv_stats.kv_total_blocks += m.kv_stats.kv_total_blocks

@@ -22,6 +22,7 @@ from dynamo_tpu.runtime.config import RuntimeConfig
 
 if TYPE_CHECKING:
     from dynamo_tpu.runtime.component import Namespace
+    from dynamo_tpu.runtime.fencing import FenceRegistry
 
 logger = dlog.get_logger("dynamo_tpu.runtime")
 
@@ -48,11 +49,57 @@ class DistributedRuntime:
         # endpoint workers) register async callbacks run on SIGTERM —
         # stop admission, finish in-flight work, deregister from discovery
         self._drain_cbs: list[Callable] = []
+        # self-fence registry: fired (once, synchronously) the moment the
+        # primary lease is discovered lost — BEFORE the whole-process
+        # cancel — so engines can fail their lanes with a structured
+        # `worker_fenced` error between dispatches instead of their
+        # consumers watching streams die with the teardown
+        self.fenced = False
+        self._fence_cbs: list[Callable] = []
+        self._fences: Optional["FenceRegistry"] = None
 
     def on_drain(self, cb: Callable) -> None:
         """Register an async zero-arg drain callback (run once, in
         registration order, bounded by the caller's drain timeout)."""
         self._drain_cbs.append(cb)
+
+    # ---------------------------------------------------------- fencing
+
+    @property
+    def fencing_epoch(self) -> int:
+        """This process incarnation's fencing epoch: derived from the
+        primary lease, so the cluster-side death certificate (the
+        ``fence/{epoch:x}`` tombstone the fabric writes on lease EXPIRY)
+        names exactly this incarnation. Stamped onto every worker-
+        originated frame (runtime/fencing.py)."""
+        return self.primary_lease
+
+    def on_fence(self, cb: Callable[[str], None]) -> None:
+        """Register a sync callback fired once when this runtime
+        discovers its primary lease is gone (worker self-fence).
+        `cb(reason)` runs BEFORE the root token is cancelled."""
+        self._fence_cbs.append(cb)
+
+    def _fire_fence(self, reason: str) -> None:
+        if self.fenced:
+            return
+        self.fenced = True
+        cbs, self._fence_cbs = self._fence_cbs, []
+        for cb in cbs:
+            try:
+                cb(reason)
+            except Exception:  # noqa: BLE001 — fencing must not be stopped
+                logger.exception("fence callback failed")
+
+    async def fences(self) -> "FenceRegistry":
+        """The runtime's fenced-epoch registry (lazily started watch over
+        the fabric's ``fence/`` tombstones)."""
+        from dynamo_tpu.runtime.fencing import FenceRegistry
+
+        if self._fences is None:
+            self._fences = FenceRegistry(self.fabric)
+        await self._fences.start()
+        return self._fences
 
     async def drain(self, timeout_s: float = 10.0) -> None:
         """Run every registered drain callback, each bounded by the
@@ -132,9 +179,23 @@ class DistributedRuntime:
                     except ConnectionError:
                         alive = False
                 if not alive:
+                    # self-fence FIRST (sync: engines fail lanes with a
+                    # structured worker_fenced between dispatches), then
+                    # best-effort write our own death certificate (the
+                    # fabric may be reachable even though the LEASE died —
+                    # e.g. a partition that healed after expiry), then the
+                    # whole-process cancel as before
                     logger.error(
-                        "primary lease %d lost; cancelling runtime", lease_id
+                        "primary lease %d lost; self-fencing + cancelling "
+                        "runtime", lease_id,
                     )
+                    self._fire_fence(f"primary lease {lease_id:x} lost")
+                    from dynamo_tpu.runtime.fencing import fence_key
+
+                    with contextlib.suppress(Exception):
+                        await self.fabric.kv_put(
+                            fence_key(lease_id), b"self_fenced"
+                        )
                     self.token.cancel()
                     return
         except asyncio.CancelledError:
@@ -165,6 +226,9 @@ class DistributedRuntime:
             return
         self._closed = True
         self.token.cancel()
+        if self._fences is not None:
+            await self._fences.close()
+            self._fences = None
         if self._keepalive_task:
             self._keepalive_task.cancel()
             with contextlib.suppress(asyncio.CancelledError):

@@ -14,6 +14,21 @@ Spec grammar — comma-separated ``key=value`` actions::
     DYN_FAULT="stall_transfer=1.5"          # sleep S in KV-transfer paths
     DYN_FAULT="drop_fabric_conn=3"          # drop the fabric conn once,
                                             # after N publishes
+    DYN_FAULT="corrupt_kv=bits"             # flip one bit in KV payloads
+    DYN_FAULT="corrupt_kv=truncate,every=3" # truncate every 3rd payload
+    DYN_FAULT="zombie_partition=2"          # swallow lease keepalives for
+                                            # S seconds (the worker keeps
+                                            # serving while the cluster
+                                            # expires its lease — a zombie)
+
+``corrupt_kv`` fires at every KV data-plane store/ship point (disagg
+stream frames, peer-pull replies, offload arenas, disk spill pages) —
+AFTER the integrity checksum was computed, so verification at land/
+promote time must catch it. ``zombie_partition`` simulates a network
+partition at the worker: keepalives are silently swallowed (the fabric
+never sees them, the worker believes them delivered) for S seconds;
+when the window ends the next keepalive reaches the fabric, reports the
+lease dead, and the runtime's self-fence hook fires.
 
 ``kill_after_tokens`` is the real-process fault (the worker dies exactly as
 a crashed decode worker would, mid-stream); ``abort_after_tokens`` is its
@@ -45,9 +60,11 @@ class FaultSpec:
     kill_after_tokens: int = 0  # 0 = off
     abort_after_tokens: int = 0
     delay_dispatch_s: float = 0.0
-    every: int = 1  # apply delay_dispatch on every Nth dispatch
+    every: int = 1  # apply delay_dispatch/corrupt_kv on every Nth visit
     stall_transfer_s: float = 0.0
     drop_fabric_conn: int = 0  # drop once, after N publishes (0 = off)
+    corrupt_kv: str = ""  # "" = off | "bits" | "truncate"
+    zombie_partition_s: float = 0.0  # swallow keepalives for S seconds
 
     @classmethod
     def parse(cls, spec: str) -> "FaultSpec":
@@ -71,6 +88,14 @@ class FaultSpec:
                 out.stall_transfer_s = float(val)
             elif key == "drop_fabric_conn":
                 out.drop_fabric_conn = int(val)
+            elif key == "corrupt_kv":
+                if val not in ("bits", "truncate"):
+                    raise ValueError(
+                        f"corrupt_kv mode must be bits|truncate, got {val!r}"
+                    )
+                out.corrupt_kv = val
+            elif key == "zombie_partition":
+                out.zombie_partition_s = float(val)
             else:
                 raise ValueError(f"unknown DYN_FAULT action {key!r}")
         return out
@@ -85,6 +110,8 @@ class FaultInjector:
         self.dispatches = 0
         self.publishes = 0
         self.fabric_dropped = False
+        self.kv_payloads = 0  # corrupt_kv fault-point visits
+        self._zombie_t0: Optional[float] = None  # partition window start
         # observability for chaos tests
         self.fired: dict[str, int] = {}
 
@@ -124,6 +151,88 @@ class FaultInjector:
         if s:
             self._mark("stall_transfer")
             await asyncio.sleep(s)
+
+    def corrupt_bytes(self, data: bytes) -> Optional[bytes]:
+        """KV payload corruption fault point (data-plane ship/store sites
+        call this AFTER checksums are computed). Returns the corrupted
+        copy when the fault fires, else None (ship the original)."""
+        mode = self.spec.corrupt_kv
+        if not mode or not data:
+            return None
+        self.kv_payloads += 1
+        if self.kv_payloads % self.spec.every:
+            return None
+        self._mark("corrupt_kv")
+        if mode == "truncate":
+            return data[: len(data) // 2]
+        # deterministic single-bit flip (position walks with the counter
+        # so repeated frames don't all corrupt the same byte)
+        b = bytearray(data)
+        idx = (self.kv_payloads * 2654435761) % len(b)
+        b[idx] ^= 1 << (self.kv_payloads % 8)
+        return bytes(b)
+
+    def corrupt_array(self, arr) -> bool:
+        """In-place corruption of a stored numpy block (offload arenas);
+        True when the fault fired."""
+        if not self.spec.corrupt_kv:
+            return False
+        import numpy as np
+
+        flat = arr.reshape(-1).view(np.uint8)
+        if flat.size == 0:
+            return False
+        self.kv_payloads += 1
+        if self.kv_payloads % self.spec.every:
+            return False
+        self._mark("corrupt_kv")
+        idx = (self.kv_payloads * 2654435761) % flat.size
+        flat[idx] ^= 1 << (self.kv_payloads % 8)
+        return True
+
+    def corrupt_file(self, path: str) -> bool:
+        """Tear a just-spilled G3 disk page; True when the fault fired."""
+        if not self.spec.corrupt_kv:
+            return False
+        self.kv_payloads += 1
+        if self.kv_payloads % self.spec.every:
+            return False
+        self._mark("corrupt_kv")
+        try:
+            if self.spec.corrupt_kv == "truncate":
+                size = os.path.getsize(path)
+                with open(path, "r+b") as f:
+                    f.truncate(max(0, size // 2))
+            else:
+                with open(path, "r+b") as f:
+                    f.seek((self.kv_payloads * 2654435761)
+                           % max(1, os.path.getsize(path)))
+                    byte = f.read(1) or b"\x00"
+                    f.seek(-1 if byte else 0, os.SEEK_CUR)
+                    f.write(bytes([byte[0] ^ (1 << (self.kv_payloads % 8))]))
+        except OSError:
+            return False
+        return True
+
+    def keepalive_swallowed(self) -> bool:
+        """Lease-keepalive fault point (fabric client). True while the
+        zombie-partition window is open: the keepalive must be silently
+        dropped — the fabric never refreshes the lease, the worker
+        believes it delivered — so the cluster declares the worker dead
+        while it keeps serving. After S seconds the partition 'heals':
+        keepalives reach the fabric again and report the lease gone,
+        firing the runtime's self-fence."""
+        s = self.spec.zombie_partition_s
+        if not s:
+            return False
+        import time
+
+        if self._zombie_t0 is None:
+            self._zombie_t0 = time.monotonic()
+        if time.monotonic() - self._zombie_t0 < s:
+            self._mark("zombie_partition")
+            return True
+        return False
 
     def should_drop_fabric(self) -> bool:
         """Fabric client calls this per publish; True at most once."""

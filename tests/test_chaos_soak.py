@@ -12,7 +12,12 @@ import time
 
 import pytest
 
-from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu import integrity
+from dynamo_tpu.engine.mocker import (
+    MockEngine,
+    MockEngineArgs,
+    MockPrefillEngine,
+)
 from dynamo_tpu.pipeline.context import Context
 from dynamo_tpu.protocols.common import (
     PreprocessedRequest,
@@ -185,3 +190,169 @@ async def test_mocker_chaos_mixed_priority_wave():
     cached = len(engine.cache.refs)
     assert engine.cache.free_blocks + cached == engine.args.num_blocks
     await engine.close()
+
+
+async def test_chaos_corruption_waves_zero_divergence():
+    """ISSUE 8 satellite: randomized corrupt_kv waves on the streaming
+    disagg data plane, alongside dispatch-delay churn. Invariants: ZERO
+    token-stream divergence under the mocker's deterministic (greedy-
+    equivalent) sampling, zero corrupt frames ever landed by decode (the
+    land counter only moves for verified frames), zero stuck streams,
+    and conserved KV blocks."""
+    from dynamo_tpu.disagg.transfer import (
+        PrefillWorkerService,
+        RemotePrefillClient,
+    )
+    from dynamo_tpu.fabric.client import FabricClient
+    from dynamo_tpu.fabric.state import FabricState
+
+    rng = random.Random(20260804)
+    fabric = FabricClient.in_process(FabricState())
+    ns = "chaos-corrupt"
+    BS = 4
+    prefill = MockPrefillEngine(
+        MockEngineArgs(block_size=BS, speedup_ratio=1000.0), chunk_blocks=1
+    )
+    service = PrefillWorkerService(fabric, ns, prefill)
+    client = RemotePrefillClient(fabric, ns, block_size=BS, timeout=20)
+    engine = MockEngine(
+        MockEngineArgs(
+            num_blocks=96, block_size=BS, max_batch=8, speedup_ratio=500.0
+        ),
+        remote_prefill_client=client,
+        disagg_threshold=2 * BS,
+    )
+    await service.start()
+    await client.start()
+    integrity.COUNTERS.reset()
+    outcomes = {"ok": 0, "error": 0, "diverged": 0}
+
+    async def one(i: int) -> None:
+        n = rng.randint(2, 32)
+        prompt = [rng.randint(1, 63) for _ in range(n)]
+        max_tokens = rng.randint(1, 24)
+        # the mocker's deterministic cycle is the gold stream: any
+        # corrupt block reaching decode would break it
+        expected = [prompt[j % n] for j in range(max_tokens)]
+        got = []
+        async for out in engine.generate(_req(prompt, max_tokens), Context()):
+            got.extend(out.token_ids)
+            if out.finish_reason is not None:
+                if out.error is not None:
+                    outcomes["error"] += 1
+                elif got != expected:
+                    outcomes["diverged"] += 1
+                else:
+                    outcomes["ok"] += 1
+                return
+
+    for wave in range(4):
+        spec = faults.FaultSpec(
+            corrupt_kv=rng.choice(["bits", "truncate"]),
+            every=rng.randint(1, 4),
+            delay_dispatch_s=rng.choice([0.0, 0.001]),
+        )
+        faults.set_injector(faults.FaultInjector(spec))
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*[one(wave * 30 + i) for i in range(30)]),
+                timeout=60,
+            )
+        finally:
+            faults.set_injector(None)
+    assert sum(outcomes.values()) == 120, outcomes
+    assert outcomes["diverged"] == 0, outcomes
+    assert outcomes["error"] == 0, outcomes  # corruption never kills a stream
+    assert outcomes["ok"] == 120
+    # corruption actually fired and every corrupt frame was refused
+    assert integrity.COUNTERS.failures.get("disagg_frame", 0) > 0
+    # KV conservation through every corrupt/fallback path
+    assert engine.active == [] and len(engine.waiting) == 0
+    assert all(n == 0 for n in engine.cache.refs.values())
+    cached = len(engine.cache.refs)
+    assert engine.cache.free_blocks + cached == engine.args.num_blocks
+    integrity.COUNTERS.reset()
+    await engine.close()
+    await client.close()
+    await service.close()
+    await fabric.close()
+
+
+async def test_chaos_zombie_partition_wave_fenced_and_migrated():
+    """ISSUE 8 satellite: a zombie-partition wave. The partitioned
+    worker keeps serving while the cluster expires its lease; the moment
+    a keepalive fails it self-fences — in-flight streams end with a
+    structured worker_fenced error and REPLAY onto a replacement worker
+    token-identically (the migration path the frontend drives). KV is
+    conserved on both workers."""
+    from dynamo_tpu.fabric.state import FabricState
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    faults.set_injector(
+        faults.FaultInjector(faults.FaultSpec(zombie_partition_s=0.6))
+    )
+    drt = await DistributedRuntime.detached(
+        config=RuntimeConfig(lease_ttl_s=0.3), state=FabricState()
+    )
+    # cache sized so replayed prompts (prompt + emitted tail) all fit:
+    # 12 concurrent requests x ~16 blocks each under 256 blocks
+    zombie = MockEngine(
+        MockEngineArgs(num_blocks=256, block_size=4, max_batch=8,
+                       speedup_ratio=1.0)
+    )
+    replacement = MockEngine(
+        MockEngineArgs(num_blocks=256, block_size=4, max_batch=8,
+                       speedup_ratio=500.0)
+    )
+    drt.on_fence(lambda reason: zombie.fence(reason))
+    outcomes = {"ok": 0, "fenced_then_migrated": 0}
+
+    async def one(i: int) -> None:
+        prompt = [(i % 60) + 1, ((i * 7) % 60) + 1, ((i * 3) % 60) + 1]
+        max_tokens = 60  # ~0.6 s on the zombie: straddles the fence
+        expected = [prompt[j % len(prompt)] for j in range(max_tokens)]
+        emitted = []
+        async for out in zombie.generate(_req(prompt, max_tokens), Context()):
+            emitted.extend(out.token_ids)
+            if out.finish_reason is not None:
+                if out.error is None:
+                    assert emitted == expected
+                    outcomes["ok"] += 1
+                    return
+                assert out.error["code"] == "worker_fenced", out.error
+                break
+        # migrate: replay prompt + already-emitted tokens onto the
+        # replacement (the engines' resume contract) — the resumed
+        # stream must be token-identical to an unfaulted run
+        req = _req(prompt + emitted, max_tokens)
+        req.extra["resume_prompt_len"] = len(prompt)
+        got = list(emitted)
+        async for out in replacement.generate(req, Context()):
+            assert out.error is None, out.error
+            got.extend(out.token_ids)
+        assert got == expected
+        outcomes["fenced_then_migrated"] += 1
+
+    try:
+        await asyncio.wait_for(
+            asyncio.gather(*[one(i) for i in range(12)]), timeout=60
+        )
+        assert drt.fenced and zombie.fenced
+        # the wave actually straddled the fence: at least one stream was
+        # cut over and finished identically on the replacement
+        assert outcomes["fenced_then_migrated"] > 0, outcomes
+        # zombie refuses post-fence work with the structured code
+        outs = [o async for o in zombie.generate(_req([1, 2], 4), Context())]
+        assert outs[-1].error["code"] == "worker_fenced"
+        # KV conserved on both engines through the fence/migration churn
+        for eng in (zombie, replacement):
+            assert eng.active == [] and len(eng.waiting) == 0
+            assert all(n == 0 for n in eng.cache.refs.values())
+            cached = len(eng.cache.refs)
+            assert eng.cache.free_blocks + cached == eng.args.num_blocks
+    finally:
+        faults.set_injector(None)
+        await zombie.close()
+        await replacement.close()
+        await drt.close()

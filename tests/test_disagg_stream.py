@@ -457,6 +457,58 @@ async def test_lost_frame_detected_and_falls_back_local():
     await ref_engine.close()
 
 
+async def test_corrupt_kv_frames_never_decoded_token_identical():
+    """ISSUE 8 acceptance: with DYN_FAULT=corrupt_kv active on the disagg
+    stream, no corrupted block is ever consumed by decode — corrupt
+    frames fail their checksum at land time, the coverage guard (or the
+    corrupt final frame's structured error) triggers the local-prefill
+    fallback, and the stream stays token-identical to a fault-free run
+    under BOTH greedy and seeded sampling."""
+    from dynamo_tpu import integrity
+    from dynamo_tpu.testing import faults
+
+    fabric = FabricClient.in_process()
+    ns = "stream-corrupt"
+    prefill_engine = make_engine()
+    service, client, decode = stream_decode_pair(
+        fabric, ns, prefill_engine, timeout=30
+    )
+    await service.start()
+    await client.start()
+    ref_engine = make_engine()
+
+    prompt = list(range(2, 42))  # 40 tokens -> 5 chunks -> 4 frames + final
+    ref, _, _ = await collect(ref_engine, prompt)
+    sampling = SamplingOptions(temperature=0.9, seed=77)
+    ref_s, _, _ = await collect(ref_engine, prompt, sampling=sampling)
+
+    integrity.COUNTERS.reset()
+    faults.set_injector(
+        faults.FaultInjector(faults.FaultSpec(corrupt_kv="bits", every=2))
+    )
+    try:
+        landed_before = decode.stats.kv_frames_rx
+        got, _, _ = await collect(decode, prompt)
+        assert got == ref
+        got_s, _, _ = await collect(decode, prompt, sampling=sampling)
+        assert got_s == ref_s
+        # corruption actually fired and was refused at land time
+        assert integrity.COUNTERS.failures.get("disagg_frame", 0) >= 1
+        # every frame the engine DID land passed verification; the
+        # corrupt ones were dropped before the inject path
+        landed = decode.stats.kv_frames_rx - landed_before
+        assert landed < client.stats.frames_rx
+    finally:
+        faults.set_injector(None)
+        integrity.COUNTERS.reset()
+
+    await decode.close()
+    await client.close()
+    await service.close()
+    await prefill_engine.close()
+    await ref_engine.close()
+
+
 async def test_decode_cancel_mid_stream_conserves_blocks():
     fabric = FabricClient.in_process()
     ns = "stream-cancel"

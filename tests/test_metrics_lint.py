@@ -47,6 +47,12 @@ INTENTIONALLY_SHARED = {
     "dyn_llm_preemptions",
     "dyn_llm_preempted_too_often",
     "dyn_llm_brownout_sheds",
+    # integrity plane (ISSUE 8): the frontend exports its own process
+    # counters (dispatch-plane fenced rejects), the metrics component the
+    # fabric-scraped fleet sums — same meaning, different scope
+    "dyn_llm_kv_integrity_failures",
+    "dyn_llm_blocks_quarantined",
+    "dyn_llm_fenced_rejects",
 }
 
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
@@ -78,6 +84,11 @@ def _all_registries() -> dict[str, CollectorRegistry]:
     frontend.attach_engine_qos(
         {"preemptions_by_class": {}, "preempted_too_often": 0,
          "shed_brownout": 0}
+    )
+    frontend.attach_integrity(
+        {"integrity_failures_by_path": {"disagg_frame": 0},
+         "blocks_quarantined": 0,
+         "fenced_rejects_by_plane": {"dispatch": 0}}
     )
     component = MetricsComponent(
         _StubComponent(), EndpointId("lint", "backend", "generate")
@@ -174,6 +185,25 @@ def test_qos_families_present_with_correct_types():
         assert fam is not None and fam.type == "counter", name
     fam = by_role["component"].get("dyn_llm_brownout_level")
     assert fam is not None and fam.type == "gauge"
+
+
+def test_integrity_families_present_with_correct_types():
+    """ISSUE 8: the integrity/fence counter families must exist with
+    counter semantics on both the frontend (process counters) and the
+    metrics component (fleet sums)."""
+    regs = _all_registries()
+    by_role = {
+        role: {f.name: f for f in _families(reg)}
+        for role, reg in regs.items()
+    }
+    for role in ("frontend", "component"):
+        for name in (
+            "dyn_llm_kv_integrity_failures",
+            "dyn_llm_blocks_quarantined",
+            "dyn_llm_fenced_rejects",
+        ):
+            fam = by_role[role].get(name)
+            assert fam is not None and fam.type == "counter", (role, name)
 
 
 def test_every_family_has_help_text():

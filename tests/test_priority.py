@@ -208,7 +208,17 @@ async def test_mocker_preemption_lands_on_bulk():
         collect(engine, req(list(range(1, 9)), max_tokens=30,
                             priority="bulk"))
     )
-    await asyncio.sleep(0.02)  # bulk admitted first (it is OLDER)
+    # wait until bulk is ADMITTED and decoding (it is OLDER) — a fixed
+    # sleep here was load-sensitive: on a busy machine bulk could finish
+    # all 30 tokens before the interactive request ever created pressure
+    deadline = time.monotonic() + 10.0
+    while not any(
+        s.priority == "bulk" and 1 <= s.generated <= 8
+        for s in engine.active
+    ):
+        assert time.monotonic() < deadline, "bulk never started decoding"
+        assert not bulk_task.done(), "bulk finished before pressure built"
+        await asyncio.sleep(0.0005)
     inter_task = asyncio.ensure_future(
         collect(engine, req(list(range(40, 48)), max_tokens=30,
                             priority="interactive"))
