@@ -259,7 +259,7 @@ def main() -> None:
         "--preset",
         choices=[
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
-            "priority", "integrity",
+            "priority", "integrity", "decode_mfu",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -288,7 +288,11 @@ def main() -> None:
         "integrity = delegates to benchmarks.integrity_sweep (checksum "
         "codec overhead, streamed-disagg TTFT checksums on vs off with "
         "a <=3% bar, and the corrupt_kv/zombie fault proof; banked "
-        "artifact benchmarks/integrity_sweep.json)",
+        "artifact benchmarks/integrity_sweep.json). "
+        "decode_mfu = delegates to benchmarks.decode_mfu_bench (modeled "
+        "HBM bytes/token + measured tiny-CPU tok/s for {bf16, int8-w, "
+        "int8-w+int8-KV} x {fused, unfused}; banked artifact "
+        "benchmarks/decode_mfu.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -330,6 +334,17 @@ def main() -> None:
 
         integrity_sweep.main(
             ["--json", args.json or "benchmarks/integrity_sweep.json"]
+        )
+        return
+    if args.preset == "decode_mfu":
+        # decode-bandwidth matrix has its own harness (modeled HBM
+        # bytes/token + measured tiny-CPU tok/s per {weights, KV, fused}
+        # cell) — one entry point for every banked curve stays
+        # `perf_sweep --preset X`
+        from benchmarks import decode_mfu_bench
+
+        decode_mfu_bench.main(
+            ["--json", args.json or "benchmarks/decode_mfu.json"]
         )
         return
     if args.preset == "slo":

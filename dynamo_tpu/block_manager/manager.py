@@ -227,6 +227,74 @@ class TieredBlockManager:
             self.on_event("stored", stored, 2)
         return len(stored)
 
+    def store_blocks_quant(
+        self,
+        seq_hashes: list[int],
+        kq: np.ndarray,  # [L, H, n, bs, D] int8 mantissas
+        ks: np.ndarray,  # [L, H, n] f32 scales
+        vq: np.ndarray,
+        vs: np.ndarray,
+    ) -> int:
+        """Offload ALREADY-QUANTIZED blocks verbatim (int8-resident device
+        caches, ModelRunner.extract_blocks_quant): the mantissas+scales go
+        straight into the int8 arenas — no recode, no double quantization.
+        Requires wire_codec="int8" tiers (factory forces this when
+        DYN_KV_DTYPE=int8)."""
+        assert self.wire_codec == "int8", "quant store needs int8 tiers"
+        kb = np.moveaxis(kq, 2, 0)
+        vb = np.moveaxis(vq, 2, 0)
+        ksb = np.moveaxis(ks, 2, 0)
+        vsb = np.moveaxis(vs, 2, 0)
+        checks = integrity.enabled()
+        inj = faults.get_injector() if faults.active() else None
+        stored = []
+        with self._lock:
+            for i, h in enumerate(seq_hashes):
+                if h in self._quarantined:
+                    self.stats.quarantine_refused += 1
+                    continue
+                if h in self._host:
+                    self._host.move_to_end(h)
+                    continue
+                if h in self._disk:
+                    continue
+                slot = self._alloc_host_slot()
+                if slot is None:
+                    break
+                self._k_arena[slot] = kb[i]
+                self._v_arena[slot] = vb[i]
+                self._k_scales[slot] = ksb[i]
+                self._v_scales[slot] = vsb[i]
+                k_sum = v_sum = 0
+                if checks:
+                    k_sum, v_sum = self._slot_sums(slot)
+                self._host[h] = BlockHandle(
+                    h, tier=2, index=slot, k_sum=k_sum, v_sum=v_sum
+                )
+                if inj is not None:
+                    inj.corrupt_array(self._k_arena[slot])
+                stored.append(h)
+            if stored:
+                self.stats.offloaded_g2 += len(stored)
+                self.stats.host_blocks_used = len(self._host)
+        if stored and self.on_event:
+            self.on_event("stored", stored, 2)
+        return len(stored)
+
+    def load_blocks_quant(
+        self, seq_hashes: list[int]
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Fetch blocks for onboarding WITHOUT dequantizing: (kq [L, H, n,
+        bs, D] int8, ks [L, H, n] f32, vq, vs) — landed verbatim by
+        ModelRunner.inject_blocks_quant. Same verification/promotion
+        semantics as load_blocks."""
+        assert self.wire_codec == "int8", "quant load needs int8 tiers"
+        k, v, ks, vs = self._load_raw(seq_hashes)
+        return (
+            np.moveaxis(k, 0, 2), np.moveaxis(ks, 0, 2),
+            np.moveaxis(v, 0, 2), np.moveaxis(vs, 0, 2),
+        )
+
     def _alloc_host_slot(self) -> Optional[int]:
         if self._free_slots:
             return self._free_slots.pop()
@@ -300,11 +368,29 @@ class TieredBlockManager:
     ) -> tuple[np.ndarray, np.ndarray]:
         """Fetch blocks for onboarding; returns [L, H, n, bs, D] pairs in
         the layout's WIRE dtype (bf16 as u16 words) regardless of the tier
-        codec — int8 tiers dequantize here, so callers never see scales.
+        codec — int8 tiers dequantize here, so callers never see scales
+        (int8-resident engines use load_blocks_quant instead and skip the
+        dequant entirely).
 
         Disk blocks are promoted back into the host arena on read
         (offload.rs onboarding path G3->G2->G1).
         """
+        k, v, ks, vs = self._load_raw(seq_hashes)
+        L = self.layout
+        if self.wire_codec == "int8":
+            from dynamo_tpu.disagg.protocols import kv_dequantize_int8
+
+            k = kv_dequantize_int8(k, ks, L.dtype)
+            v = kv_dequantize_int8(v, vs, L.dtype)
+            if L.dtype == "bfloat16":
+                k, v = k.view(np.uint16), v.view(np.uint16)
+        return np.moveaxis(k, 0, 2), np.moveaxis(v, 0, 2)
+
+    def _load_raw(
+        self, seq_hashes: list[int]
+    ) -> tuple[np.ndarray, np.ndarray, Optional[np.ndarray], Optional[np.ndarray]]:
+        """Tier fetch in STORED form, blocks-first [n, L, H, bs, D]
+        (+ scale planes for int8 tiers); verification/promotion included."""
         L = self.layout
         int8 = self.wire_codec == "int8"
         store = np.int8 if int8 else _NP_DTYPES[L.dtype]
@@ -388,14 +474,7 @@ class TieredBlockManager:
                     k_sum=k_sum, v_sum=v_sum,
                 )
             self.stats.onboarded += n
-        if int8:
-            from dynamo_tpu.disagg.protocols import kv_dequantize_int8
-
-            k = kv_dequantize_int8(k, ks, L.dtype)
-            v = kv_dequantize_int8(v, vs, L.dtype)
-            if L.dtype == "bfloat16":
-                k, v = k.view(np.uint16), v.view(np.uint16)
-        return np.moveaxis(k, 0, 2), np.moveaxis(v, 0, 2)
+        return k, v, ks, vs
 
     def _integrity_fail(self, h: int, path_label: str) -> None:
         """One block failed verification: free it exactly once (host slot

@@ -315,6 +315,17 @@ class MetricsComponent:
             "kv_stream_overlap",
             "Fraction of received KV bytes landed before the final frame",
         )
+        # decode-bandwidth plane (ISSUE 9): fleet-mean modeled HBM bytes
+        # per emitted decode token and the decode-MFU estimate — the live
+        # counterparts of benchmarks/decode_mfu.json
+        self.g_decode_hbm_bytes = g(
+            "decode_hbm_bytes_per_token",
+            "Modeled HBM bytes read per decode token (fleet mean)",
+        )
+        self.g_mfu_decode = g(
+            "mfu_decode_est",
+            "Estimated decode MFU from windowed token rate (fleet mean)",
+        )
         self.c_hit_events = Counter(
             f"{PREFIX}_kv_hit_rate_events_total",
             "kv-hit-rate events seen",
@@ -423,6 +434,10 @@ class MetricsComponent:
                 if xfer is not None:
                     self.g_kv_frames_inflight.set(xfer.kv_frames_inflight)
                     self.g_kv_overlap.set(xfer.overlap_fraction)
+                self.g_decode_hbm_bytes.set(
+                    agg.worker_stats.decode_hbm_bytes_per_token
+                )
+                self.g_mfu_decode.set(agg.worker_stats.mfu_decode_est)
                 # burn-rate windows advance on every poll, with or without
                 # fresh phase data (recovery to ok needs empty ticks too)
                 self.slo.observe(
@@ -587,6 +602,11 @@ class MockWorkerMetrics:
                 ),
                 num_blocks_quarantined=self._blocks_quarantined,
                 fenced_rejects_by_plane=dict(self._fenced_rejects) or None,
+                # decode-bandwidth gauges: bytes/token shrinks a little as
+                # load grows (bigger batches amortize the weight stream),
+                # MFU tracks load — deterministic like everything else
+                decode_hbm_bytes_per_token=4e8 / (1.0 + 3.0 * load),
+                mfu_decode_est=0.05 * load,
             ),
             kv_stats=KvStats(
                 kv_active_blocks=active_blocks,

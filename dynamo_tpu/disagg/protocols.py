@@ -149,6 +149,45 @@ class KvBlockPayload:
         return cls(shape=tuple(k.shape), dtype=dtype,
                    k_bytes=k.tobytes(), v_bytes=v.tobytes())
 
+    @classmethod
+    def from_quantized(
+        cls,
+        kq: np.ndarray,  # [L, H, n, bs, D] int8 mantissas
+        ks: np.ndarray,  # [L, H, n] f32 scales
+        vq: np.ndarray,
+        vs: np.ndarray,
+        dtype: str = "bfloat16",
+    ) -> "KvBlockPayload":
+        """No-recode constructor for int8-RESIDENT caches: the device
+        already stores the wire codec's exact mantissas+scales, so the
+        payload ships them verbatim — no dequant/requant round trip, no
+        double quantization on disagg frames or offload spills."""
+        return cls(
+            shape=tuple(kq.shape), dtype=dtype,
+            k_bytes=np.ascontiguousarray(kq, np.int8).tobytes(),
+            v_bytes=np.ascontiguousarray(vq, np.int8).tobytes(),
+            codec="int8",
+            k_scales=np.ascontiguousarray(ks, np.float32).tobytes(),
+            v_scales=np.ascontiguousarray(vs, np.float32).tobytes(),
+        )._stamp_sums()
+
+    def quantized_arrays(
+        self, verify: bool = True
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """(kq, ks, vq, vs) views of an int8 payload — the verbatim
+        landing path for int8-resident receivers (no dequantization).
+        Verifies the integrity header first, like decode()."""
+        assert self.codec == "int8", self.codec
+        if verify:
+            self.verify()
+        sshape = tuple(self.shape[:-2])
+        return (
+            np.frombuffer(self.k_bytes, np.int8).reshape(self.shape),
+            np.frombuffer(self.k_scales, np.float32).reshape(sshape),
+            np.frombuffer(self.v_bytes, np.int8).reshape(self.shape),
+            np.frombuffer(self.v_scales, np.float32).reshape(sshape),
+        )
+
     # ------------------------------------------------------------- decode
 
     def verify(self) -> None:

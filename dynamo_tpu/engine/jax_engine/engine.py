@@ -20,6 +20,7 @@ import contextlib
 import itertools
 import os
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Optional
 
@@ -166,6 +167,11 @@ class EngineStats:
     kv_bytes_overlapped: int = 0
     kv_frames_inflight: int = 0  # gauge (prefill role, bounded window)
     prefill_dropped_expired: int = 0  # queue entries dropped past deadline
+    # decode-bandwidth plane (ISSUE 9): modeled HBM bytes per emitted
+    # token for the live batch shape + a windowed-rate MFU estimate
+    # (engine/jax_engine/perf_model.py); both gauges
+    decode_hbm_bytes_per_token: float = 0.0
+    mfu_decode_est: float = 0.0
     # QoS plane (ISSUE 7): per-class preemption counts (class-aware
     # KV-preserving preemption — bulk absorbs pressure first), storm-guard
     # kills, engine-side brownout sheds, and the live brownout rung
@@ -359,6 +365,8 @@ class JaxEngine:
             total_blocks=self.config.num_blocks - 1,
             total_slots=self.config.max_batch,
         )
+        # windowed token-rate samples feeding the mfu_decode_est gauge
+        self._mfu_window: deque[tuple[float, int]] = deque()
         # self-drafting speculative decoding (spec_k > 0 and a runner that
         # carries the verify program)
         self.drafter = None
@@ -952,22 +960,48 @@ class JaxEngine:
             inj = faults.get_injector()
             if inj is not None:
                 await inj.on_transfer()
+        quant = self._tier_quant_passthrough()
         try:
             async with self._device_lock:
-                k, v = await loop.run_in_executor(
-                    None, self.runner.extract_blocks, ids
-                )
+                if quant:
+                    # int8-resident device pages spill VERBATIM into the
+                    # int8 tiers (mantissas+scales, no recode) — onboard
+                    # later returns the exact same bytes
+                    data = await loop.run_in_executor(
+                        None, self.runner.extract_blocks_quant, ids
+                    )
+                else:
+                    data = await loop.run_in_executor(
+                        None, self.runner.extract_blocks, ids
+                    )
         except Exception:  # noqa: BLE001 — offload is best-effort
             logger.exception("block offload extract failed")
             return
-        self._spawn_tracked(self._store_blocks_task(hashes, k, v))
+        self._spawn_tracked(self._store_blocks_task(hashes, data, quant))
 
-    async def _store_blocks_task(self, hashes, k, v) -> None:
+    def _tier_quant_passthrough(self) -> bool:
+        """True when device pages and offload tiers share the int8 codec,
+        so spills/onboards move mantissas+scales verbatim."""
+        return (
+            getattr(self.runner, "kv_quantized", False)
+            and getattr(self.block_manager, "wire_codec", "raw") == "int8"
+        )
+
+    async def _store_blocks_task(self, hashes, data, quant=False) -> None:
         loop = asyncio.get_running_loop()
         try:
-            stored = await loop.run_in_executor(
-                None, self.block_manager.store_blocks, hashes, k, v
-            )
+            if quant:
+                stored = await loop.run_in_executor(
+                    None,
+                    lambda: self.block_manager.store_blocks_quant(
+                        hashes, *data
+                    ),
+                )
+            else:
+                stored = await loop.run_in_executor(
+                    None, self.block_manager.store_blocks,
+                    hashes, data[0], data[1],
+                )
             if self._offload_queue is not None:
                 self._offload_queue.stats.offloaded += stored
         except Exception:  # noqa: BLE001 — offload is best-effort
@@ -1571,6 +1605,34 @@ class JaxEngine:
             "0", "false", "no",
         )
 
+    async def _inject_payload(
+        self, ids: list[int], payload, loop
+    ) -> None:
+        """Land a KvBlockPayload into device blocks. Int8 payloads land
+        VERBATIM on an int8-resident runner (mantissas+scales scatter
+        straight in — no dequant/requant, no double quantization); every
+        other combination goes through decode() + the quantize-on-inject
+        (or plain) scatter."""
+        n = len(ids)
+        if (
+            payload.codec == "int8"
+            and getattr(self.runner, "kv_quantized", False)
+        ):
+            kq, ks, vq, vs = payload.quantized_arrays()
+            async with self._device_lock:
+                await loop.run_in_executor(
+                    None, self.runner.inject_blocks_quant, ids,
+                    kq[:, :, :n], ks[:, :, :n],
+                    vq[:, :, :n], vs[:, :, :n],
+                )
+            return
+        k, v = payload.decode()
+        async with self._device_lock:
+            await loop.run_in_executor(
+                None, self.runner.inject_blocks, ids, k[:, :, :n],
+                v[:, :, :n],
+            )
+
     async def _land_stream_frame(
         self, seq: _Sequence, frame, loop, landed: Optional[set] = None
     ) -> None:
@@ -1580,16 +1642,11 @@ class JaxEngine:
         frames overwrite the same blocks with identical content."""
         if seq.slot is None or seq.ctx.is_killed() or seq.ctx.is_stopped():
             return  # cancelled mid-stream: drop the frame on the floor
-        k, v = frame.payload.decode()
-        n = k.shape[2]
+        n = frame.payload.num_blocks
         ids = seq.block_ids[frame.first_block : frame.first_block + n]
         if not ids:
             return
-        async with self._device_lock:
-            await loop.run_in_executor(
-                None, self.runner.inject_blocks, ids, k[:, :, : len(ids)],
-                v[:, :, : len(ids)],
-            )
+        await self._inject_payload(ids, frame.payload, loop)
         if landed is not None:
             landed.update(range(frame.first_block, frame.first_block + len(ids)))
         self.stats.kv_frames_rx += 1
@@ -1716,6 +1773,21 @@ class JaxEngine:
         from dynamo_tpu.disagg.transfer import from_wire_array
 
         try:
+            if self._tier_quant_passthrough():
+                # int8 tier pages land verbatim in the int8-resident cache
+                kq, ks, vq, vs = await loop.run_in_executor(
+                    None,
+                    self.block_manager.load_blocks_quant,
+                    seq.prefix_hashes[:cached],
+                )
+                async with self._device_lock:
+                    await loop.run_in_executor(
+                        None,
+                        self.runner.inject_blocks_quant,
+                        seq.block_ids[:cached],
+                        kq, ks, vq, vs,
+                    )
+                return cached
             kw, vw = await loop.run_in_executor(
                 None, self.block_manager.load_blocks, seq.prefix_hashes[:cached]
             )
@@ -1761,16 +1833,13 @@ class JaxEngine:
                 # payload may be absent when every shippable block was a
                 # prefix hit already sitting in this worker's cache; on the
                 # streaming path this is only the not-yet-streamed tail
-                k, v = resp.payload.decode()
                 self.stats.kv_wire_bytes_rx += resp.payload.wire_nbytes
                 ids = seq.block_ids[
-                    resp.first_block : resp.first_block + k.shape[2]
+                    resp.first_block
+                    : resp.first_block + resp.payload.num_blocks
                 ]
                 if ids:
-                    async with self._device_lock:
-                        await loop.run_in_executor(
-                            None, self.runner.inject_blocks, ids, k, v
-                        )
+                    await self._inject_payload(ids, resp.payload, loop)
             return (resp.first_token, resp.first_logprob, resp.first_top)
         # local fallback (also covers error responses)
         key_row = self._key_row(seq)
@@ -1849,13 +1918,26 @@ class JaxEngine:
                 )
                 tok_arr, lp_arr, tids_arr, tlps_arr = sample
                 ship = block_ids[req.cached_blocks :]
+                quant = getattr(self.runner, "kv_quantized", False)
                 if ship:
-                    k, v = await loop.run_in_executor(
-                        None, self.runner.extract_blocks, ship
-                    )
+                    if quant:
+                        # int8-resident: ship the device's mantissas+scales
+                        # verbatim — no dequant/requant recode on the wire
+                        kq, ks, vq, vs = await loop.run_in_executor(
+                            None, self.runner.extract_blocks_quant, ship
+                        )
+                    else:
+                        k, v = await loop.run_in_executor(
+                            None, self.runner.extract_blocks, ship
+                        )
             payload = None
             if ship:
-                payload = KvBlockPayload.encode(k, v, wire_codec_from_env())
+                if quant:
+                    payload = KvBlockPayload.from_quantized(kq, ks, vq, vs)
+                else:
+                    payload = KvBlockPayload.encode(
+                        k, v, wire_codec_from_env()
+                    )
                 self.stats.kv_wire_bytes_tx += payload.wire_nbytes
             self.stats.generated_tokens += 1
             return RemotePrefillResponse(
@@ -1906,9 +1988,23 @@ class JaxEngine:
                 error=f"prompt {T} exceeds max_model_len",
             )
         codec = wire_codec_from_env()
-        extract = getattr(
-            self.runner, "extract_blocks_tight", self.runner.extract_blocks
-        )
+        quant = getattr(self.runner, "kv_quantized", False)
+        if quant:
+            # int8-resident: every frame ships device mantissas+scales
+            # verbatim (no recode); tight pow2 padding like the bf16 path
+            def extract(ids):
+                return self.runner.extract_blocks_quant(ids, tight=True)
+
+            def build_payload(data):
+                return KvBlockPayload.from_quantized(*data)
+        else:
+            extract = getattr(
+                self.runner, "extract_blocks_tight",
+                self.runner.extract_blocks,
+            )
+
+            def build_payload(data):
+                return KvBlockPayload.encode(data[0], data[1], codec)
         key_data = (
             np.asarray(req.key_data, np.uint32)
             if getattr(req, "key_data", None) is not None
@@ -1956,8 +2052,8 @@ class JaxEngine:
                 if not final and upto > shipped:
                     ids = block_ids[shipped:upto]
                     async with self._device_lock:
-                        k, v = await loop.run_in_executor(None, extract, ids)
-                    payload = KvBlockPayload.encode(k, v, codec)
+                        data = await loop.run_in_executor(None, extract, ids)
+                    payload = build_payload(data)
                     frame = KvStreamFrame(
                         request_id=req.request_id,
                         seq=frame_seq,
@@ -1979,13 +2075,13 @@ class JaxEngine:
                     None, lambda: self.runner.fetch_sample(out)
                 )
                 ship = block_ids[shipped:]
-                k = v = None
+                data = None
                 if ship:
-                    k, v = await loop.run_in_executor(None, extract, ship)
+                    data = await loop.run_in_executor(None, extract, ship)
             tok_arr, lp_arr, tids_arr, tlps_arr = sample
             payload = None
             if ship:
-                payload = KvBlockPayload.encode(k, v, codec)
+                payload = build_payload(data)
                 self.stats.kv_wire_bytes_tx += payload.wire_nbytes
             self.stats.generated_tokens += 1
             return RemotePrefillResponse(
@@ -2708,3 +2804,44 @@ class JaxEngine:
         self.stats.used_blocks = (
             self.config.num_blocks - 1 - self.allocator.free_count
         )
+        self._update_perf_gauges()
+
+    def _update_perf_gauges(self) -> None:
+        """Decode-bandwidth gauges: modeled HBM bytes per emitted token
+        for the CURRENT batch/context shape, and an MFU estimate from a
+        windowed token rate (engine/jax_engine/perf_model.py — the same
+        arithmetic decode_mfu_bench banks)."""
+        mcfg = getattr(self.runner, "config", None)
+        if mcfg is None or not hasattr(mcfg, "num_layers"):
+            return  # mocker/echo engines have no model config
+        active = [s for s in self.slots if s is not None]
+        now = time.monotonic()
+        win = self._mfu_window
+        win.append((now, self.stats.generated_tokens))
+        while len(win) > 2 and now - win[0][0] > 10.0:
+            win.popleft()
+        from dynamo_tpu.engine.jax_engine import perf_model
+
+        if active:
+            mean_ctx = sum(len(s.token_ids) for s in active) / len(active)
+            params = getattr(self.runner, "params", None)
+            quant_w = False
+            if isinstance(params, dict):
+                layers = params.get("layers") or [{}]
+                quant_w = isinstance(layers[0].get("wq"), dict)
+            bb = perf_model.decode_hbm_bytes_per_token(
+                mcfg,
+                batch=len(active),
+                context=mean_ctx,
+                block_size=self.config.block_size,
+                weights_int8=quant_w,
+                kv_int8=getattr(self.runner, "kv_quantized", False),
+                fused=getattr(mcfg, "fused_decode", False),
+            )
+            self.stats.decode_hbm_bytes_per_token = bb.total
+        dt = now - win[0][0]
+        if dt > 0.5:
+            rate = (self.stats.generated_tokens - win[0][1]) / dt
+            self.stats.mfu_decode_est = perf_model.mfu_decode_est(
+                mcfg, rate, perf_model.peak_flops_from_env()
+            )

@@ -79,6 +79,8 @@ async def build_jax_engine(
     model_path = resolve_model(model_path)
     if quantize is None:
         quantize = os.environ.get("DYN_JAX_QUANTIZE_INT8", "0") in ("1", "true")
+    kv_dtype = kv_dtype_from_env()
+    fused_decode = fused_decode_from_env()
     gguf_file = None
     if model_path.endswith(".gguf"):
         # GGUF weights+config (lib/llm/src/gguf/ equivalent); tokenizer
@@ -102,7 +104,7 @@ async def build_jax_engine(
         num_blocks = default_num_blocks(
             config, max_len, max_batch,
             block_size=kv_block_size, quantized=quantize,
-            tp=tensor_parallel_size,
+            tp=tensor_parallel_size, kv_dtype=kv_dtype,
         )
     if (
         tensor_parallel_size > 1
@@ -128,6 +130,13 @@ async def build_jax_engine(
             mesh, config, params,
             put=put_global if is_multihost else put_local,
         )
+    if is_multihost and kv_dtype == "int8":
+        # the SPMD step-channel replay path ships bf16 block payloads;
+        # int8-resident caches are single-controller for now
+        logger.warning(
+            "DYN_KV_DTYPE=int8 is not supported multihost; using bf16"
+        )
+        kv_dtype = "bf16"
     runner = ModelRunner(
         config,
         params,
@@ -136,6 +145,8 @@ async def build_jax_engine(
         max_batch=max_batch,
         max_model_len=max_len,
         rng_seed=rng_seed,
+        kv_dtype=kv_dtype,
+        fused_decode=fused_decode,
         mesh=mesh,
         kv_sharding=kv_sharding,
         global_arrays=is_multihost,
@@ -206,8 +217,13 @@ def _maybe_block_manager(config, kv_block_size: int):
     from dynamo_tpu.disagg.protocols import wire_codec_from_env
 
     # DYN_KV_WIRE=int8 halves tier bytes (per-block-scale quantized
-    # storage), so the same GB budget holds twice the blocks
+    # storage), so the same GB budget holds twice the blocks. An
+    # int8-RESIDENT device cache (DYN_KV_DTYPE=int8) forces int8 tiers:
+    # device pages then spill/onboard VERBATIM (mantissas+scales, no
+    # recode, no double quantization).
     codec = wire_codec_from_env()
+    if kv_dtype_from_env() == "int8":
+        codec = "int8"
     block_nbytes = layout.block_nbytes
     if codec == "int8":
         block_nbytes = block_nbytes // layout.itemsize  # int8 mantissas
@@ -228,6 +244,23 @@ def _maybe_block_manager(config, kv_block_size: int):
         disk_dir=disk_dir, disk_blocks=disk_blocks,
         wire_codec=codec,
     )
+
+
+def kv_dtype_from_env() -> str:
+    """DYN_KV_DTYPE=int8|bf16 (default bf16): device-resident KV cache
+    dtype. int8 stores the paged cache as mantissas + per-(layer, head,
+    block) scales (ops/kv_quant.py) — ~2x the blocks per GB and ~half the
+    per-step decode KV HBM traffic, with dequant inside the attention
+    kernels. bf16 (the default) is bit-exact and unchanged."""
+    v = os.environ.get("DYN_KV_DTYPE", "bf16").strip().lower()
+    return "int8" if v == "int8" else "bf16"
+
+
+def fused_decode_from_env() -> bool:
+    """DYN_FUSED_DECODE=1: fuse the decode step's norm+QKV+rope and
+    attn-out+O-proj+residual into one pallas program each (ops/linear.py).
+    Off by default until parity is proven per deployment."""
+    return os.environ.get("DYN_FUSED_DECODE", "0") in ("1", "true", "yes")
 
 
 def spec_decode_settings() -> dict:
@@ -350,6 +383,7 @@ def default_num_blocks(
     quantized: bool = False,
     tp: int = 1,
     utilization: float = 0.85,
+    kv_dtype: str = "bf16",
 ) -> int:
     """Blocks for every batch lane at full context plus slack, capped so
     weights + KV fit the per-device HBM budget."""
@@ -368,13 +402,24 @@ def default_num_blocks(
     weight_bytes = (
         dense_params * (1 if quantized else 2) + expert_params * 2
     ) // tp
+    # int8-resident KV: 1 byte/value + one f32 scale per (layer, head,
+    # block) — the same HBM budget holds ~2x the blocks
+    kv_itemsize = 1 if kv_dtype == "int8" else 2
+    scale_bytes = (
+        4 * config.num_layers * (config.num_kv_heads // tp)
+        if kv_dtype == "int8"
+        else 0
+    )
     block_bytes = (
         2  # k + v
-        * config.num_layers
-        * block_size
-        * (config.num_kv_heads // tp)
-        * config.head_dim
-        * 2  # bf16
+        * (
+            config.num_layers
+            * block_size
+            * (config.num_kv_heads // tp)
+            * config.head_dim
+            * kv_itemsize
+            + scale_bytes
+        )
     )
     budget = int(hbm_budget_bytes() * utilization) - weight_bytes
     cap = max(16, budget // max(1, block_bytes))

@@ -5,6 +5,10 @@ The device tensors live in the runner; this module owns WHICH blocks belong
 to WHOM. Block ids are stable across the engine, the router events, and the
 offload tiers — the same currency as the reference's block manager
 (lib/llm/src/block_manager), though the multi-tier pools arrive separately.
+The device-side page ENCODING is orthogonal to this bookkeeping: with
+`DYN_KV_DTYPE=int8` the runner stores pages as int8 mantissas with
+per-(layer, head, block) scales (ops/kv_quant.py) and nothing here changes —
+a block id names the same page whether it is bf16 or quantized.
 
 Block 0 is reserved as the null block: padded/inactive lanes write there.
 """

@@ -86,7 +86,11 @@ class ModelRunner:
         max_model_len: int,
         rng_seed: int = 0,
         prefill_buckets: Optional[list[int]] = None,
-        kv_dtype: jnp.dtype = jnp.bfloat16,
+        # "int8" (or jnp.int8 / np.int8) => int8-resident paged cache with
+        # per-(layer, head, block) scales; anything else is the plain
+        # bf16/f32 cache dtype
+        kv_dtype=jnp.bfloat16,
+        fused_decode: bool = False,
         mesh: Optional[jax.sharding.Mesh] = None,
         kv_sharding: Optional[jax.sharding.NamedSharding] = None,
         attn_impl: str = "auto",
@@ -139,7 +143,10 @@ class ModelRunner:
         ):
             self._attn_mesh = mesh
             self._attn_head_axis = "tp"
-        config = dataclasses.replace(config, attn_impl=attn_impl)
+        config = dataclasses.replace(
+            config, attn_impl=attn_impl,
+            fused_decode=bool(fused_decode) or config.fused_decode,
+        )
         self.config = config
         self.params = params
         self.num_blocks = num_blocks
@@ -165,32 +172,62 @@ class ModelRunner:
             block_size,
             config.head_dim,
         )
+        from dynamo_tpu.ops import kv_quant
+
+        # DYN_KV_DTYPE=int8: the paged cache itself is int8-resident with
+        # per-(layer, head, block) f32 scales — the PR-4 wire codec
+        # promoted to device storage (ops/kv_quant.py). Halves per-step KV
+        # HBM reads; dequant happens inside the attention kernels.
+        if isinstance(kv_dtype, str):
+            kv = kv_dtype.strip().lower()
+            self.kv_quantized = kv == "int8"
+            kv_dtype = (
+                jnp.bfloat16
+                if (self.kv_quantized or kv in ("bf16", "bfloat16"))
+                else np.dtype(kv)
+            )
+        else:
+            self.kv_quantized = np.dtype(kv_dtype) == np.dtype(np.int8)
+        self.kv_dtype = jnp.bfloat16 if self.kv_quantized else kv_dtype
         self.global_arrays = global_arrays
         self._repl = (
             jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
             if (mesh is not None and global_arrays)
             else None
         )
+        # sharding tree matching the cache container ({"q", "s"} planes
+        # both head-sharded under tp; plain array otherwise)
+        kv_shard_tree = kv_quant.cache_sharding(kv_sharding, self.kv_quantized)
         if kv_sharding is not None:
             # allocate ON device under the sharding (works single- and
             # multi-controller; never materializes host zeros)
             make_zeros = jax.jit(
-                lambda: jnp.zeros(cache_shape, kv_dtype),
-                out_shardings=kv_sharding,
+                lambda: kv_quant.make_cache(
+                    cache_shape, self.kv_dtype, quantized=self.kv_quantized
+                ),
+                out_shardings=kv_shard_tree,
             )
             self.k_cache = make_zeros()
             self.v_cache = make_zeros()
         else:
-            self.k_cache = jnp.zeros(cache_shape, kv_dtype)
-            self.v_cache = jnp.zeros(cache_shape, kv_dtype)
+            self.k_cache = kv_quant.make_cache(
+                cache_shape, self.kv_dtype, quantized=self.kv_quantized
+            )
+            self.v_cache = kv_quant.make_cache(
+                cache_shape, self.kv_dtype, quantized=self.kv_quantized
+            )
         logger.info(
             "kv cache: %d blocks x %d tokens (%s), %.2f GiB",
             num_blocks,
             block_size,
-            str(kv_dtype.__name__ if hasattr(kv_dtype, "__name__") else kv_dtype),
-            2 * np.prod(cache_shape) * 2 / 2**30,
+            "int8+scales" if self.kv_quantized else str(
+                kv_dtype.__name__ if hasattr(kv_dtype, "__name__") else kv_dtype
+            ),
+            (kv_quant.cache_nbytes(self.k_cache)
+             + kv_quant.cache_nbytes(self.v_cache)) / 2**30,
         )
         self._kv_sharding = kv_sharding
+        self._kv_shard_tree = kv_shard_tree
         # Pin cache output shardings when running sharded: XLA would
         # otherwise be free to re-propagate (e.g. shard head_dim instead of
         # heads), breaking the megatron layout on the next step. Under
@@ -199,7 +236,7 @@ class ModelRunner:
         # sample outputs: (tok, logprob, top_ids, top_lps) — pinned
         # replicated under multi-controller so every process can fetch.
         cache_out = (
-            ((self._repl,) * 4, kv_sharding, kv_sharding)
+            ((self._repl,) * 4, kv_shard_tree, kv_shard_tree)
             if kv_sharding is not None
             else None
         )
@@ -250,7 +287,7 @@ class ModelRunner:
         # distinct H; the engine uses a single configured H). Output
         # sharding: packed samples replicated, caches keep theirs.
         multi_out = (
-            (self._repl, kv_sharding, kv_sharding)
+            (self._repl, kv_shard_tree, kv_shard_tree)
             if kv_sharding is not None
             else None
         )
@@ -317,26 +354,107 @@ class ModelRunner:
         # counts are padded to bucket sizes so each compiles once per
         # bucket. Under multi-controller the gathered blocks are pinned
         # replicated (an all-gather) so every process can fetch them.
-        self._extract_jit = jax.jit(
-            lambda k, v, ids: (k[:, :, ids], v[:, :, ids]),
-            **(
-                {"out_shardings": (self._repl, self._repl)}
-                if self._repl is not None
-                else {}
-            ),
+        # Int8-resident caches keep TWO gather flavors: a dequantizing one
+        # (legacy bf16 consumers) and a verbatim mantissa+scale one (the
+        # no-recode path for disagg frames / offload tiers).
+        repl_out = (
+            {"out_shardings": (self._repl, self._repl)}
+            if self._repl is not None
+            else {}
         )
-        self._inject_jit = jax.jit(
-            lambda k, v, ids, kb, vb: (
-                k.at[:, :, ids].set(kb.astype(k.dtype)),
-                v.at[:, :, ids].set(vb.astype(v.dtype)),
-            ),
-            donate_argnums=(0, 1),
-            **(
-                {"out_shardings": (kv_sharding, kv_sharding)}
-                if kv_sharding is not None
-                else {}
-            ),
-        )
+        if self.kv_quantized:
+
+            def _extract(k, v, ids):
+                kd = (
+                    k["q"][:, :, ids].astype(jnp.float32)
+                    * k["s"][:, :, ids][..., None, None]
+                ).astype(self.kv_dtype)
+                vd = (
+                    v["q"][:, :, ids].astype(jnp.float32)
+                    * v["s"][:, :, ids][..., None, None]
+                ).astype(self.kv_dtype)
+                return kd, vd
+
+            self._extract_jit = jax.jit(_extract, **repl_out)
+            self._extract_q_jit = jax.jit(
+                lambda k, v, ids: (
+                    k["q"][:, :, ids], k["s"][:, :, ids],
+                    v["q"][:, :, ids], v["s"][:, :, ids],
+                ),
+                **(
+                    {"out_shardings": (self._repl,) * 4}
+                    if self._repl is not None
+                    else {}
+                ),
+            )
+
+            def _inject(k, v, ids, kb, vb):
+                # whole-block quantize-on-inject: the wire codec's exact
+                # per-(layer, head, block) absmax scheme, on device
+                from dynamo_tpu.ops.kv_quant import (
+                    block_scale,
+                    quantize_with,
+                    scale_inv,
+                )
+
+                out = []
+                for cache, blocks in ((k, kb), (v, vb)):
+                    xf = blocks.astype(jnp.float32)
+                    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))
+                    scale = block_scale(amax)
+                    qv = quantize_with(
+                        xf, scale_inv(scale)[..., None, None]
+                    )
+                    out.append({
+                        "q": cache["q"].at[:, :, ids].set(qv),
+                        "s": cache["s"].at[:, :, ids].set(scale),
+                    })
+                return tuple(out)
+
+            self._inject_jit = jax.jit(
+                _inject,
+                donate_argnums=(0, 1),
+                **(
+                    {"out_shardings": (kv_shard_tree, kv_shard_tree)}
+                    if kv_sharding is not None
+                    else {}
+                ),
+            )
+            self._inject_q_jit = jax.jit(
+                lambda k, v, ids, kq, ks, vq, vs: (
+                    {
+                        "q": k["q"].at[:, :, ids].set(kq),
+                        "s": k["s"].at[:, :, ids].set(ks),
+                    },
+                    {
+                        "q": v["q"].at[:, :, ids].set(vq),
+                        "s": v["s"].at[:, :, ids].set(vs),
+                    },
+                ),
+                donate_argnums=(0, 1),
+                **(
+                    {"out_shardings": (kv_shard_tree, kv_shard_tree)}
+                    if kv_sharding is not None
+                    else {}
+                ),
+            )
+        else:
+            self._extract_jit = jax.jit(
+                lambda k, v, ids: (k[:, :, ids], v[:, :, ids]),
+                **repl_out,
+            )
+            self._inject_jit = jax.jit(
+                lambda k, v, ids, kb, vb: (
+                    k.at[:, :, ids].set(kb.astype(k.dtype)),
+                    v.at[:, :, ids].set(vb.astype(v.dtype)),
+                ),
+                donate_argnums=(0, 1),
+                **(
+                    {"out_shardings": (kv_sharding, kv_sharding)}
+                    if kv_sharding is not None
+                    else {}
+                ),
+            )
 
     # ------------------------------------------------------------- jitted
 
@@ -1184,6 +1302,77 @@ class ModelRunner:
         )
         return self._fetch(k)[:, :, :n], self._fetch(v)[:, :, :n]
 
+    def _quant_pad_ids(self, block_ids: list[int], tight: bool) -> np.ndarray:
+        n = len(block_ids)
+        if tight:
+            pow2 = 1
+            while pow2 < n:
+                pow2 <<= 1
+            padded = min(pow2, self._pad_block_count(n))
+        else:
+            padded = self._pad_block_count(n)
+        ids = np.zeros(padded, np.int32)
+        ids[:n] = block_ids
+        return ids
+
+    def extract_blocks_quant(
+        self, block_ids: list[int], tight: bool = False
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Gather int8-resident blocks VERBATIM: (kq [L, Hkv, n, bs, D]
+        int8, ks [L, Hkv, n] f32, vq, vs) — the exact mantissas+scales the
+        wire codec would produce, so disagg frames and offload tiers ship
+        them with no recode and no double quantization. Only valid on an
+        int8-resident runner (kv_quantized)."""
+        assert self.kv_quantized, "extract_blocks_quant needs an int8 cache"
+        n = len(block_ids)
+        ids = self._quant_pad_ids(block_ids, tight)
+        kq, ks, vq, vs = self._extract_q_jit(
+            self.k_cache, self.v_cache, self._to_dev(ids)
+        )
+        return (
+            self._fetch(kq)[:, :, :n], self._fetch(ks)[:, :, :n],
+            self._fetch(vq)[:, :, :n], self._fetch(vs)[:, :, :n],
+        )
+
+    def inject_blocks_quant(
+        self,
+        block_ids: list[int],
+        kq: np.ndarray,  # [L, Hkv, n, bs, D] int8 mantissas
+        ks: np.ndarray,  # [L, Hkv, n] f32 scales
+        vq: np.ndarray,
+        vs: np.ndarray,
+    ) -> None:
+        """Scatter already-quantized blocks verbatim (the landing half of
+        the no-recode path: int8 wire frames / int8 tier pages go straight
+        into the int8-resident cache)."""
+        assert self.kv_quantized, "inject_blocks_quant needs an int8 cache"
+        n = len(block_ids)
+        ids = self._quant_pad_ids(block_ids, tight=False)
+        padded = len(ids)
+        if padded != n:
+            pad = padded - n
+            kq = np.concatenate(
+                [kq, np.zeros(kq.shape[:2] + (pad,) + kq.shape[3:], kq.dtype)],
+                axis=2,
+            )
+            vq = np.concatenate(
+                [vq, np.zeros(vq.shape[:2] + (pad,) + vq.shape[3:], vq.dtype)],
+                axis=2,
+            )
+            ks = np.concatenate(
+                [ks, np.zeros(ks.shape[:2] + (pad,), ks.dtype)], axis=2
+            )
+            vs = np.concatenate(
+                [vs, np.zeros(vs.shape[:2] + (pad,), vs.dtype)], axis=2
+            )
+        self.k_cache, self.v_cache = self._inject_q_jit(
+            self.k_cache, self.v_cache, self._to_dev(ids),
+            self._to_dev(np.ascontiguousarray(kq, np.int8)),
+            self._to_dev(np.ascontiguousarray(ks, np.float32)),
+            self._to_dev(np.ascontiguousarray(vq, np.int8)),
+            self._to_dev(np.ascontiguousarray(vs, np.float32)),
+        )
+
     def extract_blocks_device(
         self, block_ids: list[int]
     ) -> tuple[jax.Array, jax.Array, int]:
@@ -1395,7 +1584,7 @@ class ModelRunner:
         (spec_k, extras) pair."""
         if not hasattr(self, "_spec_verify_jit"):
             spec_out = (
-                (self._repl, self._kv_sharding, self._kv_sharding)
+                (self._repl, self._kv_shard_tree, self._kv_shard_tree)
                 if self._kv_sharding is not None
                 else None
             )
@@ -1456,10 +1645,13 @@ class ModelRunner:
         def build() -> None:
             try:
                 f32 = jnp.float32
+                sds = lambda c: jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), c
+                )
                 args = (
                     self.params,
-                    jax.ShapeDtypeStruct(self.k_cache.shape, self.k_cache.dtype),
-                    jax.ShapeDtypeStruct(self.v_cache.shape, self.v_cache.dtype),
+                    sds(self.k_cache),
+                    sds(self.v_cache),
                     jax.ShapeDtypeStruct((B,), jnp.int32),
                     jax.ShapeDtypeStruct((B,), jnp.int32),
                     jax.ShapeDtypeStruct((B, self.max_blocks_per_seq), jnp.int32),
@@ -1494,20 +1686,28 @@ class ModelRunner:
         then crash). Returns True if a rebuild happened. Shape/dtype are
         metadata, readable even on a deleted array; the caller is
         responsible for knowing that live sequences' cached KV is gone."""
+        from dynamo_tpu.ops.kv_quant import cache_zeros_like
+
+        probe = jax.tree_util.tree_leaves(self.k_cache)[0]
         try:
-            dead = getattr(self.k_cache, "is_deleted", lambda: False)()
+            dead = getattr(probe, "is_deleted", lambda: False)()
         except Exception:  # noqa: BLE001
             dead = True
         if not dead:
             return False
         for name in ("k_cache", "v_cache"):
-            old = getattr(self, name)
+            # shape/dtype are metadata, readable even on deleted arrays —
+            # capture only those (never the dead buffers) in the rebuild
+            spec = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                getattr(self, name),
+            )
             if self._kv_sharding is not None:
                 make = jax.jit(
-                    lambda s=old.shape, d=old.dtype: jnp.zeros(s, d),
-                    out_shardings=self._kv_sharding,
+                    lambda sp=spec: cache_zeros_like(sp),
+                    out_shardings=self._kv_shard_tree,
                 )
                 setattr(self, name, make())
             else:
-                setattr(self, name, jnp.zeros(old.shape, old.dtype))
+                setattr(self, name, cache_zeros_like(spec))
         return True

@@ -1,0 +1,118 @@
+"""Decode-step HBM traffic model + MFU estimate.
+
+Decode on TPU is HBM-bandwidth-bound: every step streams the weights once
+per batch (amortized over B lanes), each lane's live KV pages, and the
+activation round-trips between separately-launched programs. This module
+is the single source of that arithmetic — the engine exports it as the
+`dyn_llm_decode_hbm_bytes_per_token` / `dyn_llm_mfu_decode_est` gauges and
+`benchmarks/decode_mfu_bench.py` banks the {weights, KV} x {fused,
+unfused} matrix from the same function, so the banked curves and the live
+fleet gauges can never drift apart.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+# v5e-class bf16 peak (bench.py's mfu constant); DYN_TPU_PEAK_FLOPS overrides
+DEFAULT_PEAK_FLOPS = 197e12
+
+# Distinct device programs the unfused decode layer round-trips [B, hidden]
+# (or [B, proj]) activations through HBM between: norm->qkv (3 matmuls) ->
+# rope -> attention -> o-proj -> residual -> norm -> gate/up -> act ->
+# down. The fused step collapses norm+qkv+rope into one program and
+# attn-out+o-proj+residual into another.
+UNFUSED_LAYER_BOUNDARIES = 10
+FUSED_LAYER_BOUNDARIES = 5
+
+
+@dataclass
+class DecodeBytesBreakdown:
+    weight_bytes_per_token: float
+    kv_bytes_per_token: float
+    kv_scale_bytes_per_token: float
+    activation_bytes_per_token: float
+
+    @property
+    def total(self) -> float:
+        return (
+            self.weight_bytes_per_token
+            + self.kv_bytes_per_token
+            + self.kv_scale_bytes_per_token
+            + self.activation_bytes_per_token
+        )
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["total_bytes_per_token"] = self.total
+        return d
+
+
+def decode_hbm_bytes_per_token(
+    config,
+    *,
+    batch: int,
+    context: float,
+    block_size: int = 16,
+    weights_int8: bool = False,
+    kv_int8: bool = False,
+    fused: bool = False,
+) -> DecodeBytesBreakdown:
+    """Modeled HBM bytes one decode step reads/writes per emitted token.
+
+    weights: every step streams the full dense weight set once (MoE expert
+    stacks stay bf16 and are counted at 2 bytes), amortized over the B
+    lanes decoding together. KV: each lane reads its live context's K+V
+    pages (whole blocks, as the paged kernels DMA them) at the resident
+    itemsize, plus the per-(layer, head, block) f32 scale plane when
+    int8-resident. Activations: one [B, hidden] write + read per program
+    boundary in the layer hot path (UNFUSED/FUSED_LAYER_BOUNDARIES).
+    """
+    from dynamo_tpu.models.llama import param_count
+
+    c = config
+    dense_params = param_count(dataclasses.replace(c, num_experts=0))
+    expert_params = param_count(c) - dense_params
+    weight_bytes = dense_params * (1 if weights_int8 else 2) + expert_params * 2
+    # lm_head/embed are shared in param_count's total already
+
+    blocks = -(-context // block_size)  # whole pages, as the kernels DMA
+    kv_elems = 2 * c.num_layers * c.num_kv_heads * c.head_dim
+    kv_bytes = kv_elems * blocks * block_size * (1 if kv_int8 else 2)
+    kv_scale_bytes = (
+        2 * c.num_layers * c.num_kv_heads * blocks * 4 if kv_int8 else 0.0
+    )
+
+    boundaries = (
+        FUSED_LAYER_BOUNDARIES if fused else UNFUSED_LAYER_BOUNDARIES
+    )
+    # each boundary writes then reads a [B, hidden]-sized bf16 tensor;
+    # per token that is 2 (w+r) * hidden * 2 bytes
+    act_bytes = c.num_layers * boundaries * 2 * c.hidden_size * 2
+
+    return DecodeBytesBreakdown(
+        weight_bytes_per_token=weight_bytes / max(1, batch),
+        kv_bytes_per_token=float(kv_bytes),
+        kv_scale_bytes_per_token=float(kv_scale_bytes),
+        activation_bytes_per_token=float(act_bytes),
+    )
+
+
+def mfu_decode_est(
+    config, tok_s_per_chip: float, peak_flops: float = DEFAULT_PEAK_FLOPS
+) -> float:
+    """Decode MFU estimate: 2 * params * tok/s / peak (bench.py's formula,
+    shared so the engine gauge and the banked captures agree)."""
+    from dynamo_tpu.models.llama import param_count
+
+    if tok_s_per_chip <= 0 or peak_flops <= 0:
+        return 0.0
+    return 2.0 * param_count(config) * tok_s_per_chip / peak_flops
+
+
+def peak_flops_from_env() -> float:
+    import os
+
+    v = os.environ.get("DYN_TPU_PEAK_FLOPS")
+    return float(v) if v else DEFAULT_PEAK_FLOPS

@@ -595,6 +595,10 @@ async def run_endpoint(
                 fenced_rejects_by_plane=(
                     integ["fenced_rejects_by_plane"] or None
                 ),
+                decode_hbm_bytes_per_token=d.get(
+                    "decode_hbm_bytes_per_token", 0.0
+                ),
+                mfu_decode_est=d.get("mfu_decode_est", 0.0),
             ),
             kv_stats=KvStats(
                 kv_active_blocks=used,

@@ -137,6 +137,11 @@ class WorkerStats:
     integrity_failures_by_path: Optional[dict[str, int]] = None
     num_blocks_quarantined: int = 0
     fenced_rejects_by_plane: Optional[dict[str, int]] = None
+    # decode-bandwidth plane (ISSUE 9, both gauges): modeled HBM bytes per
+    # emitted token for the worker's live batch shape, and its windowed
+    # decode-MFU estimate (engine/jax_engine/perf_model.py)
+    decode_hbm_bytes_per_token: float = 0.0
+    mfu_decode_est: float = 0.0
 
 
 @dataclass

@@ -256,6 +256,12 @@ class KvMetricsAggregator:
                 agg.worker_stats.brownout_level,
                 m.worker_stats.brownout_level,
             )
+            # decode-bandwidth gauges: averaged over reporting workers
+            # (the /n division below, alongside the cache-usage gauges)
+            agg.worker_stats.decode_hbm_bytes_per_token += (
+                m.worker_stats.decode_hbm_bytes_per_token
+            )
+            agg.worker_stats.mfu_decode_est += m.worker_stats.mfu_decode_est
             if m.worker_stats.preemptions_by_class:
                 if agg.worker_stats.preemptions_by_class is None:
                     agg.worker_stats.preemptions_by_class = {}
@@ -308,4 +314,6 @@ class KvMetricsAggregator:
         if n:
             agg.kv_stats.gpu_cache_usage_perc /= n
             agg.kv_stats.gpu_prefix_cache_hit_rate /= n
+            agg.worker_stats.decode_hbm_bytes_per_token /= n
+            agg.worker_stats.mfu_decode_est /= n
         return agg

@@ -33,8 +33,14 @@ from dynamo_tpu.ops.attention import (
     write_prefill_kv,
 )
 from dynamo_tpu.ops.basics import rms_norm, rope_freqs, swiglu
+from dynamo_tpu.ops.kv_quant import cache_layer, cache_set_layer
 from dynamo_tpu.ops.layers import attn_out, qkv_head
-from dynamo_tpu.ops.linear import linear, maybe_quantize
+from dynamo_tpu.ops.linear import (
+    fused_attn_out_residual,
+    fused_qkv_rope,
+    linear,
+    maybe_quantize,
+)
 
 
 @dataclass(frozen=True)
@@ -65,6 +71,13 @@ class LlamaConfig:
     # without stomping the ops-level global (e.g. a TP-meshed engine on
     # the XLA path next to a single-chip engine on the pallas path)
     attn_impl: Optional[str] = None
+    # Fused decode step (DYN_FUSED_DECODE): norm+QKV+rope in one pallas
+    # program and attn-out+O-proj+residual in another, cutting per-layer
+    # decode launches and activation HBM round-trips. Applies to the
+    # unsharded decode path of plain/bias models (qk-norm and sandwich
+    # norms fall back to the unfused head); bit-identical by construction
+    # (ops/linear.py fused kernels mirror the unfused op sequence).
+    fused_decode: bool = False
     # Sliding-window attention (Mistral / Gemma2 / Gemma3 local layers):
     # token i attends to (i-window, i]. None = full attention. The paged
     # cache still stores every position (the mask, not a rolling buffer,
@@ -372,8 +385,47 @@ def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_c
     return _attn_out(attn, x, layer, cfg), k_cache_l, v_cache_l
 
 
+def _use_fused_decode(cfg, layer, mesh) -> bool:
+    """Fused decode applies when enabled, for the unsharded path, and for
+    layers the fused heads cover exactly (no per-head qk-norm, no
+    sandwich post-attention norm). Independent of the attention kernel
+    choice — the fused projections are their own pallas programs."""
+    return (
+        cfg.fused_decode
+        and mesh is None
+        and "q_norm" not in layer
+        and "post_attn_norm" not in layer
+    )
+
+
+def _fused_interpret(cfg) -> bool:
+    """Interpret the fused kernels off-TPU (CPU tests/benches) or when the
+    model is pinned to the interpret attention impl."""
+    return (
+        cfg.attn_impl == "pallas_interpret"
+        or jax.default_backend() != "tpu"
+    )
+
+
 def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, block_tables, slot_indices, mesh=None, head_axis=None, li=0):
-    q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
+    fused = _use_fused_decode(cfg, layer, mesh)
+    if fused:
+        interp = _fused_interpret(cfg)
+        # cos/sin computed exactly as apply_rope's angle formula; the
+        # rotation itself runs inside the fused program
+        angles = positions[..., None].astype(jnp.float32) * inv_freqs
+        q, k, v = fused_qkv_rope(
+            x, layer["attn_norm"], layer["wq"], layer["wk"], layer["wv"],
+            jnp.cos(angles), jnp.sin(angles),
+            eps=cfg.rms_eps,
+            num_heads=cfg.num_heads,
+            num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim,
+            bq=layer.get("bq"), bk=layer.get("bk"), bv=layer.get("bv"),
+            interpret=interp,
+        )
+    else:
+        q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
     k_cache_l, v_cache_l = write_decode_kv(k_cache_l, v_cache_l, k, v, slot_indices)
     attn = paged_decode_attention(
         q, k_cache_l, v_cache_l, block_tables, positions + 1,
@@ -381,6 +433,12 @@ def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, bloc
         window=cfg.layer_window(li), scale=cfg.attn_scale,
         logit_softcap=cfg.attn_logit_softcap,
     )
+    if fused:
+        out = fused_attn_out_residual(
+            attn.reshape(x.shape[0], cfg.q_dim), layer["wo"], x,
+            interpret=_fused_interpret(cfg),
+        )
+        return out, k_cache_l, v_cache_l
     return _attn_out(attn, x, layer, cfg), k_cache_l, v_cache_l
 
 
@@ -517,11 +575,11 @@ def _prefill_from_embeds(
     for i, layer in enumerate(params["layers"]):
         x, kc, vc = _attn_prefill(
             x, layer, cfg, _layer_freqs(cfg, i, freqs), positions, valid_len,
-            k_cache[i], v_cache[i], block_table,
+            cache_layer(k_cache, i), cache_layer(v_cache, i), block_table,
             mesh=mesh, head_axis=attn_head_axis, li=i,
         )
-        k_cache = k_cache.at[i].set(kc)
-        v_cache = v_cache.at[i].set(vc)
+        k_cache = cache_set_layer(k_cache, i, kc)
+        v_cache = cache_set_layer(v_cache, i, vc)
         x = _mlp(x, layer, cfg, mesh)
     logits = _logits(x[valid_len - 1][None, :], params, cfg)[0]
     return logits, k_cache, v_cache
@@ -555,7 +613,8 @@ def prefill_chunk(
     for i, layer in enumerate(params["layers"]):
         q, k, v = _qkv(x, layer, cfg, _layer_freqs(cfg, i, freqs), positions)
         kc, vc = write_chunk_kv(
-            k_cache[i], v_cache[i], k, v, block_table, chunk_start
+            cache_layer(k_cache, i), cache_layer(v_cache, i), k, v,
+            block_table, chunk_start,
         )
         attn = chunked_prefill_attention(
             q, kc, vc, block_table, chunk_start,
@@ -564,8 +623,8 @@ def prefill_chunk(
         )
         x = _attn_out(attn, x, layer, cfg)
         x = _mlp(x, layer, cfg, mesh)
-        k_cache = k_cache.at[i].set(kc)
-        v_cache = v_cache.at[i].set(vc)
+        k_cache = cache_set_layer(k_cache, i, kc)
+        v_cache = cache_set_layer(v_cache, i, vc)
     idx = jnp.clip(valid_len - 1 - chunk_start, 0, C - 1)
     logits = _logits(x[idx][None, :], params, cfg)[0]
     return logits, k_cache, v_cache
@@ -599,7 +658,10 @@ def prefill_packed(
     x = _embed(params, cfg, tokens)
     for i, layer in enumerate(params["layers"]):
         q, k, v = _qkv(x, layer, cfg, _layer_freqs(cfg, i, freqs), positions)
-        kc, vc = write_decode_kv(k_cache[i], v_cache[i], k, v, slot_indices)
+        kc, vc = write_decode_kv(
+            cache_layer(k_cache, i), cache_layer(v_cache, i), k, v,
+            slot_indices,
+        )
         attn = packed_prefill_attention(
             q, k, v, segment_ids,
             window=cfg.layer_window(i), scale=cfg.attn_scale,
@@ -607,8 +669,8 @@ def prefill_packed(
         )
         x = _attn_out(attn, x, layer, cfg)
         x = _mlp(x, layer, cfg, mesh)
-        k_cache = k_cache.at[i].set(kc)
-        v_cache = v_cache.at[i].set(vc)
+        k_cache = cache_set_layer(k_cache, i, kc)
+        v_cache = cache_set_layer(v_cache, i, vc)
     logits = _logits(x[last_idx], params, cfg)
     return logits, k_cache, v_cache
 
@@ -659,9 +721,12 @@ def prefill_context_parallel(
         x = _attn_out(attn, x, layer, cfg)
         x = _mlp(x, layer, cfg, mesh)
         if paginate:
-            kc, vc = write_prefill_kv(k_cache[i], v_cache[i], k, v, block_table)
-            k_cache = k_cache.at[i].set(kc)
-            v_cache = v_cache.at[i].set(vc)
+            kc, vc = write_prefill_kv(
+                cache_layer(k_cache, i), cache_layer(v_cache, i), k, v,
+                block_table,
+            )
+            k_cache = cache_set_layer(k_cache, i, kc)
+            v_cache = cache_set_layer(v_cache, i, vc)
         else:
             k_all.append(k)
             v_all.append(v)
@@ -726,7 +791,10 @@ def decode_verify(
     x = _embed(params, cfg, tokens.reshape(-1))  # [B*S, hidden]
     for i, layer in enumerate(params["layers"]):
         q, k, v = _qkv(x, layer, cfg, _layer_freqs(cfg, i, freqs), pos_flat)
-        kc, vc = write_decode_kv(k_cache[i], v_cache[i], k, v, slots_flat)
+        kc, vc = write_decode_kv(
+            cache_layer(k_cache, i), cache_layer(v_cache, i), k, v,
+            slots_flat,
+        )
         attn = paged_verify_attention(
             q.reshape(B, S, cfg.num_heads, cfg.head_dim), kc, vc,
             block_tables, positions,
@@ -736,8 +804,8 @@ def decode_verify(
         )
         x = _attn_out(attn.reshape(B * S, cfg.num_heads, cfg.head_dim), x, layer, cfg)
         x = _mlp(x, layer, cfg, mesh)
-        k_cache = k_cache.at[i].set(kc)
-        v_cache = v_cache.at[i].set(vc)
+        k_cache = cache_set_layer(k_cache, i, kc)
+        v_cache = cache_set_layer(v_cache, i, vc)
     return _logits(x, params, cfg).reshape(B, S, -1), k_cache, v_cache
 
 
@@ -760,10 +828,11 @@ def decode(
     for i, layer in enumerate(params["layers"]):
         x, kc, vc = _attn_decode(
             x, layer, cfg, _layer_freqs(cfg, i, freqs), positions,
-            k_cache[i], v_cache[i], block_tables, slot_indices,
+            cache_layer(k_cache, i), cache_layer(v_cache, i),
+            block_tables, slot_indices,
             mesh=mesh, head_axis=attn_head_axis, li=i,
         )
-        k_cache = k_cache.at[i].set(kc)
-        v_cache = v_cache.at[i].set(vc)
+        k_cache = cache_set_layer(k_cache, i, kc)
+        v_cache = cache_set_layer(v_cache, i, vc)
         x = _mlp(x, layer, cfg, mesh)
     return _logits(x, params, cfg), k_cache, v_cache

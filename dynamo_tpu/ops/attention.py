@@ -62,13 +62,22 @@ def _prefill_block(P: int) -> Optional[int]:
     return None
 
 
-def _pallas_tileable(head_dim: int, block_size: int = 8) -> bool:
+def _pallas_tileable(
+    head_dim: int, block_size: int = 8, kv_bits: int = 16
+) -> bool:
     """Mosaic VMEM tiling: lane dim (head_dim) must be a multiple of 128,
     sublane dim (page block_size) a multiple of 8 — compiling outside
     that fails on real TPU ('Slice shape ... must be aligned to tiling').
-    Interpret mode has no such limits, so CPU tests still cover any
-    shape; production callers (ModelRunner) pre-check too."""
-    return head_dim % 128 == 0 and block_size % 8 == 0
+    int8-resident pages tighten the sublane minimum to 32 (the int8 tile
+    is (32, 128)). Interpret mode has no such limits, so CPU tests still
+    cover any shape; production callers (ModelRunner) pre-check too."""
+    sub = 32 if kv_bits == 8 else 8
+    return head_dim % 128 == 0 and block_size % sub == 0
+
+
+def _cache_quantized(cache) -> bool:
+    """True for the int8-resident {"q", "s"} paged-cache container."""
+    return isinstance(cache, dict)
 
 
 def _softcap(scores: jax.Array, cap: Optional[float]) -> jax.Array:
@@ -233,43 +242,67 @@ def paged_decode_attention(
     (round-1 VERDICT flagged the XLA-gather fallback here as the top perf
     weakness). Batch/tables/lens are replicated across tp; the wo psum that
     follows is GSPMD-inserted outside this op.
+
+    Int8-resident caches ({"q", "s"} containers, ops/kv_quant.py): the
+    pallas kernel DMAs the int8 pages and dequantizes per page INSIDE the
+    online-softmax loop (scales ride scalar prefetch); the XLA path
+    dequantizes right after its gather. bf16 K/V never round-trips HBM.
     """
+    quant = _cache_quantized(k_cache)
+    kq = k_cache["q"] if quant else k_cache
+    vq = v_cache["q"] if quant else v_cache
     impl = get_attention_impl(impl)
     if impl == "pallas" and not _pallas_tileable(
-        q.shape[-1], k_cache.shape[2]
+        q.shape[-1], kq.shape[2], kv_bits=8 if quant else 16
     ):
         impl = "xla"
     if impl != "xla":
         from dynamo_tpu.ops.pallas_attention import paged_decode_attention_pallas
 
         interp = impl == "pallas_interpret"
+        ks = k_cache["s"] if quant else None
+        vs = v_cache["s"] if quant else None
         if mesh is not None and head_axis is not None:
             from jax.experimental.shard_map import shard_map
 
+            cache_spec = PSpec(head_axis, None, None, None)
+            in_specs = [
+                PSpec(None, head_axis, None),  # q [B, Hq, D]
+                cache_spec,  # k cache [Hkv, nb, bs, D]
+                cache_spec,
+                PSpec(None, None),  # block tables
+                PSpec(None),  # context lens
+            ]
+            if quant:
+                in_specs += [PSpec(head_axis, None)] * 2  # scale planes
+
+            def _kern(q_, k_, v_, bt_, cl_, *scales):
+                ks_, vs_ = scales if scales else (None, None)
+                return paged_decode_attention_pallas(
+                    q_, k_, v_, bt_, cl_, k_scales=ks_, v_scales=vs_,
+                    window=window, scale=scale,
+                    logit_softcap=logit_softcap, interpret=interp,
+                )
+
             fn = shard_map(
-                lambda q_, k_, v_, bt_, cl_: paged_decode_attention_pallas(
-                    q_, k_, v_, bt_, cl_, window=window, scale=scale,
-                    logit_softcap=logit_softcap, interpret=interp
-                ),
+                _kern,
                 mesh=mesh,
-                in_specs=(
-                    PSpec(None, head_axis, None),  # q [B, Hq, D]
-                    PSpec(head_axis, None, None, None),  # k cache [Hkv, nb, bs, D]
-                    PSpec(head_axis, None, None, None),
-                    PSpec(None, None),  # block tables
-                    PSpec(None),  # context lens
-                ),
+                in_specs=tuple(in_specs),
                 out_specs=PSpec(None, head_axis, None),
                 check_rep=False,
             )
-            return fn(q, k_cache, v_cache, block_tables, context_lens)
+            args = (q, kq, vq, block_tables, context_lens)
+            if quant:
+                args += (ks, vs)
+            return fn(*args)
         return paged_decode_attention_pallas(
-            q, k_cache, v_cache, block_tables, context_lens,
+            q, kq, vq, block_tables, context_lens,
+            k_scales=ks, v_scales=vs,
             window=window, scale=scale, logit_softcap=logit_softcap,
             interpret=interp,
         )
     B, Hq, D = q.shape
-    Hkv, _, block_size, _ = k_cache.shape
+    Hkv, _, block_size, _ = kq.shape
     G = Hq // Hkv
     max_blocks = block_tables.shape[1]
     S = max_blocks * block_size
@@ -277,8 +310,18 @@ def paged_decode_attention(
         1.0 / jnp.sqrt(D).astype(jnp.float32)
     )
     # [Hkv, B, max_blocks, block_size, D] -> [Hkv, B, S, D]
-    k = k_cache[:, block_tables].reshape(Hkv, B, S, D)
-    v = v_cache[:, block_tables].reshape(Hkv, B, S, D)
+    if quant:
+        from dynamo_tpu.ops.kv_quant import dequantize
+
+        k = dequantize(
+            kq[:, block_tables], k_cache["s"][:, block_tables]
+        ).reshape(Hkv, B, S, D)
+        v = dequantize(
+            vq[:, block_tables], v_cache["s"][:, block_tables]
+        ).reshape(Hkv, B, S, D)
+    else:
+        k = k_cache[:, block_tables].reshape(Hkv, B, S, D)
+        v = v_cache[:, block_tables].reshape(Hkv, B, S, D)
     qr = q.reshape(B, Hkv, G, D)
     scores = jnp.einsum(
         "bhgd,hbsd->bhgs", qr.astype(jnp.float32), k.astype(jnp.float32)
@@ -326,9 +369,12 @@ def paged_verify_attention(
     [Hkv, B, S_ctx, D] gather window is the same size decode already
     pays).
     """
+    quant = _cache_quantized(k_cache)
+    kq = k_cache["q"] if quant else k_cache
+    vq = v_cache["q"] if quant else v_cache
     impl = get_attention_impl(impl)
     if impl == "pallas" and not _pallas_tileable(
-        q.shape[-1], k_cache.shape[2]
+        q.shape[-1], kq.shape[2], kv_bits=8 if quant else 16
     ):
         impl = "xla"
     if impl != "xla":
@@ -337,33 +383,48 @@ def paged_verify_attention(
         )
 
         interp = impl == "pallas_interpret"
+        ks = k_cache["s"] if quant else None
+        vs = v_cache["s"] if quant else None
         if mesh is not None and head_axis is not None:
             from jax.experimental.shard_map import shard_map
 
+            in_specs = [
+                PSpec(None, None, head_axis, None),  # q [B, S, Hq, D]
+                PSpec(head_axis, None, None, None),  # k cache
+                PSpec(head_axis, None, None, None),
+                PSpec(None, None),  # block tables
+                PSpec(None, None),  # positions
+            ]
+            if quant:
+                in_specs += [PSpec(head_axis, None)] * 2
+
+            def _kern(q_, k_, v_, bt_, ps_, *scales):
+                ks_, vs_ = scales if scales else (None, None)
+                return paged_verify_attention_pallas(
+                    q_, k_, v_, bt_, ps_, k_scales=ks_, v_scales=vs_,
+                    window=window, scale=scale,
+                    logit_softcap=logit_softcap, interpret=interp,
+                )
+
             fn = shard_map(
-                lambda q_, k_, v_, bt_, ps_: paged_verify_attention_pallas(
-                    q_, k_, v_, bt_, ps_, window=window, scale=scale,
-                    logit_softcap=logit_softcap, interpret=interp
-                ),
+                _kern,
                 mesh=mesh,
-                in_specs=(
-                    PSpec(None, None, head_axis, None),  # q [B, S, Hq, D]
-                    PSpec(head_axis, None, None, None),  # k cache
-                    PSpec(head_axis, None, None, None),
-                    PSpec(None, None),  # block tables
-                    PSpec(None, None),  # positions
-                ),
+                in_specs=tuple(in_specs),
                 out_specs=PSpec(None, None, head_axis, None),
                 check_rep=False,
             )
-            return fn(q, k_cache, v_cache, block_tables, positions)
+            args = (q, kq, vq, block_tables, positions)
+            if quant:
+                args += (ks, vs)
+            return fn(*args)
         return paged_verify_attention_pallas(
-            q, k_cache, v_cache, block_tables, positions,
+            q, kq, vq, block_tables, positions,
+            k_scales=ks, v_scales=vs,
             window=window, scale=scale, logit_softcap=logit_softcap,
             interpret=interp,
         )
     B, S, Hq, D = q.shape
-    Hkv, _, block_size, _ = k_cache.shape
+    Hkv, _, block_size, _ = kq.shape
     G = Hq // Hkv
     max_blocks = block_tables.shape[1]
     S_ctx = max_blocks * block_size
@@ -371,8 +432,18 @@ def paged_verify_attention(
         1.0 / jnp.sqrt(D).astype(jnp.float32)
     )
     # [Hkv, B, max_blocks, block_size, D] -> [Hkv, B, S_ctx, D]
-    k = k_cache[:, block_tables].reshape(Hkv, B, S_ctx, D)
-    v = v_cache[:, block_tables].reshape(Hkv, B, S_ctx, D)
+    if quant:
+        from dynamo_tpu.ops.kv_quant import dequantize
+
+        k = dequantize(
+            kq[:, block_tables], k_cache["s"][:, block_tables]
+        ).reshape(Hkv, B, S_ctx, D)
+        v = dequantize(
+            vq[:, block_tables], v_cache["s"][:, block_tables]
+        ).reshape(Hkv, B, S_ctx, D)
+    else:
+        k = k_cache[:, block_tables].reshape(Hkv, B, S_ctx, D)
+        v = v_cache[:, block_tables].reshape(Hkv, B, S_ctx, D)
     qr = q.reshape(B, S, Hkv, G, D)
     scores = jnp.einsum(
         "bshgd,hbkd->bhgsk", qr.astype(jnp.float32), k.astype(jnp.float32)
@@ -412,14 +483,26 @@ def chunked_prefill_attention(
     the null block and are causally masked (kpos <= qpos < chunk_end).
     """
     C, Hq, D = q.shape
-    Hkv, _, block_size, _ = k_cache.shape
+    quant = _cache_quantized(k_cache)
+    kc = k_cache["q"] if quant else k_cache
+    Hkv, _, block_size, _ = kc.shape
     G = Hq // Hkv
     S = block_table.shape[0] * block_size
     sc = jnp.float32(scale) if scale is not None else (
         1.0 / jnp.sqrt(D).astype(jnp.float32)
     )
-    k = k_cache[:, block_table].reshape(Hkv, S, D)
-    v = v_cache[:, block_table].reshape(Hkv, S, D)
+    if quant:
+        from dynamo_tpu.ops.kv_quant import dequantize
+
+        k = dequantize(
+            kc[:, block_table], k_cache["s"][:, block_table]
+        ).reshape(Hkv, S, D)
+        v = dequantize(
+            v_cache["q"][:, block_table], v_cache["s"][:, block_table]
+        ).reshape(Hkv, S, D)
+    else:
+        k = k_cache[:, block_table].reshape(Hkv, S, D)
+        v = v_cache[:, block_table].reshape(Hkv, S, D)
     qr = q.reshape(C, Hkv, G, D)
     scores = jnp.einsum(
         "chgd,hsd->hgcs", qr.astype(jnp.float32), k.astype(jnp.float32)
@@ -452,7 +535,8 @@ def write_chunk_kv(
     into EARLIER blocks, corrupting already-written KV); pad lanes land in
     null block 0, the designated garbage sink.
     """
-    Hkv, _, block_size, D = k_cache.shape
+    kc = k_cache["q"] if _cache_quantized(k_cache) else k_cache
+    Hkv, _, block_size, D = kc.shape
     nb = k_new.shape[0] // block_size
     padded_table = jnp.concatenate(
         [block_table, jnp.zeros(nb, block_table.dtype)]
@@ -470,12 +554,24 @@ def write_prefill_kv(
     v_new: jax.Array,
     block_table: jax.Array,  # [P // block_size] int32
 ) -> tuple[jax.Array, jax.Array]:
-    """Scatter a prompt's computed K/V into its allocated blocks."""
-    Hkv, _, block_size, D = k_cache.shape
+    """Scatter a prompt's computed K/V into its allocated blocks.
+
+    Int8-resident caches quantize-on-write: whole blocks get their exact
+    per-(head, block) absmax scale (the wire codec's scheme, on device)."""
+    quant = _cache_quantized(k_cache)
+    kc = k_cache["q"] if quant else k_cache
+    Hkv, _, block_size, D = kc.shape
     nb = k_new.shape[0] // block_size
     # [P, Hkv, D] -> [Hkv, nb, block_size, D]
     k_blocks = k_new.reshape(nb, block_size, Hkv, D).transpose(2, 0, 1, 3)
     v_blocks = v_new.reshape(nb, block_size, Hkv, D).transpose(2, 0, 1, 3)
+    if quant:
+        from dynamo_tpu.ops.kv_quant import write_blocks_quant
+
+        return (
+            write_blocks_quant(k_cache, k_blocks, block_table),
+            write_blocks_quant(v_cache, v_blocks, block_table),
+        )
     k_cache = k_cache.at[:, block_table].set(k_blocks)
     v_cache = v_cache.at[:, block_table].set(v_blocks)
     return k_cache, v_cache
@@ -488,7 +584,18 @@ def write_decode_kv(
     v_new: jax.Array,
     slot_indices: jax.Array,  # [B] int32 flat slot = block_id*block_size + offset
 ) -> tuple[jax.Array, jax.Array]:
-    """Scatter one new K/V token per sequence into its current block slot."""
+    """Scatter one new K/V token per sequence into its current block slot.
+
+    Int8-resident caches route through write_tokens_quant: appended tokens
+    grow the block scale monotonically (rescaling existing mantissas when
+    it grows), so decode/verify/packed writes stay duplicate-safe."""
+    if _cache_quantized(k_cache):
+        from dynamo_tpu.ops.kv_quant import write_tokens_quant
+
+        return (
+            write_tokens_quant(k_cache, k_new, slot_indices),
+            write_tokens_quant(v_cache, v_new, slot_indices),
+        )
     Hkv, num_blocks, block_size, D = k_cache.shape
     k_flat = k_cache.reshape(Hkv, num_blocks * block_size, D)
     v_flat = v_cache.reshape(Hkv, num_blocks * block_size, D)

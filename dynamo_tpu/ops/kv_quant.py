@@ -1,0 +1,206 @@
+"""Int8-resident paged KV cache: device-side quantized storage helpers.
+
+Promotes the PR-4 wire codec (disagg/protocols.kv_quantize_int8 — symmetric
+absmax int8 with one f32 scale per (layer, head, block)) from wire-only to
+DEVICE-resident: the paged KV cache itself stores int8 mantissas plus a
+per-block scale plane, so every decode step reads ~half the KV bytes from
+HBM and dequantizes inside the attention kernel (pallas) or right after the
+gather (XLA path). bf16 K/V for past tokens never materializes in HBM.
+
+Layout (a plain dict, so it rides every jit/donate/pytree path unchanged):
+
+    cache = {"q": int8 [L, Hkv, num_blocks, bs, D],
+             "s": f32  [L, Hkv, num_blocks]}
+
+The scale scheme is EXACTLY the wire codec's (amax/127 per block, inv=0 for
+all-zero blocks), so int8-resident blocks ship verbatim over disagg frames,
+peer pulls, and the G2/G3 offload tiers — no recode, no double quantization.
+
+Write semantics:
+
+  * whole-block writes (prefill / chunked prefill) compute the exact
+    per-block absmax — bit-identical to the numpy wire codec run on the
+    same values;
+  * append writes (decode / spec-verify) grow the block's scale
+    monotonically: new_scale = max(old_scale, token_absmax/127). When the
+    scale grows, the block's existing mantissas are rescaled
+    (round(q * old/new)) in the same fused scatter — old tokens lose at
+    most 1/2 ulp per growth event, bounded by the absmax-of-block-so-far
+    scheme. A write at block offset 0 RESETS the scale (recycled blocks
+    carry a dead occupant's scale; attention masks its slots by position,
+    but its scale must not inflate the fresh block's quantization range).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import jax
+import jax.numpy as jnp
+
+KVCache = Union[jax.Array, dict]
+
+
+def is_quantized(cache: Any) -> bool:
+    """True for the int8-resident {"q", "s"} cache container."""
+    return isinstance(cache, dict)
+
+
+def make_cache(
+    shape: tuple[int, ...], dtype, *, quantized: bool
+) -> KVCache:
+    """Zero-initialized cache: plain array, or the int8+scale container."""
+    if not quantized:
+        return jnp.zeros(shape, dtype)
+    return {
+        "q": jnp.zeros(shape, jnp.int8),
+        "s": jnp.zeros(shape[:-2], jnp.float32),
+    }
+
+
+def cache_zeros_like(cache: KVCache) -> KVCache:
+    return jax.tree_util.tree_map(
+        lambda a: jnp.zeros(a.shape, a.dtype), cache
+    )
+
+
+def cache_nbytes(cache: KVCache) -> int:
+    return sum(
+        a.size * a.dtype.itemsize
+        for a in jax.tree_util.tree_leaves(cache)
+    )
+
+
+def cache_layer(cache: KVCache, i: int) -> KVCache:
+    """Layer i's view: [Hkv, nb, bs, D] (+ [Hkv, nb] scales)."""
+    if is_quantized(cache):
+        return {"q": cache["q"][i], "s": cache["s"][i]}
+    return cache[i]
+
+
+def cache_set_layer(cache: KVCache, i: int, layer: KVCache) -> KVCache:
+    """Write layer i back (functional; aliases in place under donation)."""
+    if is_quantized(cache):
+        return {
+            "q": cache["q"].at[i].set(layer["q"]),
+            "s": cache["s"].at[i].set(layer["s"]),
+        }
+    return cache.at[i].set(layer)
+
+
+def cache_sharding(kv_sharding, quantized: bool):
+    """Sharding pytree matching the cache container: the scale plane
+    [L, Hkv, nb] inherits the cache's leading three axes (the head axis is
+    what TP shards)."""
+    if kv_sharding is None or not quantized:
+        return kv_sharding
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec = kv_sharding.spec
+    sspec = PartitionSpec(*tuple(spec)[:3])
+    return {
+        "q": kv_sharding,
+        "s": NamedSharding(kv_sharding.mesh, sspec),
+    }
+
+
+# ------------------------------------------------------------ quant math
+#
+# Mirrors disagg/protocols.kv_quantize_int8 exactly (scale = amax/127,
+# inv = 1/scale where scale > 0 else 0, round-half-to-even, clip +-127) so
+# device-quantized blocks and wire-quantized blocks are interchangeable.
+
+
+def block_scale(amax: jax.Array) -> jax.Array:
+    return (amax / 127.0).astype(jnp.float32)
+
+
+def scale_inv(scale: jax.Array) -> jax.Array:
+    return jnp.where(scale > 0, 1.0 / jnp.maximum(scale, 1e-30), 0.0)
+
+
+def quantize_with(x: jax.Array, inv: jax.Array) -> jax.Array:
+    """Quantize f32 values with a broadcastable inverse scale."""
+    return jnp.clip(jnp.round(x * inv), -127, 127).astype(jnp.int8)
+
+
+def dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """int8 mantissas [..., bs, D] * per-block scale [...] -> f32."""
+    return q.astype(jnp.float32) * scale[..., None, None]
+
+
+def dequantize_layer(layer: dict) -> jax.Array:
+    """Whole-layer f32 view (XLA fallback paths that need dense K/V)."""
+    return dequantize(layer["q"], layer["s"])
+
+
+# ---------------------------------------------------------------- writes
+
+
+def write_blocks_quant(
+    layer: dict,  # {"q": [Hkv, nb, bs, D] int8, "s": [Hkv, nb] f32}
+    k_blocks: jax.Array,  # [Hkv, n, bs, D] logical-dtype new blocks
+    block_table: jax.Array,  # [n] int32
+) -> dict:
+    """Whole-block write (prefill/chunk): exact per-block absmax scales."""
+    xf = k_blocks.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=(-2, -1))  # [Hkv, n]
+    scale = block_scale(amax)
+    q = quantize_with(xf, scale_inv(scale)[..., None, None])
+    return {
+        "q": layer["q"].at[:, block_table].set(q),
+        "s": layer["s"].at[:, block_table].set(scale),
+    }
+
+
+def write_tokens_quant(
+    layer: dict,  # {"q": [Hkv, nb, bs, D] int8, "s": [Hkv, nb] f32}
+    new: jax.Array,  # [T, Hkv, D] logical-dtype tokens
+    slot_indices: jax.Array,  # [T] int32 flat slots (block*bs + offset)
+) -> dict:
+    """Append-token write (decode / spec-verify / packed prefill).
+
+    Handles any number of tokens landing in the same block in one call
+    (verify windows, packed segments): incoming per-block maxima are
+    combined with a scatter-max, existing mantissas of every touched block
+    are rescaled once, then the tokens scatter by flat slot. A token at
+    block offset 0 marks the block fresh — the previous occupant's scale
+    is discarded, not grown over.
+    """
+    q_cache, s = layer["q"], layer["s"]
+    Hkv, nb, bs, D = q_cache.shape
+    bids = slot_indices // bs  # [T]
+    offs = slot_indices % bs
+    xf = new.astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, T, D]
+    tok_amax = jnp.max(jnp.abs(xf), axis=-1)  # [Hkv, T]
+
+    # per-block incoming absmax + touched/fresh masks (duplicate-safe)
+    inc = jnp.zeros((Hkv, nb), jnp.float32).at[:, bids].max(tok_amax)
+    touched = jnp.zeros((nb,), bool).at[bids].set(True)
+    fresh = (
+        jnp.zeros((nb,), jnp.int32)
+        .at[bids]
+        .max((offs == 0).astype(jnp.int32))
+    ) > 0
+
+    base = jnp.where(fresh[None, :], 0.0, s)  # scale kept from old content
+    new_s = jnp.where(
+        touched[None, :], jnp.maximum(base, block_scale(inc)), s
+    )
+
+    # rescale existing mantissas of touched blocks (gather/scatter only
+    # the T referenced blocks; duplicates gather+scatter identical data)
+    old_g = q_cache[:, bids]  # [Hkv, T, bs, D]
+    inv_g = scale_inv(new_s)[:, bids]  # [Hkv, T]
+    ratio = (base[:, bids] * inv_g)[..., None, None]
+    resc = jnp.clip(
+        jnp.round(old_g.astype(jnp.float32) * ratio), -127, 127
+    ).astype(jnp.int8)
+    q_cache = q_cache.at[:, bids].set(resc)
+
+    # insert the new tokens quantized by their block's (possibly grown)
+    # scale, via the flat-slot scatter the bf16 path uses
+    tok_q = quantize_with(xf, inv_g[..., None])  # [Hkv, T, D]
+    q_flat = q_cache.reshape(Hkv, nb * bs, D)
+    q_flat = q_flat.at[:, slot_indices].set(tok_q)
+    return {"q": q_flat.reshape(Hkv, nb, bs, D), "s": new_s}

@@ -31,6 +31,14 @@ feature set of the model zoo, applied INSIDE the online softmax:
 GQA: q for one kv head is the [G, D] group slice; scores are a [G, W*bs]
 matmul per chunk.
 
+Int8-resident caches (DYN_KV_DTYPE=int8, ops/kv_quant.py): the decode and
+verify kernels take optional per-(head, page) scale planes as extra
+scalar-prefetch operands; pages are DMA'd as int8 (half the HBM traffic)
+and the scale is multiplied onto the f32 VMEM tile inside the
+online-softmax loop — dequantized K/V exists only in VMEM, never in HBM.
+Note the int8 VMEM tile is (32, 128), so real-TPU int8 paging needs
+block_size % 32 == 0 (guarded in ops/attention._pallas_tileable).
+
 Replaces what the reference leaves to vLLM's CUDA paged_attention kernels
 (vLLM is engine-delegated at lib/llm/src/engines.rs; see also the CUDA
 block-copy kernel lib/llm/src/kernels/block_copy.cu for the layout-aware
@@ -86,26 +94,37 @@ def _decode_kernel(
     # scalar prefetch
     block_tables_ref,  # [B, max_blocks] int32 (SMEM)
     context_lens_ref,  # [B] int32 (SMEM)
-    # inputs
-    q_ref,  # [1, 1, G, D] VMEM — this (seq, kv head)'s query group
-    k_hbm,  # [Hkv, num_blocks, block_size, D] — full cache, stays in HBM
-    v_hbm,
-    # blocked output
-    o_ref,  # [1, 1, G, D]
-    # scratch
-    k_buf,  # [2, W*block_size, D] VMEM — double-buffered gathered pages
-    v_buf,
-    sems,  # DMA semaphores [2 slots, 2 (k/v), W pages]
-    m_ref,  # [G, 128] f32 — running max (replicated over lanes)
-    l_ref,  # [G, 128] f32 — running sum
-    acc_ref,  # [G, D] f32 — running weighted values
-    *,
+    # int8-resident mode only (quantized=True): two extra scalar-prefetch
+    # scale planes [Hkv, num_blocks] f32 ride SMEM, then the same refs
+    *refs,
+    # inputs (in *refs):
+    # q_ref   [1, 1, G, D] VMEM — this (seq, kv head)'s query group
+    # k_hbm   [Hkv, num_blocks, block_size, D] — full cache, stays in HBM
+    #         (int8 mantissas in quantized mode — bf16 pages never touch
+    #         HBM; dequant happens on the VMEM tile inside this loop)
+    # v_hbm
+    # o_ref   [1, 1, G, D] blocked output
+    # scratch (in *refs):
+    # k_buf   [2, W*block_size, D] VMEM — double-buffered gathered pages
+    # v_buf
+    # sems    DMA semaphores [2 slots, 2 (k/v), W pages]
+    # m_ref   [G, 128] f32 — running max (replicated over lanes)
+    # l_ref   [G, 128] f32 — running sum
+    # acc_ref [G, D] f32 — running weighted values
     block_size: int,
     pages_per_chunk: int,
     scale: float,
     window: Optional[int],
     softcap: Optional[float],
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref = refs[0], refs[1]
+        refs = refs[2:]
+    else:
+        ks_ref = vs_ref = None
+    (q_ref, k_hbm, v_hbm, o_ref,
+     k_buf, v_buf, sems, m_ref, l_ref, acc_ref) = refs
     b = pl.program_id(0)
     h = pl.program_id(1)
     ctx_len = context_lens_ref[b]
@@ -160,6 +179,21 @@ def _decode_kernel(
             q = q_ref[0, 0].astype(jnp.float32)  # [G, D]
             k = k_buf[slot].astype(jnp.float32)  # [W*bs, D]
             v = v_buf[slot].astype(jnp.float32)
+            if quantized:
+                # in-kernel dequant: one SMEM scale per fetched page,
+                # expanded to a per-row column over the [W*bs, D] tile
+                kvals = []
+                vvals = []
+                for i in range(W):
+                    page = block_tables_ref[
+                        b, jnp.minimum(c * W + i, last_page)
+                    ]
+                    kvals.append(ks_ref[h, page])
+                    vvals.append(vs_ref[h, page])
+                krow = jnp.repeat(jnp.stack(kvals), block_size)[:, None]
+                vrow = jnp.repeat(jnp.stack(vvals), block_size)[:, None]
+                k = k * krow
+                v = v * vrow
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -201,6 +235,8 @@ def paged_decode_attention_pallas(
     block_tables: jax.Array,  # [B, max_blocks] int32
     context_lens: jax.Array,  # [B] int32, INCLUDING the token just written
     *,
+    k_scales: Optional[jax.Array] = None,  # [Hkv, num_blocks] f32 — int8
+    v_scales: Optional[jax.Array] = None,  # resident cache when given
     pages_per_chunk: int = 8,
     window: Optional[int] = None,
     scale: Optional[float] = None,
@@ -208,23 +244,29 @@ def paged_decode_attention_pallas(
     interpret: bool = False,
 ) -> jax.Array:
     """Flash paged decode attention; numerics match the XLA reference for
-    every feature combination (window / scale / softcap)."""
+    every feature combination (window / scale / softcap).
+
+    With `k_scales`/`v_scales`, the cache holds int8 mantissas: the kernel
+    DMAs the int8 pages (half the HBM traffic) and multiplies each page's
+    scalar-prefetched scale onto the VMEM tile inside the online-softmax
+    loop — bf16 K/V never materializes in HBM."""
     B, Hq, D = q.shape
     Hkv, num_blocks, block_size, _ = k_cache.shape
     G = Hq // Hkv
+    quantized = k_scales is not None
     max_blocks = block_tables.shape[1]
     W = max(1, min(pages_per_chunk, max_blocks))
     sc = float(scale) if scale is not None else 1.0 / float(D) ** 0.5
 
     # index maps receive (b, h, *prefetch_refs); units are block-sized
-    def q_index(b, h, bt, cl):
+    def q_index(b, h, *prefetch):
         return (b, h, 0, 0)
 
-    def o_index(b, h, bt, cl):
+    def o_index(b, h, *prefetch):
         return (b, h, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=4 if quantized else 2,
         grid=(B, Hkv),
         in_specs=[
             pl.BlockSpec((1, 1, G, D), q_index),
@@ -249,6 +291,7 @@ def paged_decode_attention_pallas(
             scale=sc,
             window=int(window) if window is not None else None,
             softcap=float(logit_softcap) if logit_softcap is not None else None,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G, D), q.dtype),
@@ -258,13 +301,15 @@ def paged_decode_attention_pallas(
         interpret=interpret,
     )
     q_grouped = q.reshape(B, Hkv, G, D)
-    out = kernel(
+    prefetch = [
         block_tables.astype(jnp.int32),
         context_lens.astype(jnp.int32),
-        q_grouped,
-        k_cache,
-        v_cache,
-    )
+    ]
+    if quantized:
+        prefetch += [
+            k_scales.astype(jnp.float32), v_scales.astype(jnp.float32)
+        ]
+    out = kernel(*prefetch, q_grouped, k_cache, v_cache)
     return out.reshape(B, Hq, D)
 
 
@@ -275,20 +320,15 @@ def _verify_kernel(
     # scalar prefetch
     block_tables_ref,  # [B, max_blocks] int32 (SMEM)
     positions_ref,  # [B, S] int32 (SMEM) — consecutive per lane
-    # inputs
-    q_ref,  # [1, 1, S*G, D] VMEM — this lane+head's draft-window queries
-    k_hbm,  # [Hkv, num_blocks, block_size, D]
-    v_hbm,
-    # blocked output
-    o_ref,  # [1, 1, S*G, D]
-    # scratch
-    k_buf,
-    v_buf,
-    sems,
-    m_ref,  # [S*G, 128] f32
-    l_ref,
-    acc_ref,  # [S*G, D] f32
-    *,
+    # quantized mode inserts [Hkv, num_blocks] f32 k/v scale planes here,
+    # then the usual refs follow:
+    *refs,
+    # inputs (in *refs):
+    # q_ref   [1, 1, S*G, D] VMEM — this lane+head's draft-window queries
+    # k_hbm   [Hkv, num_blocks, block_size, D] (int8 when quantized)
+    # v_hbm
+    # o_ref   [1, 1, S*G, D] blocked output
+    # scratch: k_buf, v_buf, sems, m_ref [S*G, 128], l_ref, acc_ref
     block_size: int,
     pages_per_chunk: int,
     num_spec: int,  # S
@@ -297,7 +337,15 @@ def _verify_kernel(
     scale: float,
     window: Optional[int],
     softcap: Optional[float],
+    quantized: bool = False,
 ):
+    if quantized:
+        ks_ref, vs_ref = refs[0], refs[1]
+        refs = refs[2:]
+    else:
+        ks_ref = vs_ref = None
+    (q_ref, k_hbm, v_hbm, o_ref,
+     k_buf, v_buf, sems, m_ref, l_ref, acc_ref) = refs
     b = pl.program_id(0)
     h = pl.program_id(1)
     W = pages_per_chunk
@@ -350,6 +398,19 @@ def _verify_kernel(
             q = q_ref[0, 0].astype(jnp.float32)  # [S*G, D]
             k = k_buf[slot].astype(jnp.float32)  # [W*bs, D]
             v = v_buf[slot].astype(jnp.float32)
+            if quantized:
+                kvals = []
+                vvals = []
+                for i in range(W):
+                    page = block_tables_ref[
+                        b, jnp.minimum(c * W + i, last_page)
+                    ]
+                    kvals.append(ks_ref[h, page])
+                    vvals.append(vs_ref[h, page])
+                krow = jnp.repeat(jnp.stack(kvals), block_size)[:, None]
+                vrow = jnp.repeat(jnp.stack(vvals), block_size)[:, None]
+                k = k * krow
+                v = v * vrow
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -395,6 +456,8 @@ def paged_verify_attention_pallas(
     block_tables: jax.Array,  # [B, max_blocks] int32
     positions: jax.Array,  # [B, S] int32 — CONSECUTIVE per lane
     *,
+    k_scales: Optional[jax.Array] = None,  # [Hkv, num_blocks] f32 — int8
+    v_scales: Optional[jax.Array] = None,  # resident cache when given
     pages_per_chunk: int = 8,
     window: Optional[int] = None,
     scale: Optional[float] = None,
@@ -404,7 +467,8 @@ def paged_verify_attention_pallas(
     """Flash paged attention for the spec-decode verify pass: the S draft
     positions of each lane stream the lane's pages once (the decode
     kernel's DMA pattern amortized over the whole draft window) instead of
-    the XLA path's dense [Hkv, B, S_ctx, D] gather.
+    the XLA path's dense [Hkv, B, S_ctx, D] gather. With scale planes the
+    pages are int8-resident and dequantized in-kernel (see decode kernel).
 
     Assumes each lane's positions are consecutive (positions[b, s] =
     positions[b, 0] + s) — exactly what llama.decode_verify feeds; the
@@ -413,18 +477,19 @@ def paged_verify_attention_pallas(
     B, S, Hq, D = q.shape
     Hkv, num_blocks, block_size, _ = k_cache.shape
     G = Hq // Hkv
+    quantized = k_scales is not None
     max_blocks = block_tables.shape[1]
     W = max(1, min(pages_per_chunk, max_blocks))
     sc = float(scale) if scale is not None else 1.0 / float(D) ** 0.5
 
-    def q_index(b, h, bt, ps):
+    def q_index(b, h, *prefetch):
         return (b, h, 0, 0)
 
-    def o_index(b, h, bt, ps):
+    def o_index(b, h, *prefetch):
         return (b, h, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=4 if quantized else 2,
         grid=(B, Hkv),
         in_specs=[
             pl.BlockSpec((1, 1, S * G, D), q_index),
@@ -452,6 +517,7 @@ def paged_verify_attention_pallas(
             scale=sc,
             window=int(window) if window is not None else None,
             softcap=float(logit_softcap) if logit_softcap is not None else None,
+            quantized=quantized,
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hkv, S * G, D), q.dtype),
@@ -466,13 +532,15 @@ def paged_verify_attention_pallas(
             B, Hkv, S * G, D
         )
     )
-    out = kernel(
+    prefetch = [
         block_tables.astype(jnp.int32),
         positions.astype(jnp.int32),
-        q_grouped,
-        k_cache,
-        v_cache,
-    )
+    ]
+    if quantized:
+        prefetch += [
+            k_scales.astype(jnp.float32), v_scales.astype(jnp.float32)
+        ]
+    out = kernel(*prefetch, q_grouped, k_cache, v_cache)
     return (
         out.reshape(B, Hkv, S, G, D).transpose(0, 2, 1, 3, 4).reshape(
             B, S, Hq, D
