@@ -1,0 +1,327 @@
+"""Control-plane blackout sweep: degraded-mode serving + warm restarts.
+
+Two sections, one banked artifact (benchmarks/blackout_sweep.json, also
+reachable as `perf_sweep.py --preset blackout`):
+
+1. **blackout A/B** — closed-loop traffic on the mocker disagg harness
+   (decode engine + prefill fleet over the in-process fabric), run once
+   steady and once with a 1 s `fabric_blackout` injected MID-TRAFFIC.
+   Every stream must finish token-identically (disagg falls back local
+   while the queue plane is dark), with zero errors and zero
+   self-fences; banked numbers show throughput/TTFT through the
+   blackout vs steady state.
+2. **warm vs cold restart** — a repeated-prefix workload on the tiny
+   JAX engine with offload tiers: serve once, checkpoint the tiers
+   (`TieredBlockManager.checkpoint`, checksummed KVB2 pages), then
+   measure the first-request TTFT of a restarted engine that RESTORED
+   the checkpoint vs one that boots cold. The warm engine onboards the
+   prefix instead of recomputing it — measurably lower TTFT.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.blackout_sweep \
+        --json benchmarks/blackout_sweep.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import tempfile
+import time
+
+
+def _pct(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q / 100 * len(xs)))]
+
+
+async def _blackout_ab(blackout_s: float, n_requests: int) -> dict:
+    """One closed-loop run; when blackout_s > 0 the fault fires mid-run."""
+    from dynamo_tpu.engine.mocker import (
+        MockEngine,
+        MockEngineArgs,
+        MockPrefillEngine,
+    )
+    from dynamo_tpu.disagg.transfer import (
+        PrefillWorkerService,
+        RemotePrefillClient,
+    )
+    from dynamo_tpu.fabric.client import FabricClient
+    from dynamo_tpu.fabric.state import FabricState
+    from dynamo_tpu.pipeline.context import Context
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+    from dynamo_tpu.testing import faults
+
+    BS = 4
+    fabric = FabricClient.in_process(FabricState())
+    ns = "blackout-bench"
+    prefill = MockPrefillEngine(
+        MockEngineArgs(block_size=BS, speedup_ratio=1000.0), chunk_blocks=1
+    )
+    service = PrefillWorkerService(fabric, ns, prefill)
+    client = RemotePrefillClient(fabric, ns, block_size=BS, timeout=20)
+    engine = MockEngine(
+        MockEngineArgs(
+            num_blocks=256, block_size=BS, max_batch=16,
+            speedup_ratio=200.0,
+        ),
+        remote_prefill_client=client,
+        disagg_threshold=2 * BS,
+    )
+    await service.start()
+    await client.start()
+    ttfts: list[float] = []
+    errors = 0
+    diverged = 0
+    sem = asyncio.Semaphore(8)
+
+    async def one(i: int) -> None:
+        nonlocal errors, diverged
+        async with sem:
+            n = 10 + (i % 8)
+            prompt = [(j + i) % 60 + 1 for j in range(n)]
+            max_tokens = 16
+            expected = [prompt[j % n] for j in range(max_tokens)]
+            got: list[int] = []
+            t0 = time.monotonic()
+            first = None
+            async for out in engine.generate(
+                PreprocessedRequest(
+                    token_ids=prompt,
+                    sampling=SamplingOptions(),
+                    stop=StopConditions(max_tokens=max_tokens),
+                ),
+                Context(),
+            ):
+                if out.token_ids and first is None:
+                    first = time.monotonic() - t0
+                got.extend(out.token_ids)
+                if out.finish_reason is not None:
+                    if out.error is not None:
+                        errors += 1
+                    elif got != expected:
+                        diverged += 1
+                    elif first is not None:
+                        ttfts.append(first * 1e3)
+                    return
+
+    async def paced() -> None:
+        """Arrival-paced open-ish loop so the blackout window overlaps
+        live traffic: one arrival every 10 ms, the fault armed after the
+        first quarter of arrivals."""
+        arm_at = n_requests // 4
+        tasks = []
+        for i in range(n_requests):
+            if blackout_s > 0 and i == arm_at:
+                faults.set_injector(
+                    faults.FaultInjector(
+                        faults.FaultSpec(fabric_blackout_s=blackout_s)
+                    )
+                )
+            tasks.append(asyncio.ensure_future(one(i)))
+            await asyncio.sleep(0.01)
+        await asyncio.gather(*tasks)
+
+    t0 = time.monotonic()
+    try:
+        await paced()
+    finally:
+        faults.set_injector(None)
+    elapsed = time.monotonic() - t0
+    status = fabric.status()
+    out = {
+        "requests": n_requests,
+        "errors": errors,
+        "diverged": diverged,
+        "elapsed_s": round(elapsed, 3),
+        "req_per_s": round(n_requests / elapsed, 2),
+        "ttft_ms_p50": round(_pct(ttfts, 50), 2) if ttfts else None,
+        "ttft_ms_p95": round(_pct(ttfts, 95), 2) if ttfts else None,
+        "remote_prefills": engine.remote_prefills,
+        "fabric": {
+            "blackouts": status["blackouts_total"],
+            "degraded_seconds": round(
+                status["degraded_seconds_total"], 2
+            ),
+            "buffered_publishes": status["buffered_publishes"],
+        },
+    }
+    await engine.close()
+    await client.close()
+    await service.close()
+    await fabric.close()
+    return out
+
+
+async def _warm_vs_cold(prefix_blocks: int = 64) -> dict:
+    """Repeated-prefix TTFT: warm-restored tiers vs a cold boot.
+
+    Both restarted engines are COMPILE-WARMED on an alternate prompt of
+    identical shape before timing (the warm engine's warmup repeats so
+    the onboard/inject programs compile too) — the banked delta is the
+    prefill compute saved by the restored prefix cache, not XLA compile
+    noise."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from dynamo_tpu.block_manager.layout import LayoutConfig
+    from dynamo_tpu.block_manager.manager import TieredBlockManager
+    from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+    from dynamo_tpu.models import llama as L
+    from dynamo_tpu.pipeline.context import Context
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    BS = 4
+    cfg = L.LlamaConfig.tiny(vocab_size=64)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    layout = LayoutConfig(
+        num_layers=cfg.num_layers, page_size=BS,
+        num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+        dtype="bfloat16",
+    )
+    n_prompt = prefix_blocks * BS
+    prompt = [(i % 60) + 2 for i in range(n_prompt)]
+    alt_prompt = [((i * 7) % 60) + 2 for i in range(n_prompt)]
+    max_len = n_prompt + 32
+    num_blocks = 3 * prefix_blocks + 16
+
+    def make_engine(bm):
+        runner = ModelRunner(
+            cfg, params, num_blocks=num_blocks, block_size=BS, max_batch=2,
+            max_model_len=max_len,
+        )
+        return JaxEngine(
+            runner,
+            JaxEngineConfig(
+                max_batch=2, block_size=BS, num_blocks=num_blocks,
+                max_model_len=max_len, watermark_blocks=2,
+            ),
+            block_manager=bm,
+        )
+
+    async def serve(engine, toks) -> tuple[float, list[int]]:
+        t0 = time.monotonic()
+        first = None
+        out: list[int] = []
+        async for o in engine.generate(
+            PreprocessedRequest(
+                token_ids=list(toks),
+                sampling=SamplingOptions(greedy=True),
+                stop=StopConditions(max_tokens=8, ignore_eos=True),
+            ),
+            Context(),
+        ):
+            if o.token_ids and first is None:
+                first = (time.monotonic() - t0) * 1e3
+            out.extend(o.token_ids)
+        return first or 0.0, out
+
+    async def wait_offload(bm, n) -> None:
+        for _ in range(300):
+            if bm.stats.offloaded_g2 >= n:
+                return
+            await asyncio.sleep(0.02)
+
+    with tempfile.TemporaryDirectory() as ckpt:
+        # incarnation 1: serve + drain-checkpoint
+        bm1 = TieredBlockManager(layout, host_blocks=256)
+        e1 = make_engine(bm1)
+        _, gold = await serve(e1, prompt)
+        await wait_offload(bm1, prefix_blocks)
+        e1.checkpoint_tiers(ckpt)
+        await e1.close()
+
+        # cold restart: compile-warm on the alternate prompt, then time a
+        # full-recompute prefill of the target prompt
+        bm_cold = TieredBlockManager(layout, host_blocks=256)
+        e_cold = make_engine(bm_cold)
+        await serve(e_cold, alt_prompt)
+        cold_ms, cold_toks = await serve(e_cold, prompt)
+        await e_cold.close()
+
+        # warm restart: restore the checkpoint, compile-warm the SAME
+        # programs (full-prefill bucket via alt prompt, then the onboard/
+        # inject + suffix path via its repeat), then time the target
+        bm_warm = TieredBlockManager(layout, host_blocks=256)
+        e_warm = make_engine(bm_warm)
+        restored = e_warm.restore_tiers(ckpt) or {}
+        await serve(e_warm, alt_prompt)
+        await wait_offload(bm_warm, restored.get("restored", 0) + prefix_blocks)
+        await serve(e_warm, alt_prompt)  # compiles onboard path
+        warm_ms, warm_toks = await serve(e_warm, prompt)
+        onboarded = bm_warm.stats.onboarded
+        await e_warm.close()
+
+    assert cold_toks == gold and warm_toks == gold, "streams diverged"
+    return {
+        "prefix_tokens": len(prompt),
+        "restored_blocks": restored.get("restored", 0),
+        "refused_blocks": restored.get("refused", 0),
+        "onboarded_blocks": onboarded,
+        "cold_ttft_ms": round(cold_ms, 2),
+        "warm_ttft_ms": round(warm_ms, 2),
+        "warm_speedup": round(cold_ms / warm_ms, 2) if warm_ms else None,
+        "token_identical": True,
+    }
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--requests", type=int, default=60)
+    ap.add_argument("--blackout-s", type=float, default=1.0)
+    args = ap.parse_args(argv)
+    os.environ.setdefault("DYN_DEGRADED_MAX_S", "30")
+
+    async def run() -> dict:
+        steady = await _blackout_ab(0.0, args.requests)
+        blackout = await _blackout_ab(args.blackout_s, args.requests)
+        warm = await _warm_vs_cold()
+        return {
+            "bench": "blackout_sweep",
+            "blackout_s": args.blackout_s,
+            "steady": steady,
+            "blackout": blackout,
+            "warm_restart": warm,
+            "proof": {
+                "zero_errors": steady["errors"] + blackout["errors"] == 0,
+                "zero_divergence": (
+                    steady["diverged"] + blackout["diverged"] == 0
+                ),
+                "blackout_fired": blackout["fabric"]["blackouts"] >= 1,
+                "warm_beats_cold": (
+                    warm["warm_ttft_ms"] < warm["cold_ttft_ms"]
+                ),
+            },
+        }
+
+    doc = asyncio.run(run())
+    print(json.dumps(doc["proof"], indent=1))
+    print(
+        f"steady {doc['steady']['req_per_s']} req/s "
+        f"(TTFT p50 {doc['steady']['ttft_ms_p50']} ms) vs blackout "
+        f"{doc['blackout']['req_per_s']} req/s "
+        f"(p95 {doc['blackout']['ttft_ms_p95']} ms); warm restart "
+        f"{doc['warm_restart']['warm_ttft_ms']} ms vs cold "
+        f"{doc['warm_restart']['cold_ttft_ms']} ms"
+    )
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+
+
+if __name__ == "__main__":
+    main()

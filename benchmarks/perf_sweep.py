@@ -259,7 +259,7 @@ def main() -> None:
         "--preset",
         choices=[
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
-            "priority", "integrity", "decode_mfu",
+            "priority", "integrity", "decode_mfu", "blackout",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -292,7 +292,12 @@ def main() -> None:
         "decode_mfu = delegates to benchmarks.decode_mfu_bench (modeled "
         "HBM bytes/token + measured tiny-CPU tok/s for {bf16, int8-w, "
         "int8-w+int8-KV} x {fused, unfused}; banked artifact "
-        "benchmarks/decode_mfu.json)",
+        "benchmarks/decode_mfu.json). "
+        "blackout = delegates to benchmarks.blackout_sweep (throughput/"
+        "TTFT through a mid-traffic control-plane blackout vs steady "
+        "state — zero errors, zero divergence — plus warm-restart TTFT "
+        "vs cold on a repeated-prefix workload; banked artifact "
+        "benchmarks/blackout_sweep.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -345,6 +350,16 @@ def main() -> None:
 
         decode_mfu_bench.main(
             ["--json", args.json or "benchmarks/decode_mfu.json"]
+        )
+        return
+    if args.preset == "blackout":
+        # control-plane blackout sweep has its own harness (mocker disagg
+        # A/B + tiny-engine warm-restart TTFT) — one entry point for
+        # every banked curve stays `perf_sweep --preset X`
+        from benchmarks import blackout_sweep
+
+        blackout_sweep.main(
+            ["--json", args.json or "benchmarks/blackout_sweep.json"]
         )
         return
     if args.preset == "slo":

@@ -326,6 +326,49 @@ class MetricsComponent:
             "mfu_decode_est",
             "Estimated decode MFU from windowed token rate (fleet mean)",
         )
+        # control-plane health of THIS process's fabric client (degraded-
+        # mode data plane): same families every frontend exports for its
+        # own client — federation distinguishes the processes by instance
+        def _fab_status() -> dict:
+            drt = getattr(self.component, "drt", None)
+            fab = getattr(drt, "fabric", None)
+            try:
+                return fab.status() if fab is not None else {}
+            except Exception:  # noqa: BLE001 — scrape must never fail
+                return {}
+
+        def fread(key: str):
+            return lambda: float(_fab_status().get(key, 0) or 0)
+
+        g_conn = Gauge(
+            "dyn_fabric_connected",
+            "Is the fabric (control plane) reachable from this process "
+            "(1 connected, 0 unreachable)",
+            registry=self.registry,
+        )
+        g_conn.set_function(fread("connected"))
+        g_degraded = Gauge(
+            "dyn_llm_degraded_mode",
+            "Serving in degraded mode: control plane unreachable, routing "
+            "from last-known tables, publishes buffered (1 yes, 0 no)",
+            registry=self.registry,
+        )
+        g_degraded.set_function(fread("degraded"))
+        from dynamo_tpu.runtime.prom import CallbackCounter
+
+        CallbackCounter(
+            self.registry,
+            "dyn_llm_degraded_seconds_total",
+            "Cumulative seconds this process has served without a "
+            "reachable control plane",
+            fread("degraded_seconds_total"),
+        )
+        CallbackCounter(
+            self.registry,
+            "dyn_fabric_blackouts_total",
+            "Times the control plane became unreachable",
+            fread("blackouts_total"),
+        )
         self.c_hit_events = Counter(
             f"{PREFIX}_kv_hit_rate_events_total",
             "kv-hit-rate events seen",

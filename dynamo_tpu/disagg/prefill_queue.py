@@ -27,9 +27,18 @@ class PrefillQueue:
         self._fabric = fabric
         self.queue_name = f"{namespace}.prefill_queue"
 
-    async def enqueue(self, request: RemotePrefillRequest) -> int:
+    async def enqueue(
+        self, request: RemotePrefillRequest, timeout: Optional[float] = None
+    ) -> int:
+        """Enqueue one prefill. `timeout` clamps any fabric failover-gate
+        wait to the request's remaining deadline budget; when the queue
+        plane is dark (degraded mode) this raises ConnectionError fast so
+        the decode worker falls back to a LOCAL prefill instead of
+        wedging the stream on queue_put."""
         payload = msgpack.packb(request.to_wire(), use_bin_type=True)
-        return await self._fabric.queue_put(self.queue_name, payload)
+        return await self._fabric.queue_put(
+            self.queue_name, payload, timeout=timeout
+        )
 
     async def dequeue(
         self, timeout: Optional[float] = None

@@ -290,7 +290,10 @@ class RemotePrefillClient:
         if deadline is not None:
             timeout = max(0.05, min(timeout, deadline - time.time()))
         try:
-            await self.queue.enqueue(req)
+            # the enqueue itself is clamped to the same budget: a dark
+            # queue plane raises fast (degraded mode) or at the deadline
+            # (mid-failover), and the engine falls back to local prefill
+            await self.queue.enqueue(req, timeout=timeout)
             if ctx is None:
                 return await asyncio.wait_for(fut, timeout=timeout)
             # poll the requester's cancellation while waiting so a killed

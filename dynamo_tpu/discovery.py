@@ -257,6 +257,23 @@ class RemoteEngine:
                     "deadline_exceeded",
                 )
                 return
+            fab = getattr(
+                getattr(self.router, "client", None), "drt", None
+            )
+            fab = getattr(fab, "fabric", None)
+            if (
+                fab is not None
+                and getattr(fab, "in_degraded_mode", False)
+                and not getattr(fab, "failed_permanently", False)
+            ):
+                # control-plane blackout, not a worker failure: the fleet
+                # is likely healthy, only the dispatch bus is dark. Hold
+                # the replay (without burning its retry budget) until the
+                # fabric heals — bounded by the deadline/kill checks above
+                # each pass and by the client's own degraded budget.
+                dtrace.event("degraded_hold", cause=failure)
+                await fab.wait_connected(2.0)
+                continue
             failures = 1 if progressed else failures + 1
             bad = attempt_ctx.metadata.get("worker_instance_id")
             if bad is not None:

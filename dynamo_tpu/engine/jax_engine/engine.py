@@ -735,6 +735,34 @@ class JaxEngine:
         for seq in list(self._admit_order):
             self._finish(seq, FinishReason.CANCELLED)
 
+    def checkpoint_tiers(self, directory: Optional[str] = None) -> Optional[dict]:
+        """Warm-restart hook (SIGTERM drain): checkpoint the offload
+        tiers + prefix index to `directory` (default DYN_WARM_RESTART_DIR)
+        so a planned restart boots with a hot prefix cache. Returns the
+        checkpoint summary, or None when tiers/knob are absent."""
+        d = directory or os.environ.get("DYN_WARM_RESTART_DIR")
+        if not d or self.block_manager is None:
+            return None
+        try:
+            return self.block_manager.checkpoint(d)
+        except Exception:  # noqa: BLE001 — a failed checkpoint must not
+            logger.exception("warm-restart checkpoint failed")  # block exit
+            return None
+
+    def restore_tiers(self, directory: Optional[str] = None) -> Optional[dict]:
+        """Boot-side warm restart: restore verified checkpoint pages into
+        the offload tiers (corrupt pages refused, never decoded). Call
+        before serving; republish `block_manager.advert_blocks()` through
+        the KV event publisher so routers learn the restored prefixes."""
+        d = directory or os.environ.get("DYN_WARM_RESTART_DIR")
+        if not d or self.block_manager is None:
+            return None
+        try:
+            return self.block_manager.restore(d)
+        except Exception:  # noqa: BLE001 — cold boot is always acceptable
+            logger.exception("warm-restart restore failed")
+            return None
+
     async def clear_kv_blocks(self) -> dict:
         """Flush reusable KV state: the tiered offload cache (G2 host + G3
         disk) and the router-visible hash bookkeeping. In-flight sequences

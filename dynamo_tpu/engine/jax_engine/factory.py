@@ -239,11 +239,20 @@ def _maybe_block_manager(config, kv_block_size: int):
         f", disk at {disk_dir} ({disk_blocks or 'unbounded'} blocks)"
         if disk_dir else "",
     )
-    return TieredBlockManager(
+    manager = TieredBlockManager(
         layout, host_blocks=host_blocks,
         disk_dir=disk_dir, disk_blocks=disk_blocks,
         wire_codec=codec,
     )
+    warm_dir = os.environ.get("DYN_WARM_RESTART_DIR")
+    if warm_dir:
+        # warm restart: restore checksummed KVB2 checkpoint pages (written
+        # at the previous incarnation's SIGTERM drain) into the tiers —
+        # the worker boots with a hot prefix cache; corrupt pages are
+        # refused and simply recompute. run_endpoint republishes the
+        # restored block adverts once the KV event publisher is wired.
+        manager.restore(warm_dir)
+    return manager
 
 
 def kv_dtype_from_env() -> str:

@@ -272,6 +272,9 @@ async def run_http(
             asyncio.get_running_loop().create_task(_send())
 
     service.brownout_publisher = _publish_brownout
+    # control-plane health row: dyn_fabric_connected / dyn_llm_degraded_*
+    # straight off this process's fabric client (degraded-mode data plane)
+    service.metrics.attach_control_plane(drt.fabric.status)
     await service.start()
 
     async def _slo_event_loop() -> None:
@@ -453,6 +456,25 @@ async def run_endpoint(
     service = await endpoint.serve_endpoint(handler)
     await register_llm(drt, endpoint, config.mdc)
 
+    # reconcile-on-heal: when the fabric comes back from a blackout (or a
+    # promoted standby's snapshot missed our in-flight registration), re-
+    # register the instance + model ENTRY idempotently under the still-
+    # valid lease. If the lease died during the outage the puts fail and
+    # the keepalive loop self-fences — the conservative rule.
+    async def _reconcile_registration() -> None:
+        with contextlib.suppress(Exception):
+            await drt.fabric.kv_put(
+                endpoint.id.instance_key(service.instance_id),
+                service.instance.to_bytes(),
+                lease_id=service.instance_id,
+            )
+            await register_llm(drt, endpoint, config.mdc)
+            logger.info(
+                "reconciled %s registration after fabric heal", eid
+            )
+
+    drt.on_reconnect(_reconcile_registration)
+
     # self-fence: the moment a lease keepalive reports the lease gone
     # (the cluster declared us dead — possibly seconds ago, during a
     # partition), the engine fails every lane with a structured
@@ -486,6 +508,20 @@ async def run_endpoint(
     # discovery and finish in-flight requests before the process exits
     drt.on_drain(lambda: service.stop(drain=True))
 
+    # warm restart: AFTER the drain finishes (in-flight work done, its
+    # completion offloads in the tiers), checkpoint the host/disk tiers +
+    # prefix index to DYN_WARM_RESTART_DIR so the next incarnation boots
+    # with a hot prefix cache instead of cold HBM
+    if os.environ.get("DYN_WARM_RESTART_DIR") and hasattr(
+        engine, "checkpoint_tiers"
+    ):
+        async def _warm_checkpoint() -> None:
+            await asyncio.get_running_loop().run_in_executor(
+                None, engine.checkpoint_tiers
+            )
+
+        drt.on_drain(_warm_checkpoint)
+
     # KV-routing feeds: publish engine cache events + load metrics so a
     # KV-mode frontend can prefix-route to this worker (kv_router/publisher).
     from dynamo_tpu.kv_router.protocols import (
@@ -506,6 +542,19 @@ async def run_endpoint(
         engine.on_blocks_removed = kv_pub.on_blocks_removed
     if hasattr(engine, "on_cache_cleared"):
         engine.on_cache_cleared = kv_pub.publish_cleared
+    # warm restart: blocks restored from the checkpoint at boot are
+    # invisible to routers until re-advertised — republish the restored
+    # prefix chains now that the event publisher is wired
+    bm = getattr(engine, "block_manager", None)
+    if bm is not None and getattr(
+        getattr(bm, "stats", None), "warm_restored", 0
+    ):
+        adverts = bm.advert_blocks()
+        if adverts:
+            kv_pub.on_blocks_stored(adverts)
+            logger.info(
+                "republished %d warm-restored block advert(s)", len(adverts)
+            )
 
     # admin control plane: the frontend's POST /clear_kv_blocks fans out to
     # this per-worker endpoint (ref http/service/clear_kv_blocks.rs:23)

@@ -9,15 +9,41 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import inspect
 import itertools
-from typing import Any, AsyncIterator, Optional
+import os
+import time
+from collections import OrderedDict, deque
+from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.fabric import wire
 from dynamo_tpu.fabric.state import FabricState, WatchEvent
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry import trace as dtrace
+from dynamo_tpu.testing import faults
 
 logger = get_logger("dynamo_tpu.fabric.client")
+
+
+def degraded_max_s_from_env(floor: float = 0.0) -> float:
+    """Total control-plane blackout the data plane rides out before giving
+    up (DYN_DEGRADED_MAX_S, default 45 s): the fabric client keeps hunting
+    for a primary, frontends keep routing from their last-known tables,
+    and workers keep serving with publishes buffered. Past the budget the
+    client fails its streams (and workers self-fence) — serving forever on
+    stale state would risk double-serving once the cluster moves work."""
+    try:
+        v = float(os.environ.get("DYN_DEGRADED_MAX_S", "45") or 45)
+    except ValueError:
+        v = 45.0
+    return max(floor, v)
+
+
+def _degraded_buffer_size() -> int:
+    try:
+        return max(8, int(os.environ.get("DYN_DEGRADED_BUFFER", "256") or 256))
+    except ValueError:
+        return 256
 
 # Process-local fabric shared by all in-process clients, so that several
 # DistributedRuntimes in one process (e.g. tests, single-process serving)
@@ -149,9 +175,32 @@ class FabricClient:
         # promoted primary and transparently re-establishes watches/subs
         self._addrs: list[str] = []
         self._failover_s = 15.0
+        self._degraded_max_s = degraded_max_s_from_env()
         self._closed = False
         self._conn_ready = asyncio.Event()
         self._failover_task: Optional[asyncio.Task] = None
+        # ---- degraded mode (control-plane blackout tolerance) ----
+        # `degraded_since` is set the moment the store becomes unreachable
+        # (TCP loss, or an injected fabric_blackout fault) and cleared on
+        # heal; while set, event-plane publishes and stats kv-puts land in
+        # bounded rings instead of being dropped, and flush on reconnect.
+        self.degraded_since: Optional[float] = None
+        self.degraded_seconds_total = 0.0
+        self.blackouts_total = 0
+        self.buffered_publishes = 0
+        self.flushed_publishes = 0
+        self.dropped_publishes = 0
+        size = _degraded_buffer_size()
+        self._pub_ring: deque[tuple[str, bytes]] = deque(maxlen=size)
+        self._kv_ring: "OrderedDict[str, tuple[bytes, int]]" = OrderedDict()
+        self._kv_ring_max = size
+        # zero-arg callables (sync or async) fired after a heal — the
+        # reconcile-on-heal hook (re-register instances/models, re-put
+        # stats keys) consumers register via DistributedRuntime
+        self._reconnect_cbs: list[Callable] = []
+        # set when the degraded budget was exhausted and streams were
+        # closed: consumers holding for a heal must stop waiting
+        self.failed_permanently = False
 
     # ------------------------------------------------------- construction
 
@@ -246,6 +295,178 @@ class FabricClient:
         if self._state is not None:
             self._state.start()
 
+    # -------------------------------------------- degraded mode (blackout)
+
+    @property
+    def connected(self) -> bool:
+        """Is the store reachable right now (no injected blackout, and —
+        remote mode — a live primary connection)?"""
+        if self.degraded_since is not None:
+            return False
+        return self._state is not None or self._conn_ready.is_set()
+
+    @property
+    def in_degraded_mode(self) -> bool:
+        return self.degraded_since is not None
+
+    def status(self) -> dict:
+        """Control-plane health snapshot for the metrics plane
+        (`dyn_fabric_connected` / `dyn_llm_degraded_*` families)."""
+        dark = self.degraded_since
+        extra = time.monotonic() - dark if dark is not None else 0.0
+        return {
+            "connected": self.connected,
+            "degraded": dark is not None,
+            "degraded_seconds_total": self.degraded_seconds_total + extra,
+            "blackouts_total": self.blackouts_total,
+            "buffered_publishes": self.buffered_publishes,
+            "flushed_publishes": self.flushed_publishes,
+            "dropped_publishes": self.dropped_publishes,
+        }
+
+    def on_reconnect(self, cb: Callable) -> None:
+        """Register a zero-arg callable (sync or async) fired once per
+        heal, AFTER watches/subscriptions are re-established and buffered
+        publishes flushed — the reconcile-on-heal hook."""
+        self._reconnect_cbs.append(cb)
+
+    async def wait_connected(self, timeout: float) -> bool:
+        """Block until the store is reachable again (or timeout). Used by
+        callers that would otherwise burn retry budgets against a dark
+        control plane (e.g. migration replays)."""
+        end = time.monotonic() + max(0.0, timeout)
+        while True:
+            with contextlib.suppress(ConnectionError):
+                self._outage_check()
+                if self.connected:
+                    return True
+            remaining = end - time.monotonic()
+            if remaining <= 0:
+                return False
+            if self._state is None:
+                with contextlib.suppress(asyncio.TimeoutError):
+                    await asyncio.wait_for(
+                        self._conn_ready.wait(), min(remaining, 0.1)
+                    )
+            else:
+                await asyncio.sleep(min(remaining, 0.05))
+
+    def _outage_check(self) -> None:
+        """Injected-blackout fault point + heal detection, consulted at
+        every store operation. Raises ConnectionError while the window is
+        open; on the first call after it closes, flushes the degraded
+        buffers and fires the reconnect callbacks (remote natural losses
+        heal through the failover hunt instead)."""
+        if faults.active():
+            inj = faults.get_injector()
+            if inj is not None and inj.fabric_unreachable():
+                self._note_lost("injected blackout")
+                raise ConnectionError("fabric blackout (injected)")
+        if self.degraded_since is not None and (
+            self._state is not None or self._conn_ready.is_set()
+        ):
+            # fault window closed (the TCP connection never actually
+            # dropped, or we're in-process): heal here
+            self._note_healed("blackout window closed")
+
+    def _note_lost(self, cause: str) -> None:
+        if self.degraded_since is not None:
+            return
+        self.degraded_since = time.monotonic()
+        self.blackouts_total += 1
+        logger.warning(
+            "fabric unreachable (%s): DEGRADED mode — serving from "
+            "last-known tables, buffering event publishes (budget %.0fs)",
+            cause, self._degraded_max_s,
+        )
+
+    def _note_healed(self, how: str) -> None:
+        dark = self.degraded_since
+        if dark is None:
+            return
+        self.degraded_since = None
+        elapsed = time.monotonic() - dark
+        self.degraded_seconds_total += elapsed
+        logger.info(
+            "fabric healed after %.1fs degraded (%s); flushing %d buffered "
+            "publish(es) + %d stats key(s)",
+            elapsed, how, len(self._pub_ring), len(self._kv_ring),
+        )
+        self._flush_buffers()
+        self._fire_reconnect()
+
+    @staticmethod
+    def _bufferable(subject: str) -> bool:
+        """Event-plane subjects (`{ns}.events.*`: KV adverts, trace
+        exports, slo/brownout status) are fire-and-forget and safe to
+        buffer through a blackout; request/reply subjects are not — their
+        callers need the failure NOW to fall back or migrate."""
+        return ".events." in subject
+
+    def _buffer_publish(self, subject: str, payload: bytes) -> None:
+        if len(self._pub_ring) == self._pub_ring.maxlen:
+            self.dropped_publishes += 1
+        self._pub_ring.append((subject, payload))
+        self.buffered_publishes += 1
+
+    def _buffer_kv_put(self, key: str, value: bytes, lease_id: int) -> None:
+        # watch-channel semantics: the latest snapshot per key wins, so a
+        # blackout's worth of metrics ticks costs one slot, not hundreds
+        if key in self._kv_ring:
+            self._kv_ring.pop(key)
+        elif len(self._kv_ring) >= self._kv_ring_max:
+            self._kv_ring.popitem(last=False)
+            self.dropped_publishes += 1
+        self._kv_ring[key] = (value, lease_id)
+        self.buffered_publishes += 1
+
+    def _flush_buffers(self) -> None:
+        kv_items = list(self._kv_ring.items())
+        self._kv_ring.clear()
+        pubs = list(self._pub_ring)
+        self._pub_ring.clear()
+        if not kv_items and not pubs:
+            return
+        if self._state is not None:
+            for key, (value, lease_id) in kv_items:
+                if lease_id and lease_id not in self._state.leases:
+                    continue  # lease died during the blackout: stale stats
+                with contextlib.suppress(Exception):
+                    self._state.kv_put(key, value, lease_id)
+                    self.flushed_publishes += 1
+            for subject, payload in pubs:
+                with contextlib.suppress(Exception):
+                    self._state.publish(subject, payload)
+                    self.flushed_publishes += 1
+            return
+
+        async def flush_remote() -> None:
+            for key, (value, lease_id) in kv_items:
+                # a lease that died during the blackout makes the put
+                # fail server-side; the stale snapshot is dropped
+                with contextlib.suppress(Exception):
+                    await self._call_raw(
+                        "kv_put", key=key, value=value, lease_id=lease_id
+                    )
+                    self.flushed_publishes += 1
+            for subject, payload in pubs:
+                with contextlib.suppress(Exception):
+                    await self._call_raw(
+                        "publish", subject=subject, payload=payload
+                    )
+                    self.flushed_publishes += 1
+
+        self._track_pump(flush_remote())
+
+    def _fire_reconnect(self) -> None:
+        for cb in list(self._reconnect_cbs):
+            try:
+                result = cb()
+                if inspect.iscoroutine(result):
+                    self._track_pump(result)
+            except Exception:  # noqa: BLE001 — reconcile is best-effort
+                logger.exception("fabric reconnect callback failed")
+
     async def close(self) -> None:
         self._closed = True
         if self._failover_task is not None:
@@ -329,6 +550,7 @@ class FabricClient:
         except (asyncio.IncompleteReadError, ConnectionError):
             self._conn_ready.clear()
             self._conn_lost = True
+            self._note_lost("connection lost")
             # in-flight calls cannot be replayed safely (their outcome on
             # the dead primary is unknown — etcd gives the same answer);
             # callers see the error and retry through the failed-over conn
@@ -336,7 +558,10 @@ class FabricClient:
                 if not fut.done():
                     fut.set_exception(ConnectionError("fabric connection lost"))
             self._pending.clear()
-            if len(self._addrs) > 1 and not self._closed:
+            # hunt even with a SINGLE address: the common deployment is
+            # one stable fabric endpoint whose server restarts in place
+            # (supervisor/k8s) — reconnect-and-reconcile beats dying
+            if self._addrs and not self._closed:
                 if self._failover_task is None or self._failover_task.done():
                     self._failover_task = (
                         asyncio.get_running_loop().create_task(
@@ -359,33 +584,61 @@ class FabricClient:
         """Hunt for the promoted primary and resume: same leases (they
         were replicated), watches replayed level-consistently, pub/sub
         re-subscribed (messages during the gap are lost — core-NATS
-        at-most-once semantics, same as the reference)."""
+        at-most-once semantics, same as the reference).
+
+        Two nested windows: within `DYN_FABRIC_FAILOVER_S` callers park on
+        the connection gate (HA failover — a promoted standby is expected
+        momentarily); past it the client enters DEGRADED mode — calls fail
+        fast, event publishes buffer, consumers serve from their
+        last-known tables — and keeps hunting with capped full-jitter
+        backoff until `DYN_DEGRADED_MAX_S`. Only then do streams close
+        (total blackout outlived the budget: the supervisor restarts us)."""
         from dynamo_tpu.runtime.backoff import Backoff
 
+        self._note_lost("connection lost")
+        budget = max(self._degraded_max_s, self._failover_s)
         logger.warning(
-            "fabric connection lost; failing over among %s", self._addrs
+            "fabric connection lost; hunting among %s (failover gate "
+            "%.0fs, degraded budget %.0fs)",
+            self._addrs, self._failover_s, budget,
         )
         # shared retry policy (runtime/backoff.py): exp + full jitter from
-        # 100 ms up to 1 s, budgeted by the failover window — replaces the
-        # old flat 250 ms spin that synchronized every client's hunt
-        backoff = Backoff(
-            base_s=0.1, cap_s=1.0, budget_s=self._failover_s
+        # 100 ms up to 2 s, budgeted by the whole degraded window —
+        # replaces the old flat 250 ms spin that synchronized every
+        # client's hunt
+        backoff = Backoff(base_s=0.1, cap_s=2.0, budget_s=budget)
+        t0 = self.degraded_since if self.degraded_since is not None else (
+            time.monotonic()
         )
+        gate_logged = False
         while not self._closed:
             for a in self._addrs:
                 try:
                     await self._connect_to(a)
                     await self._reestablish_streams()
                     logger.info("fabric failover complete: now on %s", a)
+                    self._note_healed(f"reconnected to {a}")
                     return
                 except (OSError, RuntimeError, ConnectionError):
                     continue
+            if (
+                not gate_logged
+                and time.monotonic() - t0 > self._failover_s
+            ):
+                gate_logged = True
+                logger.warning(
+                    "failover gate (%.0fs) exhausted with no primary; "
+                    "DEGRADED data plane continues on last-known tables "
+                    "while hunting (budget %.0fs)",
+                    self._failover_s, budget,
+                )
             if not await backoff.sleep():
                 break
         logger.error(
-            "fabric failover FAILED after %.0fs; streams closed",
-            self._failover_s,
+            "fabric unreachable past the %.0fs degraded budget; "
+            "streams closed", budget,
         )
+        self.failed_permanently = True
         self._fail_streams()
 
     async def _reestablish_streams(self) -> None:
@@ -430,19 +683,34 @@ class FabricClient:
             await self._writer.drain()
         return await fut
 
-    async def _call(self, op: str, **kwargs: Any) -> Any:
+    async def _call(
+        self, op: str, *, wait_budget: Optional[float] = None, **kwargs: Any
+    ) -> Any:
         # fail fast once the read loop has died: a write into the dead
         # socket often "succeeds" (kernel buffer), and with no reader the
         # pending future would hang forever. With standby addresses the
         # call WAITS for the failover to land and proceeds on the new
-        # primary; single-address clients keep the fatal-loss contract
-        # (the supervisor restarts the process).
+        # primary — but only within the failover gate: once the client is
+        # past it (degraded mode, hunting on backoff), calls fail fast so
+        # callers can fall back / buffer instead of stalling streams.
+        # `wait_budget` clamps the gate wait further (a request with 2 s
+        # of deadline left must not park on a 15 s failover gate).
+        # Single-address clients hunt too (same address: the server may
+        # restart in place behind a stable endpoint).
+        self._outage_check()
         if not self._conn_ready.is_set():
-            if len(self._addrs) > 1 and not self._closed:
-                try:
-                    await asyncio.wait_for(
-                        self._conn_ready.wait(), self._failover_s + 1.0
+            if self._addrs and not self._closed:
+                gate = self._failover_s + 1.0
+                if self.degraded_since is not None:
+                    gate -= time.monotonic() - self.degraded_since
+                if wait_budget is not None:
+                    gate = min(gate, max(0.0, wait_budget))
+                if gate <= 0:
+                    raise ConnectionError(
+                        "fabric unreachable (degraded mode)"
                     )
+                try:
+                    await asyncio.wait_for(self._conn_ready.wait(), gate)
                 except asyncio.TimeoutError:
                     raise ConnectionError("fabric failover timed out")
             else:
@@ -460,14 +728,13 @@ class FabricClient:
     # ------------------------------------------------------------- leases
 
     async def lease_grant(self, ttl: float) -> int:
+        self._outage_check()
         if self._state is not None:
             self._ensure_started()
             return self._state.lease_grant(ttl)
         return await self._call("lease_grant", ttl=ttl)
 
     async def lease_keepalive(self, lease_id: int) -> bool:
-        from dynamo_tpu.testing import faults
-
         if faults.active():
             inj = faults.get_injector()
             if inj is not None and inj.keepalive_swallowed():
@@ -475,6 +742,11 @@ class FabricClient:
                 # Returning True keeps the worker oblivious while the
                 # fabric's janitor expires the lease and fences the epoch.
                 return True
+        # a blackout raises ConnectionError here — STORE-UNREACHABLE, which
+        # the keepalive loop treats as "keep serving, retry" (bounded by
+        # the degraded budget), distinct from alive=False = LEASE-DEAD
+        # which self-fences immediately
+        self._outage_check()
         if self._state is not None:
             return self._state.lease_keepalive(lease_id)
         return await self._call("lease_keepalive", lease_id=lease_id)
@@ -488,22 +760,36 @@ class FabricClient:
     # ----------------------------------------------------------------- kv
 
     async def kv_put(self, key: str, value: bytes, lease_id: int = 0) -> int:
-        if self._state is not None:
-            return self._state.kv_put(key, value, lease_id)
-        return await self._call("kv_put", key=key, value=value, lease_id=lease_id)
+        try:
+            self._outage_check()
+            if self._state is not None:
+                return self._state.kv_put(key, value, lease_id)
+            return await self._call(
+                "kv_put", key=key, value=value, lease_id=lease_id
+            )
+        except ConnectionError:
+            if key.startswith("stats/"):
+                # load-metrics snapshots are watch-channel state (last
+                # wins): buffer the newest per key, re-put on heal
+                self._buffer_kv_put(key, value, lease_id)
+                return 0
+            raise
 
     async def kv_create(self, key: str, value: bytes, lease_id: int = 0) -> bool:
+        self._outage_check()
         if self._state is not None:
             return self._state.kv_create(key, value, lease_id)
         return await self._call("kv_create", key=key, value=value, lease_id=lease_id)
 
     async def kv_get(self, key: str) -> Optional[bytes]:
+        self._outage_check()
         if self._state is not None:
             e = self._state.kv_get(key)
             return None if e is None else e.value
         return await self._call("kv_get", key=key)
 
     async def kv_get_prefix(self, prefix: str) -> dict[str, bytes]:
+        self._outage_check()
         if self._state is not None:
             return {
                 k: e.value for k, e in self._state.kv_get_prefix(prefix).items()
@@ -523,6 +809,7 @@ class FabricClient:
     # -------------------------------------------------------------- watch
 
     async def watch_prefix(self, prefix: str) -> Watch:
+        self._outage_check()
         if self._state is not None:
             self._ensure_started()
             wid, snapshot, q = self._state.watch_create(prefix)
@@ -566,6 +853,7 @@ class FabricClient:
     # ------------------------------------------------------------ pub/sub
 
     async def subscribe(self, subject: str, group: str = "") -> Subscription:
+        self._outage_check()
         if self._state is not None:
             self._ensure_started()
             sid, q = self._state.subscribe(subject, group)
@@ -606,11 +894,23 @@ class FabricClient:
         self._register_stream(sid, sub, "sub")
         return sub
 
-    async def publish(self, subject: str, payload: bytes) -> int:
-        if self._state is not None:
-            return self._state.publish(subject, payload)
-        from dynamo_tpu.testing import faults
-
+    async def publish(
+        self, subject: str, payload: bytes, timeout: Optional[float] = None
+    ) -> int:
+        """Publish one message. `timeout` clamps how long the call may
+        park on a failover gate (request-scoped callers pass their
+        remaining deadline budget). While the store is unreachable,
+        event-plane subjects buffer in a bounded ring (flushed on heal);
+        anything else raises so the caller can fall back or migrate."""
+        try:
+            self._outage_check()
+            if self._state is not None:
+                return self._state.publish(subject, payload)
+        except ConnectionError:
+            if self._bufferable(subject):
+                self._buffer_publish(subject, payload)
+                return 0
+            raise
         if faults.active():
             inj = faults.get_injector()
             if (
@@ -622,23 +922,44 @@ class FabricClient:
                 # the HA failover path (connection loss -> hunt primary ->
                 # re-establish watches/subs) runs under test
                 self._writer.close()
-        return await self._call("publish", subject=subject, payload=payload)
+        try:
+            return await self._call(
+                "publish", subject=subject, payload=payload,
+                wait_budget=timeout,
+            )
+        except ConnectionError:
+            if self._bufferable(subject):
+                self._buffer_publish(subject, payload)
+                return 0
+            raise
 
     # ------------------------------------------------------------- queues
 
-    async def queue_put(self, name: str, payload: bytes) -> int:
+    async def queue_put(
+        self, name: str, payload: bytes, timeout: Optional[float] = None
+    ) -> int:
+        """Enqueue one work item; raises ConnectionError FAST when the
+        queue plane is dark (degraded mode) so disagg callers fall back to
+        local prefill instead of wedging. `timeout` additionally clamps
+        the failover-gate wait to the request's remaining budget."""
+        self._outage_check()
         if self._state is not None:
             self._ensure_started()
             return self._state.queue_put(name, payload)
-        return await self._call("queue_put", name=name, payload=payload)
+        return await self._call(
+            "queue_put", name=name, payload=payload, wait_budget=timeout
+        )
 
     async def queue_pop(
         self, name: str, timeout: Optional[float] = None
     ) -> Optional[tuple[int, bytes]]:
+        self._outage_check()
         if self._state is not None:
             msg = await self._state.queue_pop(name, timeout)
             return None if msg is None else (msg.id, msg.payload)
-        res = await self._call("queue_pop", name=name, timeout=timeout)
+        res = await self._call(
+            "queue_pop", name=name, timeout=timeout, wait_budget=timeout
+        )
         return None if res is None else (res[0], res[1])
 
     async def queue_ack(self, name: str, msg_id: int) -> bool:

@@ -98,6 +98,9 @@ class FabricServer:
         self._replica_ids = 0
         self._repl_task: Optional[asyncio.Task] = None
         self.promoted = asyncio.Event()
+        # live client connections, severed on close() so clients notice
+        # the death immediately (instead of waiting on a silent socket)
+        self._conn_writers: set[asyncio.StreamWriter] = set()
 
     @property
     def addr(self) -> str:
@@ -137,6 +140,10 @@ class FabricServer:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        for w in list(self._conn_writers):
+            with contextlib.suppress(Exception):
+                w.close()
+        self._conn_writers.clear()
         await self.state.close()
 
     # -------------------------------------------------------- replication
@@ -276,6 +283,7 @@ class FabricServer:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         conn = _Conn(reader, writer)
+        self._conn_writers.add(writer)
         # Each request runs as its own task so a blocking op (queue_pop with
         # no timeout) cannot stall other multiplexed requests — in particular
         # lease keepalives — on the same connection.
@@ -329,6 +337,7 @@ class FabricServer:
                 self.state.unsubscribe(sid)
             # Leases are NOT revoked on disconnect: they expire by TTL, which
             # gives a reconnecting process its grace period (etcd semantics).
+            self._conn_writers.discard(writer)
             with contextlib.suppress(Exception):
                 writer.close()
                 await writer.wait_closed()

@@ -195,9 +195,28 @@ class FabricState:
 
     async def _janitor_loop(self) -> None:
         """Expire dead leases and redeliver unacked queue messages."""
+        from dynamo_tpu.testing import faults
+
+        was_dark = False
         try:
             while True:
                 await asyncio.sleep(0.5)
+                if faults.active():
+                    inj = faults.get_injector()
+                    if inj is not None and inj.fabric_unreachable():
+                        # injected total blackout: the store is "down", so
+                        # its janitor isn't running either — a dead fabric
+                        # cannot expire leases or redeliver queue work
+                        was_dark = True
+                        continue
+                if was_dark:
+                    # heal after a blackout plays the role of a standby
+                    # promotion / primary restart: every lease gets the
+                    # same grace window the real server grants, so a
+                    # worker that was dark WITH the store isn't expired
+                    # before its first post-heal keepalive can land
+                    was_dark = False
+                    self.grace_all_leases(10.0)
                 now = time.monotonic()
                 for lease in [
                     l for l in self.leases.values() if l.deadline < now

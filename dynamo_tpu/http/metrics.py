@@ -302,6 +302,64 @@ class ServiceMetrics:
 
         self.registry.register(_IntegrityCollector())
 
+    def attach_control_plane(self, status_src) -> None:
+        """Surface this process's fabric-client health (control-plane
+        blackout tolerance): connected flag, degraded-mode flag, time
+        spent degraded, and the buffered-publish flow through a blackout.
+        `status_src` is FabricClient.status (or a zero-arg callable
+        returning its dict); values read lazily at scrape time."""
+        if getattr(self, "_control_plane_attached", False):
+            return
+        self._control_plane_attached = True
+
+        def read(key):
+            def _read() -> float:
+                d = status_src() if callable(status_src) else status_src
+                return float((d or {}).get(key, 0) or 0)
+
+            return _read
+
+        g = Gauge(
+            "dyn_fabric_connected",
+            "Is the fabric (control plane) reachable from this process "
+            "(1 connected, 0 unreachable)",
+            registry=self.registry,
+        )
+        g.set_function(read("connected"))
+        g = Gauge(
+            "dyn_llm_degraded_mode",
+            "Serving in degraded mode: control plane unreachable, routing "
+            "from last-known tables, publishes buffered (1 yes, 0 no)",
+            registry=self.registry,
+        )
+        g.set_function(read("degraded"))
+        CallbackCounter(
+            self.registry,
+            "dyn_llm_degraded_seconds_total",
+            "Cumulative seconds this process has served without a "
+            "reachable control plane",
+            read("degraded_seconds_total"),
+        )
+        CallbackCounter(
+            self.registry,
+            "dyn_fabric_blackouts_total",
+            "Times the control plane became unreachable",
+            read("blackouts_total"),
+        )
+        CallbackCounter(
+            self.registry,
+            "dyn_llm_degraded_publishes_buffered_total",
+            "Event-plane publishes buffered while the control plane was "
+            "unreachable",
+            read("buffered_publishes"),
+        )
+        CallbackCounter(
+            self.registry,
+            "dyn_llm_degraded_publishes_flushed_total",
+            "Buffered publishes flushed to the healed control plane",
+            read("flushed_publishes"),
+        )
+
     def attach_brownout(self, controller) -> None:
         """Surface the brownout ladder on /metrics: the live rung as a
         gauge (0 ok .. 4 shed_standard) and the transition count as a real

@@ -347,7 +347,12 @@ class Client:
             [header, msgpack.packb(request, use_bin_type=True)],
             use_bin_type=True,
         )
-        delivered = await drt.fabric.publish(subject, body)
+        # clamp any fabric failover-gate wait to the request's remaining
+        # deadline: a request with 2 s of budget must not park on the full
+        # 15 s DYN_FABRIC_FAILOVER_S gate just to dispatch
+        delivered = await drt.fabric.publish(
+            subject, body, timeout=ctx.remaining_s()
+        )
         if delivered == 0:
             receiver.close()
             raise NoInstancesError(f"no subscriber on {subject}")

@@ -101,6 +101,14 @@ class RuntimeConfig:
       DYN_RUNTIME_HTTP_ENABLED / DYN_RUNTIME_HTTP_PORT  system health/metrics server
       DYN_LEASE_TTL_S       discovery lease TTL seconds
       DYN_NAMESPACE         default namespace
+      DYN_DEGRADED_MAX_S    control-plane blackout budget: how long the
+                            data plane keeps serving (degraded, publishes
+                            buffered) with the fabric unreachable before
+                            workers self-fence / clients close streams
+      DYN_WARM_RESTART_DIR  checkpoint dir for warm restarts: SIGTERM
+                            drain writes the KV offload tiers + prefix
+                            index as checksummed KVB2 pages; boot
+                            restores them so restarts rejoin warm
       DYN_JAX_CACHE_DIR     persistent XLA compilation cache directory for
                             every jax-running process (serve.py/run.py/
                             factory; "" or "off" disables) — see

@@ -63,6 +63,32 @@ class DistributedRuntime:
         registration order, bounded by the caller's drain timeout)."""
         self._drain_cbs.append(cb)
 
+    def on_reconnect(self, cb: Callable) -> None:
+        """Register a zero-arg callable (sync or async) fired every time
+        the fabric heals from a blackout/failover — the reconcile-on-heal
+        hook: re-register instances/models idempotently, re-put stats
+        keys, republish adverts. Runs AFTER watches are re-established and
+        buffered publishes flushed."""
+        self.fabric.on_reconnect(cb)
+
+    @property
+    def degraded_budget_s(self) -> float:
+        """How long this process keeps serving through a control-plane
+        blackout before self-fencing (DYN_DEGRADED_MAX_S). The no-double-
+        serve argument: during a TOTAL blackout no janitor runs, so no
+        lease can expire and no work can be re-routed — serving on is
+        safe for ANY budget. On heal (promotion/restart) every lease gets
+        the server's promotion grace (>= 10 s) and our blackout keepalive
+        retry cadence is <= 1 s, so a worker still within budget refreshes
+        its lease well inside the grace — it is never expired+fenced while
+        also serving. A worker partitioned ALONE (store up for everyone
+        else) has its lease expired at TTL and its epoch fenced (PR 8):
+        consumers reject its frames, so its bounded continued serving
+        cannot double-serve either; the budget caps the wasted compute."""
+        from dynamo_tpu.fabric.client import degraded_max_s_from_env
+
+        return degraded_max_s_from_env(floor=self.config.lease_ttl_s / 3.0)
+
     # ---------------------------------------------------------- fencing
 
     @property
@@ -160,24 +186,56 @@ class DistributedRuntime:
         )
 
     async def _keepalive_loop(self, lease_id: int, ttl: float) -> None:
-        """Refresh the lease at ttl/3 cadence; if the fabric reports the lease
-        gone (e.g. expired during a partition), shut the process's work down —
-        a dead lease means the cluster already considers us gone
-        (reference transports/etcd.rs:51-166)."""
+        """Refresh the lease at ttl/3 cadence, distinguishing two very
+        different failures:
+
+        * **store-unreachable** (ConnectionError — a control-plane
+          blackout, or an HA failover in progress): the cluster has NOT
+          declared us dead, it simply can't hear us. Keep serving,
+          retrying on a fast cadence (<= 1 s) so the heal is noticed
+          within the post-promotion lease grace, bounded by the degraded
+          budget (`DYN_DEGRADED_MAX_S`). Past the budget the conservative
+          rule applies: self-fence rather than risk serving fenced.
+        * **lease-reported-dead** (alive=False): the cluster already
+          considers us gone (expired during a partition) — self-fence
+          immediately, exactly as before (reference etcd.rs:51-166)."""
+        blackout_t0: Optional[float] = None
+        budget = self.degraded_budget_s
+        loop = asyncio.get_running_loop()
+        interval = ttl / 3.0
         try:
             while not self.token.is_cancelled():
-                await asyncio.sleep(ttl / 3.0)
+                await asyncio.sleep(
+                    interval if blackout_t0 is None
+                    else min(interval, 1.0)
+                )
                 try:
                     alive = await self.fabric.lease_keepalive(lease_id)
-                except ConnectionError:
-                    # the fabric may be mid-failover (HA standby promoting,
-                    # client hunting for it): one retry rides the client's
-                    # failover gate. A single-address client raises again
-                    # immediately, keeping the fatal-loss contract.
-                    try:
-                        alive = await self.fabric.lease_keepalive(lease_id)
-                    except ConnectionError:
-                        alive = False
+                except ConnectionError as e:
+                    now = loop.time()
+                    if blackout_t0 is None:
+                        blackout_t0 = now
+                        logger.warning(
+                            "fabric unreachable during keepalive (%s): "
+                            "store-unreachable, NOT lease-dead — serving "
+                            "degraded for up to %.0fs", e, budget,
+                        )
+                    if now - blackout_t0 < budget:
+                        continue
+                    logger.error(
+                        "control-plane blackout outlived the %.0fs "
+                        "degraded budget; conservatively self-fencing",
+                        budget,
+                    )
+                    alive = False
+                else:
+                    if blackout_t0 is not None:
+                        logger.info(
+                            "control plane healed after %.1fs; lease %d %s",
+                            loop.time() - blackout_t0, lease_id,
+                            "alive" if alive else "DEAD",
+                        )
+                        blackout_t0 = None
                 if not alive:
                     # self-fence FIRST (sync: engines fail lanes with a
                     # structured worker_fenced between dispatches), then

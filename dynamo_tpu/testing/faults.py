@@ -20,6 +20,13 @@ Spec grammar — comma-separated ``key=value`` actions::
                                             # S seconds (the worker keeps
                                             # serving while the cluster
                                             # expires its lease — a zombie)
+    DYN_FAULT="fabric_blackout=3"           # TOTAL control-plane blackout:
+                                            # every fabric op raises
+                                            # ConnectionError for S seconds
+                                            # (both HA members down)
+    DYN_FAULT="fabric_flap=1,every=4"       # flapping control plane: dark
+                                            # for S seconds out of every
+                                            # N-second cycle
 
 ``corrupt_kv`` fires at every KV data-plane store/ship point (disagg
 stream frames, peer-pull replies, offload arenas, disk spill pages) —
@@ -29,6 +36,16 @@ partition at the worker: keepalives are silently swallowed (the fabric
 never sees them, the worker believes them delivered) for S seconds;
 when the window ends the next keepalive reaches the fabric, reports the
 lease dead, and the runtime's self-fence hook fires.
+
+``fabric_blackout`` simulates BOTH HA members being unreachable: every
+fabric client operation (publishes, kv puts, queue ops, lease
+keepalives) raises ``ConnectionError`` while the window is open, and the
+in-process fabric's janitor pauses lease expiry (a dead store cannot
+expire leases either). The degraded-mode data plane must keep in-flight
+streams alive through a blackout shorter than ``DYN_DEGRADED_MAX_S``,
+buffer event-plane publishes, and flush them on heal — with ZERO worker
+self-fences. ``fabric_flap`` opens the same window periodically (dark
+for S seconds at the start of every N-second cycle).
 
 ``kill_after_tokens`` is the real-process fault (the worker dies exactly as
 a crashed decode worker would, mid-stream); ``abort_after_tokens`` is its
@@ -65,6 +82,8 @@ class FaultSpec:
     drop_fabric_conn: int = 0  # drop once, after N publishes (0 = off)
     corrupt_kv: str = ""  # "" = off | "bits" | "truncate"
     zombie_partition_s: float = 0.0  # swallow keepalives for S seconds
+    fabric_blackout_s: float = 0.0  # every fabric op fails for S seconds
+    fabric_flap_s: float = 0.0  # dark S seconds per `every`-second cycle
 
     @classmethod
     def parse(cls, spec: str) -> "FaultSpec":
@@ -96,6 +115,10 @@ class FaultSpec:
                 out.corrupt_kv = val
             elif key == "zombie_partition":
                 out.zombie_partition_s = float(val)
+            elif key == "fabric_blackout":
+                out.fabric_blackout_s = float(val)
+            elif key == "fabric_flap":
+                out.fabric_flap_s = float(val)
             else:
                 raise ValueError(f"unknown DYN_FAULT action {key!r}")
         return out
@@ -112,6 +135,7 @@ class FaultInjector:
         self.fabric_dropped = False
         self.kv_payloads = 0  # corrupt_kv fault-point visits
         self._zombie_t0: Optional[float] = None  # partition window start
+        self._fabric_t0: Optional[float] = None  # blackout/flap clock start
         # observability for chaos tests
         self.fired: dict[str, int] = {}
 
@@ -231,6 +255,34 @@ class FaultInjector:
             self._zombie_t0 = time.monotonic()
         if time.monotonic() - self._zombie_t0 < s:
             self._mark("zombie_partition")
+            return True
+        return False
+
+    def fabric_unreachable(self) -> bool:
+        """Control-plane blackout fault point: every fabric client op (and
+        the in-process janitor's lease expiry — a dead store cannot expire
+        leases) consults this. True while the injected blackout/flap
+        window is open. ``fabric_blackout=S`` opens one S-second window
+        starting at the first visit; ``fabric_flap=S,every=N`` darkens the
+        first S seconds of every N-second cycle."""
+        b = self.spec.fabric_blackout_s
+        f = self.spec.fabric_flap_s
+        if not b and not f:
+            return False
+        import time
+
+        now = time.monotonic()
+        if self._fabric_t0 is None:
+            self._fabric_t0 = now
+        elapsed = now - self._fabric_t0
+        if b:
+            if elapsed < b:
+                self._mark("fabric_blackout")
+                return True
+            return False
+        period = max(float(self.spec.every), f + 0.5)
+        if (elapsed % period) < f:
+            self._mark("fabric_flap")
             return True
         return False
 

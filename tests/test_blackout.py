@@ -1,0 +1,608 @@
+"""Control-plane blackout tolerance (ISSUE 10): degraded-mode serving,
+reconcile-on-heal, and warm KV restarts.
+
+The serving fabric must OUTLIVE its control plane transiently: a total
+fabric blackout (both HA members down, or this process partitioned from
+them) keeps the data plane up — frontends route from last-known tables,
+workers distinguish store-unreachable (keep serving, buffer publishes)
+from lease-reported-dead (self-fence), disagg falls back to local
+prefill instead of wedging on a dark queue — and a heal reconciles
+cleanly: watches replay level-consistently, buffered publishes flush,
+registrations re-put idempotently. Planned restarts come back WARM: the
+tier manager checkpoints checksummed KVB2 pages + the prefix index and
+restores them at boot, refusing (never decoding) corrupt pages.
+"""
+
+import asyncio
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu import integrity
+from dynamo_tpu.block_manager.layout import LayoutConfig
+from dynamo_tpu.block_manager.manager import TieredBlockManager
+from dynamo_tpu.engine.mocker import (
+    MockEngine,
+    MockEngineArgs,
+    MockPrefillEngine,
+)
+from dynamo_tpu.fabric.client import FabricClient
+from dynamo_tpu.fabric.server import FabricServer
+from dynamo_tpu.fabric.state import FabricState
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.config import RuntimeConfig
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.testing import faults
+
+BS = 4
+LAYOUT = LayoutConfig(
+    num_layers=2, page_size=BS, num_kv_heads=2, head_dim=16, dtype="bfloat16"
+)
+
+
+def rand_blocks(n, seed=0):
+    import ml_dtypes
+
+    rng = np.random.default_rng(seed)
+    shape = (LAYOUT.num_layers, LAYOUT.num_kv_heads, n, BS, LAYOUT.head_dim)
+    k = rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
+    v = rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
+    return k, v
+
+
+def _req(prompt, max_tokens):
+    return PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling=SamplingOptions(),
+        stop=StopConditions(max_tokens=max_tokens),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.set_injector(None)
+    yield
+    faults.set_injector(None)
+
+
+# ------------------------------------------------------------- fault spec
+
+
+def test_fault_spec_parses_blackout_and_flap():
+    spec = faults.FaultSpec.parse("fabric_blackout=3.5")
+    assert spec.fabric_blackout_s == 3.5
+    spec = faults.FaultSpec.parse("fabric_flap=1,every=4")
+    assert spec.fabric_flap_s == 1.0 and spec.every == 4
+    with pytest.raises(ValueError):
+        faults.FaultSpec.parse("fabric_nonsense=1")
+
+
+def test_blackout_window_opens_then_closes():
+    inj = faults.FaultInjector(
+        faults.FaultSpec(fabric_blackout_s=0.15)
+    )
+    assert inj.fabric_unreachable()
+    assert inj.fired.get("fabric_blackout", 0) >= 1
+    time.sleep(0.2)
+    assert not inj.fabric_unreachable()
+
+
+def test_flap_cycles():
+    inj = faults.FaultInjector(
+        faults.FaultSpec(fabric_flap_s=0.1, every=1)
+    )
+    # period = max(every, flap + 0.5) -> dark 0.1s of every 0.6s cycle
+    assert inj.fabric_unreachable()
+    time.sleep(0.15)
+    assert not inj.fabric_unreachable()
+
+
+# -------------------------------------- in-process client: degraded mode
+
+
+async def test_inproc_blackout_buffers_events_and_flushes_on_heal():
+    fabric = FabricClient.in_process(FabricState())
+    sub = await fabric.subscribe("ns.events.test")
+    faults.set_injector(
+        faults.FaultInjector(faults.FaultSpec(fabric_blackout_s=0.2))
+    )
+    # event-plane publish buffers (returns 0 deliveries) instead of raising
+    assert await fabric.publish("ns.events.test", b"dark-1") == 0
+    assert await fabric.publish("ns.events.test", b"dark-2") == 0
+    assert fabric.in_degraded_mode
+    assert fabric.buffered_publishes == 2
+    # stats kv-puts buffer last-wins: a blackout of metrics ticks costs
+    # one slot per key, and the NEWEST snapshot survives
+    assert await fabric.kv_put("stats/w1", b"v1") == 0
+    assert await fabric.kv_put("stats/w1", b"v2") == 0
+    # non-bufferable ops fail FAST so callers can fall back
+    with pytest.raises(ConnectionError):
+        await fabric.publish("ns.some.endpoint", b"dispatch")
+    with pytest.raises(ConnectionError):
+        await fabric.queue_put("q", b"job")
+    with pytest.raises(ConnectionError):
+        await fabric.kv_get("anything")
+    healed = []
+    fabric.on_reconnect(lambda: healed.append(True))
+    await asyncio.sleep(0.25)  # blackout window closes
+    # the next op notices the heal, flushes the rings, fires callbacks
+    assert await fabric.kv_get("stats/w1") == b"v2"  # last-wins flushed
+    assert not fabric.in_degraded_mode
+    assert healed == [True]
+    got = []
+    for _ in range(2):
+        item = await sub.next(timeout=2.0)
+        assert item is not None
+        got.append(item[1])
+    assert got == [b"dark-1", b"dark-2"]
+    st = fabric.status()
+    assert st["connected"] and not st["degraded"]
+    assert st["blackouts_total"] == 1
+    assert st["degraded_seconds_total"] > 0
+    assert st["flushed_publishes"] >= 3  # 2 events + 1 stats key
+    await fabric.close()
+
+
+async def test_inproc_buffer_ring_is_bounded():
+    fabric = FabricClient.in_process(FabricState())
+    fabric._pub_ring = type(fabric._pub_ring)(maxlen=4)
+    faults.set_injector(
+        faults.FaultInjector(faults.FaultSpec(fabric_blackout_s=5.0))
+    )
+    for i in range(10):
+        await fabric.publish("ns.events.x", bytes([i]))
+    assert len(fabric._pub_ring) == 4
+    assert fabric.dropped_publishes == 6
+    faults.set_injector(None)
+    await fabric.close()
+
+
+# --------------------------------------------------- keepalive loop split
+
+
+async def test_keepalive_survives_blackout_within_budget(monkeypatch):
+    """Store-unreachable != lease-dead: a blackout shorter than the
+    degraded budget causes ZERO self-fences, and the lease is still alive
+    after the heal (the janitor grants the promotion-style grace)."""
+    monkeypatch.setenv("DYN_DEGRADED_MAX_S", "10")
+    drt = await DistributedRuntime.detached(
+        config=RuntimeConfig(lease_ttl_s=0.3), state=FabricState()
+    )
+    fences = []
+    drt.on_fence(lambda reason: fences.append(reason))
+    faults.set_injector(
+        faults.FaultInjector(faults.FaultSpec(fabric_blackout_s=0.7))
+    )
+    try:
+        await asyncio.sleep(1.4)  # blackout + a couple of healed ticks
+        assert not drt.fenced and fences == []
+        assert not drt.token.is_cancelled()
+        # lease survived: keepalive succeeds against the healed store
+        assert await drt.fabric.lease_keepalive(drt.primary_lease) is True
+    finally:
+        faults.set_injector(None)
+        await drt.close()
+
+
+async def test_keepalive_self_fences_past_degraded_budget(monkeypatch):
+    """The conservative reconcile rule: a worker dark past
+    DYN_DEGRADED_MAX_S self-fences rather than risk serving fenced."""
+    monkeypatch.setenv("DYN_DEGRADED_MAX_S", "0.3")
+    drt = await DistributedRuntime.detached(
+        config=RuntimeConfig(lease_ttl_s=0.3), state=FabricState()
+    )
+    fences = []
+    drt.on_fence(lambda reason: fences.append(reason))
+    faults.set_injector(
+        faults.FaultInjector(faults.FaultSpec(fabric_blackout_s=30.0))
+    )
+    try:
+        for _ in range(100):
+            if drt.fenced:
+                break
+            await asyncio.sleep(0.05)
+        assert drt.fenced
+        assert fences and "lost" in fences[0]
+        assert drt.token.is_cancelled()
+    finally:
+        faults.set_injector(None)
+        await drt.close()
+
+
+# ------------------------------------- disagg: local fallback, dark queue
+
+
+async def test_disagg_falls_back_local_when_queue_plane_dark():
+    """A dark queue plane must not wedge decode: queue_put raises fast,
+    the engine runs the prefill locally, and the token stream is
+    IDENTICAL to an unfaulted run."""
+    from dynamo_tpu.disagg.transfer import (
+        PrefillWorkerService,
+        RemotePrefillClient,
+    )
+
+    fabric = FabricClient.in_process(FabricState())
+    ns = "blackout-disagg"
+    prefill = MockPrefillEngine(
+        MockEngineArgs(block_size=BS, speedup_ratio=1000.0), chunk_blocks=1
+    )
+    service = PrefillWorkerService(fabric, ns, prefill)
+    client = RemotePrefillClient(fabric, ns, block_size=BS, timeout=10)
+    engine = MockEngine(
+        MockEngineArgs(
+            num_blocks=96, block_size=BS, max_batch=4, speedup_ratio=500.0
+        ),
+        remote_prefill_client=client,
+        disagg_threshold=2 * BS,
+    )
+    await service.start()
+    await client.start()
+    prompt = list(range(1, 13))
+    expected = [prompt[j % len(prompt)] for j in range(10)]
+
+    async def run_one():
+        got = []
+        async for out in engine.generate(_req(prompt, 10), Context()):
+            got.extend(out.token_ids)
+            if out.finish_reason is not None:
+                assert out.error is None, out.error
+        return got
+
+    # healthy baseline goes remote
+    assert await run_one() == expected
+    assert engine.remote_prefills == 1
+    # dark queue plane: fast local fallback, identical stream
+    faults.set_injector(
+        faults.FaultInjector(faults.FaultSpec(fabric_blackout_s=30.0))
+    )
+    t0 = time.monotonic()
+    assert await asyncio.wait_for(run_one(), timeout=10) == expected
+    assert time.monotonic() - t0 < 5.0  # no 120 s queue-wedge
+    assert engine.remote_prefills == 1  # fallback, not remote
+    faults.set_injector(None)
+    await engine.close()
+    await client.close()
+    await service.close()
+    await fabric.close()
+
+
+# ------------------------------------------- remote client: degraded mode
+
+
+async def _start_server(port):
+    srv = FabricServer(port=port)
+    await srv.start()
+    return srv
+
+
+async def test_remote_blackout_degrades_heals_and_flushes(monkeypatch):
+    """Kill the only fabric member mid-session: the client rides the
+    failover gate into DEGRADED mode (fast-failing calls, buffering
+    events), keeps hunting on jittered backoff past the gate, and on the
+    server's return re-establishes streams (synthesizing deletes for keys
+    the new primary doesn't know), flushes buffers, and fires the
+    reconcile callbacks."""
+    from dynamo_tpu.serve import _free_port
+
+    monkeypatch.setenv("DYN_DEGRADED_MAX_S", "30")
+    p1, p2 = _free_port(), _free_port()
+    srv = await _start_server(p1)
+    client = await FabricClient.connect(
+        f"127.0.0.1:{p1},127.0.0.1:{p2}", failover_s=0.4
+    )
+    try:
+        await client.kv_put("instances/ns/w/ep:1", b"addr-1")
+        await client.kv_put("instances/ns/w/ep:2", b"addr-2")
+        watch = await client.watch_prefix("instances/")
+        assert len(watch.initial) == 2
+        sub = await client.subscribe("ns.events.kv_events")
+        healed = []
+        client.on_reconnect(lambda: healed.append(True))
+
+        await srv.close()  # total blackout (single member)
+        for _ in range(100):
+            if client.in_degraded_mode:
+                break
+            await asyncio.sleep(0.05)
+        assert client.in_degraded_mode
+
+        # event publish buffers; a request-plane publish fails fast once
+        # past the gate
+        assert await client.publish("ns.events.kv_events", b"advert") == 0
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            await client.publish("ns.endpoint.generate", b"dispatch")
+        assert time.monotonic() - t0 < 2.0
+
+        # the "promoted primary" comes back with a PARTIAL snapshot: it
+        # knows instance 1 but never saw instance 2
+        srv2 = FabricServer(port=p1)
+        srv2.state.kv_put("instances/ns/w/ep:1", b"addr-1")
+        await srv2.start()
+        for _ in range(200):
+            if client.connected:
+                break
+            await asyncio.sleep(0.05)
+        assert client.connected and healed == [True]
+        st = client.status()
+        assert st["blackouts_total"] == 1
+        assert st["degraded_seconds_total"] > 0
+
+        # watch replay is level-consistent: a synthesized DELETE for the
+        # vanished key, a put replay for the surviving one
+        seen = {}
+        async def drain_watch():
+            async for ev in watch:
+                if ev.type == "put":
+                    seen[ev.key] = ev.value
+                else:
+                    seen.pop(ev.key, None)
+                if ev.key == "instances/ns/w/ep:1" and ev.type == "put":
+                    return
+        await asyncio.wait_for(drain_watch(), 5.0)
+        assert "instances/ns/w/ep:2" not in seen
+        assert seen.get("instances/ns/w/ep:1") == b"addr-1"
+
+        # the buffered advert flushed onto the re-established subscription
+        item = await sub.next(timeout=5.0)
+        assert item is not None and item[1] == b"advert"
+        assert client.flushed_publishes >= 1
+        await srv2.close()
+    finally:
+        await client.close()
+        with contextlib_noop():
+            await srv.close()
+
+
+def contextlib_noop():
+    import contextlib
+
+    return contextlib.suppress(Exception)
+
+
+async def test_fabric_call_clamps_to_request_deadline(monkeypatch):
+    """ISSUE 10 satellite: during the failover gate an in-flight
+    request's fabric op gives up at its remaining deadline budget instead
+    of stalling the stream for the full DYN_FABRIC_FAILOVER_S."""
+    from dynamo_tpu.serve import _free_port
+
+    monkeypatch.setenv("DYN_DEGRADED_MAX_S", "30")
+    p1, p2 = _free_port(), _free_port()
+    srv = await _start_server(p1)
+    client = await FabricClient.connect(
+        f"127.0.0.1:{p1},127.0.0.1:{p2}", failover_s=8.0
+    )
+    try:
+        await srv.close()
+        for _ in range(100):
+            if client.in_degraded_mode:
+                break
+            await asyncio.sleep(0.05)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            await client.publish("ns.ep.generate", b"x", timeout=0.2)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 2.0, f"clamped call took {elapsed:.1f}s"
+        # queue_put honors the same clamp (disagg enqueue path)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            await client.queue_put("q", b"job", timeout=0.2)
+        assert time.monotonic() - t0 < 2.0
+    finally:
+        await client.close()
+
+
+# ----------------------------------------------------------- warm restart
+
+
+def _fill_manager(bm, n=6, seed=1):
+    k, v = rand_blocks(n, seed=seed)
+    hashes = [0x1000 + i for i in range(n)]
+    assert bm.store_blocks(hashes, k, v) == n
+    return hashes, k, v
+
+
+def test_warm_checkpoint_restore_roundtrip(tmp_path):
+    bm = TieredBlockManager(LAYOUT, host_blocks=16)
+    hashes, k, v = _fill_manager(bm)
+    summary = bm.checkpoint(str(tmp_path))
+    assert summary["blocks"] == len(hashes)
+    assert os.path.exists(tmp_path / "manifest.json")
+
+    bm2 = TieredBlockManager(LAYOUT, host_blocks=16)
+    restored = bm2.restore(str(tmp_path))
+    assert restored["restored"] == len(hashes)
+    assert restored["refused"] == 0
+    assert bm2.stats.warm_restored == len(hashes)
+    # prefix index survives: the whole chain hits
+    assert bm2.lookup_prefix(hashes) == len(hashes)
+    # restored bytes are BIT-IDENTICAL to the originals
+    k2, v2 = bm2.load_blocks(hashes)
+    ko, vo = bm.load_blocks(hashes)
+    np.testing.assert_array_equal(k2, ko)
+    np.testing.assert_array_equal(v2, vo)
+    # chain-shaped adverts: parents precede children
+    adverts = bm2.advert_blocks()
+    order = [a["block_hash"] for a in adverts]
+    assert set(order) == set(hashes)
+    for a in adverts:
+        if a["parent_hash"] is not None:
+            assert order.index(a["parent_hash"]) < order.index(
+                a["block_hash"]
+            )
+
+
+def test_warm_restore_refuses_corrupt_pages_never_decodes(tmp_path):
+    """Acceptance bar: corrupted checkpoint pages are REFUSED and
+    recomputed — never decoded into the tiers."""
+    integrity.COUNTERS.reset()
+    bm = TieredBlockManager(LAYOUT, host_blocks=16)
+    hashes, _, _ = _fill_manager(bm)
+    bm.checkpoint(str(tmp_path))
+    # flip one byte mid-payload in two pages; truncate a third
+    page0 = tmp_path / "pages" / f"{hashes[0]:#x}.kvb"
+    raw = bytearray(page0.read_bytes())
+    raw[40] ^= 0x10
+    page0.write_bytes(bytes(raw))
+    page1 = tmp_path / "pages" / f"{hashes[1]:#x}.kvb"
+    page1.write_bytes(page1.read_bytes()[: 30])
+
+    bm2 = TieredBlockManager(LAYOUT, host_blocks=16)
+    restored = bm2.restore(str(tmp_path))
+    assert restored["refused"] == 2
+    assert restored["restored"] == len(hashes) - 2
+    assert bm2.stats.warm_refused == 2
+    # the corrupt hashes are NOT in any tier: their prefixes recompute
+    assert hashes[0] not in bm2 and hashes[1] not in bm2
+    assert bm2.lookup_prefix(hashes) == 0  # chain broken at block 0
+    assert integrity.COUNTERS.failures.get("warm_restore", 0) == 2
+    integrity.COUNTERS.reset()
+
+
+def test_warm_restore_refuses_layout_and_codec_mismatch(tmp_path):
+    bm = TieredBlockManager(LAYOUT, host_blocks=16)
+    _fill_manager(bm)
+    bm.checkpoint(str(tmp_path))
+    other = LayoutConfig(
+        num_layers=3, page_size=BS, num_kv_heads=2, head_dim=16,
+        dtype="bfloat16",
+    )
+    bm2 = TieredBlockManager(other, host_blocks=16)
+    out = bm2.restore(str(tmp_path))
+    assert out.get("refused_layout") and out["restored"] == 0
+    bm3 = TieredBlockManager(LAYOUT, host_blocks=16, wire_codec="int8")
+    out = bm3.restore(str(tmp_path))
+    assert out.get("refused_layout") and out["restored"] == 0
+
+
+def test_warm_restore_skips_quarantined_and_respects_capacity(tmp_path):
+    bm = TieredBlockManager(LAYOUT, host_blocks=16)
+    hashes, _, _ = _fill_manager(bm)
+    bm.checkpoint(str(tmp_path))
+    # a hash quarantined in THIS incarnation must not resurrect via the
+    # checkpoint; and restore never evicts live blocks (host-first, then
+    # disk, else skipped)
+    bm2 = TieredBlockManager(LAYOUT, host_blocks=3)
+    bm2._quarantined.add(hashes[0])
+    out = bm2.restore(str(tmp_path))
+    assert hashes[0] not in bm2
+    assert out["restored"] == 3  # host capacity; no disk tier configured
+    assert out["skipped"] >= 1
+
+
+def test_warm_restore_overflows_to_disk_tier(tmp_path):
+    bm = TieredBlockManager(LAYOUT, host_blocks=16)
+    hashes, _, _ = _fill_manager(bm)
+    bm.checkpoint(str(tmp_path / "ckpt"))
+    bm2 = TieredBlockManager(
+        LAYOUT, host_blocks=2, disk_dir=str(tmp_path / "disk")
+    )
+    out = bm2.restore(str(tmp_path / "ckpt"))
+    assert out["restored"] == len(hashes)
+    assert bm2.stats.host_blocks_used == 2
+    assert bm2.stats.disk_blocks_used == len(hashes) - 2
+    # disk-restored pages verify + promote like any G3 page
+    assert bm2.lookup_prefix(hashes) == len(hashes)
+    k2, _ = bm2.load_blocks(hashes)
+    ko, _ = bm.load_blocks(hashes)
+    np.testing.assert_array_equal(k2, ko)
+
+
+def test_checkpoint_includes_disk_tier_pages(tmp_path):
+    bm = TieredBlockManager(
+        LAYOUT, host_blocks=2, disk_dir=str(tmp_path / "spill")
+    )
+    hashes, _, _ = _fill_manager(bm)  # 6 blocks through a 2-slot host
+    assert bm.stats.disk_blocks_used > 0
+    summary = bm.checkpoint(str(tmp_path / "ckpt"))
+    assert summary["blocks"] == len(hashes)
+    bm2 = TieredBlockManager(LAYOUT, host_blocks=16)
+    out = bm2.restore(str(tmp_path / "ckpt"))
+    assert out["restored"] == len(hashes)
+    assert bm2.lookup_prefix(hashes) == len(hashes)
+
+
+# ------------------------------------------- warm restart: engine-level
+
+
+async def test_engine_warm_restart_serves_prefix_hits(tmp_path):
+    """SIGTERM -> checkpoint -> boot -> restore: the next incarnation
+    serves the repeated prefix from the restored tier (onboard, not
+    recompute) with a token-identical stream."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from dynamo_tpu.engine.jax_engine.engine import JaxEngine, JaxEngineConfig
+    from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
+    from dynamo_tpu.models import llama as L
+
+    cfg = L.LlamaConfig.tiny(vocab_size=64)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    layout = LayoutConfig(
+        num_layers=cfg.num_layers, page_size=BS,
+        num_kv_heads=cfg.num_kv_heads, head_dim=cfg.head_dim,
+        dtype="bfloat16",
+    )
+
+    def make_engine(bm):
+        runner = ModelRunner(
+            cfg, params, num_blocks=64, block_size=BS, max_batch=2,
+            max_model_len=96,
+        )
+        return JaxEngine(
+            runner,
+            JaxEngineConfig(
+                max_batch=2, block_size=BS, num_blocks=64,
+                max_model_len=96, watermark_blocks=2,
+            ),
+            block_manager=bm,
+        )
+
+    async def collect(engine, prompt, n):
+        out = []
+        req = PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(greedy=True),
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+        )
+        async for o in engine.generate(req, Context()):
+            assert o.error is None, o.error
+            out.extend(o.token_ids)
+        return out
+
+    prompt = list(range(2, 14))  # 3 full blocks
+    bm1 = TieredBlockManager(layout, host_blocks=64)
+    engine1 = make_engine(bm1)
+    first = await collect(engine1, prompt, 12)
+    # wait for the completion-time offload to land in the tier
+    for _ in range(100):
+        if bm1.stats.offloaded_g2 >= 3:
+            break
+        await asyncio.sleep(0.02)
+    assert bm1.stats.offloaded_g2 >= 3
+    # SIGTERM drain path: checkpoint the tiers + prefix index
+    summary = engine1.checkpoint_tiers(str(tmp_path))
+    assert summary is not None and summary["blocks"] >= 3
+    await engine1.close()
+
+    # fresh incarnation restores the checkpoint and serves WARM
+    bm2 = TieredBlockManager(layout, host_blocks=64)
+    engine2 = make_engine(bm2)
+    restored = engine2.restore_tiers(str(tmp_path))
+    assert restored is not None and restored["restored"] >= 3
+    second = await collect(engine2, prompt, 12)
+    assert second == first  # token-identical across the restart
+    assert bm2.stats.hits >= 1 and bm2.stats.onboarded >= 2, (
+        "restart served cold: no prefix onboard from the checkpoint"
+    )
+    # restored chains are advertisable to the router radix tree
+    adverts = bm2.advert_blocks()
+    assert len(adverts) >= 3
+    await engine2.close()

@@ -278,6 +278,143 @@ async def test_chaos_corruption_waves_zero_divergence():
     await fabric.close()
 
 
+async def test_chaos_blackout_wave_streams_finish_zero_fences():
+    """ISSUE 10 acceptance: a mid-traffic control-plane blackout <= the
+    degraded budget. Invariants: every in-flight stream finishes
+    TOKEN-IDENTICALLY (disagg falls back local instead of wedging on the
+    dark queue), ZERO worker self-fences during the blackout, buffered
+    publishes flush on heal (the stats plane stays monotone — no gap read
+    as a counter reset), zero fenced/double-served frames after heal, and
+    KV blocks are conserved."""
+    import os
+
+    from dynamo_tpu.disagg.transfer import (
+        PrefillWorkerService,
+        RemotePrefillClient,
+    )
+    from dynamo_tpu.fabric.state import FabricState
+    from dynamo_tpu.runtime.config import RuntimeConfig
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    os.environ["DYN_DEGRADED_MAX_S"] = "20"
+    try:
+        state = FabricState()
+        drt = await DistributedRuntime.detached(
+            config=RuntimeConfig(lease_ttl_s=0.4), state=state
+        )
+        fabric = drt.fabric
+        ns = "chaos-blackout"
+        BS = 4
+        prefill = MockPrefillEngine(
+            MockEngineArgs(block_size=BS, speedup_ratio=1000.0),
+            chunk_blocks=1,
+        )
+        service = PrefillWorkerService(fabric, ns, prefill)
+        client = RemotePrefillClient(fabric, ns, block_size=BS, timeout=20)
+        engine = MockEngine(
+            MockEngineArgs(
+                num_blocks=128, block_size=BS, max_batch=8,
+                speedup_ratio=200.0,
+            ),
+            remote_prefill_client=client,
+            disagg_threshold=2 * BS,
+        )
+        drt.on_fence(lambda reason: engine.fence(reason))
+        await service.start()
+        await client.start()
+
+        # stats plane through the blackout: a monotone counter kv-put
+        # every tick (buffered last-wins while dark, flushed on heal)
+        stats_log: list[int] = []
+        stop_stats = asyncio.Event()
+
+        async def stats_loop() -> None:
+            tick = 0
+            while not stop_stats.is_set():
+                tick += 1
+                await fabric.kv_put(
+                    "stats/chaos/worker:1", tick.to_bytes(8, "big")
+                )
+                await asyncio.sleep(0.03)
+                if fabric.connected:
+                    raw = await fabric.kv_get("stats/chaos/worker:1")
+                    if raw is not None:
+                        stats_log.append(int.from_bytes(raw, "big"))
+
+        outcomes = {"ok": 0, "diverged": 0, "error": 0}
+
+        async def one(i: int) -> None:
+            n = 8 + (i % 9)
+            prompt = [(j + i) % 60 + 1 for j in range(n)]
+            max_tokens = 12 + (i % 8)
+            expected = [prompt[j % n] for j in range(max_tokens)]
+            got = []
+            async for out in engine.generate(
+                _req(prompt, max_tokens), Context()
+            ):
+                got.extend(out.token_ids)
+                if out.finish_reason is not None:
+                    if out.error is not None:
+                        outcomes["error"] += 1
+                    elif got != expected:
+                        outcomes["diverged"] += 1
+                    else:
+                        outcomes["ok"] += 1
+                    return
+
+        stats_task = asyncio.get_running_loop().create_task(stats_loop())
+        # wave 1: healthy traffic establishes the baseline
+        await asyncio.wait_for(
+            asyncio.gather(*[one(i) for i in range(20)]), timeout=60
+        )
+        # wave 2: blackout hits MID-TRAFFIC (1 s << budget); streams
+        # launched before and during it must all finish identically
+        faults.set_injector(
+            faults.FaultInjector(faults.FaultSpec(fabric_blackout_s=1.0))
+        )
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*[one(100 + i) for i in range(30)]),
+                timeout=60,
+            )
+            # ride past the heal so flushes land
+            await asyncio.sleep(1.5)
+        finally:
+            faults.set_injector(None)
+        # wave 3: healed traffic (remote prefill works again)
+        remote_before = engine.remote_prefills
+        await asyncio.wait_for(
+            asyncio.gather(*[one(200 + i) for i in range(10)]), timeout=60
+        )
+        stop_stats.set()
+        await stats_task
+
+        assert outcomes == {"ok": 60, "diverged": 0, "error": 0}, outcomes
+        # zero self-fences through the blackout
+        assert not drt.fenced and not engine.fenced
+        # heal actually restored the queue plane
+        assert engine.remote_prefills > remote_before
+        # blackout fired and the client degraded + healed exactly once...
+        st = fabric.status()
+        assert st["blackouts_total"] >= 1 and st["connected"]
+        # ...and the buffered stats plane stayed MONOTONE: reads never
+        # went backwards (a gap read as a reset would break rate())
+        assert stats_log == sorted(stats_log), "stats counter regressed"
+        assert stats_log[-1] >= max(stats_log)
+        # KV conservation through every blackout/fallback path
+        assert engine.active == [] and len(engine.waiting) == 0
+        assert all(n == 0 for n in engine.cache.refs.values())
+        cached = len(engine.cache.refs)
+        assert engine.cache.free_blocks + cached == engine.args.num_blocks
+    finally:
+        os.environ.pop("DYN_DEGRADED_MAX_S", None)
+        faults.set_injector(None)
+        await engine.close()
+        await client.close()
+        await service.close()
+        await drt.close()
+
+
 async def test_chaos_zombie_partition_wave_fenced_and_migrated():
     """ISSUE 8 satellite: a zombie-partition wave. The partitioned
     worker keeps serving while the cluster expires its lease; the moment

@@ -98,6 +98,62 @@ async def test_journal_replay_converges():
     assert l2 not in replica.leases
 
 
+async def test_promotion_mid_mutation_redelivers_inflight_queue():
+    """ISSUE 10 satellite: the primary dies BETWEEN a pop and its ack.
+    Queue pops are deliberately not replicated, so the promoted standby
+    still holds the message READY — promotion redelivers it at-least-once
+    with zero loss (and the already-acked message stays gone)."""
+    primary = FabricState()
+    replica = FabricState()
+    primary.on_replicate = replica.apply_replicated
+    m1 = primary.queue_put("q", b"job-1")
+    primary.queue_put("q", b"job-2")
+    primary.queue_put("q", b"job-3")
+    popped = await primary.queue_pop("q")  # m1 in flight on the primary
+    assert popped.id == m1
+    primary.queue_ack("q", m1)  # acked: replica drops it from ready
+    popped2 = await primary.queue_pop("q")  # in flight, NEVER acked
+    assert popped2 is not None
+    # ---- primary dies here; the replica IS the new primary's state ----
+    assert replica.queue_depth("q") == 2
+    got = set()
+    for _ in range(2):
+        msg = await replica.queue_pop("q")
+        assert msg is not None
+        got.add(msg.payload)
+    assert got == {b"job-2", b"job-3"}  # in-flight redelivered, ack held
+
+
+async def test_watch_synthesizes_deletes_for_keys_missing_from_snapshot():
+    """ISSUE 10 satellite: when the promoted primary's snapshot is
+    missing keys the client knew (journal entries lost in flight), the
+    re-established watch synthesizes DELETEs for them — consumers
+    converge level-consistently instead of routing at ghosts."""
+    from dynamo_tpu.fabric.client import Watch
+    from dynamo_tpu.fabric.state import WatchEvent
+
+    initial = [
+        WatchEvent("put", "instances/a", b"1"),
+        WatchEvent("put", "instances/b", b"2"),
+        WatchEvent("put", "instances/c", b"3"),
+    ]
+    watch = Watch(initial, cancel_fn=lambda: None)
+    assert watch.known == {"instances/a", "instances/b", "instances/c"}
+    # replay of a promoted snapshot that only knows a and c (the exact
+    # diff logic FabricClient._reestablish_streams drives)
+    fresh = {"instances/a", "instances/c"}
+    for key in sorted(watch.known - fresh):
+        watch._feed(WatchEvent("delete", key))
+    for key in sorted(fresh):
+        watch._feed(WatchEvent("put", key, b"v"))
+    events = []
+    for _ in range(3):
+        events.append(watch._queue.get_nowait())
+    assert (events[0].type, events[0].key) == ("delete", "instances/b")
+    assert {e.key for e in events[1:]} == fresh
+    assert watch.known == fresh
+
+
 async def test_replica_ids_never_collide_after_promotion():
     primary = FabricState()
     replica = FabricState()

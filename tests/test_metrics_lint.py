@@ -53,6 +53,13 @@ INTENTIONALLY_SHARED = {
     "dyn_llm_kv_integrity_failures",
     "dyn_llm_blocks_quarantined",
     "dyn_llm_fenced_rejects",
+    # control plane (ISSUE 10): every process exports its OWN fabric
+    # client's health — connected flag, degraded mode, time degraded,
+    # blackout count (frontend + metrics component)
+    "dyn_fabric_connected",
+    "dyn_fabric_blackouts",
+    "dyn_llm_degraded_mode",
+    "dyn_llm_degraded_seconds",
 }
 
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
@@ -89,6 +96,12 @@ def _all_registries() -> dict[str, CollectorRegistry]:
         {"integrity_failures_by_path": {"disagg_frame": 0},
          "blocks_quarantined": 0,
          "fenced_rejects_by_plane": {"dispatch": 0}}
+    )
+    frontend.attach_control_plane(
+        {"connected": True, "degraded": False,
+         "degraded_seconds_total": 0.0, "blackouts_total": 0,
+         "buffered_publishes": 0, "flushed_publishes": 0,
+         "dropped_publishes": 0}
     )
     component = MetricsComponent(
         _StubComponent(), EndpointId("lint", "backend", "generate")
@@ -204,6 +217,34 @@ def test_integrity_families_present_with_correct_types():
         ):
             fam = by_role[role].get(name)
             assert fam is not None and fam.type == "counter", (role, name)
+
+
+def test_control_plane_families_present_with_correct_types():
+    """ISSUE 10: the control-plane health families (degraded-mode data
+    plane) must exist on both the frontend and the metrics component —
+    reachability flags as gauges, degraded time / blackout count with
+    counter semantics."""
+    regs = _all_registries()
+    by_role = {
+        role: {f.name: f for f in _families(reg)}
+        for role, reg in regs.items()
+    }
+    for role in ("frontend", "component"):
+        for name, typ in (
+            ("dyn_fabric_connected", "gauge"),
+            ("dyn_llm_degraded_mode", "gauge"),
+            ("dyn_llm_degraded_seconds", "counter"),
+            ("dyn_fabric_blackouts", "counter"),
+        ):
+            fam = by_role[role].get(name)
+            assert fam is not None and fam.type == typ, (role, name)
+    # the buffered-publish flow is frontend-local (per-process client)
+    for name in (
+        "dyn_llm_degraded_publishes_buffered",
+        "dyn_llm_degraded_publishes_flushed",
+    ):
+        fam = by_role["frontend"].get(name)
+        assert fam is not None and fam.type == "counter", name
 
 
 def test_every_family_has_help_text():
