@@ -259,7 +259,7 @@ def main() -> None:
         "--preset",
         choices=[
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
-            "priority", "integrity", "decode_mfu", "blackout",
+            "priority", "integrity", "decode_mfu", "blackout", "planner",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -297,7 +297,13 @@ def main() -> None:
         "TTFT through a mid-traffic control-plane blackout vs steady "
         "state — zero errors, zero divergence — plus warm-restart TTFT "
         "vs cold on a repeated-prefix workload; banked artifact "
-        "benchmarks/blackout_sweep.json)",
+        "benchmarks/blackout_sweep.json). "
+        "planner = delegates to benchmarks.planner_sweep (closed-loop "
+        "planner over a mocker fleet on diurnal + flash-crowd traces: "
+        "SLO attainment vs replica-seconds against a static max fleet, "
+        "plus the chaos wave — frozen through a blackout, healed within "
+        "2 intervals, zero planner/brownout oscillation; banked "
+        "artifact benchmarks/planner_sweep.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -350,6 +356,16 @@ def main() -> None:
 
         decode_mfu_bench.main(
             ["--json", args.json or "benchmarks/decode_mfu.json"]
+        )
+        return
+    if args.preset == "planner":
+        # closed-loop planner sweep runs on the mocker fleet directly
+        # (no HTTP frontend) — one entry point for every banked curve
+        # stays `perf_sweep --preset X`
+        from benchmarks import planner_sweep
+
+        planner_sweep.main(
+            ["--json", args.json or "benchmarks/planner_sweep.json"]
         )
         return
     if args.preset == "blackout":

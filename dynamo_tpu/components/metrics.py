@@ -186,6 +186,7 @@ class _FleetCollector:
         ph = agg.phase_histograms if agg is not None else None
         yield from self._phase_families(ph)
         yield from self._slo_families()
+        yield from planner_families(self.component.planner_status)
 
     def _phase_families(self, ph: Optional[PhaseHistograms]):
         hist = HistogramMetricFamily(
@@ -251,6 +252,59 @@ class _FleetCollector:
             "Transitions into the breached SLO state",
             value=float(slo.breaches_total),
         )
+
+
+def planner_families(status: Optional[dict]):
+    """Scrape-time `dyn_planner_*` / `dyn_supervisor_*` families from a
+    planner-published status dict (Planner.status() wire form under
+    PLANNER_STATUS_KEY). Shared between the metrics component (fabric
+    scrape) and a frontend's attach_planner — same names, same types."""
+    status = status or {}
+    dec = CounterMetricFamily(
+        "dyn_planner_decisions",
+        "Planner decisions by actuation direction (up/down/hold/frozen/"
+        "heal) and reason slug",
+        labels=["direction", "reason"],
+    )
+    for key, v in sorted((status.get("decisions_total") or {}).items()):
+        direction, _, reason = str(key).partition("|")
+        dec.add_metric([direction, reason or "unknown"], float(v))
+    yield dec
+    yield GaugeMetricFamily(
+        "dyn_planner_frozen",
+        "Planner fail-static state: 1 when scaling is frozen (stale "
+        "signals, degraded control plane, or intent mismatch), else 0",
+        value=float(status.get("frozen", 0) or 0),
+    )
+    target = GaugeMetricFamily(
+        "dyn_planner_replicas_target",
+        "Planner replica intent per fleet role",
+        labels=["role"],
+    )
+    for role, v in sorted((status.get("replicas_target") or {}).items()):
+        target.add_metric([str(role)], float(v))
+    yield target
+    actual = GaugeMetricFamily(
+        "dyn_planner_replicas_actual",
+        "Observed replicas per fleet role (workers whose stats answered)",
+        labels=["role"],
+    )
+    for role, v in sorted((status.get("replicas_actual") or {}).items()):
+        actual.add_metric([str(role)], float(v))
+    yield actual
+    sup = status.get("supervisor") or {}
+    yield CounterMetricFamily(
+        "dyn_supervisor_restarts",
+        "Child processes restarted by the supervisor (crashes, health-"
+        "probe kills, injected kills)",
+        value=float(sup.get("restarts_total", 0) or 0),
+    )
+    yield GaugeMetricFamily(
+        "dyn_supervisor_quarantined",
+        "Children currently in crash-loop quarantine (slow-cadence "
+        "retries; excluded from the healthy replica count)",
+        value=float(sup.get("quarantined", 0) or 0),
+    )
 
 
 class MetricsComponent:
@@ -399,6 +453,9 @@ class MetricsComponent:
         self._overlap_sum = 0
         self._tasks: list[asyncio.Task] = []
         self.last: Optional[ForwardPassMetrics] = None
+        # latest planner-published status (PLANNER_STATUS_KEY), refreshed
+        # by the poll loop; renders as dyn_planner_*/dyn_supervisor_*
+        self.planner_status: dict = {}
 
     async def start(self) -> int:
         port = await self.server.start()
@@ -488,6 +545,19 @@ class MetricsComponent:
                     if agg.phase_histograms is not None
                     else PhaseHistograms()
                 )
+                # planner status (closed-loop fleet plane): best-effort
+                # read of the kv key the planner publishes after every
+                # decision — absent key keeps the last-seen view
+                with contextlib.suppress(Exception):
+                    from dynamo_tpu.planner.planner_core import (
+                        PLANNER_STATUS_KEY,
+                    )
+
+                    raw = await self.component.drt.fabric.kv_get(
+                        PLANNER_STATUS_KEY
+                    )
+                    if raw:
+                        self.planner_status = msgpack.unpackb(raw, raw=False)
             except Exception:  # noqa: BLE001 — scrape failures are transient
                 logger.exception("metrics poll failed")
             await asyncio.sleep(self.poll_interval)
@@ -531,6 +601,7 @@ class MockWorkerMetrics:
         total_blocks: int = 512,
         ttft_ms: float = 120.0,
         itl_ms: float = 12.0,
+        load_fn=None,  # () -> load; overrides the sine (planner sims)
     ) -> None:
         self.publisher = WorkerMetricsPublisher(
             endpoint.component, endpoint.id, instance_id
@@ -540,6 +611,10 @@ class MockWorkerMetrics:
         self.total_blocks = total_blocks
         self.ttft_ms = ttft_ms
         self.itl_ms = itl_ms
+        # externally-driven load for fleet simulations: a value > 1 means
+        # OVERLOAD — latencies blow up superlinearly past saturation, the
+        # regime the closed-loop planner must scale out of
+        self.load_fn = load_fn
         self._t = 0.0
         # monotonic counter state (worker lifetime)
         self._deadline_exceeded = 0
@@ -565,14 +640,19 @@ class MockWorkerMetrics:
 
     def snapshot(self) -> ForwardPassMetrics:
         self._t += 1.0
-        phase = (self._t % self.period_s) / self.period_s * 2 * math.pi
-        load = (math.sin(phase) + 1) / 2  # 0..1
+        if self.load_fn is not None:
+            raw_load = max(0.0, float(self.load_fn()))
+        else:
+            phase = (self._t % self.period_s) / self.period_s * 2 * math.pi
+            raw_load = (math.sin(phase) + 1) / 2  # 0..1
+        load = min(1.0, raw_load)
+        overload = max(0.0, raw_load - 1.0)  # queueing regime past 1.0
         active_blocks = int(self.total_blocks * load)
         # a few synthetic requests this tick; latencies scale with load
         # (deterministic — no RNG, so dashboards and tests are repeatable)
         reqs = 1 + int(3 * load)
         for i in range(reqs):
-            scale = 0.7 + 0.6 * load + 0.05 * i
+            scale = 0.7 + 0.6 * load + 4.0 * overload + 0.05 * i
             self.hist.observe("queue_wait", 2.0 * scale)
             self.hist.observe("prefill", 40.0 * scale)
             self.hist.observe("ttft", self.ttft_ms * scale)
@@ -633,7 +713,9 @@ class MockWorkerMetrics:
             worker_stats=WorkerStats(
                 request_active_slots=int(self.total_slots * load),
                 request_total_slots=self.total_slots,
-                num_requests_waiting=int(4 * max(0.0, load - 0.75)),
+                num_requests_waiting=int(
+                    4 * max(0.0, load - 0.75) + 16 * overload
+                ),
                 num_deadline_exceeded=self._deadline_exceeded,
                 num_watchdog_trips=self._watchdog_trips,
                 preemptions_by_class=dict(self._preemptions_by_class) or None,

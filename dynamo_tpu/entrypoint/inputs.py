@@ -275,6 +275,15 @@ async def run_http(
     # control-plane health row: dyn_fabric_connected / dyn_llm_degraded_*
     # straight off this process's fabric client (degraded-mode data plane)
     service.metrics.attach_control_plane(drt.fabric.status)
+    # closed-loop fleet row (ISSUE 11): if a planner publishes status on
+    # this fabric, render dyn_planner_*/dyn_supervisor_* here too — the
+    # frontend is the registry operators already scrape
+    from dynamo_tpu.planner.samplers import PlannerStatusCache
+
+    planner_cache = PlannerStatusCache(drt.fabric)
+    await planner_cache.start()
+    service.metrics.attach_planner(lambda: planner_cache.status)
+    service.add_background_task(planner_cache._task)
     await service.start()
 
     async def _slo_event_loop() -> None:

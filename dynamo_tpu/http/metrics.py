@@ -360,6 +360,34 @@ class ServiceMetrics:
             read("flushed_publishes"),
         )
 
+    def attach_planner(self, status_src) -> None:
+        """Surface the closed-loop planner's published status on this
+        frontend's /metrics (`dyn_planner_*` / `dyn_supervisor_*` —
+        decisions by direction/reason, fail-static frozen flag, replica
+        target vs actual, supervisor restart/quarantine counts).
+        `status_src` is a zero-arg callable returning the planner status
+        dict (e.g. `PlannerStatusCache(...).status` via lambda, or an
+        embedded `Planner.status`); read lazily at scrape time. Same
+        family builder the metrics component uses — shared series."""
+        if getattr(self, "_planner_attached", False):
+            return
+        self._planner_attached = True
+
+        def read() -> dict:
+            d = status_src() if callable(status_src) else status_src
+            return d if isinstance(d, dict) else {}
+
+        class _PlannerCollector:
+            def describe(self):
+                return []
+
+            def collect(self):
+                from dynamo_tpu.components.metrics import planner_families
+
+                yield from planner_families(read())
+
+        self.registry.register(_PlannerCollector())
+
     def attach_brownout(self, controller) -> None:
         """Surface the brownout ladder on /metrics: the live rung as a
         gauge (0 ok .. 4 shed_standard) and the transition count as a real

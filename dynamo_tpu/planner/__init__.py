@@ -10,6 +10,7 @@ supervisor instead of circus/k8s CRDs.
 from dynamo_tpu.planner.connectors import (
     Connector,
     LocalProcessConnector,
+    SupervisorConnector,
     VirtualConnector,
 )
 from dynamo_tpu.planner.load_predictor import (
@@ -25,6 +26,7 @@ from dynamo_tpu.planner.perf_interpolation import (
 from dynamo_tpu.planner.planner_core import (
     Planner,
     PlannerConfig,
+    PlannerMetrics,
     ScaleDecision,
 )
 
@@ -37,8 +39,10 @@ __all__ = [
     "MovingAveragePredictor",
     "Planner",
     "PlannerConfig",
+    "PlannerMetrics",
     "PrefillInterpolator",
     "ScaleDecision",
+    "SupervisorConnector",
     "VirtualConnector",
     "make_predictor",
 ]

@@ -8,23 +8,37 @@ frontend metrics URL and a profiled .npz (benchmarks/profiler output).
         --namespace demo --component decode --endpoint generate \
         --prefill-cmd "python -m my_prefill_worker" \
         --decode-cmd "python -m my_decode_worker"
+
+The service loop is CLOSED and SAFE (ISSUE 11): sensing comes from the
+fleet metrics plane with staleness stamps (`FleetSampler`), actuation is
+damped (hysteresis / cooldowns / step bounds / debounce via
+`PlannerConfig.from_env` — DYN_PLANNER_* knobs), the `brownout-status`
+subscription inhibits scale-down while the ladder is engaged, local
+process actuation is supervisor-backed with crash-loop quarantine
+(`SupervisorConnector`), and every decision publishes the planner's
+status for the `dyn_planner_*` metric families.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
+import contextlib
 import shlex
 
 from dynamo_tpu.planner import (
     DecodeInterpolator,
-    LocalProcessConnector,
     Planner,
     PlannerConfig,
     PrefillInterpolator,
+    SupervisorConnector,
     VirtualConnector,
 )
-from dynamo_tpu.planner.samplers import FrontendFabricSampler
+from dynamo_tpu.planner.samplers import (
+    FleetSampler,
+    FrontendFabricSampler,
+    PlannerStatusPublisher,
+)
 from dynamo_tpu.runtime import logging as dlog
 
 
@@ -71,15 +85,18 @@ def main() -> None:
     async def run() -> None:
         aggregator = None
         drt = None
+        namespace = None
         try:
             from dynamo_tpu.runtime.distributed import DistributedRuntime
             from dynamo_tpu.runtime.protocols import EndpointId
             from dynamo_tpu.kv_router.publisher import KvMetricsAggregator
 
             drt = await DistributedRuntime.from_settings()
-            component = (
-                await drt.namespace(args.namespace)
-            ).component(args.component)
+            # NOTE: namespace() is sync — the old `await drt.namespace(...)`
+            # raised TypeError into the broad except below, so the fabric
+            # sampling path silently never engaged
+            namespace = drt.namespace(args.namespace)
+            component = namespace.component(args.component)
             aggregator = KvMetricsAggregator(
                 component,
                 EndpointId(args.namespace, args.component, args.endpoint),
@@ -88,7 +105,22 @@ def main() -> None:
             dlog.get_logger("dynamo_tpu.planner").warning(
                 "no fabric available; kv_usage/queue_depth stay 0"
             )
-        sample = FrontendFabricSampler(args.metrics_url, aggregator)
+        if drt is not None and aggregator is not None:
+            # closed-loop sensing: merged fleet histograms + staleness
+            # stamps + control-plane health + fence tombstones
+            from dynamo_tpu.planner.planner_core import DECODE as _DEC
+
+            fences = None
+            with contextlib.suppress(Exception):
+                fences = await drt.fences()
+            sample = FleetSampler(
+                {_DEC: aggregator},
+                fabric=drt.fabric,
+                fences=fences,
+                metrics_url=args.metrics_url,
+            )
+        else:
+            sample = FrontendFabricSampler(args.metrics_url, aggregator)
         if args.dry_run:
             # dry-run ALWAYS wins — never actuate a live cluster from a
             # preview run, regardless of --connector
@@ -120,11 +152,15 @@ def main() -> None:
         elif not (args.prefill_cmd and args.decode_cmd):
             connector = VirtualConnector()
         else:
-            connector = LocalProcessConnector(
+            # supervisor-backed local actuation: crash-restarted children
+            # with quarantine discipline; give-ups notify the planner so
+            # the next interval substitutes capacity (ISSUE 11)
+            connector = SupervisorConnector(
                 {
                     "prefill_worker": shlex.split(args.prefill_cmd),
                     "decode_worker": shlex.split(args.decode_cmd),
-                }
+                },
+                on_giveup=lambda role, name: planner.note_capacity_loss(role),
             )
         pre = dec = None
         if args.profile:
@@ -140,7 +176,10 @@ def main() -> None:
                 "or supply a profile."
             )
         planner = Planner(
-            PlannerConfig(
+            # from_env layers the DYN_PLANNER_* safe-actuation knobs
+            # (hysteresis, cooldowns, step bounds, debounce, staleness
+            # freeze) over production-safe tuned() defaults
+            PlannerConfig.from_env(
                 mode=args.mode,
                 interval_s=args.interval,
                 ttft_target_ms=args.ttft_target_ms,
@@ -155,10 +194,44 @@ def main() -> None:
             prefill_interp=pre,
             decode_interp=dec,
         )
+        brownout_task = None
+        if drt is not None:
+            # every decision publishes the planner's status for the
+            # dyn_planner_*/dyn_supervisor_* families (metrics component
+            # scrapes PLANNER_STATUS_KEY; frontends may cache it too)
+            planner.on_decision = PlannerStatusPublisher(drt.fabric, planner)
+
+            # planner/brownout arbitration: the ladder's transitions feed
+            # note_brownout — level > ok inhibits all scale-down and adds
+            # scale-up pressure (the escalation contract: brownout
+            # degrades in seconds, the planner scales in intervals)
+            async def _brownout_events() -> None:
+                import msgpack
+
+                from dynamo_tpu.telemetry import brownout as dbrownout
+
+                with contextlib.suppress(asyncio.CancelledError, Exception):
+                    sub = await namespace.subscribe_event(
+                        dbrownout.BROWNOUT_SUBJECT
+                    )
+                    async for _subject, payload in sub:
+                        try:
+                            data = msgpack.unpackb(payload, raw=False)
+                            planner.note_brownout(int(data.get("level", 0)))
+                        except Exception:  # noqa: BLE001 — malformed event
+                            continue
+
+            brownout_task = asyncio.get_running_loop().create_task(
+                _brownout_events()
+            )
         await planner.start()
         try:
             await asyncio.Event().wait()
         finally:
+            if brownout_task is not None:
+                brownout_task.cancel()
+                with contextlib.suppress(asyncio.CancelledError):
+                    await brownout_task
             await planner.close()
             if hasattr(connector, "close"):
                 await connector.close()

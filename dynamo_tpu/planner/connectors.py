@@ -23,7 +23,7 @@ import contextlib
 import json
 import os
 import signal
-from typing import Optional, Protocol
+from typing import Any, Optional, Protocol
 
 from dynamo_tpu.runtime.logging import get_logger
 
@@ -102,6 +102,129 @@ class LocalProcessConnector:
     async def close(self) -> None:
         for component in list(self._procs):
             await self.set_replicas(component, 0)
+
+
+class SupervisorConnector:
+    """Planner Connector backed by the SDK process supervisor: one
+    ManagedProcess per replica (crash-restarted, health-probed,
+    quarantine-disciplined — sdk/supervisor.py), the self-healing
+    actuator the closed loop uses (ISSUE 11).
+
+    Semantics the planner relies on (mirroring k8s spec-vs-status):
+
+      * `replicas()` is INTENT (the last set target — spec.replicas);
+        `healthy()` is observation — running, non-quarantined children
+        (readyReplicas). A quarantined crash-looper never counts as
+        healthy, so a planner heal (re-asserting the same intent via
+        `set_replicas(target)`) spawns a substitute while quarantine
+        keeps slow retries going on the sick one;
+      * entering quarantine fires `on_giveup(component, name)` (wired to
+        `Planner.note_capacity_loss` so the next interval heals);
+      * scale-down stops the NEWEST healthy replicas via the graceful
+        SIGTERM drain path (runner: stop admission -> finish in-flight ->
+        warm KV checkpoint under DYN_WARM_RESTART_DIR) — never a SIGKILL
+        with hot KV.
+    """
+
+    def __init__(
+        self,
+        commands: dict[str, list[str]],
+        env: Optional[dict[str, str]] = None,
+        grace_s: Optional[float] = None,
+        on_giveup: Optional[Any] = None,  # (component, name) -> None
+        proc_kwargs: Optional[dict] = None,  # extra ManagedProcess knobs
+    ) -> None:
+        from dynamo_tpu.sdk.supervisor import Supervisor
+
+        self.commands = commands
+        self.env = env or {}
+        self.grace_s = (
+            grace_s
+            if grace_s is not None
+            else float(os.environ.get("DYN_DRAIN_TIMEOUT_S", "10")) + 2.0
+        )
+        self.on_giveup = on_giveup
+        self.proc_kwargs = proc_kwargs or {}
+        self.supervisor = Supervisor()
+        self._procs: dict[str, list] = {}  # component -> ManagedProcess[]
+        self._seq: dict[str, int] = {}
+        self.targets: dict[str, int] = {}  # component -> intent
+
+    def _healthy(self, component: str) -> list:
+        return [
+            p for p in self._procs.get(component, [])
+            if not p.quarantined and p._monitor_task is not None
+            and not p._monitor_task.done()
+        ]
+
+    def replicas(self, component: str) -> int:
+        """Current INTENT (the planner's baseline), not live health."""
+        return self.targets.get(component, 0)
+
+    def healthy(self, component: str) -> int:
+        """Observed replicas: running, non-quarantined children — what a
+        sampler should report as replicas_actual."""
+        return len(self._healthy(component))
+
+    def quarantined(self, component: str) -> int:
+        return sum(
+            1 for p in self._procs.get(component, []) if p.quarantined
+        )
+
+    async def set_replicas(self, component: str, n: int) -> None:
+        from dynamo_tpu.sdk.supervisor import ManagedProcess
+
+        self.targets[component] = n
+        procs = self._procs.setdefault(component, [])
+        # reap children whose monitors finished (stopped / no-restart exit)
+        procs[:] = [
+            p for p in procs
+            if p._monitor_task is None or not p._monitor_task.done()
+        ]
+        while len(self._healthy(component)) < n:
+            idx = self._seq[component] = self._seq.get(component, 0) + 1
+            name = f"{component}-{idx}"
+            proc = ManagedProcess(
+                self.commands[component],
+                name=name,
+                env={
+                    **os.environ, **self.env,
+                    "DYN_REPLICA_INDEX": str(idx),
+                },
+                on_giveup=(
+                    (lambda pname, c=component: self.on_giveup(c, pname))
+                    if self.on_giveup is not None
+                    else None
+                ),
+                **self.proc_kwargs,
+            )
+            self.supervisor.procs.pop(name, None)
+            self.supervisor.add(proc)
+            await proc.start()
+            procs.append(proc)
+            logger.info("scaled up %s -> %s (pid %s)", component, name, proc.pid)
+        while len(self._healthy(component)) > n:
+            victim = self._healthy(component)[-1]  # newest first
+            logger.info(
+                "scaling down %s: draining %s (pid %s)",
+                component, victim.name, victim.pid,
+            )
+            await victim.stop(self.grace_s)
+            procs.remove(victim)
+            self.supervisor.procs.pop(victim.name, None)
+
+    def stats(self) -> dict:
+        return self.supervisor.stats()
+
+    async def close(self) -> None:
+        for component in list(self._procs):
+            self.targets[component] = 0
+            procs = self._procs[component]
+            await asyncio.gather(
+                *(p.stop(self.grace_s) for p in procs),
+                return_exceptions=True,
+            )
+            procs.clear()
 
 
 _SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"

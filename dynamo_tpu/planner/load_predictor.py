@@ -22,7 +22,11 @@ class ConstantPredictor:
         self._last = value
 
     def predict(self) -> Optional[float]:
-        return self._last
+        # rates/lengths are non-negative quantities; a glitched observation
+        # (counter reset, clock skew) must not flow into demand math
+        if self._last is None:
+            return None
+        return max(0.0, self._last)
 
 
 class MovingAveragePredictor:
@@ -35,7 +39,7 @@ class MovingAveragePredictor:
     def predict(self) -> Optional[float]:
         if not self._buf:
             return None
-        return sum(self._buf) / len(self._buf)
+        return max(0.0, sum(self._buf) / len(self._buf))
 
 
 class LinearTrendPredictor:
@@ -56,7 +60,7 @@ class LinearTrendPredictor:
         if n == 0:
             return None
         if n < 3:
-            return self._buf[-1]
+            return max(0.0, self._buf[-1])
         xs = range(n)
         mean_x = (n - 1) / 2
         mean_y = sum(self._buf) / n

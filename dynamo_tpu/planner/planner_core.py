@@ -1,4 +1,4 @@
-"""Planner core: observe -> predict -> interpolate -> scale.
+"""Planner core: observe -> predict -> interpolate -> scale — safely.
 
 Role-equivalent of planner utils/planner_core.py (:51-436): every
 adjustment interval the planner samples the serving metrics, predicts the
@@ -12,6 +12,33 @@ Connector. Two modes, like the reference:
 Correction factors: observed TTFT/ITL vs interpolated at the same
 operating point scale the model continuously, so a mis-profiled surface
 still converges (reference :170-196).
+
+ISSUE 11 — the actuator is now SAFE, in four layers:
+
+  * **fail static**: signals carry a staleness stamp; a stale sample, a
+    degraded control plane, or observed replica state that disagrees
+    with intent freezes scaling (decision direction ``frozen``,
+    ``dyn_planner_frozen`` metric) — an autoscaler acting on garbage is
+    a reliability liability, not a feature;
+  * **damped actuation**: per-direction hysteresis bands, scale-up /
+    scale-down cooldowns, bounded step size, and a K-interval decision
+    debounce, so a noisy signal cannot flap the fleet;
+  * **brownout arbitration**: brownout level > ok converts into scale-up
+    pressure and *inhibits all scale-down*. The escalation contract:
+    brownout degrades in seconds (sheds classes, pauses spec), the
+    planner scales in intervals — scaling down while the ladder is
+    engaged would fight the degrade actuator and oscillate;
+  * **self-healing**: supervisor give-ups (crash-loop quarantine),
+    watchdog trips and fence tombstones trigger a heal — re-asserting
+    the current intent so the connector substitutes capacity — instead
+    of waiting for load to notice the shrunken fleet. Heals re-assert
+    intent; they are never new scale decisions, so cooldowns/debounce
+    do not apply.
+
+Scale-down is KV-preserving by contract: every shipped connector drains
+victims via SIGTERM (the sdk/runner drain path), so a victim's warm KV
+checkpoint (``DYN_WARM_RESTART_DIR``) fires before exit — hot KV is
+never SIGKILLed away.
 """
 
 from __future__ import annotations
@@ -19,6 +46,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import math
+import time
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
@@ -34,6 +62,11 @@ logger = get_logger("dynamo_tpu.planner")
 
 PREFILL = "prefill_worker"
 DECODE = "decode_worker"
+ROLES = (PREFILL, DECODE)
+
+# fabric kv key the planner publishes its status() under (metrics
+# component scrapes it into the dyn_planner_*/dyn_supervisor_* families)
+PLANNER_STATUS_KEY = "planner/status"
 
 
 @dataclass
@@ -47,6 +80,15 @@ class ObservedMetrics:
     itl_ms: Optional[float] = None
     kv_usage: float = 0.0  # 0..1 decode fleet cache usage
     queue_depth: float = 0.0  # waiting prefill requests
+    # --- sensing integrity (ISSUE 11) ---
+    age_s: float = 0.0  # seconds since this data was fresh
+    stale: bool = False  # sampler failed to produce a fresh sample
+    degraded: bool = False  # control plane unreachable (fabric status)
+    brownout_level: int = 0  # worst known brownout rung (0 = ok)
+    # observed workers per role (None = sampler cannot observe them)
+    replicas_actual: Optional[dict[str, int]] = None
+    watchdog_trips: int = 0  # cumulative fleet watchdog trips
+    fenced_epochs: int = 0  # cumulative fence tombstones seen
 
 
 @dataclass
@@ -68,8 +110,67 @@ class PlannerConfig:
     kv_usage_low: float = 0.3
     queue_high: float = 4.0
     queue_low: float = 0.5
+    # load-mode backlog sizing: waiting requests one replica is assumed
+    # to drain per interval (converts queue depth into a scale-up step)
+    queue_drain_per_replica: float = 8.0
     # headroom multiplier on computed demand
     headroom: float = 1.15
+    # --- safe-actuation knobs (ISSUE 11). Neutral defaults keep the raw
+    # observe->decide->actuate loop (tests, dry runs); production entry
+    # points use tuned() / from_env which damp every direction.
+    hysteresis: float = 0.0  # fractional deadband before acting
+    cooldown_up_s: float = 0.0  # min seconds between scale-ups
+    cooldown_down_s: float = 0.0  # min seconds between scale-downs
+    max_step_up: int = 0  # 0 = unbounded replicas added per decision
+    max_step_down: int = 0  # 0 = unbounded replicas removed per decision
+    debounce_intervals: int = 1  # K consecutive agreeing intervals
+    stale_after_s: float = 0.0  # 0 = staleness freeze disabled
+    mismatch_intervals: int = 3  # intent-vs-observed grace (intervals)
+
+    @classmethod
+    def tuned(cls, **overrides) -> "PlannerConfig":
+        """Production-safe damping: deadband, per-direction cooldowns,
+        one-replica scale-downs, two-interval debounce, staleness freeze
+        at three missed intervals."""
+        base = dict(
+            hysteresis=0.1,
+            cooldown_up_s=30.0,
+            cooldown_down_s=180.0,
+            max_step_up=4,
+            max_step_down=1,
+            debounce_intervals=2,
+            stale_after_s=30.0,
+        )
+        base.update(overrides)
+        return cls(**base)
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None, **overrides) -> "PlannerConfig":
+        import os
+
+        env = env if env is not None else os.environ
+
+        def f(name: str, d: float) -> float:
+            try:
+                return float(env.get(name, d) or d)
+            except (TypeError, ValueError):
+                return d
+
+        cfg = cls.tuned(**overrides)
+        cfg.hysteresis = f("DYN_PLANNER_HYSTERESIS", cfg.hysteresis)
+        cfg.cooldown_up_s = f("DYN_PLANNER_COOLDOWN_UP_S", cfg.cooldown_up_s)
+        cfg.cooldown_down_s = f(
+            "DYN_PLANNER_COOLDOWN_DOWN_S", cfg.cooldown_down_s
+        )
+        cfg.max_step_up = int(f("DYN_PLANNER_MAX_STEP_UP", cfg.max_step_up))
+        cfg.max_step_down = int(
+            f("DYN_PLANNER_MAX_STEP_DOWN", cfg.max_step_down)
+        )
+        cfg.debounce_intervals = int(
+            f("DYN_PLANNER_DEBOUNCE", cfg.debounce_intervals)
+        )
+        cfg.stale_after_s = f("DYN_PLANNER_STALE_AFTER_S", cfg.stale_after_s)
+        return cfg
 
 
 @dataclass
@@ -77,6 +178,49 @@ class ScaleDecision:
     prefill: int
     decode: int
     reason: str = ""
+    # "up" | "down" | "hold" | "frozen" | "heal" (what actually happened)
+    direction: str = "hold"
+
+
+class PlannerMetrics:
+    """The planner's own metric surface: decision counters by
+    (direction, reason slug), the frozen flag, and target-vs-actual
+    replica gauges. `status()` is the wire form published under
+    PLANNER_STATUS_KEY and rendered by the metrics component / frontend
+    as `dyn_planner_*` families."""
+
+    def __init__(self) -> None:
+        self.decisions_total: dict[str, int] = {}  # "direction|reason" -> n
+        self.frozen = 0
+        self.frozen_reason = ""
+        self.frozen_intervals_total = 0
+        self.heals_total = 0
+        self.replicas_target: dict[str, int] = {}
+        self.replicas_actual: dict[str, int] = {}
+
+    def count(self, direction: str, reason: str) -> None:
+        key = f"{direction}|{reason}"
+        self.decisions_total[key] = self.decisions_total.get(key, 0) + 1
+
+    def note_frozen(self, reason: str) -> None:
+        self.frozen = 1
+        self.frozen_reason = reason
+        self.frozen_intervals_total += 1
+
+    def clear_frozen(self) -> None:
+        self.frozen = 0
+        self.frozen_reason = ""
+
+    def status(self) -> dict:
+        return {
+            "decisions_total": dict(self.decisions_total),
+            "frozen": self.frozen,
+            "frozen_reason": self.frozen_reason,
+            "frozen_intervals_total": self.frozen_intervals_total,
+            "heals_total": self.heals_total,
+            "replicas_target": dict(self.replicas_target),
+            "replicas_actual": dict(self.replicas_actual),
+        }
 
 
 class Planner:
@@ -93,6 +237,8 @@ class Planner:
         connector: Connector,
         prefill_interp: Optional[PrefillInterpolator] = None,
         decode_interp: Optional[DecodeInterpolator] = None,
+        now_fn: Callable[[], float] = time.monotonic,
+        on_decision: Optional[Callable[[ScaleDecision], None]] = None,
     ) -> None:
         self.config = config
         self.sample = sample
@@ -108,6 +254,46 @@ class Planner:
         self._itl_corr = 1.0
         self._task: Optional[asyncio.Task] = None
         self.decisions: list[ScaleDecision] = []
+        # --- safe actuation state (ISSUE 11) ---
+        self._now = now_fn
+        self.on_decision = on_decision
+        self.metrics = PlannerMetrics()
+        self._brownout_level = 0  # fed by note_brownout (brownout-status)
+        self._heal_requests: set[str] = set()  # roles needing substitutes
+        self._last_watchdog = 0
+        self._last_fenced = 0
+        self._last_up: dict[str, float] = {}  # role -> last scale-up ts
+        self._last_down: dict[str, float] = {}
+        self._streak: dict[str, tuple[str, int]] = {}  # role -> (dir, n)
+        self._gap_accum: dict[str, int] = {}  # streak-summed |desired-cur|
+        self._mismatch_streak = 0
+
+    # ---------------------------------------------------- external signals
+
+    def note_brownout(self, level: int) -> None:
+        """Feed the current brownout rung (brownout-status subscription).
+        Level > 0 inhibits all scale-down and adds scale-up pressure."""
+        self._brownout_level = max(0, int(level))
+
+    def note_capacity_loss(self, role: str = DECODE) -> None:
+        """A supervisor gave up on a crash-looping child (quarantine) —
+        the next interval substitutes capacity by re-asserting intent."""
+        self._heal_requests.add(role)
+
+    @property
+    def frozen(self) -> bool:
+        return bool(self.metrics.frozen)
+
+    def status(self) -> dict:
+        """Wire-form status for PLANNER_STATUS_KEY publishes (the metric
+        plane) — decision counters, frozen state, target vs actual."""
+        out = self.metrics.status()
+        out["brownout_level"] = self._brownout_level
+        sup_stats = getattr(self.connector, "stats", None)
+        if callable(sup_stats):
+            with contextlib.suppress(Exception):
+                out["supervisor"] = sup_stats()
+        return out
 
     # ------------------------------------------------------------ decide
 
@@ -178,9 +364,25 @@ class Planner:
         elif m.queue_depth < cfg.queue_low and n_p > cfg.min_prefill:
             n_p -= 1
             why.append("queue_low")
-        if m.kv_usage > cfg.kv_usage_high:
-            n_d += 1
-            why.append("kv_high")
+        if m.kv_usage > cfg.kv_usage_high or m.queue_depth > cfg.queue_high:
+            # proportional scale-up, not a flat +1: size the step to the
+            # observed saturation (usage over the watermark) and to the
+            # queued backlog (usage pins at 100% under a flash crowd —
+            # the queue is the only signal that still carries magnitude).
+            # max_step_up is what bounds the actuated jump.
+            grow = 1.0
+            if m.kv_usage > cfg.kv_usage_high:
+                grow = max(grow, m.kv_usage / cfg.kv_usage_high)
+            if m.queue_depth > cfg.queue_high:
+                grow = max(
+                    grow,
+                    1.0
+                    + m.queue_depth
+                    / (cfg.queue_drain_per_replica * max(n_d, 1)),
+                )
+            n_d = max(n_d + 1, math.ceil(n_d * grow))
+            why.append("kv_high" if m.kv_usage > cfg.kv_usage_high
+                       else "queue_backlog")
         elif m.kv_usage < cfg.kv_usage_low and n_d > cfg.min_decode:
             n_d -= 1
             why.append("kv_low")
@@ -189,6 +391,141 @@ class Planner:
             decode=min(max(n_d, cfg.min_decode), cfg.max_decode),
             reason="load " + "+".join(why) if why else "load steady",
         )
+
+    # ------------------------------------------------------ safety layers
+
+    def _frozen_reason(self, m: ObservedMetrics) -> Optional[str]:
+        """Fail static: the conditions under which NO actuation happens.
+        A planner acting on stale/dark signals would scale on garbage; a
+        planner whose intent the world disagrees with (beyond the
+        actuation-lag grace) has lost its feedback loop."""
+        cfg = self.config
+        if m.stale or (cfg.stale_after_s > 0 and m.age_s > cfg.stale_after_s):
+            return "stale_signals"
+        if m.degraded:
+            return "fabric_degraded"
+        if m.replicas_actual is not None:
+            mismatch = any(
+                m.replicas_actual.get(role) is not None
+                and m.replicas_actual[role] > self.connector.replicas(role)
+                for role in m.replicas_actual
+            )
+            # MORE workers than intent means another actor is scaling (or
+            # observation is wrong) — freeze rather than fight it. FEWER
+            # than intent is the heal path (workers died), handled below.
+            self._mismatch_streak = (
+                self._mismatch_streak + 1 if mismatch else 0
+            )
+            if self._mismatch_streak >= self.config.mismatch_intervals:
+                return "intent_mismatch"
+        return None
+
+    def _heal_roles(self, m: ObservedMetrics) -> set[str]:
+        """Roles whose fleets shrank under intent: dead/quarantined
+        workers (observed < target), supervisor give-ups, watchdog trips
+        and fence tombstones. A heal re-asserts the CURRENT target so the
+        connector spawns substitutes — it is not a scale decision."""
+        roles = set(self._heal_requests)
+        if m.replicas_actual is not None:
+            for role, actual in m.replicas_actual.items():
+                if actual < self.connector.replicas(role):
+                    roles.add(role)
+        # watchdog-tripped / fenced workers deregister before their stats
+        # key expires — re-assert intent now instead of waiting for the
+        # replica count to visibly sag
+        if (
+            m.watchdog_trips > self._last_watchdog
+            or m.fenced_epochs > self._last_fenced
+        ):
+            roles.update(
+                m.replicas_actual if m.replicas_actual is not None else ROLES
+            )
+        self._last_watchdog = max(self._last_watchdog, m.watchdog_trips)
+        self._last_fenced = max(self._last_fenced, m.fenced_epochs)
+        return roles
+
+    def _bound(self, role: str, n: int) -> int:
+        cfg = self.config
+        if role == PREFILL:
+            return min(max(n, cfg.min_prefill), cfg.max_prefill)
+        return min(max(n, cfg.min_decode), cfg.max_decode)
+
+    def _damp(
+        self, role: str, current: int, desired: int, now: float,
+        brownout: int, notes: list[str],
+    ) -> int:
+        """Hysteresis band -> debounce -> cooldown -> step bound, per
+        direction. Returns the replica count to actuate (== current for
+        a damped hold)."""
+        cfg = self.config
+        if brownout > 0 and desired < current:
+            # arbitration invariant: no scale-down while the brownout
+            # ladder is engaged (it is already shedding load to protect
+            # the SLO; removing capacity would fight it)
+            desired = current
+            notes.append(f"{role}:down_inhibited_brownout")
+        direction = (
+            "up" if desired > current else "down" if desired < current else ""
+        )
+        if not direction:
+            self._streak[role] = ("", 0)
+            self._gap_accum[role] = 0
+            return current
+        prev_dir, n = self._streak.get(role, ("", 0))
+        n = n + 1 if prev_dir == direction else 1
+        self._streak[role] = (direction, n)
+        gap = abs(desired - current)
+        # hysteresis: the move must clear a fractional deadband of the
+        # current size (always >= 1 replica, so small fleets still move).
+        # The gap is ACCUMULATED over the same-direction streak: an
+        # incremental proposer (load mode suggests one replica per
+        # interval) under sustained pressure eventually clears the band,
+        # while a one-interval wiggle never does — without this a band
+        # of 2 would freeze scale-down forever on fleets >= 1/hysteresis.
+        accum = (self._gap_accum.get(role, 0) if n > 1 else 0) + gap
+        self._gap_accum[role] = accum
+        band = max(1, math.ceil(current * cfg.hysteresis))
+        if accum < band:
+            notes.append(f"{role}:hold_hysteresis")
+            return current
+        # debounce: the same direction must persist K intervals
+        if n < cfg.debounce_intervals:
+            notes.append(f"{role}:hold_debounce_{n}")
+            return current
+        # per-direction cooldown
+        if direction == "up":
+            last = self._last_up.get(role)
+            if last is not None and now - last < cfg.cooldown_up_s:
+                notes.append(f"{role}:hold_cooldown_up")
+                return current
+        else:
+            last = self._last_down.get(role)
+            if last is not None and now - last < cfg.cooldown_down_s:
+                notes.append(f"{role}:hold_cooldown_down")
+                return current
+        # bounded step
+        delta = desired - current
+        if direction == "up" and cfg.max_step_up > 0:
+            delta = min(delta, cfg.max_step_up)
+        elif direction == "down" and cfg.max_step_down > 0:
+            delta = max(delta, -cfg.max_step_down)
+        # acted: the accumulated pressure is spent — the next wiggle must
+        # clear the band on its own
+        self._gap_accum[role] = 0
+        return current + delta
+
+    async def _actuate(
+        self, targets: dict[str, int], force: bool = False
+    ) -> None:
+        """Write intent through the connector. Scale-down is drain-based
+        inside every shipped connector (SIGTERM -> runner drain -> warm
+        KV checkpoint), so victims never lose hot KV to a SIGKILL.
+        `force` re-asserts an unchanged target (the heal path: process
+        connectors spawn substitutes for dead/quarantined children)."""
+        for role, n in targets.items():
+            if force or n != self.connector.replicas(role):
+                await self.connector.set_replicas(role, n)
+            self.metrics.replicas_target[role] = n
 
     async def step(self) -> ScaleDecision:
         """One observe->decide->actuate cycle (the testable unit)."""
@@ -200,25 +537,120 @@ class Planner:
         if refresh is not None:
             await refresh()
         m = await self.sample()
+        now = self._now()
+        current = {role: self.connector.replicas(role) for role in ROLES}
+        self.metrics.replicas_target.update(current)
+        if m.replicas_actual is not None:
+            self.metrics.replicas_actual.update(m.replicas_actual)
+        brownout = max(self._brownout_level, m.brownout_level)
+
+        # ---- layer 1: fail static
+        frozen_why = self._frozen_reason(m)
+        if frozen_why is not None:
+            self.metrics.note_frozen(frozen_why)
+            self.metrics.count("frozen", frozen_why)
+            decision = ScaleDecision(
+                prefill=current[PREFILL], decode=current[DECODE],
+                reason=f"planner_frozen:{frozen_why}", direction="frozen",
+            )
+            self.decisions.append(decision)
+            logger.warning(
+                "planner frozen (%s): holding prefill=%d decode=%d",
+                frozen_why, current[PREFILL], current[DECODE],
+            )
+            if self.on_decision is not None:
+                self.on_decision(decision)
+            return decision
+        self.metrics.clear_frozen()
+
+        # ---- layer 4: self-healing (re-assert intent, not a new target)
+        heal_roles = self._heal_roles(m)
+        if heal_roles:
+            self._heal_requests.clear()
+            await self._actuate(
+                {role: current[role] for role in sorted(heal_roles)},
+                force=True,
+            )
+            self.metrics.heals_total += 1
+            self.metrics.count("heal", "replace_lost")
+            decision = ScaleDecision(
+                prefill=current[PREFILL], decode=current[DECODE],
+                reason="heal:" + "+".join(sorted(heal_roles)),
+                direction="heal",
+            )
+            self.decisions.append(decision)
+            logger.warning("planner healing %s", decision.reason)
+            if self.on_decision is not None:
+                self.on_decision(decision)
+            return decision
+
+        # ---- observe + raw decide
         self._rate.observe(m.req_per_s)
         if m.avg_isl:
             self._isl.observe(m.avg_isl)
         if m.avg_osl:
             self._osl.observe(m.avg_osl)
-        decision = (
+        raw = (
             self._decide_sla(m)
             if self.config.mode == "sla"
             else self._decide_load(m)
         )
-        self.decisions.append(decision)
-        if decision.prefill != self.connector.replicas(PREFILL):
-            await self.connector.set_replicas(PREFILL, decision.prefill)
-        if decision.decode != self.connector.replicas(DECODE):
-            await self.connector.set_replicas(DECODE, decision.decode)
-        logger.info(
-            "planner: prefill=%d decode=%d (%s)",
-            decision.prefill, decision.decode, decision.reason,
+        desired = {PREFILL: raw.prefill, DECODE: raw.decode}
+
+        # ---- layer 3: brownout arbitration — sustained degradation is a
+        # capacity problem; convert it into one-replica-per-interval
+        # scale-up pressure on both fleets (cooldowns still apply)
+        notes: list[str] = []
+        if brownout > 0:
+            for role in ROLES:
+                desired[role] = max(
+                    desired[role], self._bound(role, current[role] + 1)
+                )
+            notes.append(f"brownout_pressure_l{brownout}")
+
+        # ---- layer 2: damped actuation
+        final = {
+            role: self._damp(
+                role, current[role], desired[role], now, brownout, notes
+            )
+            for role in ROLES
+        }
+        directions = {
+            role: (
+                "up" if final[role] > current[role]
+                else "down" if final[role] < current[role] else "hold"
+            )
+            for role in ROLES
+        }
+        for role in ROLES:
+            if directions[role] == "up":
+                self._last_up[role] = now
+            elif directions[role] == "down":
+                self._last_down[role] = now
+        overall = (
+            "up" if "up" in directions.values()
+            else "down" if "down" in directions.values() else "hold"
         )
+        reason_slug = (
+            "brownout_pressure"
+            if brownout > 0 and overall == "up"
+            else self.config.mode
+        )
+        self.metrics.count(overall, reason_slug)
+        decision = ScaleDecision(
+            prefill=final[PREFILL], decode=final[DECODE],
+            reason=raw.reason + ("; " + " ".join(notes) if notes else ""),
+            direction=overall,
+        )
+        self.decisions.append(decision)
+        await self._actuate(final)
+        logger.info(
+            "planner: prefill=%d decode=%d [%s] (%s)",
+            decision.prefill, decision.decode, decision.direction,
+            decision.reason,
+        )
+        if self.on_decision is not None:
+            self.on_decision(decision)
         return decision
 
     # ------------------------------------------------------------- loop

@@ -11,15 +11,28 @@ The reference planner scrapes Prometheus (planner_core.observe_metrics
 
 Counters/histogram sums are cumulative, so each sample differences
 against the previous scrape to produce interval rates/means.
+
+ISSUE 11 adds `FleetSampler`, the closed-loop sensing plane: merged
+phase histograms (fleet-true interval TTFT/ITL percentiles + completed
+request rate), per-role replica observation, watchdog-trip and
+fence-tombstone consumption, control-plane health from
+`FabricClient.status()`, and a staleness stamp on every sample so the
+planner can FAIL STATIC instead of acting on garbage.
 """
 
 from __future__ import annotations
 
+import asyncio
+import contextlib
 import time
 import urllib.request
-from typing import Optional
+from typing import Callable, Optional
 
-from dynamo_tpu.planner.planner_core import ObservedMetrics
+from dynamo_tpu.planner.planner_core import (
+    DECODE,
+    PLANNER_STATUS_KEY,
+    ObservedMetrics,
+)
 from dynamo_tpu.runtime.logging import get_logger
 
 logger = get_logger("dynamo_tpu.planner.samplers")
@@ -109,3 +122,195 @@ class FrontendFabricSampler:
             except Exception:  # noqa: BLE001
                 logger.exception("fabric stats scrape failed")
         return m
+
+
+class FleetSampler:
+    """Fabric-backed ObservedMetrics with staleness stamps (ISSUE 11).
+
+    `aggregators` maps planner role -> KvMetricsAggregator for that
+    fleet's stats endpoint (DECODE drives kv_usage and the latency
+    signals; a PREFILL entry, when present, drives queue depth). The
+    number of workers whose stats keys answered IS the observed replica
+    count per role — the signal the planner compares against intent.
+
+    TTFT/ITL are interval percentiles over the DELTA of the merged
+    fleet phase histograms (clamped subtraction, restart-safe), and the
+    completed-request rate comes from the `e2e` histogram count delta —
+    no frontend required; an optional `metrics_url` layers the frontend
+    text plane on top for ISL/OSL (the SLA-mode demand inputs).
+
+    Fail-static inputs: every sample carries `age_s` (seconds since the
+    last successful scrape), `stale` (never-scraped or scrape failed),
+    and `degraded` (FabricClient.status()["degraded"]) so the planner
+    freezes rather than scaling on a dark or ancient view of the fleet.
+    """
+
+    def __init__(
+        self,
+        aggregators: dict,
+        fabric=None,  # FabricClient (status() for degraded-mode sensing)
+        fences=None,  # FenceRegistry (tombstone count -> heal signal)
+        metrics_url: Optional[str] = None,
+        percentile: float = 95.0,
+        brownout_level_fn: Optional[Callable[[], int]] = None,
+        now_fn: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.aggregators = dict(aggregators)
+        self.fabric = fabric
+        self.fences = fences
+        self.percentile = percentile
+        self.brownout_level_fn = brownout_level_fn
+        self._now = now_fn
+        self._frontend = (
+            FrontendFabricSampler(metrics_url) if metrics_url else None
+        )
+        self._prev_hists = None  # merged PhaseHistograms snapshot
+        self._prev_t: Optional[float] = None
+        self._fresh_t: Optional[float] = None  # last successful scrape
+
+    def _latency_signals(self, m: ObservedMetrics, hists, now: float) -> None:
+        """Interval TTFT/ITL percentiles + completed-request rate from
+        the merged-histogram delta since the previous sample."""
+        prev, prev_t = self._prev_hists, self._prev_t
+        self._prev_hists = hists.copy() if hists is not None else None
+        self._prev_t = now
+        if hists is None or prev is None or prev_t is None or now <= prev_t:
+            return
+        dt = now - prev_t
+
+        def delta(phase: str):
+            cur = hists.get(phase)
+            if cur is None:
+                return None
+            old = prev.get(phase)
+            return cur.sub(old) if old is not None else cur
+
+        e2e = delta("e2e")
+        if e2e is not None and not m.req_per_s:
+            m.req_per_s = e2e.count / dt
+        ttft = delta("ttft")
+        if ttft is not None and ttft.count > 0:
+            m.ttft_ms = ttft.percentile(self.percentile)
+        itl = delta("inter_token")
+        if itl is not None and itl.count > 0:
+            m.itl_ms = itl.percentile(self.percentile)
+
+    async def __call__(self) -> ObservedMetrics:
+        if self._frontend is not None:
+            m = await self._frontend()  # rate/ISL/OSL/interval means
+        else:
+            m = ObservedMetrics()
+        now = self._now()
+        replicas: dict[str, int] = {}
+        watchdog = 0
+        scraped = False
+        for role, agg in self.aggregators.items():
+            try:
+                per_worker = await agg.collect()
+                fleet = await agg.aggregate(per_worker)
+            except Exception:  # noqa: BLE001 — a failed scrape is stale data
+                logger.exception("fleet stats scrape failed (%s)", role)
+                continue
+            scraped = True
+            replicas[role] = len(per_worker)
+            watchdog += fleet.worker_stats.num_watchdog_trips
+            if role == DECODE or len(self.aggregators) == 1:
+                m.kv_usage = fleet.kv_stats.gpu_cache_usage_perc
+                m.queue_depth = float(fleet.worker_stats.num_requests_waiting)
+                m.brownout_level = max(
+                    m.brownout_level, fleet.worker_stats.brownout_level
+                )
+                self._latency_signals(m, fleet.phase_histograms, now)
+            else:
+                # a dedicated prefill fleet owns the waiting queue
+                m.queue_depth = float(fleet.worker_stats.num_requests_waiting)
+        if scraped:
+            self._fresh_t = now
+            m.replicas_actual = replicas
+            m.watchdog_trips = watchdog
+        if self._fresh_t is None:
+            # never scraped successfully: there is no view of the fleet
+            # at all — unconditionally stale
+            m.stale = True
+        else:
+            # a single missed scrape is NOT an instant freeze: age grows
+            # and the planner's stale_after_s threshold decides
+            m.age_s = now - self._fresh_t
+        if self.fabric is not None:
+            with contextlib.suppress(Exception):
+                m.degraded = bool(self.fabric.status().get("degraded"))
+        if self.fences is not None:
+            with contextlib.suppress(Exception):
+                m.fenced_epochs = len(self.fences._fenced)
+        if self.brownout_level_fn is not None:
+            with contextlib.suppress(Exception):
+                m.brownout_level = max(
+                    m.brownout_level, int(self.brownout_level_fn())
+                )
+        return m
+
+
+class PlannerStatusPublisher:
+    """Publishes Planner.status() under PLANNER_STATUS_KEY after every
+    decision so the metrics component (and any frontend) can render the
+    dyn_planner_*/dyn_supervisor_* families without importing the
+    planner process. Fire-and-forget: a dark fabric must never block or
+    crash the planning loop (the planner is already frozen then)."""
+
+    def __init__(self, fabric, planner) -> None:
+        self.fabric = fabric
+        self.planner = planner
+        self._tasks: set[asyncio.Task] = set()
+
+    def __call__(self, decision) -> None:
+        import msgpack
+
+        payload = self.planner.status()
+        payload["last_direction"] = decision.direction
+        payload["last_reason"] = decision.reason
+
+        async def _put() -> None:
+            with contextlib.suppress(Exception):
+                await self.fabric.kv_put(
+                    PLANNER_STATUS_KEY,
+                    msgpack.packb(payload, use_bin_type=True),
+                )
+
+        with contextlib.suppress(RuntimeError):
+            task = asyncio.get_running_loop().create_task(_put())
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+
+
+class PlannerStatusCache:
+    """Frontend-side view of the planner's published status: a slow
+    background poll of PLANNER_STATUS_KEY exposing the latest dict for
+    `ServiceMetrics.attach_planner` (scrape-time reads)."""
+
+    def __init__(self, fabric, poll_s: float = 5.0) -> None:
+        self.fabric = fabric
+        self.poll_s = poll_s
+        self.status: dict = {}
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    async def _loop(self) -> None:
+        import msgpack
+
+        with contextlib.suppress(asyncio.CancelledError):
+            while True:
+                with contextlib.suppress(Exception):
+                    raw = await self.fabric.kv_get(PLANNER_STATUS_KEY)
+                    if raw:
+                        self.status = msgpack.unpackb(raw, raw=False)
+                await asyncio.sleep(self.poll_s)
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None

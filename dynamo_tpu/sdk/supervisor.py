@@ -12,16 +12,35 @@ after an exponential backoff, up to `max_restarts` within `restart_window_s`
 (the budget refills as crashes age out). Discovery-side cleanup is the
 fabric lease's job — a killed worker's instances vanish when its lease
 expires; the supervisor's job is only to put a fresh process back.
+
+ISSUE 11 — self-healing supervision:
+
+  * **quarantine, not give-up**: a child that exhausts its crash budget
+    enters QUARANTINE — slow-cadence retries with capped exponential
+    backoff — instead of being abandoned forever (which silently shrank
+    the fleet). Entering quarantine fires ``on_giveup`` so the planner
+    can substitute capacity NOW; a retry that stays healthy for a
+    probation window exits quarantine (``on_recover``), crash budget
+    refilled.
+  * **health probes**: an optional async ``health_probe`` is polled
+    while the child runs; ``health_fails`` consecutive failures treat
+    the child as wedged — it is killed (counted as a crash) and the
+    normal restart discipline applies. A process that is alive but not
+    serving is just a slower crash.
+  * **injected kills are free**: the FT-test ``kill()`` hook restarts
+    the child WITHOUT burning the crash budget — chaos suites must not
+    be able to push a healthy child into quarantine.
 """
 
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import os
 import signal
 import sys
 import time
-from typing import Callable, Optional
+from typing import Awaitable, Callable, Optional
 
 from dynamo_tpu.runtime.logging import get_logger
 
@@ -41,6 +60,14 @@ class ManagedProcess:
         backoff_s: float = 0.5,
         on_exit: Optional[Callable[[int], None]] = None,
         forward_output: bool = True,
+        health_probe: Optional[Callable[[], Awaitable[bool]]] = None,
+        health_interval_s: float = 5.0,
+        health_fails: int = 3,
+        quarantine_retry_s: float = 30.0,
+        quarantine_retry_max_s: float = 300.0,
+        quarantine_probation_s: Optional[float] = None,
+        on_giveup: Optional[Callable[[str], None]] = None,
+        on_recover: Optional[Callable[[str], None]] = None,
     ) -> None:
         self.args = args
         self.name = name
@@ -51,11 +78,29 @@ class ManagedProcess:
         self.backoff_s = backoff_s
         self.on_exit = on_exit
         self.forward_output = forward_output
+        self.health_probe = health_probe
+        self.health_interval_s = health_interval_s
+        self.health_fails = health_fails
+        self.quarantine_retry_s = quarantine_retry_s
+        self.quarantine_retry_max_s = quarantine_retry_max_s
+        # a quarantined child must stay up this long to be trusted again
+        self.quarantine_probation_s = (
+            quarantine_probation_s
+            if quarantine_probation_s is not None
+            else restart_window_s
+        )
+        self.on_giveup = on_giveup
+        self.on_recover = on_recover
         self.proc: Optional[asyncio.subprocess.Process] = None
         self.restarts = 0
+        self.quarantines = 0  # times the crash budget was exhausted
+        self.quarantined = False
+        self.health_kills = 0  # children killed by failed health probes
+        self._injected_kills = 0  # pending budget-exempt kills (kill())
         self._crash_times: list[float] = []
         self._stopping = False
         self._monitor_task: Optional[asyncio.Task] = None
+        self._health_task: Optional[asyncio.Task] = None
         self._started = asyncio.Event()
 
     # ------------------------------------------------------------ control
@@ -68,11 +113,20 @@ class ManagedProcess:
     def running(self) -> bool:
         return self.proc is not None and self.proc.returncode is None
 
+    @property
+    def state(self) -> str:
+        if self._stopping:
+            return "stopped"
+        if self.quarantined:
+            return "quarantined"
+        return "running" if self.running else "backoff"
+
     async def start(self) -> None:
         await self._spawn()
-        self._monitor_task = asyncio.get_running_loop().create_task(
-            self._monitor()
-        )
+        loop = asyncio.get_running_loop()
+        self._monitor_task = loop.create_task(self._monitor())
+        if self.health_probe is not None:
+            self._health_task = loop.create_task(self._health_loop())
 
     async def _spawn(self) -> None:
         out = None if self.forward_output else asyncio.subprocess.DEVNULL
@@ -85,6 +139,7 @@ class ManagedProcess:
     async def _monitor(self) -> None:
         while True:
             assert self.proc is not None
+            spawned_at = time.monotonic()
             rc = await self.proc.wait()
             if self.on_exit is not None:
                 try:
@@ -97,32 +152,144 @@ class ManagedProcess:
                 logger.info("[%s] exited rc=%d (no restart)", self.name, rc)
                 return
             now = time.monotonic()
+            if self._injected_kills > 0:
+                # fault-injection kill(): restart promptly, crash budget
+                # untouched — chaos suites must not quarantine healthy
+                # children
+                self._injected_kills -= 1
+                logger.info(
+                    "[%s] injected kill — restarting (budget exempt)",
+                    self.name,
+                )
+                await asyncio.sleep(self.backoff_s)
+                if self._stopping:
+                    return
+                self.restarts += 1
+                await self._spawn()
+                continue
+            if self.quarantined and now - spawned_at >= (
+                self.quarantine_probation_s
+            ):
+                # the child survived probation before this (new) crash:
+                # it had earned its way out — treat this as a fresh crash
+                self._exit_quarantine()
             self._crash_times = [
                 t for t in self._crash_times
                 if now - t < self.restart_window_s
             ]
             self._crash_times.append(now)
-            if len(self._crash_times) > self.max_restarts:
+            if (
+                not self.quarantined
+                and len(self._crash_times) > self.max_restarts
+            ):
+                # crash loop: budget exhausted. NOT the old permanent
+                # give-up — quarantine keeps slow-cadence retries going
+                # while on_giveup lets the planner substitute capacity.
+                self.quarantined = True
+                self.quarantines += 1
                 logger.error(
-                    "[%s] crashed %d times in %.0fs — giving up",
-                    self.name, len(self._crash_times), self.restart_window_s,
+                    "[%s] crashed %d times in %.0fs — QUARANTINED "
+                    "(slow retries every %.0f-%.0fs; planner notified)",
+                    self.name, len(self._crash_times),
+                    self.restart_window_s, self.quarantine_retry_s,
+                    self.quarantine_retry_max_s,
                 )
-                return
-            delay = self.backoff_s * (2 ** (len(self._crash_times) - 1))
+                if self.on_giveup is not None:
+                    try:
+                        self.on_giveup(self.name)
+                    except Exception:  # noqa: BLE001 — advisory
+                        logger.exception(
+                            "[%s] on_giveup callback failed", self.name
+                        )
+            if self.quarantined:
+                # capped exponential slow cadence, counted from the
+                # retries SINCE quarantine entry
+                n = max(0, len(self._crash_times) - self.max_restarts - 1)
+                delay = min(
+                    self.quarantine_retry_s * (2 ** n),
+                    self.quarantine_retry_max_s,
+                )
+            else:
+                delay = self.backoff_s * (2 ** (len(self._crash_times) - 1))
             logger.warning(
-                "[%s] exited rc=%d — restarting in %.1fs (%d/%d)",
+                "[%s] exited rc=%d — restarting in %.1fs (%d/%d%s)",
                 self.name, rc, delay, len(self._crash_times),
                 self.max_restarts,
+                ", quarantined" if self.quarantined else "",
             )
             await asyncio.sleep(delay)
             if self._stopping:
                 return
             self.restarts += 1
             await self._spawn()
+            if self.quarantined:
+                # probation: if the child is still up after the window,
+                # trust it again (the monitor may be stuck in wait() —
+                # run the check on the side)
+                asyncio.get_running_loop().create_task(
+                    self._probation_check()
+                )
+
+    async def _probation_check(self) -> None:
+        proc = self.proc
+        with contextlib.suppress(asyncio.CancelledError):
+            await asyncio.sleep(self.quarantine_probation_s)
+            if (
+                self.quarantined
+                and not self._stopping
+                and self.proc is proc
+                and self.running
+            ):
+                self._exit_quarantine()
+
+    def _exit_quarantine(self) -> None:
+        self.quarantined = False
+        self._crash_times.clear()
+        logger.info(
+            "[%s] healthy through probation — quarantine lifted", self.name
+        )
+        if self.on_recover is not None:
+            try:
+                self.on_recover(self.name)
+            except Exception:  # noqa: BLE001 — advisory
+                logger.exception("[%s] on_recover callback failed", self.name)
+
+    async def _health_loop(self) -> None:
+        """Poll health_probe; `health_fails` consecutive failures kill the
+        child (a real crash — the budget applies: a child that is alive
+        but wedged forever must eventually quarantine too)."""
+        fails = 0
+        with contextlib.suppress(asyncio.CancelledError):
+            while not self._stopping:
+                await asyncio.sleep(self.health_interval_s)
+                if not self.running:
+                    fails = 0  # monitor owns dead children
+                    continue
+                try:
+                    healthy = bool(await self.health_probe())
+                except Exception:  # noqa: BLE001 — probe error = unhealthy
+                    healthy = False
+                fails = 0 if healthy else fails + 1
+                if fails >= self.health_fails:
+                    fails = 0
+                    self.health_kills += 1
+                    logger.error(
+                        "[%s] failed %d health probes — killing wedged "
+                        "child pid %s", self.name, self.health_fails,
+                        self.pid,
+                    )
+                    if self.proc is not None and self.proc.returncode is None:
+                        with contextlib.suppress(ProcessLookupError):
+                            self.proc.kill()
 
     async def stop(self, timeout: float = 5.0) -> None:
-        """Graceful stop: SIGTERM, wait, SIGKILL."""
+        """Graceful stop: SIGTERM, wait, SIGKILL. The SIGTERM leg is the
+        KV-preserving drain path — the child's runner finishes in-flight
+        work and (when configured) checkpoints its warm KV tiers before
+        exiting, so planner scale-downs never SIGKILL hot KV."""
         self._stopping = True
+        if self._health_task is not None:
+            self._health_task.cancel()
         if self.proc is not None and self.proc.returncode is None:
             try:
                 self.proc.terminate()
@@ -147,12 +314,15 @@ class ManagedProcess:
 
     def kill(self) -> None:
         """SIGKILL without marking stopped — the monitor restarts it.
-        This is the fault-injection hook the FT tests use."""
+        This is the fault-injection hook the FT tests use; injected
+        kills are exempt from the crash-restart budget so a chaos suite
+        cannot push a healthy child into quarantine."""
         if self.proc is not None and self.proc.returncode is None:
+            self._injected_kills += 1
             try:
                 os.kill(self.proc.pid, signal.SIGKILL)
             except ProcessLookupError:
-                pass
+                self._injected_kills -= 1
 
     async def wait_restarted(
         self, prev_restarts: int, timeout: float = 30.0
@@ -225,6 +395,22 @@ class Supervisor:
         await asyncio.gather(
             *(p.stop(timeout) for p in last), return_exceptions=True
         )
+
+    def stats(self) -> dict:
+        """Fleet supervision counters for the metrics plane
+        (`dyn_supervisor_restarts_total` / `dyn_supervisor_quarantined`)."""
+        return {
+            "restarts_total": sum(p.restarts for p in self.procs.values()),
+            "quarantined": sum(
+                1 for p in self.procs.values() if p.quarantined
+            ),
+            "quarantines_total": sum(
+                p.quarantines for p in self.procs.values()
+            ),
+            "health_kills_total": sum(
+                p.health_kills for p in self.procs.values()
+            ),
+        }
 
     def __getitem__(self, name: str) -> ManagedProcess:
         return self.procs[name]

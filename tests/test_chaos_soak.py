@@ -493,3 +493,168 @@ async def test_chaos_zombie_partition_wave_fenced_and_migrated():
         await zombie.close()
         await replacement.close()
         await drt.close()
+
+
+async def test_chaos_planner_wave_freezes_heals_never_fights_brownout():
+    """ISSUE 11 chaos wave: the closed-loop planner driven through a
+    demand trace with worker kills and a control-plane blackout mid-way,
+    arbitrating against a live brownout ladder. Invariants:
+
+      * ZERO actuations (and zero non-frozen decisions) while the
+        blackout has the fabric degraded — the planner fails static;
+      * the fleet heals back to intent within 2 planner intervals of
+        both the kill wave and the blackout healing;
+      * ZERO scale-down decisions while the brownout ladder is engaged
+        (level > ok) — the planner and the degrade actuator never fight;
+      * no oscillation: consecutive opposite-direction actuations never
+        occur within one cooldown window.
+    """
+    from dynamo_tpu.planner import Planner, VirtualConnector
+    from dynamo_tpu.planner.planner_core import (
+        DECODE,
+        PREFILL,
+        ObservedMetrics,
+        PlannerConfig,
+    )
+    from dynamo_tpu.telemetry.brownout import (
+        BrownoutConfig,
+        BrownoutController,
+    )
+
+    class Clock:
+        t = 5000.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    interval_s = 10.0
+
+    class SimConnector(VirtualConnector):
+        """Virtual fleet where workers can be killed; re-asserting
+        intent (the planner heal) respawns them."""
+
+        def __init__(self):
+            super().__init__()
+            self.lost = 0
+            self.actuations: list[tuple[float, str, int]] = []
+
+        async def set_replicas(self, component, n):
+            await super().set_replicas(component, n)
+            self.actuations.append((clock.t, component, n))
+            if component == DECODE:
+                self.lost = 0  # substitutes spawned
+
+        def healthy(self):
+            return max(0, self.targets.get(DECODE, 0) - self.lost)
+
+    conn = SimConnector()
+    conn.targets[PREFILL] = 1
+    conn.targets[DECODE] = 2
+
+    # worker capacity model: ~2 req/s per decode replica before queueing
+    cap_per_replica = 2.0
+    state = {"demand": 2.0, "dark": False}
+
+    async def sample():
+        healthy = conn.healthy()
+        util = state["demand"] / max(0.5, healthy * cap_per_replica)
+        ttft = 100.0 * (1.0 + max(0.0, util - 0.8) * 8.0)
+        return ObservedMetrics(
+            req_per_s=state["demand"],
+            kv_usage=min(1.0, 0.7 * util),
+            queue_depth=max(0.0, (util - 1.0) * 20.0),
+            ttft_ms=ttft,
+            degraded=state["dark"],
+            replicas_actual={DECODE: healthy},
+        )
+
+    brown = BrownoutController(
+        BrownoutConfig(step_up_s=interval_s, step_down_s=2 * interval_s),
+        now_fn=clock,
+    )
+    planner = Planner(
+        PlannerConfig(
+            mode="load",
+            interval_s=interval_s,
+            min_decode=1, max_decode=12, min_prefill=1, max_prefill=4,
+            hysteresis=0.1,
+            cooldown_up_s=interval_s,        # one up per interval max
+            cooldown_down_s=3 * interval_s,
+            max_step_up=2, max_step_down=1,
+            debounce_intervals=2,
+            stale_after_s=3 * interval_s,
+        ),
+        sample,
+        conn,
+        now_fn=clock,
+    )
+
+    slo_ttft = 300.0
+    down_while_brownout = 0
+    frozen_window_actuations = None
+    decisions = []
+    for step in range(60):
+        clock.t += interval_s
+        # --- trace: calm -> flash crowd -> calm
+        if step < 10:
+            state["demand"] = 2.0
+        elif step < 30:
+            state["demand"] = 14.0  # flash crowd: 7x
+        else:
+            state["demand"] = 2.0
+        # --- chaos: kill 1 decode worker at step 12
+        if step == 12:
+            conn.lost = 1
+        # --- chaos: control-plane blackout across steps 20-24 (mid-crowd),
+        # killing another worker while the planner is blind
+        if step == 20:
+            state["dark"] = True
+            frozen_window_actuations = len(conn.actuations)
+        if step == 22:
+            conn.lost += 1
+        if step == 25:
+            state["dark"] = False
+        # brownout ladder runs on the same observed reality
+        m = await sample()
+        sev = (
+            "breached" if (m.ttft_ms or 0) > 2 * slo_ttft
+            else "burning" if (m.ttft_ms or 0) > slo_ttft else "ok"
+        )
+        brown.observe(sev)
+        planner.note_brownout(brown.level)
+        d = await planner.step()
+        decisions.append((step, d, brown.level))
+        if d.direction == "down" and brown.level > 0:
+            down_while_brownout += 1
+        # invariant: while dark, zero actuations and only frozen decisions
+        if state["dark"]:
+            assert d.direction == "frozen", (step, d)
+            assert len(conn.actuations) == frozen_window_actuations
+        # invariant: the step-12 kill wave heals within 2 intervals
+        if step == 14:
+            assert conn.healthy() == conn.targets[DECODE], (
+                "kill wave not healed within 2 intervals"
+            )
+    # blackout window produced only frozen decisions
+    dark_steps = [d for s, d, _ in decisions if 20 <= s < 25]
+    assert dark_steps and all(d.direction == "frozen" for d in dark_steps)
+    # post-blackout: fleet healed back to intent within 2 intervals
+    post = [d for s, d, _ in decisions if 25 <= s <= 26]
+    assert any(d.direction in ("heal", "up") for d in post), post
+    assert conn.healthy() == conn.targets[DECODE]
+    # the planner scaled out for the flash crowd...
+    assert max(d.decode for _, d, _ in decisions) > 2
+    # ...and never fought the brownout ladder
+    assert down_while_brownout == 0
+    # no oscillation: after any down, no up within the same interval and
+    # vice versa (damping means direction changes are >= 1 interval apart)
+    dirs = [
+        (s, d.direction) for s, d, _ in decisions
+        if d.direction in ("up", "down")
+    ]
+    for (s1, a), (s2, b) in zip(dirs, dirs[1:]):
+        if a != b:
+            assert s2 - s1 >= 2, (s1, a, s2, b)
+    # quiet end of trace: fleet scaled back down (cost actually saved)
+    assert decisions[-1][1].decode < max(d.decode for _, d, _ in decisions)

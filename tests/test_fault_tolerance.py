@@ -244,28 +244,80 @@ async def test_fabric_kill_restart_recovery():
         await sup.stop_all()
 
 
-async def test_supervisor_restart_backoff_and_give_up():
-    """A service that always crashes restarts with backoff then gives up
-    within its restart budget (no restart storm)."""
+async def test_supervisor_crash_loop_quarantines_instead_of_giving_up():
+    """A service that always crashes restarts with backoff, then enters
+    QUARANTINE (slow-cadence retries, on_giveup fired so the planner can
+    substitute capacity) instead of the old permanent give-up that
+    silently shrank the fleet forever (ISSUE 11)."""
     from dynamo_tpu.sdk.supervisor import ManagedProcess
 
     import sys
 
+    gaveup: list[str] = []
     proc = ManagedProcess(
         [sys.executable, "-c", "import sys; sys.exit(3)"],
         name="crasher",
         max_restarts=2,
         backoff_s=0.05,
         restart_window_s=60,
+        quarantine_retry_s=0.2,
+        quarantine_retry_max_s=0.5,
+        on_giveup=gaveup.append,
     )
     await proc.start()
     for _ in range(600):  # generous: process spawns crawl on a loaded box
-        if proc._monitor_task.done():
+        if proc.quarantined:
             break
         await asyncio.sleep(0.1)
-    assert proc._monitor_task.done(), "monitor should give up"
-    assert proc.restarts == 2
+    assert proc.quarantined, "crash loop should quarantine"
+    assert proc.state == "quarantined"
+    assert gaveup == ["crasher"], "planner hook must fire exactly once"
+    assert not proc._monitor_task.done(), (
+        "monitor keeps slow retries going — quarantine is not give-up"
+    )
+    # slow-cadence retries continue while quarantined
+    before = proc.restarts
+    for _ in range(600):
+        if proc.restarts > before:
+            break
+        await asyncio.sleep(0.05)
+    assert proc.restarts > before, "quarantine must keep retrying"
+    assert proc.quarantines == 1
     await proc.stop()
+
+
+async def test_supervisor_injected_kills_exempt_from_crash_budget():
+    """The FT-test kill() hook must not burn the crash-restart budget:
+    a chaos suite SIGKILLing a healthy child repeatedly cannot push it
+    into quarantine (ISSUE 11 satellite)."""
+    from dynamo_tpu.sdk.supervisor import ManagedProcess
+
+    import sys
+
+    proc = ManagedProcess(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        name="victim",
+        max_restarts=2,
+        backoff_s=0.05,
+        restart_window_s=60,
+        forward_output=False,
+    )
+    await proc.start()
+    try:
+        # more injected kills than the whole crash budget
+        for round_ in range(4):
+            prev = proc.restarts
+            for _ in range(600):
+                if proc.running:
+                    break
+                await asyncio.sleep(0.05)
+            proc.kill()
+            await proc.wait_restarted(prev, timeout=30.0)
+        assert not proc.quarantined, "injected kills must not quarantine"
+        assert proc.restarts == 4
+        assert proc._crash_times == [], "budget must be untouched"
+    finally:
+        await proc.stop()
 
 
 async def test_midstream_kill_under_dyn_fault_migrates_stream():

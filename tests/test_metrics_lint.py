@@ -60,6 +60,15 @@ INTENTIONALLY_SHARED = {
     "dyn_fabric_blackouts",
     "dyn_llm_degraded_mode",
     "dyn_llm_degraded_seconds",
+    # closed-loop fleet plane (ISSUE 11): the planner publishes one
+    # status; the metrics component (fabric scrape) and any frontend
+    # (PlannerStatusCache attach) render the SAME families from it
+    "dyn_planner_decisions",
+    "dyn_planner_frozen",
+    "dyn_planner_replicas_target",
+    "dyn_planner_replicas_actual",
+    "dyn_supervisor_restarts",
+    "dyn_supervisor_quarantined",
 }
 
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
@@ -102,6 +111,12 @@ def _all_registries() -> dict[str, CollectorRegistry]:
          "degraded_seconds_total": 0.0, "blackouts_total": 0,
          "buffered_publishes": 0, "flushed_publishes": 0,
          "dropped_publishes": 0}
+    )
+    frontend.attach_planner(
+        {"decisions_total": {"up|sla": 1}, "frozen": 0,
+         "replicas_target": {"decode_worker": 1},
+         "replicas_actual": {"decode_worker": 1},
+         "supervisor": {"restarts_total": 0, "quarantined": 0}}
     )
     component = MetricsComponent(
         _StubComponent(), EndpointId("lint", "backend", "generate")
@@ -245,6 +260,28 @@ def test_control_plane_families_present_with_correct_types():
     ):
         fam = by_role["frontend"].get(name)
         assert fam is not None and fam.type == "counter", name
+
+
+def test_planner_families_present_with_correct_types():
+    """ISSUE 11: the closed-loop fleet families must exist with the
+    right semantics on both the frontend (PlannerStatusCache attach) and
+    the metrics component (fabric scrape of the planner's status key)."""
+    regs = _all_registries()
+    by_role = {
+        role: {f.name: f for f in _families(reg)}
+        for role, reg in regs.items()
+    }
+    for role in ("frontend", "component"):
+        for name, typ in (
+            ("dyn_planner_decisions", "counter"),
+            ("dyn_planner_frozen", "gauge"),
+            ("dyn_planner_replicas_target", "gauge"),
+            ("dyn_planner_replicas_actual", "gauge"),
+            ("dyn_supervisor_restarts", "counter"),
+            ("dyn_supervisor_quarantined", "gauge"),
+        ):
+            fam = by_role[role].get(name)
+            assert fam is not None and fam.type == typ, (role, name)
 
 
 def test_every_family_has_help_text():

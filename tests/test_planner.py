@@ -373,3 +373,612 @@ def test_planner_load_mode_drives_kubernetes_connector():
         await fake.stop()
 
     run(go())
+
+
+# ================================================== safe actuation (ISSUE 11)
+#
+# The closed-loop resilience primitives: per-direction hysteresis bands,
+# cooldowns, bounded steps, decision debounce, fail-static freezes,
+# planner/brownout arbitration, and self-healing (quarantine give-ups,
+# watchdog trips, observed-vs-intent reconciliation).
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.t = 1000.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def make_safe_planner(metrics_seq, clock=None, start=None, **cfg_kw):
+    """Load-mode planner over a VirtualConnector with a controllable
+    clock; `start` pre-seeds replica targets (the 'running fleet')."""
+    from dynamo_tpu.planner.planner_core import PlannerConfig
+
+    it = iter(metrics_seq)
+    last = metrics_seq[-1]
+
+    async def sample():
+        try:
+            return next(it)
+        except StopIteration:
+            return last
+
+    conn = VirtualConnector()
+    if start:
+        for role, n in start.items():
+            conn.targets[role] = n
+    clock = clock or FakeClock()
+    planner = Planner(
+        PlannerConfig(mode="load", **cfg_kw), sample, conn, now_fn=clock
+    )
+    return planner, conn, clock
+
+
+def test_hysteresis_band_blocks_small_moves():
+    # fleet of 8; load mode wants 7 (queue_low drop of 1): band of
+    # ceil(8 * 0.2) = 2 swallows the single-replica wiggle
+    m = ObservedMetrics(kv_usage=0.5, queue_depth=0.0)
+    planner, conn, _ = make_safe_planner(
+        [m], start={PREFILL: 8, DECODE: 8},
+        max_prefill=16, max_decode=16, hysteresis=0.2,
+    )
+    d = run(planner.step())
+    assert d.direction == "hold"
+    assert conn.replicas(PREFILL) == 8
+
+
+def test_cooldown_per_direction():
+    async def go():
+        up = ObservedMetrics(kv_usage=0.95, queue_depth=10)
+        planner, conn, clock = make_safe_planner(
+            [up], start={PREFILL: 1, DECODE: 1},
+            max_prefill=8, max_decode=8, cooldown_up_s=60.0,
+            max_step_up=1,
+        )
+        d1 = await planner.step()
+        assert d1.direction == "up" and conn.replicas(DECODE) == 2
+        clock.advance(10)  # inside the up cooldown
+        d2 = await planner.step()
+        assert d2.direction == "hold" and conn.replicas(DECODE) == 2
+        clock.advance(60)  # past it
+        d3 = await planner.step()
+        assert d3.direction == "up" and conn.replicas(DECODE) == 3
+
+    run(go())
+
+
+def test_scale_down_cooldown_independent_of_up():
+    async def go():
+        planner, conn, clock = make_safe_planner(
+            [ObservedMetrics(kv_usage=0.95, queue_depth=10),
+             ObservedMetrics(kv_usage=0.1, queue_depth=0)],
+            start={PREFILL: 2, DECODE: 2},
+            max_prefill=8, max_decode=8,
+            cooldown_up_s=60.0, cooldown_down_s=300.0,
+        )
+        d1 = await planner.step()
+        assert d1.direction == "up"
+        clock.advance(5)
+        # first DOWN is allowed right after an UP (cooldowns are tracked
+        # per direction); the SECOND down is inside the down cooldown
+        d2 = await planner.step()
+        assert d2.direction == "down"
+        clock.advance(5)
+        d3 = await planner.step()
+        assert d3.direction == "hold"
+
+    run(go())
+
+
+def test_bounded_step_size():
+    # SLA mode wants a huge jump; max_step_up caps replicas added per
+    # decision (a misread spike cannot triple the fleet in one interval)
+    huge = ObservedMetrics(req_per_s=10000, avg_isl=2048, avg_osl=512)
+    pre, dec = _interps()
+    conn = VirtualConnector()
+    conn.targets[PREFILL] = 1
+    conn.targets[DECODE] = 1
+    planner = Planner(
+        PlannerConfig(
+            mode="sla", max_prefill=16, max_decode=16, max_step_up=2
+        ),
+        (lambda: _async_const(huge))(),
+        conn, prefill_interp=pre, decode_interp=dec,
+    )
+    d = run(planner.step())
+    assert d.prefill == 3 and d.decode == 3  # 1 + max_step_up
+
+
+def _async_const(m):
+    async def sample():
+        return m
+
+    return sample
+
+
+def test_debounce_requires_k_agreeing_intervals():
+    async def go():
+        up = ObservedMetrics(kv_usage=0.95, queue_depth=10)
+        planner, conn, _ = make_safe_planner(
+            [up], start={PREFILL: 1, DECODE: 1},
+            max_prefill=8, max_decode=8, debounce_intervals=3,
+            max_step_up=1,
+        )
+        assert (await planner.step()).direction == "hold"  # streak 1
+        assert (await planner.step()).direction == "hold"  # streak 2
+        assert (await planner.step()).direction == "up"    # streak 3 acts
+        assert conn.replicas(DECODE) == 2
+
+    run(go())
+
+
+def test_flap_damping_resets_debounce_streak():
+    async def go():
+        seq = [
+            ObservedMetrics(kv_usage=0.95, queue_depth=10),  # up vote
+            ObservedMetrics(kv_usage=0.5, queue_depth=1),    # steady
+            ObservedMetrics(kv_usage=0.95, queue_depth=10),  # up vote again
+            ObservedMetrics(kv_usage=0.95, queue_depth=10),
+        ]
+        planner, conn, _ = make_safe_planner(
+            seq, start={PREFILL: 1, DECODE: 1},
+            max_prefill=8, max_decode=8, debounce_intervals=2,
+            max_step_up=1,
+        )
+        assert (await planner.step()).direction == "hold"  # streak 1
+        assert (await planner.step()).direction == "hold"  # reset
+        assert (await planner.step()).direction == "hold"  # streak 1 again
+        assert (await planner.step()).direction == "up"    # streak 2
+        # a flapping signal produced exactly ONE actuation in 4 intervals
+        assert conn.replicas(DECODE) == 2
+
+    run(go())
+
+
+# ------------------------------------------------------------- fail static
+
+
+def test_fail_static_on_stale_sample():
+    async def go():
+        stale = ObservedMetrics(kv_usage=0.95, queue_depth=10, stale=True)
+        planner, conn, _ = make_safe_planner(
+            [stale], start={PREFILL: 2, DECODE: 2},
+            max_prefill=8, max_decode=8,
+        )
+        d = await planner.step()
+        assert d.direction == "frozen"
+        assert "stale_signals" in d.reason
+        assert planner.frozen
+        assert planner.metrics.frozen == 1
+        assert conn.history == []  # ZERO actuations while frozen
+        # decision counter carries the freeze reason
+        assert planner.metrics.decisions_total.get(
+            "frozen|stale_signals"
+        ) == 1
+
+    run(go())
+
+
+def test_fail_static_on_signal_age():
+    async def go():
+        old = ObservedMetrics(kv_usage=0.95, queue_depth=10, age_s=45.0)
+        planner, conn, _ = make_safe_planner(
+            [old], start={DECODE: 2}, max_decode=8, stale_after_s=30.0,
+        )
+        d = await planner.step()
+        assert d.direction == "frozen" and "stale_signals" in d.reason
+        # a fresh sample unfreezes on the next interval
+        planner.sample = _async_const(
+            ObservedMetrics(kv_usage=0.95, queue_depth=10)
+        )
+        d2 = await planner.step()
+        assert d2.direction == "up"
+        assert planner.metrics.frozen == 0
+
+    run(go())
+
+
+def test_fail_static_on_degraded_fabric():
+    async def go():
+        dark = ObservedMetrics(kv_usage=0.95, queue_depth=10, degraded=True)
+        planner, conn, _ = make_safe_planner(
+            [dark], start={DECODE: 2}, max_decode=8,
+        )
+        d = await planner.step()
+        assert d.direction == "frozen" and "fabric_degraded" in d.reason
+        assert conn.history == []
+
+    run(go())
+
+
+def test_fail_static_on_intent_mismatch_overshoot():
+    async def go():
+        # another actor scaled ABOVE our intent: freeze, don't fight it
+        weird = ObservedMetrics(
+            kv_usage=0.5, queue_depth=1,
+            replicas_actual={DECODE: 6, PREFILL: 2},
+        )
+        planner, conn, _ = make_safe_planner(
+            [weird], start={PREFILL: 2, DECODE: 2},
+            max_decode=8, mismatch_intervals=2,
+        )
+        d1 = await planner.step()  # grace interval 1
+        assert d1.direction != "frozen"
+        d2 = await planner.step()
+        assert d2.direction == "frozen" and "intent_mismatch" in d2.reason
+
+    run(go())
+
+
+# --------------------------------------------------- brownout arbitration
+
+
+def test_brownout_inhibits_scale_down_and_pressures_up():
+    async def go():
+        # demand says scale DOWN; brownout says the fleet is hurting
+        idle = ObservedMetrics(kv_usage=0.05, queue_depth=0)
+        planner, conn, clock = make_safe_planner(
+            [idle], start={PREFILL: 4, DECODE: 4},
+            max_prefill=8, max_decode=8,
+        )
+        planner.note_brownout(2)
+        d = await planner.step()
+        # no scale-down while the ladder is engaged — instead the level
+        # converts to one-replica scale-up pressure
+        assert d.direction == "up"
+        assert conn.replicas(DECODE) == 5
+        assert "brownout" in d.reason
+        assert planner.metrics.decisions_total.get(
+            "up|brownout_pressure"
+        ) == 1
+        # ladder disengages -> scale-down becomes possible again
+        planner.note_brownout(0)
+        clock.advance(1000)
+        d2 = await planner.step()
+        assert d2.direction == "down"
+
+    run(go())
+
+
+def test_brownout_level_from_sample_counts_too():
+    async def go():
+        m = ObservedMetrics(kv_usage=0.05, queue_depth=0, brownout_level=1)
+        planner, conn, _ = make_safe_planner(
+            [m], start={DECODE: 4}, max_decode=8,
+        )
+        d = await planner.step()
+        assert d.direction == "up"  # worker-reported rung, same contract
+
+    run(go())
+
+
+# ------------------------------------------------------------ self-healing
+
+
+def test_heal_on_observed_replica_loss():
+    async def go():
+        hurt = ObservedMetrics(
+            kv_usage=0.5, queue_depth=1,
+            replicas_actual={DECODE: 1, PREFILL: 2},
+        )
+        planner, conn, _ = make_safe_planner(
+            [hurt], start={PREFILL: 2, DECODE: 3}, max_decode=8,
+        )
+        d = await planner.step()
+        assert d.direction == "heal"
+        assert "decode_worker" in d.reason
+        # intent re-asserted through the connector (spawns substitutes)
+        assert (DECODE, 3) in conn.history
+        assert planner.metrics.heals_total == 1
+
+    run(go())
+
+
+def test_heal_on_capacity_loss_note():
+    async def go():
+        ok = ObservedMetrics(kv_usage=0.5, queue_depth=1)
+        planner, conn, _ = make_safe_planner(
+            [ok], start={DECODE: 2}, max_decode=8,
+        )
+        planner.note_capacity_loss(DECODE)  # supervisor on_giveup hook
+        d = await planner.step()
+        assert d.direction == "heal"
+        assert (DECODE, 2) in conn.history
+
+    run(go())
+
+
+def test_heal_on_watchdog_trip_delta():
+    async def go():
+        seq = [
+            ObservedMetrics(kv_usage=0.5, queue_depth=1, watchdog_trips=0,
+                            replicas_actual={DECODE: 2}),
+            ObservedMetrics(kv_usage=0.5, queue_depth=1, watchdog_trips=1,
+                            replicas_actual={DECODE: 2}),
+        ]
+        planner, conn, _ = make_safe_planner(
+            [seq[0], seq[1], seq[1]], start={PREFILL: 1, DECODE: 2},
+            max_decode=8,
+        )
+        d1 = await planner.step()
+        assert d1.direction == "hold"
+        d2 = await planner.step()  # trip count rose -> re-assert intent
+        assert d2.direction == "heal"
+        d3 = await planner.step()  # same cumulative count -> no re-heal
+        assert d3.direction != "heal"
+
+    run(go())
+
+
+# --------------------------------------- supervision: quarantine + drains
+
+
+def test_quarantine_enter_retry_exit(tmp_path):
+    """A crash-looping child quarantines (on_giveup -> planner hook),
+    keeps slow-cadence retries, and EXITS quarantine once a retry
+    survives probation (crash budget refilled, on_recover fired)."""
+    import sys
+
+    from dynamo_tpu.sdk.supervisor import ManagedProcess
+
+    flag = tmp_path / "healthy"
+    # crashes until the flag file exists, then stays up
+    script = (
+        "import os, sys, time\n"
+        f"p = {str(flag)!r}\n"
+        "sys.exit(3) if not os.path.exists(p) else time.sleep(60)\n"
+    )
+
+    async def go():
+        events: list[tuple[str, str]] = []
+        proc = ManagedProcess(
+            [sys.executable, "-c", script],
+            name="flaky",
+            max_restarts=1,
+            backoff_s=0.02,
+            restart_window_s=60,
+            quarantine_retry_s=0.1,
+            quarantine_retry_max_s=0.3,
+            quarantine_probation_s=0.5,
+            on_giveup=lambda n: events.append(("giveup", n)),
+            on_recover=lambda n: events.append(("recover", n)),
+            forward_output=False,
+        )
+        await proc.start()
+        for _ in range(600):
+            if proc.quarantined:
+                break
+            await asyncio.sleep(0.05)
+        assert proc.quarantined and ("giveup", "flaky") in events
+        retries_at_q = proc.restarts
+        flag.write_text("ok")  # the next retry will be healthy
+        for _ in range(600):
+            if not proc.quarantined and proc.running:
+                break
+            await asyncio.sleep(0.05)
+        assert not proc.quarantined, "probation survivor must be trusted"
+        assert ("recover", "flaky") in events
+        assert proc.restarts > retries_at_q  # quarantine kept retrying
+        assert proc._crash_times == []  # budget refilled
+        await proc.stop()
+
+    run(go())
+
+
+def test_supervisor_connector_drain_based_scale_down(tmp_path):
+    """Scale-down victims get SIGTERM (the drain path that fires the
+    warm-KV checkpoint in a real worker), never a cold SIGKILL; the
+    newest replica is chosen; quarantined children don't count as
+    replicas so a heal spawns substitutes."""
+    import sys
+
+    from dynamo_tpu.planner import SupervisorConnector
+
+    drain_dir = tmp_path / "drains"
+    drain_dir.mkdir()
+    # child writes <idx>.drained on SIGTERM then exits 0 — the stand-in
+    # for runner drain -> TieredBlockManager.checkpoint
+    script = (
+        "import os, signal, sys, time\n"
+        f"d = {str(drain_dir)!r}\n"
+        "idx = os.environ['DYN_REPLICA_INDEX']\n"
+        "def term(sig, frm):\n"
+        "    open(os.path.join(d, idx + '.drained'), 'w').write('ok')\n"
+        "    sys.exit(0)\n"
+        "signal.signal(signal.SIGTERM, term)\n"
+        "open(os.path.join(d, idx + '.ready'), 'w').write('ok')\n"
+        "time.sleep(120)\n"
+    )
+
+    async def go():
+        conn = SupervisorConnector(
+            {"decode_worker": [sys.executable, "-c", script]},
+            grace_s=10.0,
+            proc_kwargs={"forward_output": False, "backoff_s": 0.05},
+        )
+        await conn.set_replicas("decode_worker", 3)
+        assert conn.replicas("decode_worker") == 3
+        for _ in range(600):  # children must install handlers first
+            if all(
+                (drain_dir / f"{i}.ready").exists() for i in (1, 2, 3)
+            ):
+                break
+            await asyncio.sleep(0.05)
+        await conn.set_replicas("decode_worker", 2)
+        assert conn.replicas("decode_worker") == 2
+        # newest replica (index 3) drained gracefully, not SIGKILLed
+        assert (drain_dir / "3.drained").exists()
+        assert not (drain_dir / "1.drained").exists()
+        await conn.close()
+        # close drained the remaining two the same way
+        assert (drain_dir / "1.drained").exists()
+        assert (drain_dir / "2.drained").exists()
+        assert conn.replicas("decode_worker") == 0
+
+    run(go())
+
+
+def test_supervisor_connector_quarantine_feeds_planner_heal(tmp_path):
+    """End-to-end self-healing: a crash-looping replica quarantines, the
+    connector's on_giveup notes capacity loss on the planner, and the
+    next planner interval heals by re-asserting intent — which spawns a
+    SUBSTITUTE because quarantined children don't count."""
+    import sys
+
+    from dynamo_tpu.planner import SupervisorConnector
+    from dynamo_tpu.planner.planner_core import PlannerConfig
+
+    async def go():
+        crasher = [sys.executable, "-c", "import sys; sys.exit(3)"]
+        healthy = [sys.executable, "-c", "import time; time.sleep(120)"]
+        conn = SupervisorConnector(
+            {DECODE: healthy, PREFILL: healthy},
+            proc_kwargs={
+                "forward_output": False,
+                "max_restarts": 1,
+                "backoff_s": 0.02,
+                "restart_window_s": 60,
+                "quarantine_retry_s": 5.0,  # slow: stays quarantined
+                "quarantine_retry_max_s": 5.0,
+            },
+        )
+        planner = Planner(
+            PlannerConfig(mode="load"),
+            _async_const(ObservedMetrics(kv_usage=0.5, queue_depth=1)),
+            conn,
+        )
+        conn.on_giveup = lambda role, name: planner.note_capacity_loss(role)
+        await conn.set_replicas(DECODE, 2)
+        await conn.set_replicas(PREFILL, 1)
+        # one decode replica turns into a crash looper
+        victim = conn._procs[DECODE][0]
+        victim.args = crasher
+        victim.kill()  # injected kill restarts it... as a crasher
+        for _ in range(600):
+            if victim.quarantined:
+                break
+            await asyncio.sleep(0.05)
+        assert victim.quarantined
+        assert conn.replicas(DECODE) == 2  # intent is durable...
+        assert conn.healthy(DECODE) == 1  # ...but one child is sick
+        d = await planner.step()
+        assert d.direction == "heal"
+        assert conn.healthy(DECODE) == 2  # substitute spawned
+        assert conn.quarantined(DECODE) == 1  # sick one still retrying
+        assert conn.stats()["quarantined"] == 1
+        await conn.close()
+
+    run(go())
+
+
+# ----------------------------------------------------------- fleet sampler
+
+
+class _FakeAggregator:
+    """Duck-typed KvMetricsAggregator over canned ForwardPassMetrics."""
+
+    def __init__(self, per_worker):
+        from dynamo_tpu.kv_router.publisher import KvMetricsAggregator
+
+        self.per_worker = per_worker
+        self.fail = False
+        self._agg = KvMetricsAggregator.aggregate
+
+    async def collect(self):
+        if self.fail:
+            raise ConnectionError("stats plane dark")
+        return dict(self.per_worker)
+
+    async def aggregate(self, per_worker):
+        from dynamo_tpu.kv_router.publisher import KvMetricsAggregator
+
+        return await KvMetricsAggregator.aggregate(self, per_worker)
+
+
+def _worker_metrics(kv_usage=0.5, waiting=2, ttft_ms=100.0, trips=0):
+    from dynamo_tpu.kv_router.protocols import (
+        ForwardPassMetrics,
+        KvStats,
+        WorkerStats,
+    )
+    from dynamo_tpu.telemetry.histogram import PhaseHistograms
+
+    ph = PhaseHistograms()
+    for _ in range(10):
+        ph.observe("ttft", ttft_ms)
+        ph.observe("inter_token", 10.0)
+        ph.observe("e2e", ttft_ms + 40.0)
+    return ForwardPassMetrics(
+        worker_stats=WorkerStats(
+            request_active_slots=1, request_total_slots=4,
+            num_requests_waiting=waiting, num_watchdog_trips=trips,
+        ),
+        kv_stats=KvStats(
+            kv_active_blocks=int(64 * kv_usage), kv_total_blocks=64,
+            gpu_cache_usage_perc=kv_usage,
+        ),
+        phase_histograms=ph,
+    )
+
+
+def test_fleet_sampler_signals_and_staleness():
+    from dynamo_tpu.planner.samplers import FleetSampler
+
+    async def go():
+        clock = FakeClock()
+        agg = _FakeAggregator({1: _worker_metrics(), 2: _worker_metrics()})
+
+        class _Fabric:
+            dark = False
+
+            def status(self):
+                return {"degraded": self.dark, "connected": not self.dark}
+
+        fabric = _Fabric()
+        sampler = FleetSampler(
+            {DECODE: agg}, fabric=fabric, now_fn=clock,
+        )
+        m1 = await sampler()
+        assert m1.replicas_actual == {DECODE: 2}
+        assert m1.kv_usage == pytest.approx(0.5)
+        assert m1.queue_depth == 4.0  # summed across workers
+        assert not m1.stale and not m1.degraded and m1.age_s == 0.0
+        # second sample: histogram deltas produce interval latencies
+        clock.advance(10)
+        agg.per_worker = {
+            1: _worker_metrics(ttft_ms=300.0),
+            2: _worker_metrics(ttft_ms=300.0),
+        }
+        m2 = await sampler()
+        assert m2.ttft_ms is not None and m2.ttft_ms > 100.0
+        assert m2.req_per_s > 0
+        # scrape failure: age grows instead of lying with fresh zeros
+        agg.fail = True
+        clock.advance(10)
+        m3 = await sampler()
+        assert m3.age_s == pytest.approx(10.0)
+        assert m3.replicas_actual is None  # unknown, not zero
+        # degraded control plane is stamped through
+        fabric.dark = True
+        m4 = await sampler()
+        assert m4.degraded
+
+    run(go())
+
+
+def test_fleet_sampler_never_scraped_is_stale():
+    from dynamo_tpu.planner.samplers import FleetSampler
+
+    async def go():
+        agg = _FakeAggregator({})
+        agg.fail = True
+        sampler = FleetSampler({DECODE: agg}, now_fn=FakeClock())
+        m = await sampler()
+        assert m.stale  # no view of the fleet at all
+
+    run(go())
