@@ -260,6 +260,7 @@ def main() -> None:
         choices=[
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
             "priority", "integrity", "decode_mfu", "blackout", "planner",
+            "tail",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -303,7 +304,11 @@ def main() -> None:
         "SLO attainment vs replica-seconds against a static max fleet, "
         "plus the chaos wave — frozen through a blackout, healed within "
         "2 intervals, zero planner/brownout oscillation; banked "
-        "artifact benchmarks/planner_sweep.json)",
+        "artifact benchmarks/planner_sweep.json). "
+        "tail = tail-tolerance sweep (one 5x gray straggler in a "
+        "4-worker mocker fleet: hedged-vs-unhedged p99 TTFT, ejection "
+        "count, hedge overhead accounting, gray-flap hysteresis; "
+        "banked artifact benchmarks/tail_sweep.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -376,6 +381,17 @@ def main() -> None:
 
         blackout_sweep.main(
             ["--json", args.json or "benchmarks/blackout_sweep.json"]
+        )
+        return
+    if args.preset == "tail":
+        # tail-tolerance sweep runs on the mocker fleet directly (hedged
+        # vs unhedged p99 TTFT against one 5x gray straggler + ejection
+        # and gray-flap hysteresis proof) — one entry point for every
+        # banked curve stays `perf_sweep --preset X`
+        from benchmarks import tail_sweep
+
+        tail_sweep.main(
+            ["--json", args.json or "benchmarks/tail_sweep.json"]
         )
         return
     if args.preset == "slo":

@@ -1,0 +1,307 @@
+"""Tail-tolerance sweep (ISSUE 12): hedged dispatch + latency-outlier
+ejection against a gray straggler.
+
+Four in-process phases on a 4-worker mocker fleet (detached runtimes,
+real dispatch wire, deterministic token streams):
+
+  * ``baseline``   — healthy fleet, tail plane off: the no-straggler
+    p50/p99 TTFT reference.
+  * ``straggler``  — worker 0 runs 5x slow (gray: alive, lease-healthy,
+    just slow), tail plane off: round-robin keeps landing 1-in-4
+    requests on it, so p99 TTFT degrades to ~the straggler's first
+    token (bar: >= 3x baseline).
+  * ``tail_plane`` — same straggler with DYN_HEDGE=1 + the health
+    scorer live: hedges bound the learning window, ejection then
+    removes the straggler (probation trickle stays). Bars: p99 TTFT
+    <= 1.5x baseline, extra dispatches <= 5%, every stream token-
+    identical to the unhedged run, ejection count exactly 1.
+  * ``gray_flap``  — the straggler's slowness oscillates (5x for half
+    of each period): the hysteresis proof — zero eject/re-enter flaps.
+
+    python -m benchmarks.tail_sweep --json benchmarks/tail_sweep.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+
+def _pct(xs, p):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(p / 100.0 * len(xs)))]
+
+
+def _handler_for(engine):
+    from dynamo_tpu.protocols.common import PreprocessedRequest
+
+    async def handler(request, ctx):
+        pre = PreprocessedRequest.from_dict(request)
+        async for out in engine.generate(pre, ctx):
+            yield out.to_dict()
+
+    return handler
+
+
+async def _fleet(namespace, slow_idx, slow_factor, decode_s=0.005):
+    from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    engines, drts = [], []
+    for i in range(4):
+        drt = await DistributedRuntime.detached()
+        f = slow_factor if i == slow_idx else 1.0
+        engine = MockEngine(
+            MockEngineArgs(
+                num_blocks=512, block_size=4, max_batch=32,
+                speedup_ratio=1.0, prefill_linear_s=1e-5,
+                prefill_quadratic_s=0.0, decode_per_token_s=decode_s * f,
+            )
+        )
+        ep = drt.namespace(namespace).component("worker").endpoint("generate")
+        await ep.serve_endpoint(_handler_for(engine))
+        engines.append(engine)
+        drts.append(drt)
+    front = await DistributedRuntime.detached()
+    client = await (
+        front.namespace(namespace).component("worker").endpoint("generate")
+    ).client()
+    await client.wait_for_instances()
+    return engines, drts + [front], client
+
+
+async def _drive(remote, n, concurrency, prompt, max_tokens):
+    """n interactive requests at bounded concurrency; returns
+    (ttfts_s, token_streams, errors)."""
+    from dynamo_tpu.pipeline.context import Context
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    sem = asyncio.Semaphore(concurrency)
+    ttfts, streams, errors = [], [], []
+
+    async def one(i):
+        async with sem:
+            r = PreprocessedRequest(
+                token_ids=list(prompt),
+                sampling=SamplingOptions(),
+                stop=StopConditions(max_tokens=max_tokens),
+            )
+            r.extra["priority"] = "interactive"
+            t0 = time.monotonic()
+            first = None
+            toks = []
+            async for out in remote(r, Context()):
+                if out.token_ids and first is None:
+                    first = time.monotonic() - t0
+                toks.extend(out.token_ids)
+                if out.finish_reason is not None:
+                    if out.error is not None:
+                        errors.append(out.error)
+                    break
+            if first is not None:
+                ttfts.append(first)
+            streams.append(toks)
+
+    await asyncio.gather(*[one(i) for i in range(n)])
+    return ttfts, streams, errors
+
+
+async def _phase_plain(namespace, slow_idx, slow_factor, n, concurrency,
+                       prompt, max_tokens):
+    """Fleet with no tail plane: the baseline / unhedged straggler runs."""
+    from dynamo_tpu.discovery import RemoteEngine
+    from dynamo_tpu.pipeline.router import PushRouter, RouterMode
+
+    engines, drts, client = await _fleet(namespace, slow_idx, slow_factor)
+    try:
+        remote = RemoteEngine(PushRouter(client, RouterMode.ROUND_ROBIN))
+        ttfts, streams, errors = await _drive(
+            remote, n, concurrency, prompt, max_tokens
+        )
+        return ttfts, streams, errors
+    finally:
+        await client.close()
+        for drt in drts:
+            await drt.close()
+
+
+async def _phase_tail(namespace, n_warm, n, concurrency, prompt, max_tokens,
+                      flap_period_s=None):
+    """Straggler fleet with the full tail plane (hedge + eject) live."""
+    from dynamo_tpu.discovery import RemoteEngine
+    from dynamo_tpu.pipeline.router import PushRouter, RouterMode
+    from dynamo_tpu.telemetry.health import (
+        HealthConfig,
+        HealthScorer,
+        HedgeController,
+    )
+
+    engines, drts, client = await _fleet(namespace, 0, 5.0)
+    scorer = HealthScorer(
+        HealthConfig(
+            eject_ratio=3.0, eject_intervals=3, recover_ratio=1.5,
+            recover_intervals=4, min_healthy=1, probe_every=128,
+            alpha=0.5, stale_after_s=10.0,
+        )
+    )
+    transitions = []
+    scorer.on_restore = lambda wid: transitions.append("restore")
+    client.health = scorer
+    hedger = HedgeController(budget_fraction=0.05, min_delay_ms=8.0)
+    remote = RemoteEngine(
+        PushRouter(client, RouterMode.ROUND_ROBIN),
+        health=scorer, hedger=hedger,
+    )
+    stop = asyncio.Event()
+
+    async def ticker():
+        while not stop.is_set():
+            scorer.tick()
+            await asyncio.sleep(0.05)
+
+    async def flapper():
+        # gray flap: the straggler oscillates between 5x slow and healthy
+        base = engines[0].args.decode_per_token_s / 5.0
+        while not stop.is_set():
+            engines[0].args.decode_per_token_s = base * 5.0
+            await asyncio.sleep(flap_period_s / 2)
+            engines[0].args.decode_per_token_s = base
+            await asyncio.sleep(flap_period_s / 2)
+
+    tasks = [asyncio.create_task(ticker())]
+    if flap_period_s:
+        tasks.append(asyncio.create_task(flapper()))
+    try:
+        # learning window: health signals accumulate, hedges bound the
+        # damage, ejection fires — not measured (steady state is)
+        await _drive(remote, n_warm, concurrency, prompt, max_tokens)
+        await asyncio.sleep(0.4)
+        ttfts, streams, errors = await _drive(
+            remote, n, concurrency, prompt, max_tokens
+        )
+        return {
+            "ttfts": ttfts,
+            "streams": streams,
+            "errors": errors,
+            "ejections_total": sum(scorer.ejections_total.values()),
+            "restores_total": scorer.restores_total,
+            "ejected_now": len(scorer.ejected()),
+            "hedge": hedger.status(),
+        }
+    finally:
+        stop.set()
+        for t in tasks:
+            t.cancel()
+        await client.close()
+        for drt in drts:
+            await drt.close()
+
+
+async def _run() -> dict:
+    prompt = [7, 11, 13, 17, 19, 23, 29, 31]
+    max_tokens, conc, n = 6, 4, 200
+    expected = [prompt[i % len(prompt)] for i in range(max_tokens)]
+
+    base_ttfts, base_streams, base_err = await _phase_plain(
+        "tailsw-base", None, 1.0, n, conc, prompt, max_tokens
+    )
+    strag_ttfts, strag_streams, strag_err = await _phase_plain(
+        "tailsw-strag", 0, 5.0, n, conc, prompt, max_tokens
+    )
+    os.environ["DYN_HEDGE"] = "1"
+    try:
+        tail = await _phase_tail(
+            "tailsw-tail", 60, n, conc, prompt, max_tokens
+        )
+        flap = await _phase_tail(
+            "tailsw-flap", 60, 120, conc, prompt, max_tokens,
+            flap_period_s=0.5,
+        )
+    finally:
+        os.environ.pop("DYN_HEDGE", None)
+
+    base_p99 = _pct(base_ttfts, 99)
+    strag_p99 = _pct(strag_ttfts, 99)
+    tail_p99 = _pct(tail["ttfts"], 99)
+    hedge = tail["hedge"]
+    extra_frac = hedge["hedges"] / max(1, hedge["dispatches"])
+    token_identical = all(s == expected for s in tail["streams"]) and all(
+        s == expected for s in strag_streams + base_streams
+    )
+    out = {
+        "fleet": {"workers": 4, "straggler_factor": 5.0,
+                  "decode_per_token_s": 0.005, "concurrency": conc,
+                  "requests_measured": n},
+        "baseline": {
+            "ttft_p50_ms": round(_pct(base_ttfts, 50) * 1e3, 3),
+            "ttft_p99_ms": round(base_p99 * 1e3, 3),
+            "errors": len(base_err),
+        },
+        "straggler_unhedged": {
+            "ttft_p50_ms": round(_pct(strag_ttfts, 50) * 1e3, 3),
+            "ttft_p99_ms": round(strag_p99 * 1e3, 3),
+            "p99_vs_baseline": round(strag_p99 / base_p99, 2),
+            "errors": len(strag_err),
+        },
+        "straggler_tail_plane": {
+            "ttft_p50_ms": round(_pct(tail["ttfts"], 50) * 1e3, 3),
+            "ttft_p99_ms": round(tail_p99 * 1e3, 3),
+            "p99_vs_baseline": round(tail_p99 / base_p99, 2),
+            "ejections_total": tail["ejections_total"],
+            "restores_total": tail["restores_total"],
+            "hedge": hedge,
+            "extra_dispatch_fraction": round(extra_frac, 4),
+            "errors": len(tail["errors"]),
+        },
+        "gray_flap": {
+            "ejections_total": flap["ejections_total"],
+            "restores_total": flap["restores_total"],
+            "flaps": flap["restores_total"],
+            "errors": len(flap["errors"]),
+        },
+        "token_identical": token_identical,
+    }
+    bars = {
+        "unhedged_p99_degrades_3x": strag_p99 >= 3.0 * base_p99,
+        "tail_plane_p99_within_1p5x": tail_p99 <= 1.5 * base_p99,
+        "extra_dispatches_within_5pct": extra_frac <= 0.05 + 2.0 / max(
+            1, hedge["dispatches"]
+        ),
+        "token_identical": token_identical,
+        "ejection_exactly_one": tail["ejections_total"] == 1
+        and tail["restores_total"] == 0,
+        "gray_flap_zero_flaps": flap["restores_total"] == 0
+        and flap["ejections_total"] <= 1,
+        "zero_errors": not (base_err or strag_err or tail["errors"]
+                            or flap["errors"]),
+    }
+    out["bars"] = bars
+    return out
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+    result = asyncio.run(_run())
+    print(json.dumps(result, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+        print(f"wrote {args.json}")
+    failed = [k for k, ok in result["bars"].items() if not ok]
+    if failed:
+        raise SystemExit(f"acceptance bars failed: {failed}")
+
+
+if __name__ == "__main__":
+    main()

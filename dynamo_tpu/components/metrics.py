@@ -58,6 +58,7 @@ from dynamo_tpu.runtime.http_server import SystemStatusServer
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import EndpointId
 from dynamo_tpu.telemetry import slo as dslo
+from dynamo_tpu.telemetry.health import HealthScorer
 from dynamo_tpu.telemetry.histogram import BOUNDS, NUM_BUCKETS, PhaseHistograms
 
 logger = get_logger("dynamo_tpu.components.metrics")
@@ -185,8 +186,39 @@ class _FleetCollector:
         )
         ph = agg.phase_histograms if agg is not None else None
         yield from self._phase_families(ph)
+        yield from self._health_families()
         yield from self._slo_families()
         yield from planner_families(self.component.planner_status)
+
+    def _health_families(self):
+        """Tail-tolerance plane from the component's own scorer (fed by
+        the poll loop with each worker's self-reported phase-histogram
+        deltas — the fleet-wide view of gray workers, observable with no
+        frontend at all)."""
+        health = self.component.health
+        score = GaugeMetricFamily(
+            f"{PREFIX}_worker_health_score",
+            "Worker slowness ratio vs the fleet median "
+            "(1.0 typical; >= DYN_EJECT_RATIO is an outlier)",
+            labels=["instance"],
+        )
+        for wid, s in sorted(health.scores().items()):
+            score.add_metric([f"{wid:x}"], float(s))
+        yield score
+        yield GaugeMetricFamily(
+            f"{PREFIX}_workers_ejected",
+            "Workers currently ejected from routing as latency outliers "
+            "(probation trickle still flows)",
+            value=float(len(health.ejected())),
+        )
+        ej = CounterMetricFamily(
+            f"{PREFIX}_ejections",
+            "Latency-outlier ejections by dominant slow signal",
+            labels=["cause"],
+        )
+        for cause, v in sorted(health.ejections_total.items()):
+            ej.add_metric([str(cause)], float(v))
+        yield ej
 
     def _phase_families(self, ph: Optional[PhaseHistograms]):
         hist = HistogramMetricFamily(
@@ -330,6 +362,9 @@ class MetricsComponent:
         self.slo = dslo.SloEngine(
             dslo.SloConfig.from_env(), on_transition=self._on_slo_transition
         )
+        # tail-tolerance plane: scored from the scraped self-reported
+        # histograms each poll (no consumer-side signal in this process)
+        self.health = HealthScorer()
 
         def g(name: str, doc: str) -> Gauge:
             return Gauge(f"{PREFIX}_{name}", doc, registry=self.registry)
@@ -516,6 +551,11 @@ class MetricsComponent:
                 per_worker = await self.aggregator.collect()
                 agg = await self.aggregator.aggregate(per_worker)
                 self.last = agg
+                for wid, m in per_worker.items():
+                    self.health.observe_worker_hists(
+                        wid, m.phase_histograms
+                    )
+                self.health.tick()
                 self.g_workers.set(len(per_worker))
                 self.g_active_slots.set(agg.worker_stats.request_active_slots)
                 self.g_total_slots.set(agg.worker_stats.request_total_slots)
@@ -602,6 +642,7 @@ class MockWorkerMetrics:
         ttft_ms: float = 120.0,
         itl_ms: float = 12.0,
         load_fn=None,  # () -> load; overrides the sine (planner sims)
+        slow_factor: float = 1.0,  # gray-worker knob: all latencies xN
     ) -> None:
         self.publisher = WorkerMetricsPublisher(
             endpoint.component, endpoint.id, instance_id
@@ -615,6 +656,12 @@ class MockWorkerMetrics:
         # OVERLOAD — latencies blow up superlinearly past saturation, the
         # regime the closed-loop planner must scale out of
         self.load_fn = load_fn
+        # gray-worker simulation (tail-tolerance plane): every published
+        # latency is slow_factor times the fleet-typical value, while
+        # slots/blocks/lease stay perfectly healthy — a straggler the
+        # health scorer must catch from self-reports alone. Settable live
+        # so tests can flap it (gray_flap hysteresis, engine-free).
+        self.slow_factor = slow_factor
         self._t = 0.0
         # monotonic counter state (worker lifetime)
         self._deadline_exceeded = 0
@@ -652,7 +699,9 @@ class MockWorkerMetrics:
         # (deterministic — no RNG, so dashboards and tests are repeatable)
         reqs = 1 + int(3 * load)
         for i in range(reqs):
-            scale = 0.7 + 0.6 * load + 4.0 * overload + 0.05 * i
+            scale = (0.7 + 0.6 * load + 4.0 * overload + 0.05 * i) * max(
+                0.01, self.slow_factor
+            )
             self.hist.observe("queue_wait", 2.0 * scale)
             self.hist.observe("prefill", 40.0 * scale)
             self.hist.observe("ttft", self.ttft_ms * scale)

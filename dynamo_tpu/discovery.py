@@ -14,9 +14,11 @@ import asyncio
 import contextlib
 import json
 import os
+import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Optional
 
+from dynamo_tpu import qos
 from dynamo_tpu.http.service import ModelExecution, ModelManager
 from dynamo_tpu.model_card import ModelDeploymentCard
 from dynamo_tpu.pipeline.annotated import Annotated
@@ -32,6 +34,7 @@ from dynamo_tpu.runtime.component import Endpoint, NoInstancesError
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import MODEL_ROOT, EndpointId
+from dynamo_tpu.telemetry import health as dhealth
 from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.discovery")
@@ -78,6 +81,60 @@ async def register_llm(
     return key
 
 
+class _ResumedStream:
+    """ResponseStream facade that resumes iteration after the hedging
+    logic pulled (or started pulling) the first frame: yields the pending
+    first item, then delegates to the underlying iterator. close()
+    cancels the pending pull and closes the inner stream (killing its
+    per-attempt context — the CancellationToken cascade the engines
+    already honor for consumer disconnects)."""
+
+    def __init__(self, inner: Any, it: Any, pending: Optional[asyncio.Task]):
+        self._inner = inner
+        self._it = it
+        self._pending = pending
+        self.context = inner.context
+
+    def __aiter__(self):
+        async def gen():
+            try:
+                if self._pending is not None:
+                    item = await self._pending
+                    self._pending = None
+                    yield item
+                while True:
+                    yield await self._it.__anext__()
+            except StopAsyncIteration:
+                return
+
+        return gen()
+
+    async def close(self) -> None:
+        if self._pending is not None:
+            self._pending.cancel()
+            self._pending = None
+        await self._inner.close()
+
+
+def _first_frame_tokens(task: asyncio.Task) -> int:
+    """Tokens carried by a completed first-frame pull (0 for errors)."""
+    if not task.done() or task.cancelled() or task.exception() is not None:
+        return 0
+    item = task.result()
+    data = getattr(item, "data", None)
+    if isinstance(data, dict):
+        return len(data.get("token_ids") or ())
+    return 0
+
+
+def _is_good_first_frame(task: asyncio.Task) -> bool:
+    """A completed pull that yielded a non-error data frame."""
+    if not task.done() or task.cancelled() or task.exception() is not None:
+        return False
+    item = task.result()
+    return not item.is_error()
+
+
 class RemoteEngine:
     """EngineFn adapter with in-flight migration: forwards
     PreprocessedRequests over a PushRouter; when the serving worker dies
@@ -89,7 +146,20 @@ class RemoteEngine:
     with exponential backoff + jitter. The resumed stream carries no
     duplicated and no dropped tokens: every engine counts the replayed tail
     as generated output, so budgets and per-token RNG counters continue
-    exactly where the dead worker stopped."""
+    exactly where the dead worker stopped.
+
+    Tail tolerance (ISSUE 12): with a `health` scorer wired, every
+    dispatch / first-frame / inter-frame latency is recorded against the
+    serving worker (the consumer-observed half of gray-failure
+    detection), and ejected stragglers are excluded from replays. With
+    `DYN_HEDGE=1` and a `hedger`, an interactive request whose first
+    token hasn't arrived within the dynamic hedge delay launches ONE
+    hedge dispatch on a different worker; the first stream to produce a
+    token wins and the loser is cancelled through the normal
+    CancellationToken cascade (freeing its lane + KV). A hedge is a
+    FRESH dispatch of the same request — not a replay — so per-token
+    threefry counters line up and hedged streams are token-identical
+    under greedy and seeded sampling."""
 
     def __init__(
         self,
@@ -98,9 +168,16 @@ class RemoteEngine:
         cancel_token: Optional[Any] = None,
         fences: Optional[Any] = None,  # runtime.fencing.FenceRegistry
         on_fenced_reject: Optional[Callable[[], None]] = None,
+        health: Optional[Any] = None,  # telemetry.health.HealthScorer
+        hedger: Optional[Any] = None,  # telemetry.health.HedgeController
     ) -> None:
         self.router = router
         self.on_migration = on_migration
+        self.health = health
+        self.hedger = hedger
+        # DYN_HEDGE resolved once: the disabled fast path is this single
+        # attribute check per request (PR 5/6 overhead discipline)
+        self._hedge = hedger is not None and dhealth.hedge_enabled()
         # the hosting runtime's CancellationToken: when the frontend itself
         # is dying (fabric/lease loss), replays must abort IMMEDIATELY so
         # the structured error still reaches the client before teardown
@@ -121,6 +198,103 @@ class RemoteEngine:
     def _runtime_dying(self) -> bool:
         return self.cancel_token is not None and self.cancel_token.is_cancelled()
 
+    async def _hedged_first(
+        self,
+        stream: Any,
+        ctx: Context,
+        attempt_ctx: Context,
+        req_dict: dict,
+        exclude: set[int],
+        dsp: Any,
+    ) -> Any:
+        """Hedged first token ("The Tail at Scale"): wait the dynamic
+        hedge delay for the primary's first frame; past it, launch ONE
+        hedge dispatch on a different eligible worker (budget
+        permitting), race the two first frames, keep the winner, and
+        cancel the loser. Always returns a stream-like to iterate — on
+        any internal failure the primary passes through untouched."""
+        hedger = self.hedger
+        it = stream.__aiter__()
+        first_task = asyncio.ensure_future(it.__anext__())
+        done, _ = await asyncio.wait(
+            {first_task}, timeout=hedger.delay_ms() / 1e3
+        )
+        if done:
+            # primary answered inside the delay: the common case — no
+            # hedge, no extra dispatch
+            return _ResumedStream(stream, it, first_task)
+        if not hedger.try_acquire():  # counts outcome=budget_denied
+            dsp.set(hedge="budget_denied")
+            return _ResumedStream(stream, it, first_task)
+        primary_wid = attempt_ctx.metadata.get("worker_instance_id")
+        hx = set(exclude)
+        if primary_wid is not None:
+            hx.add(primary_wid)
+        # the hedge context is a SIBLING of the primary's attempt context
+        # (both children of the request ctx): cancelling the loser must
+        # not cascade into the winner
+        hedge_ctx = ctx.child()
+        hstream = None
+        try:
+            hstream = await asyncio.wait_for(
+                self.router.generate(req_dict, hedge_ctx, exclude=hx),
+                self.dispatch_timeout_s,
+            )
+        except Exception as e:  # noqa: BLE001 — a failed hedge is a no-op
+            dtrace.event("hedge_dispatch_failed", cause=str(e))
+        if hstream is None:
+            hedger.note_outcome("lost")
+            return _ResumedStream(stream, it, first_task)
+        hedge_wid = hedge_ctx.metadata.get("worker_instance_id")
+        dsp.set(
+            hedged=True,
+            hedge_worker=f"{hedge_wid:x}" if hedge_wid is not None else None,
+        )
+        hit = hstream.__aiter__()
+        hedge_task = asyncio.ensure_future(hit.__anext__())
+        await asyncio.wait(
+            {first_task, hedge_task}, return_when=asyncio.FIRST_COMPLETED
+        )
+        # pick the winner: the first GOOD frame; prefer the primary on a
+        # tie (no switch); a side whose pull errored loses even if first
+        primary_good = _is_good_first_frame(first_task)
+        hedge_good = _is_good_first_frame(hedge_task)
+        if primary_good:
+            hedge_wins = False
+        elif hedge_good:
+            hedge_wins = True
+        elif first_task.done() and not hedge_task.done():
+            # primary's first pull failed while the hedge is still in
+            # flight: ride the hedge rather than burning a migration
+            hedge_wins = True
+        else:
+            # hedge failed first (or both failed): stay on the primary —
+            # the outer failure/migration logic owns what happens next
+            hedge_wins = False
+        if hedge_wins:
+            wasted = _first_frame_tokens(first_task)
+            first_task.cancel()
+            with contextlib.suppress(Exception):
+                await stream.close()
+            hedger.note_outcome("won", wasted_tokens=wasted)
+            dsp.set(hedge="won")
+            dtrace.event(
+                "hedge_won",
+                loser=f"{primary_wid:x}" if primary_wid is not None else None,
+            )
+            # downstream bookkeeping (failure exclusion, health
+            # attribution) follows the worker actually serving the stream
+            if hedge_wid is not None:
+                attempt_ctx.metadata["worker_instance_id"] = hedge_wid
+            return _ResumedStream(hstream, hit, hedge_task)
+        wasted = _first_frame_tokens(hedge_task)
+        hedge_task.cancel()
+        with contextlib.suppress(Exception):
+            await hstream.close()
+        hedger.note_outcome("lost", wasted_tokens=wasted)
+        dsp.set(hedge="lost")
+        return _ResumedStream(stream, it, first_task)
+
     async def __call__(
         self, request: PreprocessedRequest, ctx: Context
     ) -> AsyncIterator[LLMEngineOutput]:
@@ -133,6 +307,15 @@ class RemoteEngine:
         # worker; a mid-stream replay cannot reproduce them faithfully
         can_replay = not any(
             k in request.extra for k in ("mm", "mm_images", "mm_videos")
+        )
+        # hedging applies to interactive-class first attempts only (tail
+        # latency is an interactive problem; bulk work can wait out a
+        # straggler) and requires replayability for the same reason
+        # migration does: the hedge must reproduce the stream exactly
+        hedge_this = (
+            self._hedge
+            and can_replay
+            and qos.priority_of(ctx, request) == "interactive"
         )
         attempt = 0
         while True:
@@ -147,6 +330,9 @@ class RemoteEngine:
             # per-attempt dispatch span: replays share the request's trace
             # id (ctx carries it), so a migrated stream is ONE trace with
             # one dispatch span per attempt, all parented to the root
+            t_attempt = time.monotonic()
+            t_first: Optional[float] = None
+            t_last_frame: Optional[float] = None
             with dtrace.span(
                 "dispatch", ctx=attempt_ctx, attach=True, attempt=attempt,
                 replayed_tokens=len(emitted),
@@ -179,6 +365,20 @@ class RemoteEngine:
                     no_instances = isinstance(e, NoInstancesError)
                 if stream is not None:
                     wid = attempt_ctx.metadata.get("worker_instance_id")
+                    if self.health is not None and wid is not None:
+                        self.health.record(
+                            wid, "dispatch",
+                            (time.monotonic() - t_attempt) * 1e3,
+                        )
+                    if self.hedger is not None:
+                        self.hedger.note_dispatch()
+                    if hedge_this and attempt == 1 and not emitted:
+                        stream = await self._hedged_first(
+                            stream, ctx, attempt_ctx, req_dict, exclude, dsp
+                        )
+                        # the hedge may have won: exclusion bookkeeping
+                        # and health attribution follow the live worker
+                        wid = attempt_ctx.metadata.get("worker_instance_id")
                     if wid is not None:
                         dsp.set(worker=f"{wid:x}")
                     finished = False
@@ -222,6 +422,28 @@ class RemoteEngine:
                                 if out.token_ids:
                                     emitted.extend(out.token_ids)
                                     progressed = True
+                                    if self.health is not None:
+                                        now = time.monotonic()
+                                        if t_first is None:
+                                            t_first = now
+                                            ms = (now - t_attempt) * 1e3
+                                            if wid is not None:
+                                                self.health.record(
+                                                    wid, "first_frame", ms
+                                                )
+                                            if self.hedger is not None:
+                                                self.hedger.note_first_frame(
+                                                    ms
+                                                )
+                                        elif (
+                                            wid is not None
+                                            and t_last_frame is not None
+                                        ):
+                                            self.health.record(
+                                                wid, "inter_frame",
+                                                (now - t_last_frame) * 1e3,
+                                            )
+                                        t_last_frame = now
                                 yield out
                                 if out.finish_reason is not None:
                                     finished = True
@@ -328,15 +550,23 @@ class RemoteEngine:
 class WorkerCapacityPoller:
     """Background scrape of aggregated worker `load_metrics` for one
     endpoint: feeds the frontend's AdmissionController with the fleet's
-    total request slots (the base of the shed watermark)."""
+    total request slots (the base of the shed watermark), and — when a
+    HealthScorer is wired — feeds each worker's self-reported phase
+    histograms into the tail-tolerance plane and advances its score
+    tick (the self-reported half of gray-failure detection)."""
 
     def __init__(
-        self, component: Any, endpoint_id: EndpointId, interval_s: float = 2.0
+        self,
+        component: Any,
+        endpoint_id: EndpointId,
+        interval_s: float = 2.0,
+        health: Optional[Any] = None,  # telemetry.health.HealthScorer
     ) -> None:
         from dynamo_tpu.kv_router.publisher import KvMetricsAggregator
 
         self.aggregator = KvMetricsAggregator(component, endpoint_id)
         self.interval_s = interval_s
+        self.health = health
         self.total_slots: Optional[int] = None
         self.waiting: int = 0
         self._task: Optional[asyncio.Task] = None
@@ -358,8 +588,17 @@ class WorkerCapacityPoller:
                         for m in per_worker.values()
                     )
                     self.total_slots = slots or None
+                    if self.health is not None:
+                        for wid, m in per_worker.items():
+                            self.health.observe_worker_hists(
+                                wid, m.phase_histograms
+                            )
                 except Exception:  # noqa: BLE001 — scrape gaps tolerated
                     self.total_slots = None
+                if self.health is not None:
+                    # tick even on a failed scrape: staleness must AGE
+                    # scores, not freeze them
+                    self.health.tick()
                 await asyncio.sleep(self.interval_s)
 
     async def stop(self) -> None:
@@ -395,6 +634,11 @@ class ModelWatcher:
         self._key_to_model: dict[str, str] = {}
         self._kv_routers: dict[str, Any] = {}
         self._capacity_pollers: dict[str, WorkerCapacityPoller] = {}
+        # tail-tolerance plane: one HealthScorer + HedgeController per
+        # worker endpoint (shared by the Client, the KV scheduler, and
+        # the RemoteEngine so ejection and hedging see one truth)
+        self._health: dict[str, Any] = {}
+        self._hedgers: dict[str, Any] = {}
         # trace-export event-plane fallback: one ingest loop per worker
         # namespace (spans a torn-down stream's final frame couldn't carry)
         self._trace_subs: set[str] = set()
@@ -405,6 +649,29 @@ class ModelWatcher:
         for ev in self._watch.initial:
             await self._on_put(ev.key, ev.value)
         self._task = asyncio.get_running_loop().create_task(self._loop())
+
+    def _make_eject_publisher(self, namespace: str):
+        """Ejections are fleet events: publish on `health-status` so the
+        planner converts them into capacity-loss pressure
+        (note_capacity_loss -> substitute spawns) without importing the
+        frontend."""
+
+        def on_eject(worker_id: int, cause: str) -> None:
+            async def _pub() -> None:
+                with contextlib.suppress(Exception):
+                    await self.drt.namespace(namespace).publish_event(
+                        dhealth.HEALTH_SUBJECT,
+                        {
+                            "event": "ejected",
+                            "worker": worker_id,
+                            "cause": cause,
+                        },
+                    )
+
+            with contextlib.suppress(RuntimeError):  # no loop (tests)
+                asyncio.get_running_loop().create_task(_pub())
+
+        return on_eject
 
     async def _ensure_trace_ingest(self, namespace: str) -> None:
         """Subscribe (once per namespace) to the workers' trace-export
@@ -477,6 +744,18 @@ class ModelWatcher:
         if client is None:
             client = await endpoint.client()
             self._clients[entry.endpoint] = client
+        health = self._health.get(entry.endpoint)
+        if health is None:
+            health = dhealth.HealthScorer(
+                on_eject=self._make_eject_publisher(eid.namespace)
+            )
+            self._health[entry.endpoint] = health
+            # latency-ejected workers leave round-robin/random selection
+            # and migration replays alongside dead-worker exclusions
+            client.health = health
+        hedger = self._hedgers.get(entry.endpoint)
+        if hedger is None:
+            hedger = self._hedgers[entry.endpoint] = dhealth.HedgeController()
         if self.router_mode is RouterMode.KV:
             from dynamo_tpu.kv_router.router import KvPushRouter, KvRouter
 
@@ -489,6 +768,7 @@ class ModelWatcher:
                     config=self.kv_router_config,
                 )
                 await kv_router.start()
+                kv_router.scheduler.health = health
                 self._kv_routers[entry.endpoint] = kv_router
                 if self.metrics is not None:
                     # in-process router: its hit accounting scrapes straight
@@ -547,22 +827,30 @@ class ModelWatcher:
                 on_migration=on_migration,
                 cancel_token=self.drt.token,
                 fences=fences,
+                health=health,
+                hedger=hedger,
             ),
             clear_fn=clear_fn,
         )
         self.manager.add_model(entry.name, execution, ref=key)
         self._key_to_model[key] = entry.name
-        if (
-            self.admission is not None
-            and entry.name not in self._capacity_pollers
-        ):
-            # admission watermark follows the discovered fleet's slot count
-            poller = WorkerCapacityPoller(endpoint.component, eid)
+        if self.metrics is not None:
+            # tail metric families (attach-once: first endpoint wins,
+            # same contract as attach_kv_hit_stats)
+            self.metrics.attach_health(health, hedger)
+        if entry.name not in self._capacity_pollers:
+            # the poller doubles as the health plane's scrape loop, so it
+            # runs with or without admission control
+            poller = WorkerCapacityPoller(
+                endpoint.component, eid, health=health
+            )
             poller.start()
             self._capacity_pollers[entry.name] = poller
-            self.admission.set_capacity_fn(
-                entry.name, lambda p=poller: p.total_slots
-            )
+            if self.admission is not None:
+                # admission watermark follows the fleet's slot count
+                self.admission.set_capacity_fn(
+                    entry.name, lambda p=poller: p.total_slots
+                )
         logger.info("watcher wired model %s via %s", entry.name, entry.endpoint)
 
     async def _on_delete(self, key: str) -> None:

@@ -628,10 +628,12 @@ class JaxEngine:
         """Run one device dispatch in the executor, visible to the
         stuck-horizon watchdog (and to fault injection). Callers hold
         self._device_lock."""
+        slow_factor = 1.0
         if faults.active():
             inj = faults.get_injector()
             if inj is not None:
                 await inj.on_dispatch()
+                slow_factor = inj.dispatch_slow_factor()
         run = fn
         if dprofile.active():
             # a profile window is open: name this dispatch on the device
@@ -645,7 +647,16 @@ class JaxEngine:
         self._dispatch_info = (label, time.monotonic())
         t0 = self._dispatch_info[1]
         try:
-            return await loop.run_in_executor(None, run)
+            result = await loop.run_in_executor(None, run)
+            if slow_factor > 1.0:
+                # injected gray-worker fault: stretch the dispatch to
+                # FACTOR times its real duration (the device did the work;
+                # the worker is throttled, not wedged — the watchdog's EMA
+                # budget tracks the stretched time so it doesn't trip)
+                await asyncio.sleep(
+                    (slow_factor - 1.0) * (time.monotonic() - t0)
+                )
+            return result
         finally:
             elapsed = time.monotonic() - t0
             self._dispatch_info = None

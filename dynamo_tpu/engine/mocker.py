@@ -609,12 +609,15 @@ class MockEngine:
                 if self.waiting:
                     await asyncio.sleep(0.001)
                 continue
-            # one decode iteration for the whole batch
+            # one decode iteration for the whole batch (a gray-worker
+            # fault stretches the simulated step: slow, never dead)
+            step_s = self.args.decode_per_token_s
             if faults.active():
                 inj = faults.get_injector()
                 if inj is not None:
                     await inj.on_dispatch()
-            await self._sim_sleep(self.args.decode_per_token_s)
+                    step_s *= inj.dispatch_slow_factor()
+            await self._sim_sleep(step_s)
             # deadline expiry mid-generation: cancel + structured error
             for seq in [
                 s for s in list(self.active) if s.context.expired()

@@ -17,7 +17,7 @@ from prometheus_client import (
     Histogram,
     generate_latest,
 )
-from prometheus_client.core import CounterMetricFamily
+from prometheus_client.core import CounterMetricFamily, GaugeMetricFamily
 
 from dynamo_tpu.runtime.prom import CallbackCounter
 from dynamo_tpu.telemetry.histogram import PhaseHistograms
@@ -387,6 +387,68 @@ class ServiceMetrics:
                 yield from planner_families(read())
 
         self.registry.register(_PlannerCollector())
+
+    def attach_health(self, scorer, hedger=None) -> None:
+        """Surface the tail-tolerance plane (ISSUE 12) on this frontend's
+        /metrics: per-worker health scores (slowness ratio vs the fleet
+        median), the live ejected-worker count, ejection causes, and —
+        when a HedgeController is wired — hedge outcomes and the tokens
+        the cancelled losers wasted. Scrape-time families; attach-once
+        guarded (first discovered endpoint wins, like attach_kv_hit_stats);
+        the metrics component and the standalone router export the same
+        score/ejection families from their own scorers."""
+        if getattr(self, "_health_attached", False):
+            return
+        self._health_attached = True
+
+        class _HealthCollector:
+            def describe(self):
+                return []
+
+            def collect(self):
+                score = GaugeMetricFamily(
+                    "dyn_llm_worker_health_score",
+                    "Worker slowness ratio vs the fleet median "
+                    "(1.0 typical; >= DYN_EJECT_RATIO is an outlier)",
+                    labels=["instance"],
+                )
+                for wid, s in sorted(scorer.scores().items()):
+                    score.add_metric([f"{wid:x}"], float(s))
+                yield score
+                yield GaugeMetricFamily(
+                    "dyn_llm_workers_ejected",
+                    "Workers currently ejected from routing as latency "
+                    "outliers (probation trickle still flows)",
+                    value=float(len(scorer.ejected())),
+                )
+                ej = CounterMetricFamily(
+                    "dyn_llm_ejections",
+                    "Latency-outlier ejections by dominant slow signal",
+                    labels=["cause"],
+                )
+                for cause, v in sorted(scorer.ejections_total.items()):
+                    ej.add_metric([str(cause)], float(v))
+                yield ej
+                if hedger is None:
+                    return
+                hedges = CounterMetricFamily(
+                    "dyn_llm_hedges",
+                    "Hedged dispatches by outcome (won = hedge beat the "
+                    "primary, lost = primary answered first, "
+                    "budget_denied = DYN_HEDGE_BUDGET spent)",
+                    labels=["outcome"],
+                )
+                for outcome, v in sorted(hedger.outcomes.items()):
+                    hedges.add_metric([str(outcome)], float(v))
+                yield hedges
+                yield CounterMetricFamily(
+                    "dyn_llm_hedge_wasted_tokens",
+                    "Tokens emitted by cancelled hedge losers (the cost "
+                    "side of the hedge budget)",
+                    value=float(hedger.wasted_tokens),
+                )
+
+        self.registry.register(_HealthCollector())
 
     def attach_brownout(self, controller) -> None:
         """Surface the brownout ladder on /metrics: the live rung as a

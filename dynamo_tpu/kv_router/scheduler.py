@@ -37,6 +37,10 @@ class SchedulingRequest:
     overlap: OverlapScores
     # worker_id -> blocks the worker would hold if this request landed there
     potential_blocks: dict[int, int] = field(default_factory=dict)
+    # tail-tolerance deweight (telemetry/health.py): worker_id -> factor
+    # >= 1.0 multiplying its cost logit — SUSPECT (slow-but-not-ejected)
+    # workers get proportionally less traffic without leaving the pool
+    health_factors: dict[int, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -125,6 +129,12 @@ class DefaultWorkerSelector:
             logit = (
                 self.config.overlap_score_weight * prefill_blocks + potential
             )
+            factor = request.health_factors.get(worker_id)
+            if factor is not None and factor != 1.0:
+                # cost logits are non-negative (cached <= request blocks),
+                # so multiplying deweights; the additive term keeps a
+                # suspect strictly worse even at zero load/overlap
+                logit = logit * factor + (factor - 1.0)
             logits[worker_id] = logit
             max_logit = max(max_logit, logit)
             logger.debug(
@@ -164,6 +174,11 @@ class KvScheduler:
         self.selector = selector or DefaultWorkerSelector()
         self.sequences = ActiveSequencesMultiWorker(block_size, [])
         self.on_hit_rate_event = on_hit_rate_event
+        # tail-tolerance plane (telemetry/health.HealthScorer, optional):
+        # ejected workers leave the candidate set (probation trickle +
+        # min-healthy floor handled inside the scorer), suspects are
+        # deweighted in the cost function
+        self.health = None
         # local per-decision aggregation (reference plane 3): every
         # schedule() records how many of the request's blocks the chosen
         # worker already held — the standalone router's /metrics and the
@@ -198,12 +213,20 @@ class KvScheduler:
             chain = compute_seq_hash_chain(token_ids, self.block_size)
         partial = 1 if len(token_ids) % self.block_size else 0
         worker_ids = list(self.sequences.workers.keys())
+        health_factors: dict[int, float] = {}
+        if self.health is not None:
+            worker_ids = self.health.route_set(worker_ids)
+            health_factors = {
+                w: f for w in worker_ids
+                if (f := self.health.penalty(w)) != 1.0
+            }
         request = SchedulingRequest(
             isl_tokens=len(token_ids),
             overlap=overlap,
             potential_blocks=self.sequences.potential_blocks_chain(
                 chain, partial
             ),
+            health_factors=health_factors,
         )
         result = self.selector.select_worker(
             worker_ids, request, self.block_size

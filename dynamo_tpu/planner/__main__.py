@@ -224,14 +224,43 @@ def main() -> None:
             brownout_task = asyncio.get_running_loop().create_task(
                 _brownout_events()
             )
+
+            # tail-tolerance arbitration: a latency-ejected worker is
+            # lost capacity even though its process is alive — the
+            # frontend's health plane publishes the ejection and the
+            # planner substitutes via the same heal path a quarantined
+            # crash-looper uses (note_capacity_loss)
+            async def _health_events() -> None:
+                import msgpack
+
+                from dynamo_tpu.telemetry import health as dhealth
+
+                with contextlib.suppress(asyncio.CancelledError, Exception):
+                    sub = await namespace.subscribe_event(
+                        dhealth.HEALTH_SUBJECT
+                    )
+                    async for _subject, payload in sub:
+                        try:
+                            data = msgpack.unpackb(payload, raw=False)
+                            if data.get("event") == "ejected":
+                                planner.note_capacity_loss()
+                        except Exception:  # noqa: BLE001 — malformed event
+                            continue
+
+            health_task = asyncio.get_running_loop().create_task(
+                _health_events()
+            )
+        else:
+            health_task = None
         await planner.start()
         try:
             await asyncio.Event().wait()
         finally:
-            if brownout_task is not None:
-                brownout_task.cancel()
-                with contextlib.suppress(asyncio.CancelledError):
-                    await brownout_task
+            for task in (brownout_task, health_task):
+                if task is not None:
+                    task.cancel()
+                    with contextlib.suppress(asyncio.CancelledError):
+                        await task
             await planner.close()
             if hasattr(connector, "close"):
                 await connector.close()

@@ -33,16 +33,54 @@ from dynamo_tpu.telemetry import trace as dtrace
 logger = get_logger("dynamo_tpu.router")
 
 
-def build_router_registry(scheduler, decisions_fn, shed_fn):
+def build_router_registry(scheduler, decisions_fn, shed_fn, health=None):
     """The standalone router's Prometheus registry: hit-rate gauge plus
     monotonic counters with real counter semantics (scrape-time callback
     families, not `_total`-named gauges). Factored out so the metrics-lint
     suite can walk the registry without a live router."""
     from prometheus_client import CollectorRegistry, Gauge
+    from prometheus_client.core import (
+        CounterMetricFamily,
+        GaugeMetricFamily,
+    )
 
     from dynamo_tpu.runtime.prom import CallbackCounter
 
     registry = CollectorRegistry()
+    if health is not None:
+        # tail-tolerance plane: per-worker health scores + ejection state
+        # as THIS router's scorer sees them (the frontend exports the
+        # same families from its own scorer — shared series)
+        class _HealthCollector:
+            def describe(self):
+                return []
+
+            def collect(self):
+                score = GaugeMetricFamily(
+                    "dyn_llm_worker_health_score",
+                    "Worker slowness ratio vs the fleet median "
+                    "(1.0 typical; >= DYN_EJECT_RATIO is an outlier)",
+                    labels=["instance"],
+                )
+                for wid, s in sorted(health.scores().items()):
+                    score.add_metric([f"{wid:x}"], float(s))
+                yield score
+                yield GaugeMetricFamily(
+                    "dyn_llm_workers_ejected",
+                    "Workers currently ejected from routing as latency "
+                    "outliers (probation trickle still flows)",
+                    value=float(len(health.ejected())),
+                )
+                ej = CounterMetricFamily(
+                    "dyn_llm_ejections",
+                    "Latency-outlier ejections by dominant slow signal",
+                    labels=["cause"],
+                )
+                for cause, v in sorted(health.ejections_total.items()):
+                    ej.add_metric([str(cause)], float(v))
+                yield ej
+
+        registry.register(_HealthCollector())
     g = Gauge(
         "dyn_llm_kv_hit_rate",
         "Router KV hit rate: matched / required prefill blocks",
@@ -107,6 +145,14 @@ class StandaloneRouter:
         # shed replies: backlog above the watermark over the measured
         # drain rate, instead of a constant (qos.DrainRateEstimator)
         self._drain = qos.DrainRateEstimator()
+        # tail-tolerance plane: scored from the workers' self-reported
+        # phase histograms (the same 1 s load scrape), so latency-ejected
+        # stragglers leave this router's candidate set too — a frontend
+        # retrying after a shed/failure must not be handed the same gray
+        # worker again
+        from dynamo_tpu.telemetry.health import HealthScorer
+
+        self.health = HealthScorer()
         # /metrics + /health for the routing brain itself (None disables):
         # KV hit rate, matched blocks, shed + decision counters
         self.metrics_port = metrics_port
@@ -117,6 +163,7 @@ class StandaloneRouter:
         from dynamo_tpu.kv_router.router import KvRouter
 
         client = await self.worker_endpoint.client()
+        client.health = self.health
         self.router = KvRouter(
             self.component,
             client,
@@ -124,6 +171,7 @@ class StandaloneRouter:
             config=self.kv_config,
         )
         await self.router.start()
+        self.router.scheduler.health = self.health
         self._aggregator = KvMetricsAggregator(
             self.component, self.worker_endpoint.id
         )
@@ -150,6 +198,7 @@ class StandaloneRouter:
             self.router.scheduler,
             lambda: self.decisions_total,
             lambda: self.shed_total,
+            health=self.health,
         )
         self._status_server = SystemStatusServer(
             port=self.metrics_port, registry=registry
@@ -177,9 +226,16 @@ class StandaloneRouter:
                     for m in per_worker.values()
                 )
                 self._load = (slots, load)
+                # the same scrape feeds the health plane: self-reported
+                # phase-hist deltas score each worker vs the fleet median
+                for wid, m in per_worker.items():
+                    self.health.observe_worker_hists(
+                        wid, m.phase_histograms
+                    )
             except Exception:  # noqa: BLE001 — missing stats = no shedding
                 self._load = (0, 0)
             self._load_at = now
+            self.health.tick()
         slots, load = self._load
         return bool(slots) and load >= slots * self.queue_factor
 

@@ -198,6 +198,11 @@ class Client:
         self._watch_task: Optional[asyncio.Task] = None
         self._rr_counter = 0
         self._change = asyncio.Event()
+        # tail-tolerance plane (telemetry/health.HealthScorer, optional):
+        # latency-ejected workers are excluded from selection alongside
+        # the caller's migration exclusions, so replays and round-robin
+        # both stop landing on a known straggler
+        self.health = None
 
     @property
     def drt(self) -> DistributedRuntime:
@@ -266,11 +271,18 @@ class Client:
 
     def _eligible(self, exclude: Optional[set[int]]) -> list[int]:
         """Live instances minus an exclusion set (workers a migrating
-        request just watched die). If exclusion would empty the pool, fall
-        back to the full list — a restarted worker may be healthy again."""
+        request just watched die) and minus latency-ejected workers (the
+        tail-tolerance plane's gray stragglers — alive but slow, so
+        replaying onto them burns the backoff budget for another slow
+        stream). If exclusion would empty the pool, fall back to the full
+        list — a restarted worker may be healthy again, and an ejected
+        one still beats nothing."""
         ids = self.instance_ids()
-        if exclude:
-            kept = [i for i in ids if i not in exclude]
+        avoid = set(exclude) if exclude else set()
+        if self.health is not None:
+            avoid |= self.health.routing_excluded()
+        if avoid:
+            kept = [i for i in ids if i not in avoid]
             if kept:
                 return kept
         return ids

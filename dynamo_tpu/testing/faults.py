@@ -11,6 +11,16 @@ Spec grammar — comma-separated ``key=value`` actions::
     DYN_FAULT="abort_after_tokens=5"        # abort all streams after N tokens
     DYN_FAULT="delay_dispatch=0.05"         # sleep S before each dispatch
     DYN_FAULT="delay_dispatch=0.2,every=4"  # ... but only every 4th dispatch
+    DYN_FAULT="slow_decode=5"               # SUSTAINED slowdown: every
+                                            # dispatch runs 5x slower (a
+                                            # gray worker — throttled, not
+                                            # dead)
+    DYN_FAULT="slow_decode=5,after=20"      # ... starting at dispatch 20
+    DYN_FAULT="slow_decode=5,every=3"       # ... on every 3rd dispatch
+    DYN_FAULT="gray_flap=5,period=2"        # OSCILLATING slowness: 5x slow
+                                            # for the first half of every
+                                            # 2-second cycle, healthy the
+                                            # other half
     DYN_FAULT="stall_transfer=1.5"          # sleep S in KV-transfer paths
     DYN_FAULT="drop_fabric_conn=3"          # drop the fabric conn once,
                                             # after N publishes
@@ -47,6 +57,16 @@ buffer event-plane publishes, and flush them on heal — with ZERO worker
 self-fences. ``fabric_flap`` opens the same window periodically (dark
 for S seconds at the start of every N-second cycle).
 
+``slow_decode`` is the SUSTAINED gray-worker fault (distinct from the
+one-shot ``delay_dispatch``): engines multiply each dispatch's duration
+by FACTOR (the mocker scales its simulated step cost; the JaxEngine
+sleeps out the difference after the real dispatch), so the worker stays
+alive, lease-healthy, and checksum-clean while being FACTOR-times slow —
+exactly the failure the tail-tolerance plane (telemetry/health.py) must
+catch. ``gray_flap`` oscillates the same slowdown (slow for the first
+half of every ``period``-second cycle) — the hysteresis test: the
+ejection state machine must not flap the route set in response.
+
 ``kill_after_tokens`` is the real-process fault (the worker dies exactly as
 a crashed decode worker would, mid-stream); ``abort_after_tokens`` is its
 in-process twin for engine-level chaos tests: the engine fails every live
@@ -78,6 +98,10 @@ class FaultSpec:
     abort_after_tokens: int = 0
     delay_dispatch_s: float = 0.0
     every: int = 1  # apply delay_dispatch/corrupt_kv on every Nth visit
+    slow_decode_factor: float = 0.0  # 0 = off; sustained per-step slowdown
+    after: int = 0  # slow_decode only fires from the Nth dispatch on
+    gray_flap_factor: float = 0.0  # 0 = off; oscillating slowdown
+    period_s: float = 2.0  # gray_flap cycle length (slow first half)
     stall_transfer_s: float = 0.0
     drop_fabric_conn: int = 0  # drop once, after N publishes (0 = off)
     corrupt_kv: str = ""  # "" = off | "bits" | "truncate"
@@ -103,6 +127,14 @@ class FaultSpec:
                 out.delay_dispatch_s = float(val)
             elif key == "every":
                 out.every = max(1, int(val))
+            elif key == "slow_decode":
+                out.slow_decode_factor = float(val)
+            elif key == "after":
+                out.after = max(0, int(val))
+            elif key == "gray_flap":
+                out.gray_flap_factor = float(val)
+            elif key == "period":
+                out.period_s = float(val)
             elif key == "stall_transfer":
                 out.stall_transfer_s = float(val)
             elif key == "drop_fabric_conn":
@@ -136,6 +168,7 @@ class FaultInjector:
         self.kv_payloads = 0  # corrupt_kv fault-point visits
         self._zombie_t0: Optional[float] = None  # partition window start
         self._fabric_t0: Optional[float] = None  # blackout/flap clock start
+        self._gray_t0: Optional[float] = None  # gray_flap clock start
         # observability for chaos tests
         self.fired: dict[str, int] = {}
 
@@ -168,6 +201,35 @@ class FaultInjector:
         if d and self.dispatches % self.spec.every == 0:
             self._mark("delay_dispatch")
             await asyncio.sleep(d)
+
+    def dispatch_slow_factor(self) -> float:
+        """Gray-worker fault point: engines multiply the CURRENT
+        dispatch's duration by the returned factor (1.0 = no fault).
+        ``slow_decode=F[,after=N][,every=K]`` is sustained slowness from
+        the Nth dispatch, on every Kth; ``gray_flap=F,period=S`` is slow
+        for the first half of every S-second cycle. Callers must have
+        counted the dispatch via on_dispatch() already."""
+        f = self.spec.slow_decode_factor
+        if f and f != 1.0:
+            if (
+                self.dispatches > self.spec.after
+                and self.dispatches % self.spec.every == 0
+            ):
+                self._mark("slow_decode")
+                return f
+            return 1.0
+        g = self.spec.gray_flap_factor
+        if g and g != 1.0:
+            import time
+
+            now = time.monotonic()
+            if self._gray_t0 is None:
+                self._gray_t0 = now
+            period = max(1e-3, self.spec.period_s)
+            if ((now - self._gray_t0) % period) < period / 2.0:
+                self._mark("gray_flap")
+                return g
+        return 1.0
 
     async def on_transfer(self) -> None:
         """KV-transfer paths (disagg ship, offload) call this."""

@@ -658,3 +658,158 @@ async def test_chaos_planner_wave_freezes_heals_never_fights_brownout():
             assert s2 - s1 >= 2, (s1, a, s2, b)
     # quiet end of trace: fleet scaled back down (cost actually saved)
     assert decisions[-1][1].decode < max(d.decode for _, d, _ in decisions)
+
+
+async def test_chaos_slow_worker_wave_hedge_and_eject(monkeypatch):
+    """ISSUE 12 satellite: one 5x straggler in a 4-worker mocker fleet
+    under mixed-priority load, with hedging + health ejection live.
+    Invariants: zero stuck streams (every consumer sees a final), all
+    streams token-identical to the deterministic mocker cycle,
+    interactive p99 TTFT bounded (the straggler must not own the tail),
+    KV conserved on every engine, and the tail plane never fights the
+    fleet planes — at most one ejection, zero eject/re-enter flaps, and
+    capacity-loss pressure fired exactly once per ejection."""
+    monkeypatch.setenv("DYN_HEDGE", "1")
+    from dynamo_tpu.discovery import RemoteEngine
+    from dynamo_tpu.pipeline.router import PushRouter, RouterMode
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+    from dynamo_tpu.telemetry.health import (
+        HealthConfig,
+        HealthScorer,
+        HedgeController,
+    )
+
+    rng = random.Random(20260804)
+    engines, drts = [], []
+
+    def handler_for(engine):
+        async def handler(request, ctx):
+            pre = PreprocessedRequest.from_dict(request)
+            async for out in engine.generate(pre, ctx):
+                yield out.to_dict()
+
+        return handler
+
+    for i in range(4):
+        drt = await DistributedRuntime.detached()
+        args = MockEngineArgs(
+            num_blocks=256, block_size=4, max_batch=16, speedup_ratio=1.0,
+            prefill_linear_s=1e-5, prefill_quadratic_s=0.0,
+            decode_per_token_s=0.003 * (5.0 if i == 0 else 1.0),
+        )
+        engine = MockEngine(args)
+        ep = drt.namespace("tailchaos").component("worker").endpoint(
+            "generate"
+        )
+        await ep.serve_endpoint(handler_for(engine))
+        engines.append(engine)
+        drts.append(drt)
+    front = await DistributedRuntime.detached()
+    client = await (
+        front.namespace("tailchaos").component("worker").endpoint("generate")
+    ).client()
+    await client.wait_for_instances()
+    capacity_loss = []
+    scorer = HealthScorer(
+        HealthConfig(
+            eject_ratio=3.0, eject_intervals=3, recover_ratio=1.5,
+            recover_intervals=4, min_healthy=1, probe_every=32,
+            alpha=0.4, stale_after_s=10.0,
+        ),
+        # the planner path: ejections surface as capacity-loss pressure
+        on_eject=lambda wid, cause: capacity_loss.append((wid, cause)),
+    )
+    client.health = scorer
+    hedger = HedgeController(budget_fraction=0.05, min_delay_ms=8.0)
+    remote = RemoteEngine(
+        PushRouter(client, RouterMode.ROUND_ROBIN),
+        health=scorer, hedger=hedger,
+    )
+    transitions = []
+    scorer.on_restore = lambda wid: transitions.append("restore")
+
+    async def ticker(stop):
+        while not stop.is_set():
+            scorer.tick()
+            await asyncio.sleep(0.1)
+
+    ttfts = {"interactive": [], "bulk": []}
+    outcomes = {"ok": 0, "error": 0, "cancel": 0}
+
+    async def one(i: int) -> None:
+        cls = "interactive" if i % 3 == 0 else "bulk"
+        prompt = [rng.randint(1, 63) for _ in range(rng.randint(2, 10))]
+        max_tokens = rng.randint(2, 8)
+        expected = [prompt[j % len(prompt)] for j in range(max_tokens)]
+        r = PreprocessedRequest(
+            token_ids=list(prompt),
+            sampling=SamplingOptions(),
+            stop=StopConditions(max_tokens=max_tokens),
+        )
+        r.extra["priority"] = cls
+        ctx = Context()
+        t0 = time.monotonic()
+        first = None
+        toks = []
+        async for out in remote(r, ctx):
+            if out.token_ids and first is None:
+                first = time.monotonic() - t0
+            toks.extend(out.token_ids)
+            if out.finish_reason is not None:
+                if out.error is not None:
+                    outcomes["error"] += 1
+                elif out.finish_reason.value == "cancelled":
+                    outcomes["cancel"] += 1
+                else:
+                    outcomes["ok"] += 1
+                    assert toks == expected, (toks, expected)
+                    if first is not None:
+                        ttfts[cls].append(first)
+                return
+
+    stop = asyncio.Event()
+    tick_task = asyncio.create_task(ticker(stop))
+    try:
+        # 5 waves x 24 requests: every stream must terminate
+        for wave in range(5):
+            await asyncio.wait_for(
+                asyncio.gather(*[one(wave * 24 + i) for i in range(24)]),
+                timeout=60,
+            )
+    finally:
+        stop.set()
+        await tick_task
+        await client.close()
+    try:
+        assert sum(outcomes.values()) == 120, outcomes
+        assert outcomes["error"] == 0 and outcomes["cancel"] == 0
+        # the straggler is ejected exactly once, with zero flaps, and
+        # the capacity-loss pressure fired once per ejection
+        total_ejections = sum(scorer.ejections_total.values())
+        assert total_ejections == 1, scorer.status()
+        assert transitions == [], "eject/re-enter flap under steady slow"
+        assert len(capacity_loss) == total_ejections
+        # the tail held: interactive p99 TTFT bounded well under the
+        # straggler's unhedged first-token time (~15ms+)
+        inter = sorted(ttfts["interactive"])
+        assert inter, "no interactive request completed"
+        p99 = inter[min(len(inter) - 1, int(0.99 * len(inter)))]
+        assert p99 < 1.0, f"interactive p99 TTFT {p99:.3f}s"
+        # hedge budget respected
+        assert hedger.hedges <= max(2, 0.05 * hedger.dispatches) + 1
+        # KV conserved everywhere (loser teardowns included)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(
+            e.active or e.waiting for e in engines
+        ):
+            await asyncio.sleep(0.05)
+        for i, e in enumerate(engines):
+            assert not e.active and not e.waiting, f"engine {i} busy"
+            assert all(n == 0 for n in e.cache.refs.values()), (
+                f"engine {i} leaked KV refs"
+            )
+            cached = len(e.cache.refs)
+            assert e.cache.free_blocks + cached == e.args.num_blocks
+    finally:
+        for drt in drts + [front]:
+            await drt.close()

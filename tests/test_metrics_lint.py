@@ -69,6 +69,14 @@ INTENTIONALLY_SHARED = {
     "dyn_planner_replicas_actual",
     "dyn_supervisor_restarts",
     "dyn_supervisor_quarantined",
+    # tail-tolerance plane (ISSUE 12): frontend (consumer-observed +
+    # self-reported scorer), metrics component (fleet scrape scorer),
+    # and standalone router (its own scorer) all export the score and
+    # ejection families; hedge families are frontend-only (hedging
+    # happens where dispatch happens)
+    "dyn_llm_worker_health_score",
+    "dyn_llm_workers_ejected",
+    "dyn_llm_ejections",
 }
 
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
@@ -77,6 +85,21 @@ UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
 class _StubScheduler:
     hit_stats = {"decisions": 0, "isl_blocks": 0, "matched_blocks": 0}
     hit_rate = 0.0
+
+
+class _StubHealth:
+    ejections_total = {"first_frame": 0}
+
+    def scores(self):
+        return {1: 1.0}
+
+    def ejected(self):
+        return set()
+
+
+class _StubHedger:
+    outcomes = {"won": 0, "lost": 0, "budget_denied": 0}
+    wasted_tokens = 0
 
 
 class _StubBrownout:
@@ -96,6 +119,7 @@ def _all_registries() -> dict[str, CollectorRegistry]:
                                 "num_accepted_tokens": 0})
     frontend.attach_kv_transfer_stats({})
     frontend.attach_kv_hit_stats(_StubScheduler())
+    frontend.attach_health(_StubHealth(), _StubHedger())
     frontend.attach_brownout(_StubBrownout())
     frontend.attach_engine_qos(
         {"preemptions_by_class": {}, "preempted_too_often": 0,
@@ -125,7 +149,7 @@ def _all_registries() -> dict[str, CollectorRegistry]:
         "frontend": frontend.registry,
         "component": component.registry,
         "router": build_router_registry(
-            _StubScheduler(), lambda: 0, lambda: 0
+            _StubScheduler(), lambda: 0, lambda: 0, health=_StubHealth()
         ),
         "system": SystemStatusServer().registry,
     }
@@ -282,6 +306,31 @@ def test_planner_families_present_with_correct_types():
         ):
             fam = by_role[role].get(name)
             assert fam is not None and fam.type == typ, (role, name)
+
+
+def test_tail_families_present_with_correct_types():
+    """ISSUE 12: the tail-tolerance families must exist with the right
+    semantics — score/ejected as gauges, ejections/hedges/wasted-tokens
+    as counters — on every role that exports them (hedge families are
+    frontend-only: hedging happens where dispatch happens)."""
+    regs = _all_registries()
+    by_role = {
+        role: {f.name: f for f in _families(reg)}
+        for role, reg in regs.items()
+    }
+    for role in ("frontend", "component", "router"):
+        for name, typ in (
+            ("dyn_llm_worker_health_score", "gauge"),
+            ("dyn_llm_workers_ejected", "gauge"),
+            ("dyn_llm_ejections", "counter"),
+        ):
+            fam = by_role[role].get(name)
+            assert fam is not None and fam.type == typ, (role, name)
+    for name in ("dyn_llm_hedges", "dyn_llm_hedge_wasted_tokens"):
+        fam = by_role["frontend"].get(name)
+        assert fam is not None and fam.type == "counter", name
+        for role in ("component", "router"):
+            assert name not in by_role[role], (role, name)
 
 
 def test_every_family_has_help_text():
