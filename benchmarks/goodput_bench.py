@@ -1,0 +1,392 @@
+"""Goodput-ledger bench (ISSUE 14): waste reconciliation, overhead A/B,
+and recompile forensics.
+
+Four banked sections, each with an enforced bar:
+
+  * ``waste_reconciliation`` — a mixed mocker workload (clean runs,
+    client cancels, hedged pairs with client-side loser cancellation,
+    mid-stream deadline expiries, migration resumes) where every wasted
+    token is ALSO counted client-side from the streams themselves. The
+    ledger's taxonomy must reconcile with that ground truth within 1%
+    (it is exact in practice — the bar absorbs nothing but races).
+  * ``spec_reconciliation`` — the tiny CPU model with self-drafting on:
+    the ledger's ``spec_rejected`` must equal the spec plane's own
+    ``num_draft_tokens - num_accepted_tokens`` (independent counters
+    maintained by the verify kernel's host loop).
+  * ``preempt_pressure`` — a block-starved two-class workload; every
+    preemption must waste at least the victim's prompt (the ledger
+    value is bounds-checked, since replay sizes are engine-internal).
+  * ``overhead_ab`` — mocker token throughput with the ledger recording
+    (DYN_GOODPUT=1, the default) vs disabled (DYN_GOODPUT=0); the
+    always-on cost must stay <= 2%.
+  * ``recompile_forensics`` — the engine's exact warm-label detector
+    wiring (EMA + RecompileDetector) driven over a forced shape-bucket
+    miss: exactly ONE ``dyn_llm_recompiles_total`` increment, carrying
+    the offending label, end-to-end through the Prometheus families.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.goodput_bench \
+        --json benchmarks/goodput_sweep.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import time
+
+
+def _make_engine(**kw):
+    from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+
+    args = dict(
+        block_size=16, speedup_ratio=1000.0, decode_per_token_s=0.01
+    )
+    args.update(kw)
+    return MockEngine(MockEngineArgs(**args))
+
+
+def _req(prompt, max_tokens, priority=None):
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    pre = PreprocessedRequest(
+        token_ids=list(prompt),
+        sampling=SamplingOptions(greedy=True),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+    )
+    if priority is not None:
+        pre.extra["priority"] = priority
+    return pre
+
+
+async def _consume(engine, request, ctx, stop_after=None):
+    """Stream to completion, counting every token the client actually
+    received (the ground truth the ledger must reconcile with). With
+    `stop_after`, cancels once that many tokens arrived — the stream
+    keeps draining until the engine acknowledges with CANCELLED."""
+    toks, final = [], None
+    async for out in engine.generate(request, ctx):
+        toks.extend(out.token_ids)
+        if (
+            stop_after is not None
+            and len(toks) >= stop_after
+            and not ctx.is_stopped()
+        ):
+            ctx.stop_generating()
+        if out.finish_reason is not None:
+            final = out
+    return toks, final
+
+
+async def _waste_workload() -> dict:
+    from dynamo_tpu.pipeline.context import Context
+    from dynamo_tpu.telemetry.health import HedgeController
+
+    engine = _make_engine()
+    hedger = HedgeController()
+    truth = {
+        "cancelled_partial": 0,
+        "hedge_loser": 0,
+        "deadline_partial": 0,
+        "migration_replay": 0,
+    }
+    goodput_tokens = 0
+
+    # clean runs: all output is goodput, no waste
+    for i in range(8):
+        toks, _ = await _consume(
+            engine, _req([(i + j) % 50 + 3 for j in range(12)], 16), Context()
+        )
+        goodput_tokens += len(toks)
+
+    # client cancels: the consumer walks away after ~5 tokens; everything
+    # it received is cancelled_partial on the engine's ledger
+    for i in range(6):
+        toks, final = await _consume(
+            engine, _req([60 + i, 61, 62], 400), Context(), stop_after=5
+        )
+        truth["cancelled_partial"] += len(toks)
+
+    # hedged pairs: the frontend races a duplicate, cancels the loser at
+    # its own first tokens, and attributes the loser's stream to the
+    # hedge budget (engine-side these are indistinguishable from cancels)
+    for i in range(6):
+        hedger.note_dispatch()
+        winner = asyncio.ensure_future(
+            _consume(engine, _req([80 + i, 81, 82, 83], 12), Context())
+        )
+        loser_toks, _ = await _consume(
+            engine, _req([80 + i, 81, 82, 83], 400), Context(), stop_after=3
+        )
+        hedger.note_outcome("won", wasted_tokens=len(loser_toks))
+        truth["hedge_loser"] += len(loser_toks)
+        w_toks, _ = await winner
+        goodput_tokens += len(w_toks)
+
+    # mid-stream deadline expiries: whatever streamed before the budget
+    # lapsed is deadline_partial
+    for i in range(4):
+        ctx = Context()
+        ctx.set_deadline_ms(40)
+        toks, final = await _consume(
+            engine, _req([100 + i, 101, 102], 5000), ctx
+        )
+        assert final.error["code"] == "deadline_exceeded", final
+        truth["deadline_partial"] += len(toks)
+
+    # migration resumes: a "dead worker" streamed `cut` tokens; the
+    # resume re-prefills exactly that replayed tail
+    for i in range(6):
+        prompt = [120 + i, 7, 3, 9, 4]
+        baseline, _ = await _consume(engine, _req(prompt, 12), Context())
+        cut = 6
+        resumed = _req(prompt + baseline[:cut], 12)
+        resumed.extra["resume_prompt_len"] = len(prompt)
+        tail, _ = await _consume(engine, resumed, Context())
+        truth["migration_replay"] += cut
+        goodput_tokens += len(baseline) + len(tail)
+
+    gp = engine.stats()["goodput"]
+    ledger = {c: gp.waste_by_cause.get(c, 0) for c in sorted(truth)}
+    # the engine books hedge losers as cancels; split them back out with
+    # the frontend hedger's attribution (exactly how /metrics exports)
+    ledger["hedge_loser"] = hedger.wasted_tokens
+    ledger["cancelled_partial"] -= hedger.wasted_tokens
+    errors = {
+        c: abs(ledger[c] - truth[c]) / max(1, truth[c]) * 100.0
+        for c in truth
+    }
+    await engine.close()
+    return {
+        "goodput_tokens": goodput_tokens,
+        "ledger": ledger,
+        "client_truth": truth,
+        "reconcile_err_pct": {c: round(e, 3) for c, e in errors.items()},
+        "reconcile_err_pct_max": round(max(errors.values()), 3),
+        "bar_pct": 1.0,
+        "pass": max(errors.values()) <= 1.0,
+    }
+
+
+def _spec_reconciliation(n_requests: int, osl: int) -> dict:
+    """Tiny CPU model, self-drafting on: the ledger's spec_rejected vs
+    the spec plane's own draft/accept counters."""
+    from benchmarks.spec_smoke import build_engine, make_workload, run_one
+
+    engine, _cfg = build_engine(spec_k=2)
+    workload = make_workload("repetitive", n_requests, 256, 64, osl)
+    asyncio.run(run_one(engine, workload, concurrency=2))
+    stats = engine.stats
+    gp = stats.goodput
+    drafted = stats.num_draft_tokens
+    accepted = stats.num_accepted_tokens
+    rejected = gp.waste_by_cause.get("spec_rejected", 0)
+    asyncio.run(engine.close())
+    return {
+        "draft_tokens": drafted,
+        "accepted_tokens": accepted,
+        "ledger_spec_rejected": rejected,
+        "expected_spec_rejected": drafted - accepted,
+        "pass": rejected == drafted - accepted and drafted > 0,
+    }
+
+
+async def _preempt_pressure() -> dict:
+    from dynamo_tpu.pipeline.context import Context
+
+    engine = _make_engine(
+        num_blocks=12, block_size=4, max_batch=4, speedup_ratio=500.0,
+        watermark=0.0, preempt_backoff_ms=1.0,
+    )
+    bulk = asyncio.ensure_future(
+        _consume(engine, _req(list(range(1, 9)), 30, priority="bulk"),
+                 Context())
+    )
+    deadline = time.monotonic() + 10.0
+    while not any(
+        s.priority == "bulk" and 1 <= s.generated <= 8 for s in engine.active
+    ):
+        if time.monotonic() > deadline or bulk.done():
+            break
+        await asyncio.sleep(0.0005)
+    inter = asyncio.ensure_future(
+        _consume(engine, _req(list(range(40, 48)), 30,
+                              priority="interactive"), Context())
+    )
+    await asyncio.gather(bulk, inter)
+    gp = engine.stats()["goodput"]
+    n_preempt = sum(engine.preemptions_by_class.values())
+    waste = gp.waste_by_cause.get("preempt_replay", 0)
+    await engine.close()
+    # replay sizes are engine-internal; every preemption must waste at
+    # least the victim's 8-token prompt and at most prompt + max_tokens
+    return {
+        "preemptions": n_preempt,
+        "ledger_preempt_replay": waste,
+        "min_expected": 8 * n_preempt,
+        "max_expected": (8 + 30) * n_preempt,
+        "pass": n_preempt >= 1
+        and 8 * n_preempt <= waste <= (8 + 30) * n_preempt,
+    }
+
+
+async def _throughput(requests: int, prompt: int, tokens: int) -> float:
+    from dynamo_tpu.pipeline.context import Context
+
+    engine = _make_engine(speedup_ratio=1e6, decode_per_token_s=0.001)
+
+    async def one(i: int) -> int:
+        toks, _ = await _consume(
+            engine,
+            _req([(i + j) % 512 + 3 for j in range(prompt)], tokens),
+            Context(),
+        )
+        return len(toks)
+
+    t0 = time.monotonic()
+    counts = await asyncio.gather(*(one(i) for i in range(requests)))
+    dt = time.monotonic() - t0
+    await engine.close()
+    return sum(counts) / dt
+
+
+def _overhead_ab(requests: int, prompt: int, tokens: int, repeats: int) -> dict:
+    """A/B the always-on ledger against DYN_GOODPUT=0 at a huge mocker
+    speedup (simulated sleeps vanish; host scheduling work — the path
+    the ledger rides — dominates). Best-of-N per mode to shed CI noise."""
+    out = {}
+    prior = os.environ.get("DYN_GOODPUT")
+    try:
+        for mode, env in (("on", "1"), ("off", "0")):
+            os.environ["DYN_GOODPUT"] = env
+            best = 0.0
+            for _ in range(repeats):
+                best = max(
+                    best, asyncio.run(_throughput(requests, prompt, tokens))
+                )
+            out[mode] = round(best, 1)
+    finally:
+        if prior is None:
+            os.environ.pop("DYN_GOODPUT", None)
+        else:
+            os.environ["DYN_GOODPUT"] = prior
+    overhead = (out["off"] - out["on"]) / out["off"] * 100.0
+    return {
+        "tokens_per_s_on": out["on"],
+        "tokens_per_s_off": out["off"],
+        "overhead_pct": round(overhead, 2),
+        "bar_pct": 2.0,
+        "pass": overhead <= 2.0,
+    }
+
+
+def _recompile_forensics() -> dict:
+    """Exactly the engine's _dispatch wiring (EMA + RecompileDetector +
+    ledger), driven over a warm label and ONE forced shape-bucket miss,
+    exported through the shared Prometheus families."""
+    from prometheus_client import generate_latest
+
+    from dynamo_tpu.http.metrics import ServiceMetrics
+    from dynamo_tpu.telemetry.goodput import GoodputLedger, RecompileDetector
+
+    gp = GoodputLedger(enabled=True)
+    det = RecompileDetector(min_s=0.2, factor=10.0)
+    ema = 0.0
+    label = "decode"
+
+    def dispatch(elapsed_s: float, lanes: int):
+        nonlocal ema
+        if label not in gp.compile_s_by_label:
+            gp.record_compile(label, elapsed_s)
+        elif det.is_recompile(elapsed_s, ema):
+            gp.record_recompile(
+                label, "shape_miss", shape=f"lanes={lanes},tokens=0"
+            )
+        ema = elapsed_s if ema == 0.0 else 0.9 * ema + 0.1 * elapsed_s
+        gp.record_step(label, elapsed_s, lanes=lanes, capacity=8)
+
+    dispatch(5.0, 1)  # first touch: the label's compile, not a recompile
+    for _ in range(200):
+        dispatch(0.004, 4)  # warm steady state
+    dispatch(2.5, 7)  # the forced shape-bucket miss: ~600x the EMA
+    for _ in range(50):
+        dispatch(0.004, 4)  # recovered: no further increments
+
+    metrics = ServiceMetrics()
+    metrics.attach_goodput({"goodput": gp})
+    sample = None
+    for line in generate_latest(metrics.registry).decode().splitlines():
+        if line.startswith("dyn_llm_recompiles_total{"):
+            sample = line
+    expected = (
+        'dyn_llm_recompiles_total{cause="shape_miss",label="decode"} 1.0'
+    )
+    return {
+        "dispatches": gp.steps_total,
+        "recompiles": dict(gp.recompiles),
+        "exported_sample": sample,
+        "compile_s": gp.compile_s_by_label,
+        "pass": gp.recompiles == {"decode|shape_miss": 1}
+        and sample == expected,
+    }
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--prompt-tokens", type=int, default=32)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--spec-requests", type=int, default=4)
+    ap.add_argument("--spec-osl", type=int, default=24)
+    ap.add_argument("--skip-spec", action="store_true",
+                    help="skip the tiny-model spec section (no jax)")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    doc: dict = {"bench": "goodput", "sections": {}}
+    print("== waste reconciliation (mixed mocker workload) ==")
+    doc["sections"]["waste_reconciliation"] = asyncio.run(_waste_workload())
+    print(json.dumps(doc["sections"]["waste_reconciliation"], indent=1))
+
+    if args.skip_spec:
+        doc["sections"]["spec_reconciliation"] = {"skipped": True}
+    else:
+        print("== spec reconciliation (tiny model, spec_k=2) ==")
+        doc["sections"]["spec_reconciliation"] = _spec_reconciliation(
+            args.spec_requests, args.spec_osl
+        )
+        print(json.dumps(doc["sections"]["spec_reconciliation"], indent=1))
+
+    print("== preemption pressure ==")
+    doc["sections"]["preempt_pressure"] = asyncio.run(_preempt_pressure())
+    print(json.dumps(doc["sections"]["preempt_pressure"], indent=1))
+
+    print("== overhead A/B (DYN_GOODPUT on vs off) ==")
+    doc["sections"]["overhead_ab"] = _overhead_ab(
+        args.requests, args.prompt_tokens, args.max_tokens, args.repeats
+    )
+    print(json.dumps(doc["sections"]["overhead_ab"], indent=1))
+
+    print("== recompile forensics (forced shape-bucket miss) ==")
+    doc["sections"]["recompile_forensics"] = _recompile_forensics()
+    print(json.dumps(doc["sections"]["recompile_forensics"], indent=1))
+
+    doc["pass"] = all(
+        s.get("pass", True) for s in doc["sections"].values()
+    )
+    print(json.dumps({"pass": doc["pass"]}))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    return doc
+
+
+if __name__ == "__main__":
+    main()

@@ -260,7 +260,7 @@ def main() -> None:
         choices=[
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
             "priority", "integrity", "decode_mfu", "blackout", "planner",
-            "tail",
+            "tail", "goodput",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -308,7 +308,13 @@ def main() -> None:
         "tail = tail-tolerance sweep (one 5x gray straggler in a "
         "4-worker mocker fleet: hedged-vs-unhedged p99 TTFT, ejection "
         "count, hedge overhead accounting, gray-flap hysteresis; "
-        "banked artifact benchmarks/tail_sweep.json)",
+        "banked artifact benchmarks/tail_sweep.json). "
+        "goodput = delegates to benchmarks.goodput_bench (token-waste "
+        "taxonomy reconciled against client-side ground truth <=1%, "
+        "spec_rejected vs the spec plane's own counters, DYN_GOODPUT "
+        "on/off overhead <=2%, and a forced shape-bucket miss producing "
+        "exactly one labelled recompile increment; banked artifact "
+        "benchmarks/goodput_sweep.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -392,6 +398,17 @@ def main() -> None:
 
         tail_sweep.main(
             ["--json", args.json or "benchmarks/tail_sweep.json"]
+        )
+        return
+    if args.preset == "goodput":
+        # goodput-ledger sweep runs on the mocker + tiny spec engine
+        # directly (waste reconciliation, overhead A/B, recompile
+        # forensics) — one entry point for every banked curve stays
+        # `perf_sweep --preset X`
+        from benchmarks import goodput_bench
+
+        goodput_bench.main(
+            ["--json", args.json or "benchmarks/goodput_sweep.json"]
         )
         return
     if args.preset == "slo":

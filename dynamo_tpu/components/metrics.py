@@ -58,6 +58,11 @@ from dynamo_tpu.runtime.http_server import SystemStatusServer
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import EndpointId
 from dynamo_tpu.telemetry import slo as dslo
+from dynamo_tpu.telemetry.goodput import (
+    WASTE_CAUSES,
+    GoodputLedger,
+    GoodputStats,
+)
 from dynamo_tpu.telemetry.health import HealthScorer
 from dynamo_tpu.telemetry.histogram import BOUNDS, NUM_BUCKETS, PhaseHistograms
 
@@ -186,6 +191,9 @@ class _FleetCollector:
         )
         ph = agg.phase_histograms if agg is not None else None
         yield from self._phase_families(ph)
+        yield from goodput_families(
+            agg.goodput if agg is not None else None
+        )
         yield from self._health_families()
         yield from self._slo_families()
         yield from planner_families(self.component.planner_status)
@@ -286,6 +294,116 @@ class _FleetCollector:
         )
 
 
+def goodput_families(
+    gp: Optional[GoodputStats], hedge_loser_tokens: float = 0.0
+):
+    """Scrape-time `dyn_llm_step_*` / waste / recompile families from a
+    merged GoodputStats (telemetry/goodput.py, ISSUE 14). Shared between
+    the metrics component (fleet-merged) and a frontend's attach_goodput
+    (colocated engine) — same names, same types, merged views add.
+    `hedge_loser_tokens` overlays the frontend HedgeController's waste on
+    the taxonomy: hedge losers are attributed where hedging happens (the
+    engine only sees a consumer disconnect, i.e. cancelled_partial)."""
+    hist = HistogramMetricFamily(
+        f"{PREFIX}_step_duration_seconds",
+        "Device-step duration per dispatch label (merged fixed-log "
+        "bucket histograms; one observation per engine dispatch)",
+        labels=["label"],
+    )
+    if gp is not None:
+        for label in sorted(gp.step_hists.phases):
+            h = gp.step_hists.phases[label]
+            buckets = []
+            cum = 0
+            lo = 0
+            for idx in _EXPORT_IDX:
+                cum += sum(h.counts[lo : idx + 1])
+                lo = idx + 1
+                buckets.append((f"{BOUNDS[idx] / 1e3:.9g}", float(cum)))
+            buckets.append(("+Inf", float(h.count)))
+            hist.add_metric([label], buckets=buckets, sum_value=h.sum_ms / 1e3)
+    yield hist
+    yield CounterMetricFamily(
+        f"{PREFIX}_steps",
+        "Engine device dispatches (fleet sum over all labels)",
+        value=float(gp.steps_total if gp is not None else 0),
+    )
+    yield GaugeMetricFamily(
+        f"{PREFIX}_step_occupancy",
+        "Decode-family lane occupancy: lanes occupied / lane capacity, "
+        "summed over steps (1.0 = every dispatched step ran full)",
+        value=float(gp.occupancy if gp is not None else 0.0),
+    )
+    yield CounterMetricFamily(
+        f"{PREFIX}_phase_bubble_seconds",
+        "Device idle time between consecutive dispatches while work was "
+        "in flight (the phase-transition bubble; fleet sum)",
+        value=float(gp.bubble_s_total if gp is not None else 0.0),
+    )
+    tokens = CounterMetricFamily(
+        f"{PREFIX}_device_tokens",
+        "Tokens through the device by phase: prefill tokens consumed and "
+        "decode tokens emitted (fleet sum)",
+        labels=["phase"],
+    )
+    tokens.add_metric(
+        ["prefill"], float(gp.prefill_tokens if gp is not None else 0)
+    )
+    tokens.add_metric(
+        ["decode"], float(gp.decode_tokens if gp is not None else 0)
+    )
+    yield tokens
+    waste = CounterMetricFamily(
+        f"{PREFIX}_tokens_wasted",
+        "Scheduled-then-discarded tokens by cause (spec_rejected / "
+        "preempt_replay / migration_replay / deadline_partial / "
+        "cancelled_partial / hedge_loser; fleet sum)",
+        labels=["cause"],
+    )
+    by_cause = dict(gp.waste_by_cause) if gp is not None else {}
+    if hedge_loser_tokens:
+        by_cause["hedge_loser"] = by_cause.get("hedge_loser", 0) + int(
+            hedge_loser_tokens
+        )
+    for cause in WASTE_CAUSES:
+        waste.add_metric([cause], float(by_cause.get(cause, 0)))
+    yield waste
+    rec = CounterMetricFamily(
+        f"{PREFIX}_recompiles",
+        "Unexpected post-warmup XLA recompiles by dispatch label and "
+        "cause (shape_miss = unbucketed shape; prebake_miss = drifted "
+        "prebaked cache)",
+        labels=["label", "cause"],
+    )
+    for key, v in sorted((gp.recompiles if gp is not None else {}).items()):
+        label, _, cause = str(key).partition("|")
+        rec.add_metric([label, cause or "shape_miss"], float(v))
+    yield rec
+    comp = GaugeMetricFamily(
+        f"{PREFIX}_compile_seconds",
+        "First-dispatch (compile-inclusive) wall time per dispatch label "
+        "(fleet max — the worst cold-start cost)",
+        labels=["label"],
+    )
+    for label, v in sorted(
+        (gp.compile_s_by_label if gp is not None else {}).items()
+    ):
+        comp.add_metric([label], float(v))
+    yield comp
+    yield GaugeMetricFamily(
+        f"{PREFIX}_mfu_achieved",
+        "Achieved decode MFU from real dispatch shapes through the "
+        "roofline model (fleet mean)",
+        value=float(gp.mfu_achieved if gp is not None else 0.0),
+    )
+    yield GaugeMetricFamily(
+        f"{PREFIX}_hbm_bytes_per_token_achieved",
+        "Achieved HBM bytes per emitted token from real dispatch shapes "
+        "(fleet mean)",
+        value=float(gp.hbm_bytes_per_token if gp is not None else 0.0),
+    )
+
+
 def planner_families(status: Optional[dict]):
     """Scrape-time `dyn_planner_*` / `dyn_supervisor_*` families from a
     planner-published status dict (Planner.status() wire form under
@@ -357,6 +475,7 @@ class MetricsComponent:
         self.registry = CollectorRegistry()
         self.server = SystemStatusServer(port=port, registry=self.registry)
         self.server.add_route("/debug/slo", self._debug_slo)
+        self.server.add_route("/debug/goodput", self._debug_goodput)
         # fleet SLO engine over the merged phase histograms; transitions
         # publish `slo-status` on the namespace (the planner's SLA hook)
         self.slo = dslo.SloEngine(
@@ -488,6 +607,9 @@ class MetricsComponent:
         self._overlap_sum = 0
         self._tasks: list[asyncio.Task] = []
         self.last: Optional[ForwardPassMetrics] = None
+        # latest per-worker scrape, kept for /debug/goodput's per-worker
+        # view (the fleet-merged view comes from self.last.goodput)
+        self.last_per_worker: dict[int, ForwardPassMetrics] = {}
         # latest planner-published status (PLANNER_STATUS_KEY), refreshed
         # by the poll loop; renders as dyn_planner_*/dyn_supervisor_*
         self.planner_status: dict = {}
@@ -543,6 +665,24 @@ class MetricsComponent:
             }
         )
 
+    async def _debug_goodput(self, request: web.Request) -> web.Response:
+        """Fleet-merged goodput ledger plus the per-worker views it was
+        merged from (GoodputStats.summary() both levels)."""
+        agg = self.last
+        fleet = (
+            agg.goodput.summary()
+            if agg is not None and agg.goodput is not None
+            else None
+        )
+        workers = {
+            f"{wid:x}": m.goodput.summary()
+            for wid, m in sorted(self.last_per_worker.items())
+            if m.goodput is not None
+        }
+        return web.json_response(
+            {"scope": "fleet", "fleet": fleet, "workers": workers}
+        )
+
     # -------------------------------------------------------------- loops
 
     async def _poll_loop(self) -> None:
@@ -551,6 +691,7 @@ class MetricsComponent:
                 per_worker = await self.aggregator.collect()
                 agg = await self.aggregator.aggregate(per_worker)
                 self.last = agg
+                self.last_per_worker = per_worker
                 for wid, m in per_worker.items():
                     self.health.observe_worker_hists(
                         wid, m.phase_histograms
@@ -684,6 +825,11 @@ class MockWorkerMetrics:
         )
         self._xfer = KvTransferStats()
         self.hist = PhaseHistograms()
+        # goodput ledger (ISSUE 14): always-on here regardless of env so
+        # the efficiency dashboards render engine-free. Steps ride a
+        # simulated clock, so bubbles/occupancy are exact and repeatable.
+        self.goodput = GoodputLedger(enabled=True)
+        self._sim_t = 0.0
 
     def snapshot(self) -> ForwardPassMetrics:
         self._t += 1.0
@@ -758,6 +904,51 @@ class MockWorkerMetrics:
             self._fenced_rejects["dispatch"] = (
                 self._fenced_rejects.get("dispatch", 0) + 1
             )
+        # goodput ledger: one prefill + a decode burst per synthetic
+        # request on the simulated clock (1 ms scheduling bubble between
+        # dispatches), waste consistent with the other synthetic planes —
+        # spec rejects match the 3-of-4 acceptance above, preempt replays
+        # match preemptions_by_class, deadline partials match
+        # num_deadline_exceeded
+        gp = self.goodput
+        if self._t == 1.0:
+            gp.record_compile("prefill", 6.0)
+            gp.record_compile("decode", 11.0)
+        lanes = max(1, int(self.total_slots * load))
+        t = self._sim_t
+        for i in range(reqs):
+            scale = (0.7 + 0.6 * load + 4.0 * overload + 0.05 * i) * max(
+                0.01, self.slow_factor
+            )
+            t += 0.001
+            dur = 0.040 * scale
+            gp.record_step("prefill", dur, prefill_tokens=256, t_start=t)
+            t += dur
+            for _ in range(4):
+                t += 0.001
+                dur = self.itl_ms / 1e3 * scale
+                gp.record_step(
+                    "decode",
+                    dur,
+                    lanes=lanes,
+                    capacity=self.total_slots,
+                    t_start=t,
+                )
+                t += dur
+        self._sim_t = t
+        gp.record_decode_tokens(4 * reqs)
+        gp.record_waste("spec_rejected", reqs)  # 1 of 4 drafts rejected
+        if load > 0.8:
+            gp.record_waste("preempt_replay", 2 * 128)
+        if load > 0.95:
+            gp.record_waste("deadline_partial", 32)
+        if self._t % 250 == 50:
+            gp.record_waste("cancelled_partial", 16)
+        if self._t % 1000 == 500:
+            gp.record_recompile(
+                "decode", "shape_miss", shape=f"lanes={lanes},tokens=0"
+            )
+        gp.set_perf_gauges(0.05 * load, 4e8 / (1.0 + 3.0 * load))
         return ForwardPassMetrics(
             worker_stats=WorkerStats(
                 request_active_slots=int(self.total_slots * load),
@@ -791,6 +982,7 @@ class MockWorkerMetrics:
             spec_decode_stats=self._spec,
             kv_transfer_stats=self._xfer,
             phase_histograms=self.hist,
+            goodput=self.goodput,
         )
 
     async def start(self) -> None:

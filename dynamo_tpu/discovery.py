@@ -838,6 +838,11 @@ class ModelWatcher:
             # tail metric families (attach-once: first endpoint wins,
             # same contract as attach_kv_hit_stats)
             self.metrics.attach_health(health, hedger)
+            # hedge losers ride the goodput waste taxonomy too — the only
+            # frontend-attributable cause (the engine sees a loser as a
+            # plain consumer disconnect); no engine ledger here, remote
+            # workers report theirs via the fabric scrape
+            self.metrics.attach_goodput(None, hedger)
         if entry.name not in self._capacity_pollers:
             # the poller doubles as the health plane's scrape loop, so it
             # runs with or without admission control

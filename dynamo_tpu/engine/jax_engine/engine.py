@@ -45,6 +45,12 @@ from dynamo_tpu.protocols.common import (
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry import profile as dprofile
 from dynamo_tpu.telemetry import trace as dtrace
+from dynamo_tpu.telemetry.goodput import (
+    GoodputLedger,
+    RecompileDetector,
+    load_prebaked_labels,
+    normalize_label,
+)
 from dynamo_tpu.telemetry.histogram import PhaseHistograms
 from dynamo_tpu.tokens import TokenBlockSequence
 
@@ -185,6 +191,12 @@ class EngineStats:
     # addition (telemetry/histogram.py). Unlike spans (DYN_TRACE-gated),
     # an observe() is a bisect + two adds — cheap enough to never gate.
     phase_histograms: PhaseHistograms = field(default_factory=PhaseHistograms)
+    # goodput ledger (ISSUE 14): per-device-step efficiency accounting —
+    # step-duration histograms by dispatch label, occupancy, phase
+    # bubbles, the token-waste taxonomy, compile/recompile forensics, and
+    # achieved MFU/HBM gauges. Always-on (DYN_GOODPUT=0 disables); ships
+    # on ForwardPassMetrics and merges fleet-wide like the histograms.
+    goodput: GoodputLedger = field(default_factory=GoodputLedger)
 
     @property
     def kv_usage(self) -> float:
@@ -395,6 +407,18 @@ class JaxEngine:
         self._dispatch_ema: dict[str, float] = {}
         self._watchdog_task: Optional[asyncio.Task] = None
         self._tripped = False
+        # recompile forensics (ISSUE 14): a warm label dispatching far off
+        # its EMA is an unexpected serve-time XLA compile; labels covered
+        # by tools/prebake_cache.py count separately (cache drift)
+        self._recompile = RecompileDetector()
+        try:
+            from dynamo_tpu.runtime.config import default_jax_cache_dir
+
+            self._prebaked_labels = load_prebaked_labels(
+                default_jax_cache_dir()
+            )
+        except Exception:  # noqa: BLE001 — forensics must never block boot
+            self._prebaked_labels = frozenset()
         # Disaggregation (SURVEY §7.6): when both are set, long prompts are
         # shipped to the prefill fleet instead of running locally.
         self.disagg_router = disagg_router
@@ -545,6 +569,13 @@ class JaxEngine:
                 )
                 return
         seq = _Sequence(next(self._seq_ids), request, context)
+        if seq.num_prompt < len(request.token_ids):
+            # in-flight migration resume: the tail past resume_prompt_len
+            # was already streamed by a dead worker, but its KV must be
+            # re-prefilled here — replayed work, not new goodput
+            self.stats.goodput.record_waste(
+                "migration_replay", len(request.token_ids) - seq.num_prompt
+            )
         if dtrace.enabled():
             self._sp_begin(
                 seq, "queue_wait",
@@ -624,10 +655,19 @@ class JaxEngine:
 
     # ---------------------------------------------------------- watchdog
 
-    async def _dispatch(self, label: str, fn) -> Any:
+    async def _dispatch(
+        self,
+        label: str,
+        fn,
+        *,
+        lanes: int = 0,
+        capacity: int = 0,
+        tokens: int = 0,
+    ) -> Any:
         """Run one device dispatch in the executor, visible to the
         stuck-horizon watchdog (and to fault injection). Callers hold
-        self._device_lock."""
+        self._device_lock. `lanes`/`capacity` (decode-family steps) and
+        `tokens` (prefill chunk size) feed the goodput ledger."""
         slow_factor = 1.0
         if faults.active():
             inj = faults.get_injector()
@@ -664,6 +704,46 @@ class JaxEngine:
             self._dispatch_ema[label] = (
                 elapsed if ema is None else 0.8 * ema + 0.2 * elapsed
             )
+            gp = self.stats.goodput
+            if gp.enabled:
+                if ema is None:
+                    # first dispatch of this label includes its XLA
+                    # compile (same fact the cold watchdog budget uses)
+                    gp.record_compile(label, elapsed)
+                    if (
+                        normalize_label(label) in self._prebaked_labels
+                        and elapsed >= self._recompile.min_s
+                    ):
+                        # a prebaked label should boot as a cache HIT;
+                        # a compile-sized first dispatch is cache drift
+                        gp.record_recompile(
+                            label,
+                            "prebake_miss",
+                            shape=f"lanes={lanes},tokens={tokens}",
+                        )
+                elif self._recompile.is_recompile(elapsed, ema):
+                    cause = (
+                        "prebake_miss"
+                        if normalize_label(label) in self._prebaked_labels
+                        else "shape_miss"
+                    )
+                    gp.record_recompile(
+                        label,
+                        cause,
+                        shape=f"lanes={lanes},tokens={tokens}",
+                    )
+                gp.record_step(
+                    label,
+                    elapsed,
+                    lanes=lanes,
+                    capacity=capacity,
+                    prefill_tokens=tokens,
+                    t_start=t0,
+                )
+                if dtrace.enabled():
+                    dtrace.counter("step_ms", elapsed * 1e3)
+                    if capacity > 0:
+                        dtrace.counter("occupancy", lanes / capacity)
 
     async def _watchdog_loop(self) -> None:
         poll = max(0.02, min(1.0, self.config.watchdog_min_s / 4))
@@ -1145,6 +1225,13 @@ class JaxEngine:
         victim.preemptions += 1
         by_class = self.stats.preemptions_by_class
         by_class[victim.priority] = by_class.get(victim.priority, 0) + 1
+        # goodput ledger: every token whose device KV this preemption
+        # discards must be recomputed on re-admission (the host-tier spill
+        # below may onboard some back — counted as an upper bound)
+        self.stats.goodput.record_waste(
+            "preempt_replay",
+            victim.prefill_pos if victim.prefilling else len(victim.token_ids),
+        )
         if victim.preemptions > self.config.max_preemptions:
             self.stats.preempted_too_often += 1
             self._sp_event(victim, "preempted_too_often")
@@ -1261,6 +1348,9 @@ class JaxEngine:
                     self._wake.clear()
                     if self._closed:
                         return
+                    # idle (no work anywhere): the gap to the next
+                    # dispatch is not a phase bubble
+                    self.stats.goodput.mark_idle()
                     await self._wake.wait()
                 else:
                     # remote prefills in flight (or unadmittable backlog):
@@ -1320,11 +1410,22 @@ class JaxEngine:
                 self.stats.deadline_exceeded += 1
                 seq.ctx.kill()  # cascade: frees child work, then the lane
                 self._sp_event(seq, "deadline_exceeded", phase="decode")
+                # partial output discarded: the consumer gets an error,
+                # not the tokens this lane already generated
+                self.stats.goodput.record_waste(
+                    "deadline_partial", seq.num_generated
+                )
                 self._finish_error(
                     seq, "decode", "deadline exceeded mid-generation",
                     "deadline_exceeded",
                 )
             elif seq.ctx.is_killed():
+                # consumer disconnected (plain cancel or a hedge loser —
+                # the engine cannot tell; the frontend hedger attributes
+                # hedge_loser from its side)
+                self.stats.goodput.record_waste(
+                    "cancelled_partial", seq.num_generated
+                )
                 self._finish(seq, FinishReason.CANCELLED)
 
     async def _admit_phase(self, loop) -> bool:
@@ -1475,6 +1576,7 @@ class JaxEngine:
                             eos_suppress=seq.needs_eos_suppress,
                         )
                     ),
+                    tokens=len(replay),
                 )
             # the admission pass may have prebuilt the identical chain for
             # the prefix lookup — reuse instead of re-hashing the prompt
@@ -1528,6 +1630,7 @@ class JaxEngine:
                         eos_suppress=seq.needs_eos_suppress,
                     )
                 ),
+                tokens=len(seq.token_ids),
             )
         self._append_sample(seq, sample)
 
@@ -1549,6 +1652,7 @@ class JaxEngine:
                 lambda: self.runner.fetch_sample(
                     self.runner.prefill_packed_arrays(**packed)
                 ),
+                tokens=sum(len(s.token_ids) for s in group),
             )
         toks, lps, tids, tlps = sample
         for i, seq in enumerate(group):
@@ -1593,7 +1697,9 @@ class JaxEngine:
                 )
                 return self.runner.fetch_sample(out) if final else None
 
-            sample = await self._dispatch("prefill_chunk", run_chunk)
+            sample = await self._dispatch(
+                "prefill_chunk", run_chunk, tokens=len(chunk)
+            )
         if seq.spans:
             sp = seq.spans.get("prefill")
             if sp is not None and len(sp.events) < 64:
@@ -2081,7 +2187,9 @@ class JaxEngine:
                             eos_suppress=getattr(req, "eos_suppress", False),
                         )
 
-                    out = await self._dispatch("prefill_chunk", run_chunk)
+                    out = await self._dispatch(
+                        "prefill_chunk", run_chunk, tokens=len(chunk)
+                    )
                 pos += len(chunk)
                 # ship the blocks this chunk completed (the partial tail
                 # stays for the final frame so the decode side has exactly
@@ -2375,6 +2483,8 @@ class JaxEngine:
                         eos_mask=eos_mask,
                     )
                 ),
+                lanes=len(active),
+                capacity=self.config.max_batch,
             )
         if dtrace.enabled():
             self._sp_batch_event(active, "decode_step", batch=len(active))
@@ -2529,6 +2639,8 @@ class JaxEngine:
                         penalties=penalties,
                     )
                 ),
+                lanes=len(active),
+                capacity=self.config.max_batch,
             )
         if dtrace.enabled():
             self._sp_batch_event(
@@ -2563,6 +2675,12 @@ class JaxEngine:
                 if seq.slot is None or (h < len(d) and not accept):
                     break
             if d:
+                # verify premium paid for rejected draft positions: the
+                # device computed len(d)+1 positions but only
+                # lane_accepted drafts landed
+                self.stats.goodput.record_waste(
+                    "spec_rejected", len(d) - lane_accepted
+                )
                 if lane_accepted:
                     seq.spec_fail = 0
                 else:
@@ -2655,6 +2773,8 @@ class JaxEngine:
                             penalties=penalties,
                         )
                     ),
+                    lanes=len(active),
+                    capacity=self.config.max_batch,
                 )
         except Exception:  # noqa: BLE001
             if not self.config.lazy_horizon:
@@ -2719,6 +2839,7 @@ class JaxEngine:
     ) -> None:
         """Record a newly generated token: stream it, grow blocks, stop."""
         self.stats.generated_tokens += 1
+        self.stats.goodput.record_decode_tokens()
         if seq.spans and "decode" not in seq.spans:
             # first token: the prefill phase (local or remote) is over
             self._sp_finish(seq, "prefill")
@@ -2883,4 +3004,14 @@ class JaxEngine:
             rate = (self.stats.generated_tokens - win[0][1]) / dt
             self.stats.mfu_decode_est = perf_model.mfu_decode_est(
                 mcfg, rate, perf_model.peak_flops_from_env()
+            )
+        # goodput ledger: latest achieved point from the REAL dispatch
+        # shapes (n=1 sample; the fleet merge averages across workers)
+        self.stats.goodput.set_perf_gauges(
+            self.stats.mfu_decode_est, self.stats.decode_hbm_bytes_per_token
+        )
+        if dtrace.enabled() and self.stats.goodput.enabled:
+            dtrace.counter("mfu_achieved", self.stats.mfu_decode_est)
+            dtrace.counter(
+                "tokens_wasted", float(self.stats.goodput.wasted_total())
             )

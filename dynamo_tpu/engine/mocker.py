@@ -38,6 +38,7 @@ from dynamo_tpu.protocols.common import (
     PreprocessedRequest,
 )
 from dynamo_tpu.telemetry import trace as dtrace
+from dynamo_tpu.telemetry.goodput import GoodputLedger
 from dynamo_tpu.telemetry.histogram import PhaseHistograms
 from dynamo_tpu.testing import faults
 from dynamo_tpu.tokens import TokenBlockSequence
@@ -261,6 +262,10 @@ class MockEngine:
         # points as the DYN_TRACE spans, but distribution-valued and never
         # gated) — ride stats() -> ForwardPassMetrics to the fleet planes
         self.phase_hist = PhaseHistograms()
+        # goodput ledger (ISSUE 14 parity with EngineStats.goodput): steps
+        # recorded in SIMULATED seconds (the deterministic cost model, not
+        # wall clock) so fleet-vs-direct comparisons are exact
+        self.goodput = GoodputLedger()
         # trace process track (set by the worker host; None = process name)
         self.trace_proc: Optional[str] = None
 
@@ -360,6 +365,11 @@ class MockEngine:
         prompt_len = len(request.token_ids)
         resume = int(request.extra.get("resume_prompt_len") or 0)
         if 0 < resume < prompt_len:
+            # replayed tail: already streamed by a dead worker, but its KV
+            # must be re-prefilled here (goodput taxonomy: migration)
+            self.goodput.record_waste(
+                "migration_replay", prompt_len - resume
+            )
             prompt_len = resume
         first_remote: Optional[int] = None
         if (
@@ -482,6 +492,7 @@ class MockEngine:
             "preempted_too_often": self.preempted_too_often,
             "shed_brownout": self.shed_brownout,
             "brownout_level": self.brownout_level,
+            "goodput": self.goodput,
         }
 
     def apply_brownout(self, level: int) -> None:
@@ -524,6 +535,7 @@ class MockEngine:
     def _admit(self) -> float:
         """Watermark admission (scheduler.rs:197); returns prefill sim-cost."""
         cost = 0.0
+        n_prefill_total = 0
         watermark_blocks = int(self.args.num_blocks * self.args.watermark)
         # reap abandoned requests before they consume sim capacity
         for seq in [s for s in self.waiting if s.context.is_killed()]:
@@ -578,6 +590,7 @@ class MockEngine:
                 n_prefill = max(0, len(seq.request.token_ids)
                                 - cached * self.args.block_size)
             self.prefilled_tokens += n_prefill
+            n_prefill_total += n_prefill
             cost += (
                 self.args.prefill_linear_s * n_prefill
                 + self.args.prefill_quadratic_s * n_prefill * n_prefill
@@ -590,6 +603,12 @@ class MockEngine:
                     self._sp_begin(seq, "prefill", tokens=n_prefill)
                 else:
                     self._sp_begin(seq, "decode")
+        if cost > 0:
+            # one simulated prefill "dispatch" for the admitted batch,
+            # recorded in sim-seconds (deterministic cost model)
+            self.goodput.record_step(
+                "prefill", cost, prefill_tokens=n_prefill_total
+            )
         return cost
 
     async def _run(self) -> None:
@@ -618,11 +637,19 @@ class MockEngine:
                     await inj.on_dispatch()
                     step_s *= inj.dispatch_slow_factor()
             await self._sim_sleep(step_s)
+            self.goodput.record_step(
+                "decode",
+                step_s,
+                lanes=len(self.active),
+                capacity=self.args.max_batch,
+            )
             # deadline expiry mid-generation: cancel + structured error
             for seq in [
                 s for s in list(self.active) if s.context.expired()
             ]:
                 self.deadline_exceeded += 1
+                # partial output discarded (goodput taxonomy: deadline)
+                self.goodput.record_waste("deadline_partial", seq.generated)
                 seq.context.kill()
                 self.active.remove(seq)
                 self.cache.release(seq.acquired_hashes, seq.unique_blocks)
@@ -693,6 +720,7 @@ class MockEngine:
         tok = prompt[seq.generated % max(1, len(prompt))]
         seq.generated += 1
         self.generated_tokens += 1
+        self.goodput.record_decode_tokens()
         prev_blocks = len(seq.hash_seq.blocks)
         seq.hash_seq.append(tok)
         new_blocks = seq.hash_seq.blocks[prev_blocks:]
@@ -710,6 +738,12 @@ class MockEngine:
                 if seq.context.is_stopped()
                 else FinishReason.LENGTH
             )
+            if reason is FinishReason.CANCELLED:
+                # consumer disconnected mid-stream (goodput taxonomy:
+                # cancelled partial — same attribution as JaxEngine)
+                self.goodput.record_waste(
+                    "cancelled_partial", seq.generated
+                )
         seq.out.put_nowait(
             LLMEngineOutput(
                 token_ids=[tok],
@@ -748,6 +782,11 @@ class MockEngine:
         victim.preemptions += 1
         self.preemptions_by_class[victim.priority] = (
             self.preemptions_by_class.get(victim.priority, 0) + 1
+        )
+        # every token whose simulated KV this preemption released must be
+        # recomputed on re-admission (goodput taxonomy: preempt replay)
+        self.goodput.record_waste(
+            "preempt_replay", victim.prompt_len + victim.generated
         )
         if victim.preemptions > self.args.max_preemptions:
             # preemption-storm guard (parity with JaxEngine._preempt_seq)

@@ -212,6 +212,10 @@ async def run_http(
         # and MockEngine (stats() dict) carry the keys
         if stats is not None:
             service.metrics.attach_engine_qos(stats)
+        # goodput ledger (ISSUE 14): step histograms, occupancy, waste
+        # taxonomy, recompile forensics — both engines carry `goodput`
+        if stats is not None:
+            service.metrics.attach_goodput(stats)
         # admission watermark for the colocated engine follows its slot
         # count (dynamic mode gets this from the discovery capacity poller)
         if stats is not None:
@@ -627,6 +631,12 @@ async def run_endpoint(
         ph = d.get("phase_histograms")
         if ph is not None and not getattr(ph, "total_count", lambda: 0)():
             ph = None
+        # goodput ledger (ISSUE 14): shipped whenever the engine recorded
+        # a step / waste / compile, so the aggregator can merge the fleet
+        # efficiency view (step hists, occupancy, waste taxonomy, MFU)
+        gp = d.get("goodput")
+        if gp is not None and not getattr(gp, "total_events", lambda: 0)():
+            gp = None
         # integrity plane: the process-wide counters (data-plane checksum
         # failures, quarantines, fence-stamp rejects) ride WorkerStats to
         # the aggregator and the metrics component
@@ -666,6 +676,7 @@ async def run_endpoint(
             spec_decode_stats=spec,
             kv_transfer_stats=xfer,
             phase_histograms=ph,
+            goodput=gp,
         )
 
     if stats_fn is not None:

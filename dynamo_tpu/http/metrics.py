@@ -450,6 +450,47 @@ class ServiceMetrics:
 
         self.registry.register(_HealthCollector())
 
+    def attach_goodput(self, stats_src, hedger=None) -> None:
+        """Surface a colocated engine's goodput ledger (ISSUE 14) on this
+        frontend's /metrics: per-label step-duration histograms, lane
+        occupancy, phase-bubble time, the token-waste taxonomy, recompile
+        forensics, and achieved MFU / HBM-bytes-per-token. `stats_src` is
+        the engine's stats object or a zero-arg callable returning it
+        (dict or EngineStats — the `goodput` entry is the ledger). When a
+        HedgeController is wired its wasted_tokens overlay the
+        `hedge_loser` cause — the engine only ever sees the loser as a
+        consumer disconnect. Same family builder the metrics component
+        uses — shared series, merged views add."""
+        if getattr(self, "_goodput_attached", False):
+            return
+        self._goodput_attached = True
+
+        def read():
+            s = stats_src() if callable(stats_src) else stats_src
+            d = s if isinstance(s, dict) else getattr(s, "__dict__", {})
+            return d.get("goodput")
+
+        # kept for GET /debug/goodput (service.py): same source, same
+        # hedge overlay, rendered as JSON instead of families
+        self._goodput_read = read
+        self._goodput_hedger = hedger
+
+        class _GoodputCollector:
+            def describe(self):
+                return []
+
+            def collect(self):
+                from dynamo_tpu.components.metrics import goodput_families
+
+                yield from goodput_families(
+                    read(),
+                    hedge_loser_tokens=(
+                        hedger.wasted_tokens if hedger is not None else 0.0
+                    ),
+                )
+
+        self.registry.register(_GoodputCollector())
+
     def attach_brownout(self, controller) -> None:
         """Surface the brownout ladder on /metrics: the live rung as a
         gauge (0 ok .. 4 shed_standard) and the transition count as a real

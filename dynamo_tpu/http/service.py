@@ -573,6 +573,7 @@ class HttpService:
                 web.get("/live", self._health),
                 web.get("/metrics", self._metrics),
                 web.get("/debug/slo", self._debug_slo),
+                web.get("/debug/goodput", self._debug_goodput),
                 web.get("/debug/traces", self._debug_traces_list),
                 web.get("/debug/traces/{request_id}", self._debug_trace),
                 web.get("/debug/profile", self._debug_profile),
@@ -1279,6 +1280,38 @@ class HttpService:
                 "brownout": self.brownout.status(),
             }
         )
+
+    async def _debug_goodput(self, request: web.Request) -> web.Response:
+        """Colocated-engine goodput ledger (ISSUE 14): per-label step
+        distributions, occupancy, phase bubbles, the token-waste taxonomy
+        (with the frontend hedger's hedge_loser overlay), and recompile
+        forensics. The fleet-merged view lives on the metrics component's
+        /debug/goodput."""
+        from dynamo_tpu.telemetry import goodput as dgoodput
+
+        read = getattr(self.metrics, "_goodput_read", None)
+        hedger = getattr(self.metrics, "_goodput_hedger", None)
+        gp = read() if read is not None else None
+        summary = gp.summary() if gp is not None else None
+        hedge_tokens = (
+            int(hedger.wasted_tokens) if hedger is not None else 0
+        )
+        if summary is not None and hedge_tokens:
+            summary["tokens_wasted"]["hedge_loser"] += hedge_tokens
+            summary["tokens_wasted_total"] += hedge_tokens
+        body: dict[str, Any] = {
+            "scope": "frontend",
+            "enabled": dgoodput.enabled_from_env(),
+            "goodput": summary,
+            "hedge_loser_tokens": hedge_tokens,
+        }
+        if summary is None:
+            body["hint"] = (
+                "no colocated engine ledger on this frontend; the "
+                "fleet-merged view is GET /debug/goodput on the metrics "
+                "component"
+            )
+        return web.json_response(body)
 
     async def _debug_traces_list(self, request: web.Request) -> web.Response:
         """List retained trace exemplars (DYN_TRACE=auto flight recorder)

@@ -17,6 +17,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from dynamo_tpu.telemetry.goodput import GoodputStats
 from dynamo_tpu.telemetry.histogram import PhaseHistograms
 
 
@@ -233,6 +234,11 @@ class ForwardPassMetrics:
     # (telemetry/histogram.py): merged across the fleet by bucket
     # addition, the substrate for true fleet percentiles and SLO burn
     phase_histograms: Optional[PhaseHistograms] = None
+    # goodput ledger (telemetry/goodput.py, ISSUE 14): per-device-step
+    # efficiency accounting — step-duration hists by dispatch label,
+    # occupancy, phase bubbles, the token-waste taxonomy, and
+    # compile/recompile forensics. Merges like the histograms.
+    goodput: Optional[GoodputStats] = None
 
     def to_dict(self) -> dict[str, Any]:
         d: dict[str, Any] = {
@@ -245,6 +251,8 @@ class ForwardPassMetrics:
             d["kv_transfer_stats"] = self.kv_transfer_stats.__dict__
         if self.phase_histograms is not None:
             d["phase_histograms"] = self.phase_histograms.to_dict()
+        if self.goodput is not None:
+            d["goodput"] = self.goodput.to_dict()
         return d
 
     @classmethod
@@ -252,12 +260,14 @@ class ForwardPassMetrics:
         spec = d.get("spec_decode_stats")
         xfer = d.get("kv_transfer_stats")
         ph = d.get("phase_histograms")
+        gp = d.get("goodput")
         return cls(
             worker_stats=WorkerStats(**d.get("worker_stats", {})),
             kv_stats=KvStats(**d.get("kv_stats", {})),
             spec_decode_stats=SpecDecodeStats(**spec) if spec else None,
             kv_transfer_stats=KvTransferStats(**xfer) if xfer else None,
             phase_histograms=PhaseHistograms.from_dict(ph) if ph else None,
+            goodput=GoodputStats.from_dict(gp) if gp else None,
         )
 
 

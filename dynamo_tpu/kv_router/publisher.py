@@ -27,6 +27,7 @@ from dynamo_tpu.kv_router.protocols import (
     RouterEvent,
     SpecDecodeStats,
 )
+from dynamo_tpu.telemetry.goodput import GoodputStats
 from dynamo_tpu.telemetry.histogram import PhaseHistograms
 from dynamo_tpu.runtime.component import Component
 from dynamo_tpu.runtime.logging import get_logger
@@ -311,6 +312,13 @@ class KvMetricsAggregator:
                 if agg.phase_histograms is None:
                     agg.phase_histograms = PhaseHistograms()
                 agg.phase_histograms.merge(m.phase_histograms)
+            if m.goodput is not None:
+                # goodput ledger: same contract — counters/buckets add,
+                # compile times take the max, the MFU/HBM gauges ride as
+                # (sum, n) pairs so averaging stays associative
+                if agg.goodput is None:
+                    agg.goodput = GoodputStats()
+                agg.goodput.merge(m.goodput)
         if n:
             agg.kv_stats.gpu_cache_usage_perc /= n
             agg.kv_stats.gpu_prefix_cache_hit_rate /= n

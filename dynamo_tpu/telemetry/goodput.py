@@ -1,0 +1,449 @@
+"""Goodput ledger: always-on per-device-step efficiency accounting.
+
+PRs 5-6 built the *latency* observability plane (phase histograms, SLO
+burn); nothing recorded where device time and scheduled tokens actually
+GO. This module is the efficiency sensing plane: every engine dispatch
+("device step") is folded into fixed-log-bucket histograms keyed by its
+dispatch label, alongside occupancy (lanes used vs capacity), prefill /
+decode token throughput, phase-bubble time between dispatches, a
+**token-waste taxonomy** of cumulative counters, per-step achieved
+MFU / HBM-bytes-per-token gauges (fed from `perf_model.py` with the real
+dispatch shapes), and **recompile forensics** — per-label compile time
+plus a counter of *unexpected* recompiles after warmup.
+
+Waste taxonomy (the `cause` label on `dyn_llm_tokens_wasted_total`):
+
+  * ``spec_rejected``     — draft tokens the verify step rejected
+  * ``preempt_replay``    — KV work (prompt + generated) discarded by a
+                            preemption and recomputed on re-admission
+  * ``migration_replay``  — already-streamed tokens re-prefilled on an
+                            in-flight migration resume
+  * ``deadline_partial``  — tokens generated for a request whose deadline
+                            expired mid-generation (partial discarded)
+  * ``cancelled_partial`` — tokens generated for a consumer that
+                            disconnected (includes engine-side hedge
+                            losers, which the engine cannot distinguish)
+  * ``hedge_loser``       — tokens the losing hedge stream emitted
+                            (frontend-attributed: hedging happens where
+                            dispatch happens)
+
+Recompile causes (`dyn_llm_recompiles_total{label,cause}`):
+
+  * ``shape_miss``   — a warm label dispatched far off its EMA (a shape
+                       bucket the jit cache had not seen)
+  * ``prebake_miss`` — same, but the label was pre-baked by
+                       `tools/prebake_cache.py` — cache drift, the image
+                       no longer matches the serve shapes
+
+Everything here follows the `telemetry/histogram.py` contract: fixed
+grids, plain-addition merges (associative + commutative), sparse
+msgpack/JSON-safe wire forms, and `observe()` cheap enough to stay
+always-on in the engine hot path. `DYN_GOODPUT=0` disables recording
+entirely (the overhead A/B knob used by `benchmarks/goodput_bench.py`).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Optional
+
+from dynamo_tpu.telemetry.histogram import PhaseHistograms
+
+logger = logging.getLogger(__name__)
+
+# Fixed taxonomy — exporters iterate this so dashboards get stable,
+# zero-valued series instead of appearing-on-first-waste label churn.
+WASTE_CAUSES = (
+    "spec_rejected",
+    "preempt_replay",
+    "migration_replay",
+    "deadline_partial",
+    "cancelled_partial",
+    "hedge_loser",
+)
+
+RECOMPILE_CAUSES = ("shape_miss", "prebake_miss")
+
+# Bound on dict-keyed state: dispatch labels are a small closed set, but
+# a bug (label built from a shape) must never grow the ledger unbounded.
+MAX_LABELS = 32
+
+
+def enabled_from_env() -> bool:
+    return os.environ.get("DYN_GOODPUT", "1") not in ("0", "false", "off")
+
+
+class GoodputStats:
+    """Mergeable goodput snapshot (the wire/aggregate half).
+
+    Merging follows the phase-histogram contract: counters add, bucket
+    grids add, compile times take the max (worst worker), and the
+    MFU/HBM gauges ship as (sum, n) pairs so fleet averaging is
+    associative no matter the merge order.
+    """
+
+    __slots__ = (
+        "step_hists",
+        "steps_total",
+        "bubble_s_total",
+        "lane_steps",
+        "lane_capacity_steps",
+        "prefill_tokens",
+        "decode_tokens",
+        "waste_by_cause",
+        "recompiles",
+        "compile_s_by_label",
+        "mfu_sum",
+        "hbm_sum",
+        "gauge_n",
+    )
+
+    def __init__(self) -> None:
+        # per-dispatch-label step-duration distributions (ms grid)
+        self.step_hists = PhaseHistograms()
+        self.steps_total = 0
+        # idle gap between the end of one dispatch and the start of the
+        # next while work was in flight — the "phase bubble" the unified
+        # mixed-step ROADMAP item wants to close
+        self.bubble_s_total = 0.0
+        # occupancy: sum of lanes occupied / lane capacity per decode-
+        # family step (occupancy = lane_steps / lane_capacity_steps)
+        self.lane_steps = 0
+        self.lane_capacity_steps = 0
+        self.prefill_tokens = 0
+        self.decode_tokens = 0
+        self.waste_by_cause: dict[str, int] = {}
+        # "label|cause" -> count of unexpected post-warmup recompiles
+        self.recompiles: dict[str, int] = {}
+        # label -> first-dispatch (compile-inclusive) seconds
+        self.compile_s_by_label: dict[str, float] = {}
+        # achieved-efficiency gauges as associative (sum, n) pairs; a
+        # single worker publishes n=1 with its latest values
+        self.mfu_sum = 0.0
+        self.hbm_sum = 0.0
+        self.gauge_n = 0
+
+    # ------------------------------------------------------------- query
+
+    @property
+    def occupancy(self) -> float:
+        if not self.lane_capacity_steps:
+            return 0.0
+        return self.lane_steps / self.lane_capacity_steps
+
+    @property
+    def mfu_achieved(self) -> float:
+        return self.mfu_sum / self.gauge_n if self.gauge_n else 0.0
+
+    @property
+    def hbm_bytes_per_token(self) -> float:
+        return self.hbm_sum / self.gauge_n if self.gauge_n else 0.0
+
+    def wasted_total(self) -> int:
+        return sum(self.waste_by_cause.values())
+
+    def recompiles_total(self) -> int:
+        return sum(self.recompiles.values())
+
+    def total_events(self) -> int:
+        """Nonzero iff this snapshot carries anything worth shipping."""
+        return (
+            self.steps_total
+            + self.wasted_total()
+            + self.recompiles_total()
+            + len(self.compile_s_by_label)
+        )
+
+    # ------------------------------------------------------------- merge
+
+    def merge(self, other: "GoodputStats") -> None:
+        self.step_hists.merge(other.step_hists)
+        self.steps_total += other.steps_total
+        self.bubble_s_total += other.bubble_s_total
+        self.lane_steps += other.lane_steps
+        self.lane_capacity_steps += other.lane_capacity_steps
+        self.prefill_tokens += other.prefill_tokens
+        self.decode_tokens += other.decode_tokens
+        for k, v in other.waste_by_cause.items():
+            self.waste_by_cause[k] = self.waste_by_cause.get(k, 0) + v
+        for k, v in other.recompiles.items():
+            self.recompiles[k] = self.recompiles.get(k, 0) + v
+        for k, v in other.compile_s_by_label.items():
+            if len(self.compile_s_by_label) < MAX_LABELS or (
+                k in self.compile_s_by_label
+            ):
+                self.compile_s_by_label[k] = max(
+                    self.compile_s_by_label.get(k, 0.0), v
+                )
+        self.mfu_sum += other.mfu_sum
+        self.hbm_sum += other.hbm_sum
+        self.gauge_n += other.gauge_n
+
+    def copy(self) -> "GoodputStats":
+        out = GoodputStats()
+        out.merge(self)
+        return out
+
+    # -------------------------------------------------------------- wire
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "sh": self.step_hists.to_dict(),
+            "st": self.steps_total,
+            "bub": round(self.bubble_s_total, 6),
+            "ls": self.lane_steps,
+            "lc": self.lane_capacity_steps,
+            "pt": self.prefill_tokens,
+            "dt": self.decode_tokens,
+            "w": dict(self.waste_by_cause),
+            "rc": dict(self.recompiles),
+            "cs": {k: round(v, 4) for k, v in self.compile_s_by_label.items()},
+            "mfu": self.mfu_sum,
+            "hbm": self.hbm_sum,
+            "n": self.gauge_n,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "GoodputStats":
+        out = cls()
+        if not isinstance(d, dict):
+            return out
+        out.step_hists = PhaseHistograms.from_dict(d.get("sh") or {})
+        out.steps_total = int(d.get("st") or 0)
+        out.bubble_s_total = float(d.get("bub") or 0.0)
+        out.lane_steps = int(d.get("ls") or 0)
+        out.lane_capacity_steps = int(d.get("lc") or 0)
+        out.prefill_tokens = int(d.get("pt") or 0)
+        out.decode_tokens = int(d.get("dt") or 0)
+        for k, v in (d.get("w") or {}).items():
+            out.waste_by_cause[str(k)] = int(v)
+        for k, v in (d.get("rc") or {}).items():
+            out.recompiles[str(k)] = int(v)
+        for k, v in (d.get("cs") or {}).items():
+            if len(out.compile_s_by_label) < MAX_LABELS:
+                out.compile_s_by_label[str(k)] = float(v)
+        out.mfu_sum = float(d.get("mfu") or 0.0)
+        out.hbm_sum = float(d.get("hbm") or 0.0)
+        out.gauge_n = int(d.get("n") or 0)
+        return out
+
+    # ------------------------------------------------------------- debug
+
+    def summary(self) -> dict[str, Any]:
+        """Human-oriented JSON for `GET /debug/goodput`."""
+        steps: dict[str, Any] = {}
+        for label, h in self.step_hists.phases.items():
+            steps[label] = {
+                "count": h.count,
+                "mean_ms": round(h.mean_ms, 3),
+                "p50_ms": round(h.percentile(50), 3),
+                "p99_ms": round(h.percentile(99), 3),
+            }
+        return {
+            "steps_total": self.steps_total,
+            "steps_by_label": steps,
+            "occupancy": round(self.occupancy, 4),
+            "phase_bubble_s": round(self.bubble_s_total, 4),
+            "prefill_tokens": self.prefill_tokens,
+            "decode_tokens": self.decode_tokens,
+            "tokens_wasted": {
+                c: self.waste_by_cause.get(c, 0) for c in WASTE_CAUSES
+            },
+            "tokens_wasted_total": self.wasted_total(),
+            "recompiles": dict(self.recompiles),
+            "compile_s_by_label": {
+                k: round(v, 3) for k, v in self.compile_s_by_label.items()
+            },
+            "mfu_achieved": round(self.mfu_achieved, 5),
+            "hbm_bytes_per_token": round(self.hbm_bytes_per_token, 1),
+        }
+
+
+class GoodputLedger(GoodputStats):
+    """The recording half, embedded in a live engine.
+
+    Adds the dispatch-edge state (`_last_end` for bubble accounting) and
+    the record_* API the engines call. All recorders no-op when
+    `DYN_GOODPUT=0`, and the ledger is bounded by construction: fixed
+    histogram grids, the closed waste/recompile taxonomies, and a
+    MAX_LABELS cap on every label-keyed dict.
+    """
+
+    __slots__ = ("enabled", "_last_end")
+
+    def __init__(self, enabled: Optional[bool] = None) -> None:
+        super().__init__()
+        self.enabled = enabled_from_env() if enabled is None else enabled
+        self._last_end: Optional[float] = None
+
+    def record_step(
+        self,
+        label: str,
+        elapsed_s: float,
+        *,
+        lanes: int = 0,
+        capacity: int = 0,
+        prefill_tokens: int = 0,
+        t_start: Optional[float] = None,
+    ) -> None:
+        """One device dispatch completed. `t_start` (time.monotonic) feeds
+        phase-bubble accounting: the gap since the previous dispatch's end
+        is device idle time between phases."""
+        if not self.enabled:
+            return
+        self.steps_total += 1
+        if len(self.step_hists.phases) < MAX_LABELS or (
+            label in self.step_hists.phases
+        ):
+            self.step_hists.observe(label, elapsed_s * 1e3)
+        if capacity > 0:
+            self.lane_steps += lanes
+            self.lane_capacity_steps += capacity
+        if prefill_tokens > 0:
+            self.prefill_tokens += prefill_tokens
+        if t_start is not None:
+            if self._last_end is not None and t_start > self._last_end:
+                self.bubble_s_total += t_start - self._last_end
+            self._last_end = t_start + elapsed_s
+
+    def record_decode_tokens(self, n: int = 1) -> None:
+        if self.enabled:
+            self.decode_tokens += n
+
+    def record_waste(self, cause: str, tokens: int) -> None:
+        if not self.enabled or tokens <= 0:
+            return
+        self.waste_by_cause[cause] = self.waste_by_cause.get(cause, 0) + int(
+            tokens
+        )
+
+    def record_compile(self, label: str, seconds: float) -> None:
+        """A label's first dispatch (includes its XLA compile)."""
+        if not self.enabled:
+            return
+        if len(self.compile_s_by_label) < MAX_LABELS or (
+            label in self.compile_s_by_label
+        ):
+            self.compile_s_by_label[label] = max(
+                self.compile_s_by_label.get(label, 0.0), float(seconds)
+            )
+
+    def record_recompile(
+        self, label: str, cause: str, shape: Optional[str] = None
+    ) -> None:
+        """An *unexpected* post-warmup recompile (shape-bucket miss, or
+        cache drift on a prebaked label). Always WARNs naming the
+        offending shape — a recompile mid-serving is an SLO incident."""
+        if not self.enabled:
+            return
+        key = f"{label}|{cause}"
+        if len(self.recompiles) < MAX_LABELS or key in self.recompiles:
+            self.recompiles[key] = self.recompiles.get(key, 0) + 1
+        logger.warning(
+            "unexpected recompile of %s (%s): offending shape %s — "
+            "a serve-time XLA compile stalls every lane; widen the shape "
+            "buckets or re-run tools/prebake_cache.py",
+            label,
+            cause,
+            shape or "unknown",
+        )
+
+    def set_perf_gauges(self, mfu: float, hbm_bytes_per_token: float) -> None:
+        """Latest achieved-efficiency point (real dispatch shapes through
+        perf_model). Stored as an n=1 sample so fleet merges average."""
+        if not self.enabled:
+            return
+        self.mfu_sum = float(mfu)
+        self.hbm_sum = float(hbm_bytes_per_token)
+        self.gauge_n = 1
+
+    def mark_idle(self) -> None:
+        """Nothing in flight: the next dispatch's gap is idleness, not a
+        phase bubble. Resets the bubble baseline."""
+        self._last_end = None
+
+
+class RecompileDetector:
+    """Warm-label recompile heuristic shared by engine + tools.
+
+    A label's first dispatch is its compile (by construction of jit);
+    after warmup, a dispatch taking `factor`× its EMA *and* over an
+    absolute floor is a recompile — python-side jitter can double a step,
+    but only an XLA compile multiplies it by orders of magnitude while
+    also crossing hundreds of ms.
+    """
+
+    def __init__(
+        self,
+        min_s: Optional[float] = None,
+        factor: Optional[float] = None,
+    ) -> None:
+        self.min_s = (
+            float(os.environ.get("DYN_RECOMPILE_MIN_S", "0.2"))
+            if min_s is None
+            else min_s
+        )
+        self.factor = (
+            float(os.environ.get("DYN_RECOMPILE_FACTOR", "10"))
+            if factor is None
+            else factor
+        )
+
+    def is_recompile(self, elapsed_s: float, ema_s: float) -> bool:
+        return elapsed_s >= self.min_s and elapsed_s >= self.factor * ema_s
+
+
+def normalize_label(label: str) -> str:
+    """Map a prebake program label to its dispatch label: prebake bakes
+    per-shape programs (`prefill@2048`, `decode_multi@H4`, `decode_eos`)
+    while the engine dispatches under the base label."""
+    base = label.split("@", 1)[0]
+    return "decode" if base == "decode_eos" else base
+
+
+PREBAKE_MANIFEST = "prebake_manifest.json"
+
+
+def load_prebaked_labels(cache_dir: Optional[str]) -> frozenset[str]:
+    """Dispatch labels covered by a prior `tools/prebake_cache.py` run
+    (read from the manifest it drops in the cache dir). Serve-time
+    recompiles of these labels are counted as `prebake_miss` — the baked
+    cache has drifted from the serve shapes."""
+    if not cache_dir:
+        return frozenset()
+    path = os.path.join(cache_dir, PREBAKE_MANIFEST)
+    try:
+        import json
+
+        with open(path) as f:
+            doc = json.load(f)
+        labels = doc.get("labels") or []
+        return frozenset(normalize_label(str(x)) for x in labels)
+    except (OSError, ValueError):
+        return frozenset()
+
+
+def write_prebake_manifest(
+    cache_dir: Optional[str], programs: list
+) -> Optional[str]:
+    """Drop the manifest `load_prebaked_labels` reads; called by
+    tools/prebake_cache.py after baking."""
+    if not cache_dir or not os.path.isdir(cache_dir):
+        return None
+    import json
+
+    path = os.path.join(cache_dir, PREBAKE_MANIFEST)
+    doc = {
+        "labels": sorted({normalize_label(lbl) for lbl, _ in programs}),
+        "programs": [[lbl, s] for lbl, s in programs],
+        "baked_at": time.time(),
+    }
+    try:
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    except OSError:
+        return None
+    return path

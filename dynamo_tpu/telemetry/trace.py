@@ -287,6 +287,12 @@ class Tracer:
         )
         self._ring: deque[Span] = deque(maxlen=max(16, ring))
         self._requests: OrderedDict[str, str] = OrderedDict()
+        # counter-track samples (goodput ledger: occupancy / step time /
+        # wasted tokens / MFU): (name, proc, unix_ns, value), bounded the
+        # same way the span ring is
+        self._counters: deque[tuple[str, str, int, float]] = deque(
+            maxlen=max(16, ring)
+        )
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- record
@@ -294,6 +300,25 @@ class Tracer:
     def _record(self, sp: Span) -> None:
         with self._lock:
             self._ring.append(sp)
+
+    def record_counter(self, name: str, value: float) -> None:
+        with self._lock:
+            self._counters.append(
+                (
+                    name,
+                    _proc_label.get() or self.proc,
+                    time.time_ns(),
+                    float(value),
+                )
+            )
+
+    def counters_between(
+        self, start_ns: int, end_ns: int
+    ) -> list[tuple[str, str, int, float]]:
+        with self._lock:
+            return [
+                c for c in self._counters if start_ns <= c[2] <= end_ns
+            ]
 
     def ingest(self, span_dicts: list[dict[str, Any]]) -> int:
         """File spans shipped from another process (deduped by span_id)."""
@@ -586,6 +611,15 @@ def event(name: str, **attrs: Any) -> None:
         cur.event(name, **attrs)
 
 
+def counter(name: str, value: float) -> None:
+    """Record a counter-track sample (Perfetto "ph":"C"): goodput gauges
+    like step occupancy / wasted tokens / achieved MFU ride the trace
+    timeline next to the spans. No-op when tracing is disabled."""
+    if not _enabled:
+        return
+    tracer().record_counter(name, value)
+
+
 # -------------------------------------------------------------- W3C interop
 
 
@@ -688,6 +722,37 @@ def chrome_trace(trace_id: str) -> dict[str, Any]:
                     # span anchor plus the monotonic offset into the span
                     "ts": (s.start_unix_ns + (ev["ns"] - s.start_ns)) / 1e3,
                     "args": ev.get("attrs") or {},
+                }
+            )
+    if spans:
+        # Overlay counter-track samples ("ph":"C") that fall inside the
+        # trace window: goodput gauges (step_ms / occupancy / mfu_achieved
+        # / tokens_wasted) render as Perfetto counter lanes next to spans.
+        lo = min(s.start_unix_ns for s in spans)
+        hi = max(s.start_unix_ns + s.dur_ns for s in spans)
+        for name, proc, ts_ns, value in tracer().counters_between(lo, hi):
+            pid = seen_procs.get(proc)
+            if pid is None:
+                pid = _proc_pid(proc)
+                seen_procs[proc] = pid
+                events.append(
+                    {
+                        "name": "process_name",
+                        "ph": "M",
+                        "pid": pid,
+                        "tid": 0,
+                        "args": {"name": proc},
+                    }
+                )
+            events.append(
+                {
+                    "name": name,
+                    "cat": "counter",
+                    "ph": "C",
+                    "pid": pid,
+                    "tid": 0,
+                    "ts": ts_ns / 1e3,
+                    "args": {"value": value},
                 }
             )
     return {

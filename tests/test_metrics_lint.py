@@ -25,6 +25,7 @@ from dynamo_tpu.http.metrics import ServiceMetrics
 from dynamo_tpu.router import build_router_registry
 from dynamo_tpu.runtime.http_server import SystemStatusServer
 from dynamo_tpu.runtime.protocols import EndpointId
+from dynamo_tpu.telemetry.goodput import WASTE_CAUSES, GoodputLedger
 
 # Series deliberately exported by several roles (same meaning, different
 # process — normal Prometheus federation, distinguished by instance).
@@ -77,6 +78,19 @@ INTENTIONALLY_SHARED = {
     "dyn_llm_worker_health_score",
     "dyn_llm_workers_ejected",
     "dyn_llm_ejections",
+    # goodput ledger (ISSUE 14): colocated-engine attach on the frontend
+    # vs the fleet-merged view on the metrics component — same families,
+    # merged views add (histograms bucket-add, counters sum)
+    "dyn_llm_step_duration_seconds",
+    "dyn_llm_steps",
+    "dyn_llm_step_occupancy",
+    "dyn_llm_phase_bubble_seconds",
+    "dyn_llm_device_tokens",
+    "dyn_llm_tokens_wasted",
+    "dyn_llm_recompiles",
+    "dyn_llm_compile_seconds",
+    "dyn_llm_mfu_achieved",
+    "dyn_llm_hbm_bytes_per_token_achieved",
 }
 
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
@@ -135,6 +149,9 @@ def _all_registries() -> dict[str, CollectorRegistry]:
          "degraded_seconds_total": 0.0, "blackouts_total": 0,
          "buffered_publishes": 0, "flushed_publishes": 0,
          "dropped_publishes": 0}
+    )
+    frontend.attach_goodput(
+        {"goodput": GoodputLedger(enabled=True)}, _StubHedger()
     )
     frontend.attach_planner(
         {"decisions_total": {"up|sla": 1}, "frozen": 0,
@@ -331,6 +348,41 @@ def test_tail_families_present_with_correct_types():
         assert fam is not None and fam.type == "counter", name
         for role in ("component", "router"):
             assert name not in by_role[role], (role, name)
+
+
+def test_goodput_families_present_with_correct_types():
+    """ISSUE 14: the goodput-ledger families must exist with the right
+    semantics on both the frontend (colocated-engine attach) and the
+    metrics component (fleet merge) — step durations as a real histogram,
+    waste/recompiles/tokens/bubbles with counter semantics, occupancy and
+    the achieved-efficiency gauges as gauges."""
+    regs = _all_registries()
+    by_role = {
+        role: {f.name: f for f in _families(reg)}
+        for role, reg in regs.items()
+    }
+    for role in ("frontend", "component"):
+        for name, typ in (
+            ("dyn_llm_step_duration_seconds", "histogram"),
+            ("dyn_llm_steps", "counter"),
+            ("dyn_llm_step_occupancy", "gauge"),
+            ("dyn_llm_phase_bubble_seconds", "counter"),
+            ("dyn_llm_device_tokens", "counter"),
+            ("dyn_llm_tokens_wasted", "counter"),
+            ("dyn_llm_recompiles", "counter"),
+            ("dyn_llm_compile_seconds", "gauge"),
+            ("dyn_llm_mfu_achieved", "gauge"),
+            ("dyn_llm_hbm_bytes_per_token_achieved", "gauge"),
+        ):
+            fam = by_role[role].get(name)
+            assert fam is not None and fam.type == typ, (role, name)
+    # the waste taxonomy exports ALL causes as stable zero-valued series
+    # (dashboards must not see label churn on first waste)
+    for role in ("frontend", "component"):
+        fam = by_role[role]["dyn_llm_tokens_wasted"]
+        causes = {s.labels.get("cause") for s in fam.samples}
+        for cause in WASTE_CAUSES:
+            assert cause in causes, (role, cause)
 
 
 def test_every_family_has_help_text():

@@ -683,6 +683,10 @@ def test_mock_worker_metrics_slow_factor_scores():
 
         m._xfer = KvTransferStats()
         m.hist = PhaseHistograms()
+        from dynamo_tpu.telemetry.goodput import GoodputLedger
+
+        m.goodput = GoodputLedger(enabled=True)
+        m._sim_t = 0.0
     for _ in range(4):
         for wid, m in mocks.items():
             scorer.observe_worker_hists(wid, m.snapshot().phase_histograms)

@@ -33,6 +33,8 @@ import time
 
 import numpy as np
 
+from dynamo_tpu.telemetry.goodput import write_prebake_manifest
+
 
 def _build_runner(args):
     import jax
@@ -169,10 +171,23 @@ def prebake(args) -> dict:
     entries = 0
     if cache_dir and os.path.isdir(cache_dir):
         entries = sum(len(fs) for _, _, fs in os.walk(cache_dir))
+    # per-program compile-time table (what the 46.6 s actually buys), then
+    # the manifest the engine reads at boot: serve-time recompiles of any
+    # label baked here are counted as cause="prebake_miss" — the shipped
+    # cache has drifted from the serve shapes
+    width = max(len(lbl) for lbl, _ in compiled) if compiled else 8
+    print(f"\n  {'program':<{width}}  compile_s")
+    for lbl, secs in sorted(compiled, key=lambda p: -p[1]):
+        print(f"  {lbl:<{width}}  {secs:9.3f}")
+    print(f"  {'TOTAL':<{width}}  {sum(t for _, t in compiled):9.3f}")
+    manifest = write_prebake_manifest(cache_dir, compiled)
+    if manifest:
+        print(f"  manifest: {manifest}")
     return {
         "cache_dir": cache_dir,
         "cache_entries": entries,
         "programs": compiled,
+        "manifest": manifest,
         "total_s": round(sum(t for _, t in compiled), 3),
     }
 
