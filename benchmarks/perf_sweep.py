@@ -260,7 +260,7 @@ def main() -> None:
         choices=[
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
             "priority", "integrity", "decode_mfu", "blackout", "planner",
-            "tail", "goodput",
+            "tail", "goodput", "sim",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -314,7 +314,12 @@ def main() -> None:
         "spec_rejected vs the spec plane's own counters, DYN_GOODPUT "
         "on/off overhead <=2%, and a forced shape-bucket miss producing "
         "exactly one labelled recompile increment; banked artifact "
-        "benchmarks/goodput_sweep.json)",
+        "benchmarks/goodput_sweep.json). "
+        "sim = delegates to tools.sim_sweep (N-seed deterministic "
+        "virtual-clock chaos sweep: the real fleet through every fault "
+        "class with always-on invariant checkers; failing seeds bank "
+        "ddmin-shrunk replay artifacts; banked artifact "
+        "benchmarks/sim_sweep.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -411,6 +416,15 @@ def main() -> None:
             ["--json", args.json or "benchmarks/goodput_sweep.json"]
         )
         return
+    if args.preset == "sim":
+        # deterministic-simulation sweep runs the whole fleet on a
+        # virtual clock (no HTTP frontend, no wall-clock sleeps) — one
+        # entry point for every banked curve stays `perf_sweep --preset X`
+        from tools import sim_sweep
+
+        raise SystemExit(sim_sweep.main(
+            ["--json", args.json or "benchmarks/sim_sweep.json"]
+        ))
     if args.preset == "slo":
         # SLO-plane overhead sweep runs on the mocker directly: always-on
         # histogram recording must stay within a few percent of the PR 5

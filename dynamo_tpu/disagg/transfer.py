@@ -30,7 +30,6 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import os
-import time
 import uuid
 from collections import deque
 from dataclasses import dataclass
@@ -49,6 +48,7 @@ from dynamo_tpu.disagg.protocols import (
 )
 from dynamo_tpu.fabric.client import FabricClient
 from dynamo_tpu.runtime.backoff import Backoff
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry import trace as dtrace
 from dynamo_tpu.testing import faults
@@ -288,7 +288,7 @@ class RemotePrefillClient:
         # request with 3 s left must not camp on the queue for 120 s
         timeout = self.timeout
         if deadline is not None:
-            timeout = max(0.05, min(timeout, deadline - time.time()))
+            timeout = max(0.05, min(timeout, deadline - dclock.wall()))
         try:
             # the enqueue itself is clamped to the same budget: a dark
             # queue plane raises fast (degraded mode) or at the deadline
@@ -299,13 +299,13 @@ class RemotePrefillClient:
             # poll the requester's cancellation while waiting so a killed
             # sequence tears the stream down instead of riding out the
             # full timeout (PR 3's deadline cascade reaches the data plane)
-            end = time.monotonic() + timeout
+            end = dclock.now() + timeout
             while True:
                 if ctx.is_killed() or ctx.is_stopped():
                     await self._send_cancel(rid)
                     self.stats.streams_cancelled += 1
                     raise PrefillStreamCancelled(rid)
-                remaining = end - time.monotonic()
+                remaining = end - dclock.now()
                 if remaining <= 0:
                     raise asyncio.TimeoutError(
                         f"remote prefill {rid} timed out"
@@ -421,7 +421,7 @@ class PrefillWorkerService:
 
     def _is_cancelled(self, req: RemotePrefillRequest) -> bool:
         return req.request_id in self._cancelled or (
-            req.deadline is not None and time.time() > req.deadline
+            req.deadline is not None and dclock.wall() > req.deadline
         )
 
     def _bump_engine_stat(self, attr: str, delta: int) -> None:
@@ -488,7 +488,7 @@ class PrefillWorkerService:
     ) -> Optional[RemotePrefillResponse]:
         """Serve one request; None means the stream was torn down by a
         requester cancel (nothing to publish)."""
-        if req.deadline is not None and time.time() > req.deadline:
+        if req.deadline is not None and dclock.wall() > req.deadline:
             # expired while queued: don't burn prefill compute on KV
             # nobody will consume — tell the requester and move on
             self.stats.dropped_expired += 1

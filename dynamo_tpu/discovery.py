@@ -14,7 +14,6 @@ import asyncio
 import contextlib
 import json
 import os
-import time
 from dataclasses import dataclass
 from typing import Any, AsyncIterator, Callable, Optional
 
@@ -32,6 +31,7 @@ from dynamo_tpu.protocols.common import (
 from dynamo_tpu.runtime.backoff import full_jitter_delay
 from dynamo_tpu.runtime.component import Endpoint, NoInstancesError
 from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import MODEL_ROOT, EndpointId
 from dynamo_tpu.telemetry import health as dhealth
@@ -330,7 +330,7 @@ class RemoteEngine:
             # per-attempt dispatch span: replays share the request's trace
             # id (ctx carries it), so a migrated stream is ONE trace with
             # one dispatch span per attempt, all parented to the root
-            t_attempt = time.monotonic()
+            t_attempt = dclock.now()
             t_first: Optional[float] = None
             t_last_frame: Optional[float] = None
             with dtrace.span(
@@ -368,7 +368,7 @@ class RemoteEngine:
                     if self.health is not None and wid is not None:
                         self.health.record(
                             wid, "dispatch",
-                            (time.monotonic() - t_attempt) * 1e3,
+                            (dclock.now() - t_attempt) * 1e3,
                         )
                     if self.hedger is not None:
                         self.hedger.note_dispatch()
@@ -423,7 +423,7 @@ class RemoteEngine:
                                     emitted.extend(out.token_ids)
                                     progressed = True
                                     if self.health is not None:
-                                        now = time.monotonic()
+                                        now = dclock.now()
                                         if t_first is None:
                                             t_first = now
                                             ms = (now - t_attempt) * 1e3

@@ -25,12 +25,12 @@ import bisect
 import collections
 import itertools
 import os
-import time
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu import qos
 from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.telemetry import brownout as dbrownout
 from dynamo_tpu.protocols.common import (
     FinishReason,
@@ -312,7 +312,7 @@ class MockEngine:
         """Always-on phase histogram recording at the stream edge (same
         contract as JaxEngine._observe_stream)."""
         ph = self.phase_hist
-        now = time.monotonic()
+        now = dclock.now()
         if item.token_ids:
             if seq.t_first is None:
                 seq.t_first = now
@@ -328,7 +328,7 @@ class MockEngine:
     async def generate(
         self, request: PreprocessedRequest, context: Optional[Context] = None
     ) -> AsyncIterator[LLMEngineOutput]:
-        t_arrival = time.monotonic()
+        t_arrival = dclock.now()
         ctx = context or Context()
         if self.fenced:
             yield LLMEngineOutput.final_error(
@@ -561,7 +561,7 @@ class MockEngine:
         idx = 0
         while idx < len(self.waiting) and len(self.active) < self.args.max_batch:
             seq = self.waiting[idx]
-            if seq.requeue_after and time.monotonic() < seq.requeue_after:
+            if seq.requeue_after and dclock.now() < seq.requeue_after:
                 # preemption re-admission backoff: don't head-block others
                 idx += 1
                 continue
@@ -576,7 +576,7 @@ class MockEngine:
                 break
             self.waiting.pop(idx)
             if seq.t_admitted is None:  # first admission (not a resume)
-                seq.t_admitted = time.monotonic()
+                seq.t_admitted = dclock.now()
                 self.phase_hist.observe(
                     "queue_wait", (seq.t_admitted - seq.t_arrival) * 1e3
                 )
@@ -811,7 +811,7 @@ class MockEngine:
             / 1e3
             * (1 << (victim.preemptions - 1)),
         )
-        victim.requeue_after = time.monotonic() + backoff_s
+        victim.requeue_after = dclock.now() + backoff_s
         self._enqueue(victim)
 
 

@@ -12,12 +12,12 @@ import contextlib
 import inspect
 import itertools
 import os
-import time
 from collections import OrderedDict, deque
 from typing import Any, AsyncIterator, Callable, Optional
 
 from dynamo_tpu.fabric import wire
 from dynamo_tpu.fabric.state import FabricState, WatchEvent
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry import trace as dtrace
 from dynamo_tpu.testing import faults
@@ -313,7 +313,7 @@ class FabricClient:
         """Control-plane health snapshot for the metrics plane
         (`dyn_fabric_connected` / `dyn_llm_degraded_*` families)."""
         dark = self.degraded_since
-        extra = time.monotonic() - dark if dark is not None else 0.0
+        extra = dclock.now() - dark if dark is not None else 0.0
         return {
             "connected": self.connected,
             "degraded": dark is not None,
@@ -334,13 +334,13 @@ class FabricClient:
         """Block until the store is reachable again (or timeout). Used by
         callers that would otherwise burn retry budgets against a dark
         control plane (e.g. migration replays)."""
-        end = time.monotonic() + max(0.0, timeout)
+        end = dclock.now() + max(0.0, timeout)
         while True:
             with contextlib.suppress(ConnectionError):
                 self._outage_check()
                 if self.connected:
                     return True
-            remaining = end - time.monotonic()
+            remaining = end - dclock.now()
             if remaining <= 0:
                 return False
             if self._state is None:
@@ -372,7 +372,7 @@ class FabricClient:
     def _note_lost(self, cause: str) -> None:
         if self.degraded_since is not None:
             return
-        self.degraded_since = time.monotonic()
+        self.degraded_since = dclock.now()
         self.blackouts_total += 1
         logger.warning(
             "fabric unreachable (%s): DEGRADED mode — serving from "
@@ -385,7 +385,7 @@ class FabricClient:
         if dark is None:
             return
         self.degraded_since = None
-        elapsed = time.monotonic() - dark
+        elapsed = dclock.now() - dark
         self.degraded_seconds_total += elapsed
         logger.info(
             "fabric healed after %.1fs degraded (%s); flushing %d buffered "
@@ -608,7 +608,7 @@ class FabricClient:
         # client's hunt
         backoff = Backoff(base_s=0.1, cap_s=2.0, budget_s=budget)
         t0 = self.degraded_since if self.degraded_since is not None else (
-            time.monotonic()
+            dclock.now()
         )
         gate_logged = False
         while not self._closed:
@@ -623,7 +623,7 @@ class FabricClient:
                     continue
             if (
                 not gate_logged
-                and time.monotonic() - t0 > self._failover_s
+                and dclock.now() - t0 > self._failover_s
             ):
                 gate_logged = True
                 logger.warning(
@@ -702,7 +702,7 @@ class FabricClient:
             if self._addrs and not self._closed:
                 gate = self._failover_s + 1.0
                 if self.degraded_since is not None:
-                    gate -= time.monotonic() - self.degraded_since
+                    gate -= dclock.now() - self.degraded_since
                 if wait_budget is not None:
                     gate = min(gate, max(0.0, wait_budget))
                 if gate <= 0:
@@ -737,7 +737,7 @@ class FabricClient:
     async def lease_keepalive(self, lease_id: int) -> bool:
         if faults.active():
             inj = faults.get_injector()
-            if inj is not None and inj.keepalive_swallowed():
+            if inj is not None and inj.keepalive_swallowed(lease_id):
                 # zombie_partition fault: the refresh is silently lost.
                 # Returning True keeps the worker oblivious while the
                 # fabric's janitor expires the lease and fences the epoch.

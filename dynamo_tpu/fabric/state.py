@@ -21,11 +21,11 @@ from __future__ import annotations
 import asyncio
 import functools
 import inspect
-import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
 
 logger = get_logger("dynamo_tpu.fabric")
@@ -217,7 +217,7 @@ class FabricState:
                     # before its first post-heal keepalive can land
                     was_dark = False
                     self.grace_all_leases(10.0)
-                now = time.monotonic()
+                now = dclock.now()
                 for lease in [
                     l for l in self.leases.values() if l.deadline < now
                 ]:
@@ -242,7 +242,7 @@ class FabricState:
     def lease_grant(self, ttl: float) -> int:
         lease_id = self.next_id()
         self.leases[lease_id] = _Lease(
-            id=lease_id, ttl=ttl, deadline=time.monotonic() + ttl
+            id=lease_id, ttl=ttl, deadline=dclock.now() + ttl
         )
         return lease_id
 
@@ -251,7 +251,7 @@ class FabricState:
         lease = self.leases.get(lease_id)
         if lease is None:
             return False
-        lease.deadline = time.monotonic() + lease.ttl
+        lease.deadline = dclock.now() + lease.ttl
         return True
 
     @_replicated
@@ -420,7 +420,7 @@ class FabricState:
             if fut.done():
                 continue
             msg = q.ready.popleft()
-            q.inflight[msg.id] = (msg, time.monotonic() + q.redeliver_after)
+            q.inflight[msg.id] = (msg, dclock.now() + q.redeliver_after)
             fut.set_result(msg)
 
     @_replicated
@@ -438,7 +438,7 @@ class FabricState:
         q = self._queue(name)
         if q.ready:
             msg = q.ready.popleft()
-            q.inflight[msg.id] = (msg, time.monotonic() + q.redeliver_after)
+            q.inflight[msg.id] = (msg, dclock.now() + q.redeliver_after)
             return msg
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         q.waiters.append(fut)
@@ -500,7 +500,7 @@ class FabricState:
     def snapshot(self) -> dict:
         """Full durable state as a msgpack-able dict (watches and subs are
         connection-local and die with their connections)."""
-        now = time.monotonic()
+        now = dclock.now()
         return {
             "revision": self.revision,
             "next_id": self._next_id,
@@ -533,7 +533,7 @@ class FabricState:
         """Replace state from a snapshot. `lease_grace` widens every lease
         deadline (promotion: clients need time to fail over before their
         instances vanish)."""
-        now = time.monotonic()
+        now = dclock.now()
         self.kv = {
             k: KVEntry(value=v[0], lease_id=v[1], create_rev=v[2], mod_rev=v[3])
             for k, v in snap["kv"].items()
@@ -560,7 +560,7 @@ class FabricState:
     def grace_all_leases(self, grace: float) -> None:
         """Extend every lease to at least now+grace (promotion time: the
         fleet must get a failover window before instances expire)."""
-        floor = time.monotonic() + grace
+        floor = dclock.now() + grace
         for lease in self.leases.values():
             lease.deadline = max(lease.deadline, floor)
 
@@ -570,7 +570,7 @@ class FabricState:
             self._pin_id(result)
             self.leases[result] = _Lease(
                 id=result, ttl=a["ttl"],
-                deadline=time.monotonic() + a["ttl"],
+                deadline=dclock.now() + a["ttl"],
             )
         elif op == "lease_keepalive":
             self.lease_keepalive(a["lease_id"])

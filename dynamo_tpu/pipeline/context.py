@@ -19,10 +19,10 @@ of milliseconds, far above realistic skew). Two budgets ride along:
 
 from __future__ import annotations
 
-import time
 import uuid
 from typing import Any, Optional
 
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.cancellation import CancellationToken
 
 
@@ -88,7 +88,7 @@ class Context:
         self, timeout_ms: Optional[float], ttft_ms: Optional[float] = None
     ) -> None:
         """Arm deadlines relative to now (None leaves a budget unset)."""
-        now = time.time()
+        now = dclock.wall()
         if timeout_ms is not None:
             self.deadline = now + timeout_ms / 1e3
         if ttft_ms is not None:
@@ -98,15 +98,15 @@ class Context:
         """Seconds until the request deadline; None when unbounded."""
         if self.deadline is None:
             return None
-        return self.deadline - time.time()
+        return self.deadline - dclock.wall()
 
     def expired(self) -> bool:
-        return self.deadline is not None and time.time() > self.deadline
+        return self.deadline is not None and dclock.wall() > self.deadline
 
     def ttft_expired(self) -> bool:
         """True when the first-token budget has lapsed (callers only check
         this while no token has been produced yet)."""
-        return self.ttft_deadline is not None and time.time() > self.ttft_deadline
+        return self.ttft_deadline is not None and dclock.wall() > self.ttft_deadline
 
     # --- wire form ---
 

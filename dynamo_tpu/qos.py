@@ -31,9 +31,10 @@ Context-bearing transport.
 from __future__ import annotations
 
 import os
-import time
 from collections import deque
-from typing import Any, Optional
+from typing import Any, Callable, Optional
+
+from dynamo_tpu.runtime import clock as dclock
 
 PRIORITY_CLASSES = ("interactive", "standard", "bulk")
 DEFAULT_CLASS = "standard"
@@ -144,16 +145,22 @@ class DrainRateEstimator:
     ``retry_after_s`` falls back to the caller's constant when the window
     holds no signal (cold start, total stall)."""
 
-    def __init__(self, window_s: float = 30.0, max_events: int = 512) -> None:
+    def __init__(
+        self,
+        window_s: float = 30.0,
+        max_events: int = 512,
+        now_fn: Callable[[], float] = dclock.now,
+    ) -> None:
         self.window_s = window_s
         self._events: deque[float] = deque(maxlen=max_events)
+        self._now = now_fn
 
     def note(self, now: Optional[float] = None) -> None:
-        self._events.append(time.monotonic() if now is None else now)
+        self._events.append(self._now() if now is None else now)
 
     def rate(self, now: Optional[float] = None) -> Optional[float]:
         """Completions per second over the window; None = no signal."""
-        now = time.monotonic() if now is None else now
+        now = self._now() if now is None else now
         cutoff = now - self.window_s
         while self._events and self._events[0] < cutoff:
             self._events.popleft()

@@ -14,14 +14,17 @@ failover hunted on a flat 0.25 s, the prefill dequeue retried on a flat
   * **budget**: an optional wall-clock budget and/or attempt cap after
     which `next_delay()` returns None and the caller gives up.
 
-Deterministic tests inject ``rng`` (any callable returning [0, 1))."""
+Deterministic tests inject ``rng`` (any callable returning [0, 1)); the
+wall-clock budget reads the process clock (`runtime/clock.py`), so a
+simulated fleet exhausts retry budgets in virtual time."""
 
 from __future__ import annotations
 
 import asyncio
 import random
-import time
 from typing import Callable, Optional
+
+from dynamo_tpu.runtime import clock as dclock
 
 
 class Backoff:
@@ -37,7 +40,7 @@ class Backoff:
         budget_s: Optional[float] = None,
         max_attempts: Optional[int] = None,
         rng: Optional[Callable[[], float]] = None,
-        clock: Callable[[], float] = time.monotonic,
+        clock: Callable[[], float] = dclock.now,
     ) -> None:
         self.base_s = base_s
         self.factor = factor

@@ -31,10 +31,10 @@ to its AdmissionController; workers call ``engine.apply_brownout``).
 from __future__ import annotations
 
 import os
-import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
 
 logger = get_logger("dynamo_tpu.brownout")
@@ -91,7 +91,7 @@ class BrownoutController:
         self,
         config: Optional[BrownoutConfig] = None,
         on_change: Optional[Callable[[int, int, str], None]] = None,
-        now_fn: Callable[[], float] = time.monotonic,
+        now_fn: Callable[[], float] = dclock.now,
         scope: str = "",
     ) -> None:
         self.config = config or BrownoutConfig.from_env()

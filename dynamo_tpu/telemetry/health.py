@@ -50,10 +50,10 @@ from __future__ import annotations
 
 import math
 import os
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry.histogram import PhaseHistograms
 
@@ -193,7 +193,7 @@ class HealthScorer:
     def __init__(
         self,
         config: Optional[HealthConfig] = None,
-        now_fn: Callable[[], float] = time.monotonic,
+        now_fn: Callable[[], float] = dclock.now,
         on_eject: Optional[Callable[[int, str], None]] = None,
         on_restore: Optional[Callable[[int], None]] = None,
     ) -> None:

@@ -35,11 +35,11 @@ from __future__ import annotations
 import os
 import random
 import threading
-import time
 from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.telemetry.histogram import PhaseHistogram, PhaseHistograms
 
 try:
@@ -154,7 +154,7 @@ class SloEngine:
         config: SloConfig,
         model: Optional[str] = None,
         on_transition: Optional[Callable[[str, str, dict], None]] = None,
-        now_fn: Callable[[], float] = time.monotonic,
+        now_fn: Callable[[], float] = dclock.now,
     ) -> None:
         self.config = config
         self.model = model
@@ -374,7 +374,7 @@ class FlightRecorder:
             "reason": reason,
             "path": path,
             "bytes": size,
-            "unix_ms": int(time.time() * 1e3),
+            "unix_ms": int(dclock.wall() * 1e3),
         }
         with self._lock:
             old = self._entries.pop(key, None)

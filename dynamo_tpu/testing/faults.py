@@ -84,12 +84,43 @@ import signal
 from dataclasses import dataclass, field
 from typing import Optional
 
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
 
 logger = get_logger("dynamo_tpu.testing.faults")
 
 _active: bool = False
 _injector: Optional["FaultInjector"] = None
+
+
+class FaultSpecError(ValueError):
+    """A malformed/unknown ``DYN_FAULT`` action. Raised at PARSE time so a
+    typo'd fault spec fails the run loudly instead of silently injecting
+    nothing (and the chaos wave "passing" against zero chaos)."""
+
+
+# the taxonomy: action -> (value parser, value description). `every`,
+# `after`, and `period` are modifiers that attach to the preceding action.
+_ACTIONS: dict[str, tuple] = {
+    "kill_after_tokens": (int, "int (tokens)"),
+    "abort_after_tokens": (int, "int (tokens)"),
+    "delay_dispatch": (float, "float (seconds)"),
+    "every": (int, "int (apply on every Nth visit)"),
+    "slow_decode": (float, "float (slowdown factor)"),
+    "after": (int, "int (first dispatch affected)"),
+    "gray_flap": (float, "float (slowdown factor)"),
+    "period": (float, "float (cycle seconds)"),
+    "stall_transfer": (float, "float (seconds)"),
+    "drop_fabric_conn": (int, "int (publishes before drop)"),
+    "corrupt_kv": (str, "bits|truncate"),
+    "zombie_partition": (float, "float (seconds)"),
+    "fabric_blackout": (float, "float (seconds)"),
+    "fabric_flap": (float, "float (dark seconds per cycle)"),
+}
+
+
+def _taxonomy() -> str:
+    return ", ".join(sorted(_ACTIONS))
 
 
 @dataclass
@@ -116,9 +147,27 @@ class FaultSpec:
             part = part.strip()
             if not part:
                 continue
-            key, _, val = part.partition("=")
+            key, sep, val = part.partition("=")
             key = key.strip()
             val = val.strip()
+            if key not in _ACTIONS:
+                raise FaultSpecError(
+                    f"unknown DYN_FAULT action {key!r}; known actions: "
+                    f"{_taxonomy()}"
+                )
+            if not sep or not val:
+                raise FaultSpecError(
+                    f"DYN_FAULT action {key!r} needs a value "
+                    f"({_ACTIONS[key][1]}), got {part!r}"
+                )
+            caster = _ACTIONS[key][0]
+            try:
+                caster(val)
+            except ValueError:
+                raise FaultSpecError(
+                    f"DYN_FAULT action {key!r} value {val!r} is not a valid "
+                    f"{_ACTIONS[key][1]}; known actions: {_taxonomy()}"
+                ) from None
             if key == "kill_after_tokens":
                 out.kill_after_tokens = int(val)
             elif key == "abort_after_tokens":
@@ -141,7 +190,7 @@ class FaultSpec:
                 out.drop_fabric_conn = int(val)
             elif key == "corrupt_kv":
                 if val not in ("bits", "truncate"):
-                    raise ValueError(
+                    raise FaultSpecError(
                         f"corrupt_kv mode must be bits|truncate, got {val!r}"
                     )
                 out.corrupt_kv = val
@@ -151,8 +200,6 @@ class FaultSpec:
                 out.fabric_blackout_s = float(val)
             elif key == "fabric_flap":
                 out.fabric_flap_s = float(val)
-            else:
-                raise ValueError(f"unknown DYN_FAULT action {key!r}")
         return out
 
 
@@ -220,9 +267,7 @@ class FaultInjector:
             return 1.0
         g = self.spec.gray_flap_factor
         if g and g != 1.0:
-            import time
-
-            now = time.monotonic()
+            now = dclock.now()
             if self._gray_t0 is None:
                 self._gray_t0 = now
             period = max(1e-3, self.spec.period_s)
@@ -300,7 +345,7 @@ class FaultInjector:
             return False
         return True
 
-    def keepalive_swallowed(self) -> bool:
+    def keepalive_swallowed(self, lease_id: int = 0) -> bool:
         """Lease-keepalive fault point (fabric client). True while the
         zombie-partition window is open: the keepalive must be silently
         dropped — the fabric never refreshes the lease, the worker
@@ -311,11 +356,9 @@ class FaultInjector:
         s = self.spec.zombie_partition_s
         if not s:
             return False
-        import time
-
         if self._zombie_t0 is None:
-            self._zombie_t0 = time.monotonic()
-        if time.monotonic() - self._zombie_t0 < s:
+            self._zombie_t0 = dclock.now()
+        if dclock.now() - self._zombie_t0 < s:
             self._mark("zombie_partition")
             return True
         return False
@@ -331,9 +374,7 @@ class FaultInjector:
         f = self.spec.fabric_flap_s
         if not b and not f:
             return False
-        import time
-
-        now = time.monotonic()
+        now = dclock.now()
         if self._fabric_t0 is None:
             self._fabric_t0 = now
         elapsed = now - self._fabric_t0

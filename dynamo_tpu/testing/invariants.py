@@ -1,0 +1,313 @@
+"""Always-on fleet invariants for deterministic simulation (ISSUE 15).
+
+Jepsen-style checkers evaluated CONTINUOUSLY while a simulated fleet
+runs — not asserted once at the end — so a violation is caught at the
+virtual instant it happens and the banked `(seed, schedule)` artifact
+replays straight to it.  Each checker is a small pure function over a
+`FleetView` (the duck-typed window `testing/sim.py` maintains); the
+suite counts evaluations per invariant so a green run can prove the
+checkers actually ran (`benchmarks/sim_sweep.json` banks the counts).
+
+The catalog (each is a property every robustness plane already promises;
+the sim harness makes the promises continuously machine-checked):
+
+  * **kv-conservation** — per engine, at every await point:
+    ``free + cached + Σ unique(active) == num_blocks``, no negative
+    refcounts.  A leak through any crash/cancel/preempt/fault path
+    breaks the identity immediately, not at teardown.
+  * **token-identity** — every stream (including across a migration
+    replay) is a prefix of, and finally equal to, the deterministic
+    expected stream.  Corruption reaching decode, double-applied
+    replays, or lost tokens all surface here.
+  * **no-double-serve** — the epoch-fence promise: once the cluster has
+    written a fence tombstone for a worker's lease, no CONSUMER may
+    accept tokens from that worker (past a short in-flight grace).  A
+    partitioned zombie legitimately keeps decoding into the void — the
+    promise is that every landing point refuses its frames.  Accepting
+    one is the double-serve window PR 8 closed; this checker catches it
+    being re-opened (the planted fence-check-disabled bug).
+  * **monotone-counters** — every counter the stats plane exports only
+    moves forward (blackout buffering must never make a reader observe
+    a counter regression).
+  * **bounded-queues** — admission queues, per-stream output queues,
+    and the degraded-mode rings stay under their configured bounds; an
+    unbounded queue is an OOM on a real fleet.
+  * **no-stuck-stream** — a virtual-time watchdog: every in-flight
+    request makes progress (a token, a state change, or termination)
+    within ``stall_limit_s`` SIMULATED seconds.  This replaces the
+    wall-clock `asyncio.wait_for` racing the old chaos soaks relied on.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+__all__ = [
+    "Violation",
+    "Invariant",
+    "InvariantSuite",
+    "KvConservation",
+    "TokenIdentity",
+    "NoDoubleServe",
+    "MonotoneCounters",
+    "BoundedQueues",
+    "NoStuckStream",
+    "default_suite",
+]
+
+
+@dataclass
+class Violation:
+    """One invariant violation at one virtual instant."""
+
+    invariant: str
+    t_sim: float
+    detail: str
+    context: dict = field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {
+            "invariant": self.invariant,
+            "t_sim": round(self.t_sim, 6),
+            "detail": self.detail,
+            "context": self.context,
+        }
+
+
+class Invariant:
+    """Base checker: `check(fleet)` returns violation details (strings)."""
+
+    name = "invariant"
+
+    def __init__(self) -> None:
+        self.evals = 0
+        self.violations = 0
+
+    def check(self, fleet: Any) -> list[str]:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def observe(self, fleet: Any) -> list[Violation]:
+        self.evals += 1
+        out = []
+        for detail in self.check(fleet):
+            self.violations += 1
+            out.append(Violation(self.name, fleet.now(), detail))
+        return out
+
+
+class KvConservation(Invariant):
+    """free + cached + Σ unique(active) == num_blocks, refs >= 0."""
+
+    name = "kv_conservation"
+
+    def check(self, fleet: Any) -> list[str]:
+        out = []
+        for wname, engine in fleet.engines().items():
+            cache = engine.cache
+            neg = [h for h, n in cache.refs.items() if n < 0]
+            if neg:
+                out.append(f"{wname}: negative KV refcounts {neg[:4]}")
+            held = sum(s.unique_blocks for s in engine.active)
+            total = cache.free_blocks + len(cache.refs) + held
+            if total != engine.args.num_blocks:
+                out.append(
+                    f"{wname}: KV blocks not conserved: free="
+                    f"{cache.free_blocks} cached={len(cache.refs)} "
+                    f"active_unique={held} != total={engine.args.num_blocks}"
+                )
+            if cache.free_blocks < 0:
+                out.append(f"{wname}: free_blocks={cache.free_blocks} < 0")
+        return out
+
+
+class TokenIdentity(Invariant):
+    """Every stream is a prefix of (finally equal to) its expected
+    deterministic token sequence, across migrations/hedges/replays."""
+
+    name = "token_identity"
+
+    def check(self, fleet: Any) -> list[str]:
+        out = []
+        for track in fleet.tracks():
+            exp = track.expected
+            got = track.got
+            if got[: len(exp)] != exp[: len(got)]:
+                out.append(
+                    f"req {track.rid}: diverged at {len(got)} tokens "
+                    f"(got tail {got[-4:]}, want {exp[max(0, len(got) - 4):len(got)]})"
+                )
+            elif len(got) > len(exp):
+                out.append(
+                    f"req {track.rid}: over-generated {len(got)} > "
+                    f"{len(exp)} expected tokens"
+                )
+            elif track.done and track.error is None and got != exp:
+                out.append(
+                    f"req {track.rid}: finished ok with {len(got)}/"
+                    f"{len(exp)} expected tokens"
+                )
+        return out
+
+
+class NoDoubleServe(Invariant):
+    """No consumer accepts tokens from a worker whose lease the cluster
+    has tombstoned (past `grace_s` simulated seconds of in-flight
+    drain).  The harness appends every consumer-ACCEPTED frame to
+    `fleet.accept_log()` as ``(rid, worker, t_sim, n_tokens)`` and maps
+    the fabric's fence/ prefix to `fleet.fence_tombstones()` =
+    ``{worker: t_first_seen}``; this checker scans new log entries each
+    tick with a cursor."""
+
+    name = "no_double_serve"
+
+    def __init__(self, grace_s: float = 2.0) -> None:
+        super().__init__()
+        self.grace_s = grace_s
+        self._cursor = 0
+
+    def check(self, fleet: Any) -> list[str]:
+        out = []
+        tombstones = fleet.fence_tombstones()
+        log = fleet.accept_log()
+        for rid, worker, t_accept, n_tokens in log[self._cursor:]:
+            t_fenced = tombstones.get(worker)
+            if t_fenced is None or n_tokens <= 0:
+                continue
+            if t_accept > t_fenced + self.grace_s:
+                out.append(
+                    f"req {rid}: accepted {n_tokens} token(s) from {worker} "
+                    f"{t_accept - t_fenced:.3f}s after its fence tombstone "
+                    f"— zombie double-serve window"
+                )
+        self._cursor = len(log)
+        return out
+
+
+class MonotoneCounters(Invariant):
+    """Every exported counter only moves forward."""
+
+    name = "monotone_counters"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._last: dict[str, float] = {}
+
+    def check(self, fleet: Any) -> list[str]:
+        out = []
+        cur = fleet.counters()
+        for key, val in cur.items():
+            prev = self._last.get(key)
+            if prev is not None and val < prev:
+                out.append(f"counter {key} regressed {prev} -> {val}")
+        self._last = dict(cur)
+        return out
+
+
+class BoundedQueues(Invariant):
+    """Admission queues, stream output queues, and degraded rings stay
+    under bound (an unbounded queue is a fleet OOM)."""
+
+    name = "bounded_queues"
+
+    def __init__(
+        self, max_waiting: int = 4096, max_stream_queue: int = 4096
+    ) -> None:
+        super().__init__()
+        self.max_waiting = max_waiting
+        self.max_stream_queue = max_stream_queue
+
+    def check(self, fleet: Any) -> list[str]:
+        out = []
+        for wname, engine in fleet.engines().items():
+            if len(engine.waiting) > self.max_waiting:
+                out.append(
+                    f"{wname}: admission queue {len(engine.waiting)} > "
+                    f"{self.max_waiting}"
+                )
+            for seq in engine.active:
+                if seq.out.qsize() > self.max_stream_queue:
+                    out.append(
+                        f"{wname}: stream queue {seq.out.qsize()} > "
+                        f"{self.max_stream_queue}"
+                    )
+        for cname, client in fleet.fabric_clients().items():
+            ring = client._pub_ring
+            if ring.maxlen is not None and len(ring) > ring.maxlen:
+                out.append(f"{cname}: degraded publish ring over maxlen")
+            if len(client._kv_ring) > client._kv_ring_max:
+                out.append(
+                    f"{cname}: degraded kv ring {len(client._kv_ring)} > "
+                    f"{client._kv_ring_max}"
+                )
+        return out
+
+
+class NoStuckStream(Invariant):
+    """Virtual-time watchdog: every in-flight request progresses within
+    `stall_limit_s` simulated seconds."""
+
+    name = "no_stuck_stream"
+
+    def __init__(self, stall_limit_s: float = 120.0) -> None:
+        super().__init__()
+        self.stall_limit_s = stall_limit_s
+
+    def check(self, fleet: Any) -> list[str]:
+        out = []
+        now = fleet.now()
+        for track in fleet.tracks():
+            if track.done:
+                continue
+            idle = now - track.last_progress_t
+            if idle > self.stall_limit_s:
+                out.append(
+                    f"req {track.rid}: no progress for {idle:.1f} simulated "
+                    f"seconds (worker={track.worker}, "
+                    f"{len(track.got)} tokens so far)"
+                )
+        return out
+
+
+class InvariantSuite:
+    """A set of checkers evaluated together each monitor tick."""
+
+    def __init__(self, invariants: list[Invariant]) -> None:
+        self.invariants = invariants
+        self.found: list[Violation] = []
+
+    def observe(self, fleet: Any) -> list[Violation]:
+        fresh: list[Violation] = []
+        for inv in self.invariants:
+            fresh.extend(inv.observe(fleet))
+        self.found.extend(fresh)
+        return fresh
+
+    def stats(self) -> dict:
+        return {
+            inv.name: {"evals": inv.evals, "violations": inv.violations}
+            for inv in self.invariants
+        }
+
+    def get(self, name: str) -> Optional[Invariant]:
+        for inv in self.invariants:
+            if inv.name == name:
+                return inv
+        return None
+
+
+def default_suite(
+    stall_limit_s: float = 120.0,
+    fence_grace_s: float = 2.0,
+) -> InvariantSuite:
+    """The full catalog with scenario-tunable bounds."""
+    return InvariantSuite(
+        [
+            KvConservation(),
+            TokenIdentity(),
+            NoDoubleServe(grace_s=fence_grace_s),
+            MonotoneCounters(),
+            BoundedQueues(),
+            NoStuckStream(stall_limit_s=stall_limit_s),
+        ]
+    )

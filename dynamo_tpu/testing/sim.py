@@ -1,0 +1,1229 @@
+"""Deterministic fleet simulation: virtual-clock chaos with always-on
+invariants and failure-seed shrinking (ISSUE 15).
+
+The wall-clock chaos soaks (tests/test_chaos_soak.py) buy their realism
+with real seconds: a 30-simulated-minute blackout wave takes 30 real
+minutes, a lost race reproduces one run in fifty, and "the stream got
+stuck" is diagnosed from a timeout stack.  This module runs the SAME
+fleet — real `DistributedRuntime` leases + fencing, real in-proc fabric
+(janitor, degraded-mode rings, blackout heal), real discovery watches,
+real `RemoteEngine` migration/hedging, real `HealthScorer` ejection,
+real mocker engines with their simulated KV caches — on a **virtual
+clock**:
+
+  * `SimClock` is installed process-wide (`runtime/clock.py`), so every
+    EWMA, lease deadline, retry ladder, and staleness window reads
+    simulated seconds;
+  * `SimEventLoop` (a `SelectorEventLoop` whose `time()` is the
+    SimClock) advances the clock straight to the next timer whenever no
+    callback is ready — `asyncio.sleep(300)` costs zero wall time — so
+    hundreds of simulated minutes run in seconds of wall time;
+  * ONE seeded RNG stream drives the workload and the fault schedule;
+    `random.seed(seed)` pins the library jitter (migration backoff,
+    random routing), so a run is **bit-identical** for a given
+    `(seed, config)` — the digest over every accepted emission proves
+    it.
+
+Chaos arrives as a `FaultSchedule`: virtual-time-stamped events drawn
+from the DYN_FAULT taxonomy (worker kill via real lease expiry +
+fencing, control-plane blackout windows, gray stragglers, KV
+corruption windows, zombie partitions, dispatch delay/abort windows),
+applied by `SimScheduledInjector` + a schedule-applier task.  The
+invariant suite (`testing/invariants.py`) is evaluated every monitor
+tick, the whole run long.
+
+On a violation the harness banks a replayable **artifact** — the seed,
+the config, the exact schedule, and the violation — then `shrink()`
+delta-debugs (ddmin) the schedule down to a minimal reproducing event
+set.  `tools/sim_replay.py` re-executes an artifact byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import hashlib
+import heapq
+import json
+import os
+import random
+import time
+from dataclasses import asdict, dataclass, field, replace
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+from dynamo_tpu.runtime import clock as dclock
+from dynamo_tpu.testing import faults
+from dynamo_tpu.testing.invariants import InvariantSuite, default_suite
+
+__all__ = [
+    "SimClock",
+    "SimEventLoop",
+    "SimDeadlockError",
+    "SimScheduledInjector",
+    "FaultEvent",
+    "FaultSchedule",
+    "SimConfig",
+    "SimResult",
+    "run_sim",
+    "chaos_scenario",
+    "planted_fence_bug_scenario",
+    "bank_artifact",
+    "load_artifact",
+    "shrink_schedule",
+    "FAULT_CLASSES",
+]
+
+
+# --------------------------------------------------------------- the clock
+
+
+class SimClock:
+    """Virtual monotonic + epoch clock, advanced only by the event loop."""
+
+    def __init__(self, start: float = 1000.0, epoch: float = 1.7e9) -> None:
+        self.t = float(start)
+        self._epoch_off = float(epoch) - self.t
+
+    def now(self) -> float:
+        return self.t
+
+    def wall(self) -> float:
+        return self._epoch_off + self.t
+
+    def advance_to(self, t: float) -> None:
+        if t > self.t:
+            self.t = t
+
+
+class SimDeadlockError(RuntimeError):
+    """The loop has no ready callback AND no scheduled timer while work
+    is still pending: the simulated fleet is genuinely wedged (a lost
+    wakeup — the bug class the virtual-time watchdog exists to catch,
+    surfaced here when even the watchdog's own timer is gone)."""
+
+
+class SimEventLoop(asyncio.SelectorEventLoop):
+    """SelectorEventLoop on virtual time.
+
+    `time()` reads the SimClock.  `_run_once` is replaced: when no
+    callback is ready, instead of blocking in `select()` until the next
+    timer's wall deadline, the SimClock jumps straight to it.  Ready
+    callbacks run in FIFO order and the timer heap orders solely by
+    virtual deadline (insertion-ordered on ties), so execution order —
+    and therefore the whole run — is deterministic."""
+
+    def __init__(self, clock: SimClock) -> None:
+        super().__init__()
+        self._sim_clock = clock
+
+    def time(self) -> float:
+        return self._sim_clock.now()
+
+    def _run_once(self) -> None:
+        sched = self._scheduled
+        # drop cancelled timers from the heap head (the bookkeeping the
+        # base loop does before computing its select() timeout)
+        while sched and sched[0]._cancelled:
+            self._timer_cancelled_count -= 1
+            handle = heapq.heappop(sched)
+            handle._scheduled = False
+        if not self._ready:
+            if sched:
+                self._sim_clock.advance_to(sched[0]._when)
+            elif not self._stopping:
+                raise SimDeadlockError(
+                    f"simulation deadlock at t={self._sim_clock.now():.3f}: "
+                    "no ready callback and no scheduled timer, but the "
+                    "main future is not done"
+                )
+        # never block: virtual time means there is nothing to wait FOR
+        self._process_events(self._selector.select(0))
+        end_time = self.time() + self._clock_resolution
+        while sched and sched[0]._when < end_time:
+            handle = heapq.heappop(sched)
+            handle._scheduled = False
+            if handle._cancelled:
+                self._timer_cancelled_count -= 1
+                continue
+            self._ready.append(handle)
+        for _ in range(len(self._ready)):
+            handle = self._ready.popleft()
+            if not handle._cancelled:
+                handle._run()
+        handle = None  # noqa: F841 — break the cycle, as the base loop does
+
+
+# ----------------------------------------------------------- the injector
+
+
+class SimScheduledInjector(faults.FaultInjector):
+    """FaultInjector whose partition/blackout decisions come from
+    virtual-time WINDOWS instead of first-visit-relative onsets, and
+    whose zombie partitions are per-lease (only the target worker's
+    keepalives are swallowed).  Spec-field faults (corrupt_kv, dispatch
+    delay, abort windows) are applied by the schedule applier mutating
+    `self.spec` at event times — the standard injector machinery then
+    fires them exactly as production code expects."""
+
+    def __init__(self) -> None:
+        super().__init__(faults.FaultSpec())
+        self.blackout_windows: list[tuple[float, float]] = []
+        self.zombie_windows: dict[int, list[tuple[float, float]]] = {}
+
+    def fabric_unreachable(self) -> bool:
+        now = dclock.now()
+        for t0, t1 in self.blackout_windows:
+            if t0 <= now < t1:
+                self._mark("fabric_blackout")
+                return True
+        return False
+
+    def keepalive_swallowed(self, lease_id: int = 0) -> bool:
+        now = dclock.now()
+        for t0, t1 in self.zombie_windows.get(lease_id, ()):
+            if t0 <= now < t1:
+                self._mark("zombie_partition")
+                return True
+        return False
+
+
+# ----------------------------------------------------------- the schedule
+
+
+# the sim's fault classes; each maps onto DYN_FAULT taxonomy machinery
+FAULT_CLASSES = (
+    "worker_kill",      # real lease expiry -> fence tombstone -> migration
+    "fabric_blackout",  # control-plane dark window (degraded-mode rings)
+    "gray_straggler",   # one worker N-times slow (health ejection + hedge)
+    "corrupt_kv",       # disagg payload corruption window (integrity)
+    "zombie_partition", # keepalives swallowed: cluster expires the lease
+    "delay_window",     # delay_dispatch churn window
+    "abort_window",     # abort_after_tokens window (in-process crashes)
+)
+
+
+@dataclass
+class FaultEvent:
+    """One scheduled fault: fires at virtual second `t` (relative to sim
+    start), targets worker index `target` (-1 = fleet-wide), lasts
+    `duration_s`, with an action-specific `param`."""
+
+    t: float
+    action: str
+    target: int = -1
+    duration_s: float = 0.0
+    param: Any = None
+
+    def to_json(self) -> dict:
+        return {
+            "t": round(self.t, 6),
+            "action": self.action,
+            "target": self.target,
+            "duration_s": round(self.duration_s, 6),
+            "param": self.param,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FaultEvent":
+        return cls(
+            t=float(d["t"]),
+            action=str(d["action"]),
+            target=int(d.get("target", -1)),
+            duration_s=float(d.get("duration_s", 0.0)),
+            param=d.get("param"),
+        )
+
+
+@dataclass
+class FaultSchedule:
+    events: list[FaultEvent] = field(default_factory=list)
+
+    def to_json(self) -> list[dict]:
+        return [e.to_json() for e in self.events]
+
+    @classmethod
+    def from_json(cls, raw: list[dict]) -> "FaultSchedule":
+        return cls([FaultEvent.from_json(d) for d in raw])
+
+    def classes(self) -> set[str]:
+        return {e.action for e in self.events}
+
+    @classmethod
+    def generate(
+        cls,
+        rng: random.Random,
+        sim_seconds: float,
+        n_workers: int,
+        classes: tuple = FAULT_CLASSES,
+        density: float = 1.0,
+    ) -> "FaultSchedule":
+        """Draw a schedule covering every requested fault class at least
+        once, then fill with `density` extra events per simulated minute.
+        Times land in the middle 80% of the run so every fault hits live
+        traffic."""
+        events: list[FaultEvent] = []
+        lo, hi = 0.1 * sim_seconds, 0.9 * sim_seconds
+
+        def draw(action: str) -> FaultEvent:
+            t = rng.uniform(lo, hi)
+            target = rng.randrange(n_workers)
+            if action == "worker_kill":
+                # duration = respawn delay for the replacement incarnation
+                return FaultEvent(t, action, target, rng.uniform(2.0, 6.0))
+            if action == "fabric_blackout":
+                # always under the degraded budget: blackouts longer than
+                # DYN_DEGRADED_MAX_S are a different (self-fence) scenario
+                return FaultEvent(t, action, -1, rng.uniform(0.5, 2.0))
+            if action == "gray_straggler":
+                return FaultEvent(
+                    t, action, target, rng.uniform(4.0, 10.0),
+                    rng.choice([3.0, 5.0, 8.0]),
+                )
+            if action == "corrupt_kv":
+                return FaultEvent(
+                    t, action, -1, rng.uniform(2.0, 6.0),
+                    rng.choice(["bits", "truncate"]),
+                )
+            if action == "zombie_partition":
+                return FaultEvent(t, action, target, rng.uniform(3.0, 6.0))
+            if action == "delay_window":
+                return FaultEvent(
+                    t, action, -1, rng.uniform(2.0, 5.0),
+                    rng.choice([0.01, 0.05]),
+                )
+            if action == "abort_window":
+                return FaultEvent(
+                    t, action, -1, rng.uniform(1.0, 3.0),
+                    rng.choice([50, 120]),
+                )
+            raise ValueError(f"unknown fault class {action!r}")
+
+        for action in classes:
+            events.append(draw(action))
+        extra = int(density * sim_seconds / 60.0)
+        for _ in range(extra):
+            events.append(draw(rng.choice(classes)))
+        events.sort(key=lambda e: e.t)
+        return cls(events)
+
+
+# ------------------------------------------------------------- the config
+
+
+@dataclass
+class SimConfig:
+    seed: int = 0
+    sim_minutes: float = 1.0
+    n_workers: int = 4
+    num_blocks: int = 768
+    block_size: int = 4
+    max_batch: int = 8
+    lease_ttl_s: float = 1.0
+    decode_per_token_s: float = 0.01  # ~100 tok/s per worker, simulated
+    # workload: mean inter-arrival gap and request shapes (mixed priority:
+    # every 3rd request interactive, the rest bulk)
+    request_interval_s: float = 1.0
+    prompt_len: tuple = (3, 20)
+    max_tokens: tuple = (4, 32)
+    disagg: bool = True
+    hedge: bool = False
+    planner: bool = False
+    planner_interval_s: float = 5.0
+    schedule: Optional[FaultSchedule] = None
+    monitor_interval_s: float = 0.5
+    stall_limit_s: float = 60.0
+    fence_grace_s: float = 2.0
+    degraded_max_s: float = 20.0
+    stop_on_violation: bool = True
+    # planted-bug flag (tests only): drop the consumer-side epoch-fence
+    # stamp check, re-opening the zombie double-serve window that the
+    # no_double_serve invariant must then catch
+    disable_fence_check: bool = False
+
+    def to_json(self) -> dict:
+        d = asdict(self)
+        d["prompt_len"] = list(self.prompt_len)
+        d["max_tokens"] = list(self.max_tokens)
+        d["schedule"] = self.schedule.to_json() if self.schedule else None
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "SimConfig":
+        d = dict(d)
+        if d.get("schedule") is not None:
+            d["schedule"] = FaultSchedule.from_json(d["schedule"])
+        for k in ("prompt_len", "max_tokens"):
+            if k in d:
+                d[k] = tuple(d[k])
+        known = {f for f in cls.__dataclass_fields__}  # noqa: C416
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class SimResult:
+    ok: bool
+    seed: int
+    sim_seconds: float
+    wall_seconds: float
+    digest: str
+    violations: list[dict]
+    invariant_stats: dict
+    outcomes: dict
+    counters: dict
+    fault_fired: dict
+    n_requests: int
+    fault_classes: list[str]
+    config: dict
+
+    @property
+    def sim_min_per_wall_s(self) -> float:
+        return (self.sim_seconds / 60.0) / max(1e-9, self.wall_seconds)
+
+    def to_json(self) -> dict:
+        d = asdict(self)
+        d["sim_min_per_wall_s"] = round(self.sim_min_per_wall_s, 3)
+        return d
+
+
+# -------------------------------------------------------------- the fleet
+
+
+@dataclass
+class _Track:
+    """Driver-side record of one request: the FleetView unit the
+    token-identity / stuck-stream invariants read."""
+
+    rid: str
+    priority: str
+    prompt: list[int]
+    expected: list[int]
+    got: list[int] = field(default_factory=list)
+    done: bool = False
+    error: Optional[dict] = None
+    worker: str = ""
+    last_progress_t: float = 0.0
+
+
+class _Worker:
+    """One live worker incarnation: engine + its own DistributedRuntime
+    (own lease, keepalive loop, fence hook) on the shared fabric state."""
+
+    def __init__(self, name: str, drt: Any, engine: Any, service: Any):
+        self.name = name
+        self.drt = drt
+        self.engine = engine
+        self.service = service
+
+    @property
+    def lease(self) -> int:
+        return self.drt.primary_lease
+
+
+class SimFleet:
+    """Assembles and runs the fleet; implements the FleetView surface
+    the invariant suite reads (now/engines/tracks/fence_tombstones/
+    accept_log/counters/fabric_clients)."""
+
+    NS = "sim"
+    # engine error codes that, on the wire, mean the worker died under
+    # the consumer (fence teardown / injected crash): the handler turns
+    # them into a broken stream so RemoteEngine's migration plane — not
+    # the consumer — absorbs them, exactly as TCP teardown would
+    BREAK_CODES = ("worker_fenced", "injected_fault")
+
+    def __init__(self, cfg: SimConfig, suite: InvariantSuite) -> None:
+        self.cfg = cfg
+        self.suite = suite
+        self.rng = random.Random(cfg.seed)
+        self.injector = SimScheduledInjector()
+        self.t0 = dclock.now()
+        self.workers: list[_Worker] = []  # every incarnation, ever
+        self._live: dict[int, _Worker] = {}  # worker index -> incarnation
+        self._gen: dict[int, int] = {}  # worker index -> incarnation count
+        self._lease_names: dict[int, str] = {}  # lease -> worker name
+        self._tracks: list[_Track] = []
+        self._accept_log: list[tuple] = []
+        self._emissions: list[str] = []  # digest feed
+        self._tombstones: dict[str, float] = {}  # worker name -> t_seen
+        self.outcomes = {"ok": 0, "error": 0}
+        self.violation_stop = asyncio.Event()
+        self.state = None
+        self.front = None
+        self.client = None
+        self.remote = None
+        self.scorer = None
+        self.hedger = None
+        self.prefill_service = None
+        self.prefill_client = None
+        self._stats_reads: dict[str, int] = {}
+        self._bg: list[asyncio.Task] = []
+
+    # ------------------------------------------------------ FleetView API
+
+    def now(self) -> float:
+        return dclock.now()
+
+    def engines(self) -> dict:
+        return {w.name: w.engine for w in self.workers}
+
+    def tracks(self) -> list[_Track]:
+        return self._tracks
+
+    def fence_tombstones(self) -> dict[str, float]:
+        return self._tombstones
+
+    def accept_log(self) -> list[tuple]:
+        return self._accept_log
+
+    def fabric_clients(self) -> dict:
+        out = {}
+        if self.front is not None:
+            out["front"] = self.front.fabric
+        for w in self.workers:
+            out[w.name] = w.drt.fabric
+        return out
+
+    def counters(self) -> dict:
+        out: dict[str, float] = {}
+        for w in self.workers:
+            e = w.engine
+            out[f"tokens/{w.name}"] = e.generated_tokens
+            out[f"prefilled/{w.name}"] = e.prefilled_tokens
+            out[f"remote_prefills/{w.name}"] = e.remote_prefills
+        if self.scorer is not None:
+            out["ejections"] = sum(self.scorer.ejections_total.values())
+        if self.hedger is not None:
+            out["hedges"] = self.hedger.hedges
+        if self.front is not None:
+            out["blackouts"] = self.front.fabric.blackouts_total
+        out.update(self._stats_reads)
+        return out
+
+    # ------------------------------------------------------ fleet assembly
+
+    def _engine_args(self):
+        from dynamo_tpu.engine.mocker import MockEngineArgs
+
+        cfg = self.cfg
+        return MockEngineArgs(
+            num_blocks=cfg.num_blocks,
+            block_size=cfg.block_size,
+            max_batch=cfg.max_batch,
+            speedup_ratio=1.0,  # virtual time is free: simulate 1:1
+            decode_per_token_s=cfg.decode_per_token_s,
+            prefill_linear_s=1e-4,
+            prefill_quadratic_s=0.0,
+        )
+
+    def _make_handler(self, worker: _Worker) -> Callable:
+        from dynamo_tpu.protocols.common import PreprocessedRequest
+        from dynamo_tpu.runtime.fencing import make_stamp
+
+        engine = worker.engine
+        wname = worker.name
+        stamp = make_stamp(worker.lease, worker.lease)
+
+        async def handler(request, ctx):
+            pre = PreprocessedRequest.from_dict(request)
+            async for out in engine.generate(pre, ctx):
+                if out.error is not None and (
+                    out.error.get("code") in self.BREAK_CODES
+                ):
+                    # on the wire a fenced/crashed worker tears the TCP
+                    # stream down; locally we surface the same signal so
+                    # the real migration plane handles it
+                    raise ConnectionError(out.error.get("cause", "died"))
+                d = out.to_dict()
+                d["stamp"] = stamp  # epoch fencing, as the worker host does
+                d["text"] = wname  # worker attribution for the accept log
+                yield d
+
+        return handler
+
+    async def _spawn_worker(self, idx: int) -> _Worker:
+        from dynamo_tpu.engine.mocker import MockEngine
+        from dynamo_tpu.runtime.config import RuntimeConfig
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+        gen = self._gen.get(idx, 0)
+        self._gen[idx] = gen + 1
+        drt = await DistributedRuntime.detached(
+            config=RuntimeConfig(lease_ttl_s=self.cfg.lease_ttl_s),
+            state=self.state,
+        )
+        engine = MockEngine(
+            self._engine_args(),
+            remote_prefill_client=self.prefill_client if self.cfg.disagg
+            else None,
+            disagg_threshold=2 * self.cfg.block_size,
+        )
+        drt.on_fence(engine.fence)
+        ep = (
+            drt.namespace(self.NS).component("worker").endpoint("generate")
+        )
+        worker = _Worker(f"w{idx}.g{gen}", drt, engine, None)
+        worker.service = await ep.serve_endpoint(self._make_handler(worker))
+        self._lease_names[worker.lease] = worker.name
+        self.workers.append(worker)
+        self._live[idx] = worker
+        # local short-circuit for the frontend (the fleet is one process:
+        # dispatch must not open real sockets under virtual time)
+        if self.front is not None:
+            self.front.local_endpoints.update(drt.local_endpoints)
+        return worker
+
+    async def start(self) -> None:
+        from dynamo_tpu.disagg.transfer import (
+            PrefillWorkerService,
+            RemotePrefillClient,
+        )
+        from dynamo_tpu.discovery import RemoteEngine
+        from dynamo_tpu.engine.mocker import (
+            MockEngineArgs,
+            MockPrefillEngine,
+        )
+        from dynamo_tpu.fabric.state import FabricState
+        from dynamo_tpu.pipeline.router import PushRouter, RouterMode
+        from dynamo_tpu.runtime.config import RuntimeConfig
+        from dynamo_tpu.runtime.distributed import DistributedRuntime
+        from dynamo_tpu.telemetry.health import (
+            HealthConfig,
+            HealthScorer,
+            HedgeController,
+        )
+
+        cfg = self.cfg
+        self.state = FabricState()
+        faults.set_injector(self.injector)
+        self.front = await DistributedRuntime.detached(
+            config=RuntimeConfig(lease_ttl_s=cfg.lease_ttl_s),
+            state=self.state,
+        )
+        if cfg.disagg:
+            BS = cfg.block_size
+            prefill = MockPrefillEngine(
+                MockEngineArgs(block_size=BS, speedup_ratio=1.0,
+                               prefill_linear_s=1e-4,
+                               prefill_quadratic_s=0.0),
+                chunk_blocks=1,
+            )
+            self.prefill_service = PrefillWorkerService(
+                self.front.fabric, self.NS, prefill
+            )
+            self.prefill_client = RemotePrefillClient(
+                self.front.fabric, self.NS, block_size=BS, timeout=20
+            )
+            await self.prefill_service.start()
+            await self.prefill_client.start()
+        for i in range(cfg.n_workers):
+            await self._spawn_worker(i)
+        ep = (
+            self.front.namespace(self.NS)
+            .component("worker")
+            .endpoint("generate")
+        )
+        self.client = await ep.client()
+        await self.client.wait_for_instances()
+        self.scorer = HealthScorer(
+            HealthConfig(
+                eject_ratio=3.0, eject_intervals=3, recover_ratio=1.5,
+                recover_intervals=4, min_healthy=1, probe_every=32,
+                alpha=0.4, stale_after_s=10.0,
+            )
+        )
+        self.client.health = self.scorer
+        if cfg.hedge:
+            self.hedger = HedgeController(
+                budget_fraction=0.05, min_delay_ms=8.0
+            )
+        fences = None
+        if not cfg.disable_fence_check:
+            fences = await self.front.fences()
+        self.remote = RemoteEngine(
+            PushRouter(self.client, RouterMode.ROUND_ROBIN),
+            health=self.scorer,
+            hedger=self.hedger,
+            fences=fences,
+        )
+
+    async def close(self) -> None:
+        for t in self._bg:
+            t.cancel()
+        if self._bg:
+            await asyncio.gather(*self._bg, return_exceptions=True)
+        faults.set_injector(None)
+        if self.client is not None:
+            await self.client.close()
+        for w in self.workers:
+            with contextlib.suppress(Exception):
+                await w.engine.close()
+        if self.prefill_client is not None:
+            await self.prefill_client.close()
+        if self.prefill_service is not None:
+            await self.prefill_service.close()
+        for w in self.workers:
+            with contextlib.suppress(Exception):
+                await w.drt.close()
+        if self.front is not None:
+            await self.front.close()
+
+    # --------------------------------------------------------- background
+
+    def _spawn_bg(self, coro) -> None:
+        self._bg.append(asyncio.get_running_loop().create_task(coro))
+
+    async def _monitor_loop(self) -> None:
+        """The always-on invariant evaluator: every tick, refresh the
+        fence-tombstone view from the fabric and run the whole suite."""
+        while True:
+            await asyncio.sleep(self.cfg.monitor_interval_s)
+            self._refresh_tombstones()
+            fresh = self.suite.observe(self)
+            if fresh and self.cfg.stop_on_violation:
+                self.violation_stop.set()
+            if self.scorer is not None:
+                self.scorer.tick()
+
+    def _refresh_tombstones(self) -> None:
+        from dynamo_tpu.runtime.fencing import FENCE_ROOT
+
+        now = dclock.now()
+        for key in self.state.kv:
+            if not key.startswith(FENCE_ROOT):
+                continue
+            try:
+                lease = int(key[len(FENCE_ROOT):], 16)
+            except ValueError:
+                continue
+            name = self._lease_names.get(lease)
+            if name is not None and name not in self._tombstones:
+                self._tombstones[name] = now
+
+    async def _stats_loop(self) -> None:
+        """PR 10 backport: a per-worker monotone tick published through
+        the fabric every interval — buffered last-wins through
+        blackouts, flushed on heal. Read-backs feed MonotoneCounters:
+        a blackout must never make a reader observe a regression."""
+        tick = 0
+        fabric = self.front.fabric
+        while True:
+            await asyncio.sleep(self.cfg.monitor_interval_s)
+            tick += 1
+            with contextlib.suppress(ConnectionError):
+                await fabric.kv_put(
+                    f"stats/{self.NS}/front", tick.to_bytes(8, "big")
+                )
+            if fabric.connected:
+                with contextlib.suppress(ConnectionError):
+                    raw = await fabric.kv_get(f"stats/{self.NS}/front")
+                    if raw is not None:
+                        self._stats_reads["stats_read/front"] = (
+                            int.from_bytes(raw, "big")
+                        )
+
+    async def _planner_loop(self) -> None:
+        """The real closed-loop planner on the sim fleet: observes
+        virtual-time metrics, freezes while the fabric is degraded, and
+        heals killed capacity by spawning replacement incarnations."""
+        from dynamo_tpu.planner import Planner, VirtualConnector
+        from dynamo_tpu.planner.planner_core import (
+            DECODE,
+            PREFILL,
+            ObservedMetrics,
+            PlannerConfig,
+        )
+
+        cfg = self.cfg
+        fleet = self
+
+        class SimConnector(VirtualConnector):
+            async def set_replicas(self, component, n):
+                await super().set_replicas(component, n)
+                if component != DECODE:
+                    return
+                alive = sum(
+                    1 for w in fleet._live.values() if not w.engine.fenced
+                )
+                for idx, w in list(fleet._live.items()):
+                    if alive >= n:
+                        break
+                    if w.engine.fenced:
+                        await fleet._spawn_worker(idx)
+                        alive += 1
+
+        conn = SimConnector()
+        conn.targets[PREFILL] = 1
+        conn.targets[DECODE] = cfg.n_workers
+
+        async def sample():
+            live = [w for w in self._live.values() if not w.engine.fenced]
+            usage = max((w.engine.cache.usage for w in live), default=0.0)
+            queued = sum(len(w.engine.waiting) for w in live)
+            return ObservedMetrics(
+                req_per_s=1.0 / max(1e-3, cfg.request_interval_s),
+                kv_usage=usage,
+                queue_depth=float(queued),
+                ttft_ms=None,
+                degraded=self.front.fabric.in_degraded_mode,
+                replicas_actual={DECODE: len(live)},
+            )
+
+        planner = Planner(
+            PlannerConfig(
+                mode="load",
+                interval_s=cfg.planner_interval_s,
+                min_decode=cfg.n_workers,
+                max_decode=2 * cfg.n_workers,
+                min_prefill=1, max_prefill=1,
+            ),
+            sample,
+            conn,
+            now_fn=dclock.now,
+        )
+        while True:
+            await asyncio.sleep(cfg.planner_interval_s)
+            with contextlib.suppress(ConnectionError):
+                await planner.step()
+
+    # ----------------------------------------------------------- schedule
+
+    async def _apply_schedule(self, schedule: FaultSchedule) -> None:
+        """Register window-based faults up front (their fault points are
+        virtual-clock-driven), then walk the timed events that need live
+        actuation (kills, spec mutation windows)."""
+        t0 = self.t0
+        inj = self.injector
+        timed: list[FaultEvent] = []
+        for ev in schedule.events:
+            if ev.action == "fabric_blackout":
+                inj.blackout_windows.append(
+                    (t0 + ev.t, t0 + ev.t + ev.duration_s)
+                )
+            elif ev.action == "zombie_partition":
+                worker = self._live.get(ev.target % max(1, len(self._live)))
+                if worker is not None:
+                    inj.zombie_windows.setdefault(worker.lease, []).append(
+                        (t0 + ev.t, t0 + ev.t + ev.duration_s)
+                    )
+            else:
+                timed.append(ev)
+        for ev in sorted(timed, key=lambda e: e.t):
+            delay = (t0 + ev.t) - dclock.now()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            await self._fire_event(ev)
+
+    async def _fire_event(self, ev: FaultEvent) -> None:
+        inj = self.injector
+        if ev.action == "worker_kill":
+            idx = ev.target % max(1, self.cfg.n_workers)
+            worker = self._live.get(idx)
+            if worker is None or worker.engine.fenced:
+                return
+            # the REAL death path: cluster-side lease expiry writes the
+            # fence tombstone; the worker's own keepalive loop discovers
+            # the dead lease and self-fences; consumers migrate
+            self.state.lease_expire(worker.lease)
+            inj._mark("worker_kill")
+            if not self.cfg.planner:
+                self._spawn_bg(self._respawn(idx, ev.duration_s))
+        elif ev.action == "gray_straggler":
+            worker = self._live.get(ev.target % max(1, self.cfg.n_workers))
+            if worker is None:
+                return
+            factor = float(ev.param or 5.0)
+            worker.engine.args.decode_per_token_s *= factor
+            inj._mark("gray_straggler")
+            self._spawn_bg(
+                self._restore_speed(worker, factor, ev.duration_s)
+            )
+        elif ev.action == "corrupt_kv":
+            inj.spec.corrupt_kv = str(ev.param or "bits")
+            inj.spec.every = 2
+            self._spawn_bg(
+                self._clear_spec(ev.duration_s, corrupt_kv="")
+            )
+        elif ev.action == "delay_window":
+            inj.spec.delay_dispatch_s = float(ev.param or 0.01)
+            self._spawn_bg(
+                self._clear_spec(ev.duration_s, delay_dispatch_s=0.0)
+            )
+        elif ev.action == "abort_window":
+            inj.tokens = 0
+            inj.spec.abort_after_tokens = int(ev.param or 100)
+            self._spawn_bg(
+                self._clear_spec(ev.duration_s, abort_after_tokens=0)
+            )
+
+    async def _respawn(self, idx: int, delay_s: float) -> None:
+        await asyncio.sleep(delay_s)
+        # a blackout may be open when the replacement boots: retry the
+        # lease grant until the fabric is reachable again
+        while True:
+            try:
+                await self._spawn_worker(idx)
+                return
+            except ConnectionError:
+                await asyncio.sleep(0.5)
+
+    async def _restore_speed(self, worker, factor: float, dur: float) -> None:
+        await asyncio.sleep(dur)
+        worker.engine.args.decode_per_token_s /= factor
+
+    async def _clear_spec(self, dur: float, **fields) -> None:
+        await asyncio.sleep(dur)
+        for k, v in fields.items():
+            setattr(self.injector.spec, k, v)
+
+    # ----------------------------------------------------------- workload
+
+    async def _one_request(self, i: int, track: _Track) -> None:
+        from dynamo_tpu.pipeline.context import Context
+        from dynamo_tpu.protocols.common import (
+            PreprocessedRequest,
+            SamplingOptions,
+            StopConditions,
+        )
+
+        req = PreprocessedRequest(
+            token_ids=list(track.prompt),
+            sampling=SamplingOptions(),
+            stop=StopConditions(max_tokens=len(track.expected)),
+        )
+        req.extra["priority"] = track.priority
+        ctx = Context()
+        try:
+            async for out in self.remote(req, ctx):
+                now = dclock.now()
+                if out.token_ids:
+                    track.got.extend(out.token_ids)
+                    track.last_progress_t = now
+                    worker = out.text or "?"
+                    track.worker = worker
+                    self._accept_log.append(
+                        (track.rid, worker, now, len(out.token_ids))
+                    )
+                    self._emissions.append(
+                        f"{track.rid}|{worker}|{now:.6f}|"
+                        f"{','.join(map(str, out.token_ids))}"
+                    )
+                if out.finish_reason is not None:
+                    track.error = out.error
+                    track.done = True
+                    track.last_progress_t = now
+                    self.outcomes["error" if out.error else "ok"] += 1
+                    self._emissions.append(
+                        f"{track.rid}|final|{out.finish_reason.value}|"
+                        f"{(out.error or {}).get('code', '')}"
+                    )
+                    return
+            # EOF without a final frame: record as an error outcome (the
+            # no-stuck-stream contract is a FINAL, not silence)
+            track.done = True
+            track.error = {"code": "eof_without_final"}
+            self.outcomes["error"] += 1
+        finally:
+            ctx.kill()
+
+    async def _workload(self) -> None:
+        cfg = self.cfg
+        rng = random.Random(cfg.seed ^ 0x57AC)
+        t_end = self.t0 + cfg.sim_minutes * 60.0
+        pending: list[asyncio.Task] = []
+        i = 0
+        while dclock.now() < t_end and not self.violation_stop.is_set():
+            n = rng.randint(*cfg.prompt_len)
+            prompt = [rng.randint(1, 63) for _ in range(n)]
+            priority = "interactive" if i % 3 == 0 else "bulk"
+            m = (
+                rng.randint(cfg.max_tokens[0],
+                            max(cfg.max_tokens[0], cfg.max_tokens[1] // 4))
+                if priority == "interactive"
+                else rng.randint(*cfg.max_tokens)
+            )
+            track = _Track(
+                rid=f"r{i:05d}",
+                priority=priority,
+                prompt=prompt,
+                expected=[prompt[j % n] for j in range(m)],
+                last_progress_t=dclock.now(),
+            )
+            self._tracks.append(track)
+            pending.append(
+                asyncio.get_running_loop().create_task(
+                    self._one_request(i, track)
+                )
+            )
+            i += 1
+            await asyncio.sleep(rng.expovariate(1.0 / cfg.request_interval_s))
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
+
+    # ---------------------------------------------------------------- run
+
+    async def run(self) -> None:
+        await self.start()
+        self.t0 = dclock.now()
+        for track in self._tracks:
+            track.last_progress_t = self.t0
+        self._spawn_bg(self._monitor_loop())
+        self._spawn_bg(self._stats_loop())
+        if self.cfg.planner:
+            self._spawn_bg(self._planner_loop())
+        if self.cfg.schedule is not None:
+            self._spawn_bg(self._apply_schedule(self.cfg.schedule))
+        workload = asyncio.get_running_loop().create_task(self._workload())
+        stopper = asyncio.get_running_loop().create_task(
+            self.violation_stop.wait()
+        )
+        try:
+            done, _ = await asyncio.wait(
+                {workload, stopper}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if workload not in done:
+                workload.cancel()
+                await asyncio.gather(workload, return_exceptions=True)
+            else:
+                # quiesce: let fences/replays settle, then one last sweep
+                await asyncio.sleep(2 * self.cfg.monitor_interval_s)
+                self._refresh_tombstones()
+                self.suite.observe(self)
+        finally:
+            stopper.cancel()
+            await asyncio.gather(stopper, return_exceptions=True)
+
+    def digest(self) -> str:
+        h = hashlib.sha256()
+        for line in self._emissions:
+            h.update(line.encode())
+            h.update(b"\n")
+        return h.hexdigest()
+
+
+# ---------------------------------------------------------------- run_sim
+
+
+def run_sim(cfg: SimConfig) -> SimResult:
+    """Execute one deterministic simulation: install the virtual clock
+    and loop, assemble the fleet, drive traffic + schedule, evaluate
+    invariants continuously, tear down, restore the real clock."""
+    wall0 = time.perf_counter()
+    sim_clock = SimClock()
+    prev_clock = dclock.set_clock(sim_clock)
+    loop = SimEventLoop(sim_clock)
+    asyncio.set_event_loop(loop)
+    # pin library-level jitter (migration backoff, random routing): ONE
+    # seed pins the whole run
+    random.seed(cfg.seed)
+    suite = default_suite(
+        stall_limit_s=cfg.stall_limit_s, fence_grace_s=cfg.fence_grace_s
+    )
+    prev_budget = os.environ.get("DYN_DEGRADED_MAX_S")
+    os.environ["DYN_DEGRADED_MAX_S"] = str(cfg.degraded_max_s)
+    if cfg.hedge:
+        prev_hedge = os.environ.get("DYN_HEDGE")
+        os.environ["DYN_HEDGE"] = "1"
+    fleet = SimFleet(cfg, suite)
+    t_start = sim_clock.now()
+    try:
+        try:
+            loop.run_until_complete(fleet.run())
+        finally:
+            loop.run_until_complete(fleet.close())
+            pending = [
+                t for t in asyncio.all_tasks(loop) if not t.done()
+            ]
+            for t in pending:
+                t.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+    finally:
+        faults.set_injector(None)
+        asyncio.set_event_loop(None)
+        loop.close()
+        dclock.set_clock(prev_clock)
+        if prev_budget is None:
+            os.environ.pop("DYN_DEGRADED_MAX_S", None)
+        else:
+            os.environ["DYN_DEGRADED_MAX_S"] = prev_budget
+        if cfg.hedge:
+            if prev_hedge is None:
+                os.environ.pop("DYN_HEDGE", None)
+            else:
+                os.environ["DYN_HEDGE"] = prev_hedge
+    sim_seconds = sim_clock.now() - t_start
+    violations = [v.to_json() for v in suite.found]
+    return SimResult(
+        ok=not violations,
+        seed=cfg.seed,
+        sim_seconds=round(sim_seconds, 3),
+        wall_seconds=round(time.perf_counter() - wall0, 3),
+        digest=fleet.digest(),
+        violations=violations,
+        invariant_stats=suite.stats(),
+        outcomes=dict(fleet.outcomes),
+        counters={k: float(v) for k, v in fleet.counters().items()},
+        fault_fired=dict(fleet.injector.fired),
+        n_requests=len(fleet._tracks),
+        fault_classes=sorted(
+            cfg.schedule.classes() if cfg.schedule else []
+        ),
+        config=cfg.to_json(),
+    )
+
+
+# ---------------------------------------------------- canonical scenarios
+
+
+def chaos_scenario(
+    seed: int,
+    sim_minutes: float = 10.0,
+    n_workers: int = 4,
+    density: float = 1.0,
+    **overrides: Any,
+) -> SimConfig:
+    """The canonical mixed-priority chaos scenario: a generated schedule
+    covering every fault class at least once, fully pinned by `seed`.
+    The sweep tool and the tier-1 pinned-seed test share this builder."""
+    rng = random.Random(seed ^ 0x5EED)
+    schedule = FaultSchedule.generate(
+        rng, sim_minutes * 60.0, n_workers, density=density
+    )
+    return SimConfig(
+        seed=seed,
+        sim_minutes=sim_minutes,
+        n_workers=n_workers,
+        schedule=schedule,
+        **overrides,
+    )
+
+
+def planted_fence_bug_scenario(
+    seed: int = 3, disable_fence_check: bool = True
+) -> SimConfig:
+    """The planted-bug regression scenario: decode slow enough that any
+    stream on the zombied worker is still mid-flight when the cluster
+    expires its lease.  With `disable_fence_check` (the planted bug:
+    consumers skip the epoch-fence stamp check) the zombie's frames keep
+    landing and `no_double_serve` must fire; with the check enabled the
+    same chaos is green — streams migrate off the zombie."""
+    events = [
+        FaultEvent(t=1.0, action="delay_window", target=-1,
+                   duration_s=2.0, param=0.01),
+        FaultEvent(t=2.0, action="zombie_partition", target=0,
+                   duration_s=15.0),
+        FaultEvent(t=4.0, action="fabric_blackout", target=-1,
+                   duration_s=1.0),
+        FaultEvent(t=6.0, action="gray_straggler", target=1,
+                   duration_s=4.0, param=3.0),
+        FaultEvent(t=9.0, action="worker_kill", target=2, duration_s=3.0),
+        FaultEvent(t=12.0, action="corrupt_kv", target=-1,
+                   duration_s=3.0, param="bits"),
+    ]
+    return SimConfig(
+        seed=seed,
+        sim_minutes=0.5,
+        n_workers=3,
+        schedule=FaultSchedule(events),
+        decode_per_token_s=0.05,
+        max_tokens=(150, 300),
+        request_interval_s=0.5,
+        fence_grace_s=0.5,
+        disable_fence_check=disable_fence_check,
+    )
+
+
+# ----------------------------------------------------- artifacts + shrink
+
+
+def bank_artifact(
+    result: SimResult, out_dir: str = "benchmarks/sim_failures"
+) -> Path:
+    """Persist a failing run as a replayable (seed, schedule) artifact."""
+    d = Path(out_dir)
+    d.mkdir(parents=True, exist_ok=True)
+    name = f"seed{result.seed}-{result.digest[:12]}.json"
+    path = d / name
+    path.write_text(
+        json.dumps(
+            {
+                "kind": "sim_failure_artifact",
+                "seed": result.seed,
+                "config": result.config,
+                "violations": result.violations,
+                "digest": result.digest,
+                "sim_seconds": result.sim_seconds,
+            },
+            indent=2,
+        )
+        + "\n"
+    )
+    return path
+
+
+def load_artifact(path: str) -> SimConfig:
+    raw = json.loads(Path(path).read_text())
+    return SimConfig.from_json(raw["config"])
+
+
+def _reproduces(cfg: SimConfig, invariants: set[str]) -> bool:
+    res = run_sim(cfg)
+    return any(v["invariant"] in invariants for v in res.violations)
+
+
+def shrink_schedule(
+    cfg: SimConfig,
+    invariants: Optional[set[str]] = None,
+    max_runs: int = 64,
+) -> tuple[FaultSchedule, int]:
+    """ddmin (Zeller) over the fault schedule's events: find a minimal
+    event subset whose sim run still violates one of `invariants`
+    (default: the invariants the full schedule violates).  Returns the
+    shrunk schedule and how many sim runs the shrink consumed."""
+    assert cfg.schedule is not None, "nothing to shrink"
+    events = list(cfg.schedule.events)
+    if invariants is None:
+        full = run_sim(cfg)
+        invariants = {v["invariant"] for v in full.violations}
+        if not invariants:
+            raise ValueError("the full schedule does not violate anything")
+
+    runs = 0
+
+    def test(subset: list[FaultEvent]) -> bool:
+        nonlocal runs
+        runs += 1
+        sub_cfg = replace(
+            cfg, schedule=FaultSchedule(sorted(subset, key=lambda e: e.t))
+        )
+        return _reproduces(sub_cfg, invariants)
+
+    n = 2
+    while len(events) >= 2 and runs < max_runs:
+        chunk = max(1, len(events) // n)
+        subsets = [
+            events[i: i + chunk] for i in range(0, len(events), chunk)
+        ]
+        reduced = False
+        for i, subset in enumerate(subsets):
+            if runs >= max_runs:
+                break
+            complement = [
+                e for j, s in enumerate(subsets) if j != i for e in s
+            ]
+            if subset and test(subset):
+                events, n, reduced = subset, 2, True
+                break
+            if complement and test(complement):
+                events = complement
+                n = max(2, n - 1)
+                reduced = True
+                break
+        if not reduced:
+            if n >= len(events):
+                break
+            n = min(len(events), 2 * n)
+    return FaultSchedule(sorted(events, key=lambda e: e.t)), runs

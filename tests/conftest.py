@@ -49,6 +49,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running test (soak/FT/multihost/bench smoke)"
     )
+    config.addinivalue_line(
+        "markers",
+        "sim: multi-seed deterministic-simulation sweeps (select with "
+        "-m sim; tools/sim_sweep.py is the standalone entry point)",
+    )
 
 
 # pytest-timeout is not in the image; a wedged multi-process test must fail

@@ -1,0 +1,248 @@
+"""Deterministic fleet simulation: virtual-clock chaos, always-on
+invariants, failure-seed shrinking (ISSUE 15).
+
+These tests run the REAL fleet — DistributedRuntime leases + fencing,
+in-proc fabric with its janitor and degraded-mode rings, discovery
+watches, RemoteEngine migration/hedging, HealthScorer ejection, mocker
+engines — on a virtual clock, so minutes of simulated chaos cost
+seconds of wall time and every run is bit-identical for a pinned seed.
+
+The pinned-seed scenarios here replace wall-clock racing with exact
+replay: the blackout wave (PR 10) and the straggler wave (PR 12) are
+backported from tests/test_chaos_soak.py as deterministic sims, and the
+planted-bug test proves the invariant plane actually catches a
+re-opened double-serve window — then shrinks the schedule to the one
+event that triggers it.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from dynamo_tpu.testing.sim import (
+    FaultEvent,
+    FaultSchedule,
+    SimClock,
+    SimConfig,
+    SimDeadlockError,
+    SimEventLoop,
+    bank_artifact,
+    chaos_scenario,
+    load_artifact,
+    planted_fence_bug_scenario,
+    run_sim,
+    shrink_schedule,
+)
+
+REQUIRED_CLASSES = {
+    "worker_kill", "fabric_blackout", "gray_straggler",
+    "corrupt_kv", "zombie_partition",
+}
+
+
+# ------------------------------------------------------------- loop unit
+
+
+def test_sim_loop_virtual_sleep_is_free():
+    clock = SimClock()
+    loop = SimEventLoop(clock)
+    try:
+        async def main():
+            t0 = loop.time()
+            await asyncio.sleep(600.0)
+            return loop.time() - t0
+
+        wall0 = time.perf_counter()
+        elapsed = loop.run_until_complete(main())
+        wall = time.perf_counter() - wall0
+        assert elapsed >= 600.0
+        assert wall < 2.0, f"virtual sleep cost {wall:.1f}s of wall time"
+    finally:
+        loop.close()
+
+
+def test_sim_loop_detects_deadlock():
+    loop = SimEventLoop(SimClock())
+    try:
+        with pytest.raises(SimDeadlockError):
+            loop.run_until_complete(loop.create_future())
+    finally:
+        loop.close()
+
+
+# ------------------------------------------------------------- schedules
+
+
+def test_fault_schedule_json_roundtrip():
+    import random
+
+    sched = FaultSchedule.generate(
+        random.Random(5), sim_seconds=300.0, n_workers=4
+    )
+    assert REQUIRED_CLASSES <= sched.classes()
+    clone = FaultSchedule.from_json(json.loads(json.dumps(sched.to_json())))
+    assert clone.to_json() == sched.to_json()
+    # config embedding round-trips too (the artifact path)
+    cfg = SimConfig(seed=5, schedule=sched)
+    cfg2 = SimConfig.from_json(json.loads(json.dumps(cfg.to_json())))
+    assert cfg2.schedule.to_json() == sched.to_json()
+    assert cfg2.seed == 5
+
+
+# ---------------------------------------------------- backported waves
+
+
+def test_sim_blackout_wave():
+    """PR 10 backport: control-plane blackouts mid-traffic on a disagg
+    fleet.  Degraded-mode rings buffer, the janitor pauses expiry while
+    dark and graces leases on heal — zero client-visible errors, zero
+    fences, counters stay monotone (all checked every monitor tick)."""
+    events = [
+        FaultEvent(t=5.0, action="fabric_blackout", target=-1,
+                   duration_s=1.5),
+        FaultEvent(t=12.0, action="fabric_blackout", target=-1,
+                   duration_s=1.0),
+        FaultEvent(t=16.0, action="delay_window", target=-1,
+                   duration_s=3.0, param=0.01),
+    ]
+    res = run_sim(
+        SimConfig(seed=10, sim_minutes=0.5, n_workers=3, disagg=True,
+                  schedule=FaultSchedule(events))
+    )
+    assert res.ok, res.violations
+    assert res.outcomes["error"] == 0
+    assert res.counters["blackouts"] >= 1.0
+    assert res.fault_fired.get("fabric_blackout", 0) >= 1
+    assert sum(
+        v for k, v in res.counters.items()
+        if k.startswith("remote_prefills/")
+    ) > 0, "disagg path not exercised"
+    assert res.invariant_stats["monotone_counters"]["evals"] > 10
+
+
+def test_sim_straggler_wave():
+    """PR 12 backport: one 5x gray straggler in a 4-worker fleet with
+    hedged dispatch on.  The health plane must eject it from routing
+    while every stream still finishes token-identical."""
+    events = [
+        FaultEvent(t=5.0, action="gray_straggler", target=0,
+                   duration_s=12.0, param=5.0),
+    ]
+    res = run_sim(
+        SimConfig(seed=9, sim_minutes=0.7, n_workers=4, hedge=True,
+                  disagg=False, schedule=FaultSchedule(events))
+    )
+    assert res.ok, res.violations
+    assert res.outcomes["error"] == 0
+    assert res.counters["ejections"] >= 1.0
+    assert res.fault_fired.get("gray_straggler", 0) >= 1
+
+
+def test_sim_planner_heals_killed_worker():
+    """The closed-loop planner rides the sim: when chaos kills a worker
+    (real lease expiry), the planner observes the replica deficit and
+    spawns a replacement incarnation."""
+    events = [FaultEvent(t=5.0, action="worker_kill", target=1,
+                         duration_s=4.0)]
+    res = run_sim(
+        SimConfig(seed=11, sim_minutes=0.7, n_workers=3, planner=True,
+                  planner_interval_s=3.0, schedule=FaultSchedule(events))
+    )
+    assert res.ok, res.violations
+    assert "tokens/w1.g1" in res.counters, (
+        "planner never spawned the replacement incarnation: "
+        f"{sorted(res.counters)}"
+    )
+
+
+# ------------------------------------------- the acceptance-scale chaos
+
+
+def test_sim_ten_minutes_mixed_chaos_bit_identical():
+    """Ten simulated minutes of mixed-priority traffic through every
+    fault class, in well under a minute of wall time, invariants green
+    the whole way — and the run is BIT-IDENTICAL when repeated with the
+    same seed (the property replay and shrinking stand on)."""
+    cfg = chaos_scenario(seed=42, sim_minutes=10.0, n_workers=4)
+    assert REQUIRED_CLASSES <= cfg.schedule.classes()
+    r1 = run_sim(cfg)
+    assert r1.ok, r1.violations
+    assert r1.sim_seconds >= 600.0
+    assert r1.wall_seconds < 60.0, (
+        f"10 sim-minutes took {r1.wall_seconds:.0f}s wall"
+    )
+    assert r1.outcomes["ok"] > 100
+    assert r1.outcomes["error"] == 0
+    # the five headline fault classes all actually fired
+    fired = set(r1.fault_fired)
+    assert {"worker_kill", "fabric_blackout", "gray_straggler",
+            "corrupt_kv", "zombie_partition"} <= fired, fired
+    # every invariant was evaluated continuously, not once
+    for name, st in r1.invariant_stats.items():
+        assert st["evals"] > 100, (name, st)
+        assert st["violations"] == 0, (name, st)
+    r2 = run_sim(cfg)
+    assert r2.digest == r1.digest, "same seed, different run"
+    assert r2.n_requests == r1.n_requests
+
+
+# --------------------------------------- planted bug + shrink + replay
+
+
+def test_sim_planted_fence_bug_caught_by_invariant():
+    """Disable the consumer-side epoch-fence stamp check (the planted
+    bug) and the zombie partition's frames keep landing after the
+    cluster tombstoned its lease: no_double_serve MUST fire.  The same
+    chaos with the check enabled is green — proof the invariant detects
+    the bug, not the fault injection."""
+    bugged = run_sim(planted_fence_bug_scenario(disable_fence_check=True))
+    assert not bugged.ok
+    assert {v["invariant"] for v in bugged.violations} == {
+        "no_double_serve"
+    }, bugged.violations
+    fixed = run_sim(planted_fence_bug_scenario(disable_fence_check=False))
+    assert fixed.ok, fixed.violations
+    assert fixed.outcomes["error"] == 0
+
+
+def test_sim_shrinker_minimizes_planted_bug_schedule(tmp_path):
+    """ddmin over the 6-event planted-bug schedule must isolate the one
+    zombie-partition event that opens the double-serve window, and the
+    banked artifact must replay byte-for-byte."""
+    cfg = planted_fence_bug_scenario(disable_fence_check=True)
+    res = run_sim(cfg)
+    assert not res.ok
+    shrunk, runs = shrink_schedule(cfg, invariants={"no_double_serve"})
+    assert len(shrunk.events) <= 2, shrunk.to_json()
+    assert "zombie_partition" in shrunk.classes(), shrunk.to_json()
+    assert runs <= 32
+    # the shrunk schedule still reproduces
+    from dataclasses import replace
+
+    shrunk_res = run_sim(replace(cfg, schedule=shrunk))
+    assert any(
+        v["invariant"] == "no_double_serve" for v in shrunk_res.violations
+    )
+    # artifact round-trip: bank -> load -> re-run -> identical digest
+    path = bank_artifact(res, out_dir=str(tmp_path))
+    replay = run_sim(load_artifact(str(path)))
+    assert replay.digest == res.digest
+    assert {v["invariant"] for v in replay.violations} == {
+        "no_double_serve"
+    }
+
+
+# ------------------------------------------------------- multi-seed sweep
+
+
+@pytest.mark.sim
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(8))
+def test_sim_seed_sweep(seed):
+    """The N-seed robustness sweep (tools/sim_sweep.py drives the same
+    scenario standalone and banks benchmarks/sim_sweep.json)."""
+    res = run_sim(chaos_scenario(seed=seed, sim_minutes=5.0, n_workers=4))
+    assert res.ok, (seed, res.violations)
+    assert res.outcomes["error"] == 0

@@ -1,0 +1,65 @@
+"""Re-execute a banked simulation-failure artifact (ISSUE 15).
+
+An artifact (written by ``tools/sim_sweep.py`` or
+``dynamo_tpu.testing.sim.bank_artifact``) pins the seed, the full
+config, and the exact fault schedule of a failing run, so the failure
+replays byte-for-byte — same virtual-time interleaving, same digest,
+same violation — on any machine:
+
+    python -m tools.sim_replay benchmarks/sim_failures/seed3-abc.json
+    python -m tools.sim_replay --shrunk <artifact>   # minimal repro
+
+``--shrunk`` swaps in the ddmin-minimized schedule the sweep stored
+alongside the original, reproducing the violation from the smallest
+event set the shrinker found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import replace
+from pathlib import Path
+
+from dynamo_tpu.testing.sim import FaultSchedule, load_artifact, run_sim
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("artifact", help="path to a banked sim-failure JSON")
+    ap.add_argument("--shrunk", action="store_true",
+                    help="replay the ddmin-shrunk schedule instead of "
+                    "the original")
+    args = ap.parse_args(argv)
+
+    raw = json.loads(Path(args.artifact).read_text())
+    cfg = load_artifact(args.artifact)
+    if args.shrunk:
+        if "shrunk_schedule" not in raw:
+            ap.error("artifact has no shrunk_schedule (run the sweep "
+                     "without --no-shrink, or shrink_schedule() manually)")
+        cfg = replace(
+            cfg, schedule=FaultSchedule.from_json(raw["shrunk_schedule"])
+        )
+
+    res = run_sim(cfg)
+    print(json.dumps({
+        "seed": res.seed,
+        "reproduced": not res.ok,
+        "violations": [
+            {"invariant": v["invariant"], "t_sim": v["t_sim"],
+             "detail": v["detail"]}
+            for v in res.violations[:10]
+        ],
+        "digest": res.digest,
+        "digest_matches_artifact": (
+            None if args.shrunk else res.digest == raw.get("digest")
+        ),
+        "sim_seconds": res.sim_seconds,
+        "wall_seconds": res.wall_seconds,
+    }, indent=2))
+    return 0 if not res.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

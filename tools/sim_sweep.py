@@ -1,0 +1,133 @@
+"""N-seed deterministic-simulation sweep (ISSUE 15).
+
+Runs the canonical mixed-chaos scenario (`chaos_scenario` — every
+DYN_FAULT class at least once, mixed-priority traffic, real fleet on
+the virtual clock) across N seeds and banks the aggregate in
+``benchmarks/sim_sweep.json``: per-seed outcomes, the simulated-minutes
+per wall-second ratio, and per-invariant evaluation counts (the proof
+the checkers ran, not just passed).
+
+A failing seed banks a replayable ``(seed, schedule)`` artifact under
+``benchmarks/sim_failures/``, ddmin-shrinks the schedule to a minimal
+reproducing event set, and records the shrunk repro in the artifact —
+``tools/sim_replay.py <artifact>`` re-executes it byte-for-byte.
+
+    python -m tools.sim_sweep --seeds 8 --sim-minutes 5
+    python -m benchmarks.perf_sweep --preset sim        # same entry
+
+The pytest twin is ``tests/test_sim.py::test_sim_seed_sweep``
+(``pytest -m sim``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import replace
+from pathlib import Path
+
+from dynamo_tpu.testing.sim import (
+    bank_artifact,
+    chaos_scenario,
+    run_sim,
+    shrink_schedule,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--seeds", type=int, default=8,
+                    help="number of seeds to sweep (0..N-1)")
+    ap.add_argument("--sim-minutes", type=float, default=5.0)
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--density", type=float, default=1.0,
+                    help="extra fault events per simulated minute")
+    ap.add_argument("--json", default="benchmarks/sim_sweep.json")
+    ap.add_argument("--failures-dir", default="benchmarks/sim_failures")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="bank failing artifacts without ddmin-shrinking")
+    args = ap.parse_args(argv)
+
+    results = []
+    eval_totals: dict[str, int] = {}
+    failures = 0
+    for seed in range(args.seeds):
+        cfg = chaos_scenario(
+            seed=seed,
+            sim_minutes=args.sim_minutes,
+            n_workers=args.workers,
+            density=args.density,
+        )
+        res = run_sim(cfg)
+        row = {
+            "seed": seed,
+            "ok": res.ok,
+            "sim_seconds": res.sim_seconds,
+            "wall_seconds": res.wall_seconds,
+            "sim_min_per_wall_s": round(res.sim_min_per_wall_s, 3),
+            "n_requests": res.n_requests,
+            "outcomes": res.outcomes,
+            "fault_classes": res.fault_classes,
+            "fault_fired": res.fault_fired,
+            "digest": res.digest,
+            "invariant_stats": res.invariant_stats,
+        }
+        for name, st in res.invariant_stats.items():
+            eval_totals[name] = eval_totals.get(name, 0) + st["evals"]
+        if not res.ok:
+            failures += 1
+            path = bank_artifact(res, out_dir=args.failures_dir)
+            row["artifact"] = str(path)
+            row["violations"] = res.violations
+            if not args.no_shrink:
+                shrunk, runs = shrink_schedule(cfg)
+                doc = json.loads(path.read_text())
+                doc["shrunk_schedule"] = shrunk.to_json()
+                doc["shrink_runs"] = runs
+                path.write_text(json.dumps(doc, indent=2) + "\n")
+                row["shrunk_events"] = len(shrunk.events)
+                # sanity: the shrunk schedule still reproduces
+                shrunk_res = run_sim(replace(cfg, schedule=shrunk))
+                row["shrunk_reproduces"] = not shrunk_res.ok
+            print(f"seed {seed}: FAIL "
+                  f"({[v['invariant'] for v in res.violations[:3]]}) "
+                  f"-> {path}")
+        else:
+            print(f"seed {seed}: ok  "
+                  f"{res.sim_seconds:7.1f} sim-s in "
+                  f"{res.wall_seconds:5.2f} wall-s  "
+                  f"({res.n_requests} reqs, "
+                  f"fired={sorted(res.fault_fired)})")
+        results.append(row)
+
+    total_sim = sum(r["sim_seconds"] for r in results)
+    total_wall = sum(r["wall_seconds"] for r in results)
+    doc = {
+        "bench": "sim_sweep",
+        "seeds": args.seeds,
+        "sim_minutes_per_seed": args.sim_minutes,
+        "workers": args.workers,
+        "all_ok": failures == 0,
+        "failures": failures,
+        "total_sim_minutes": round(total_sim / 60.0, 2),
+        "total_wall_seconds": round(total_wall, 2),
+        "sim_min_per_wall_s": round(
+            (total_sim / 60.0) / max(1e-9, total_wall), 3
+        ),
+        "invariant_evals_total": eval_totals,
+        "results": results,
+    }
+    if args.json:
+        Path(args.json).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.json).write_text(json.dumps(doc, indent=1) + "\n")
+    print(json.dumps({
+        "all_ok": doc["all_ok"],
+        "total_sim_minutes": doc["total_sim_minutes"],
+        "total_wall_seconds": doc["total_wall_seconds"],
+        "sim_min_per_wall_s": doc["sim_min_per_wall_s"],
+    }))
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
