@@ -260,7 +260,7 @@ def main() -> None:
         choices=[
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
             "priority", "integrity", "decode_mfu", "blackout", "planner",
-            "tail", "goodput", "sim",
+            "tail", "goodput", "sim", "mixed",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -319,7 +319,12 @@ def main() -> None:
         "virtual-clock chaos sweep: the real fleet through every fault "
         "class with always-on invariant checkers; failing seeds bank "
         "ddmin-shrunk replay artifacts; banked artifact "
-        "benchmarks/sim_sweep.json)",
+        "benchmarks/sim_sweep.json). "
+        "mixed = delegates to benchmarks.mixed_load_sweep (unified mixed "
+        "prefill+decode device steps vs the phase-separated scheduler on "
+        "the same workload: phase-bubble fraction, TTFT/ITL, dispatch "
+        "count, token-identity, zero steady-state recompiles; banked "
+        "artifact benchmarks/mixed_load_sweep.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -425,6 +430,16 @@ def main() -> None:
         raise SystemExit(sim_sweep.main(
             ["--json", args.json or "benchmarks/sim_sweep.json"]
         ))
+    if args.preset == "mixed":
+        # mixed-step A/B runs two in-proc tiny-llama engines directly
+        # (no HTTP frontend) — one entry point for every banked curve
+        # stays `perf_sweep --preset X`
+        from benchmarks import mixed_load_sweep
+
+        mixed_load_sweep.main(
+            ["--json", args.json or "benchmarks/mixed_load_sweep.json"]
+        )
+        return
     if args.preset == "slo":
         # SLO-plane overhead sweep runs on the mocker directly: always-on
         # histogram recording must stay within a few percent of the PR 5

@@ -353,6 +353,27 @@ def goodput_families(
         ["decode"], float(gp.decode_tokens if gp is not None else 0)
     )
     yield tokens
+    yield CounterMetricFamily(
+        f"{PREFIX}_mixed_steps",
+        "Unified mixed prefill+decode dispatches — prefill chunks packed "
+        "into the decode step instead of alternating with it (fleet sum)",
+        value=float(gp.mixed_steps if gp is not None else 0),
+    )
+    mixed_tokens = CounterMetricFamily(
+        f"{PREFIX}_mixed_step_tokens",
+        "Tokens through unified mixed steps by half: prefill chunk "
+        "tokens packed alongside decode-lane emissions (fleet sum)",
+        labels=["half"],
+    )
+    mixed_tokens.add_metric(
+        ["prefill"],
+        float(gp.mixed_prefill_tokens if gp is not None else 0),
+    )
+    mixed_tokens.add_metric(
+        ["decode"],
+        float(gp.mixed_decode_tokens if gp is not None else 0),
+    )
+    yield mixed_tokens
     waste = CounterMetricFamily(
         f"{PREFIX}_tokens_wasted",
         "Scheduled-then-discarded tokens by cause (spec_rejected / "

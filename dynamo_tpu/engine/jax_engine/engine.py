@@ -136,6 +136,26 @@ class JaxEngineConfig:
             os.environ.get("DYN_PREEMPT_BACKOFF_MS", "25")
         )
     )
+    # Unified mixed steps (ISSUE 16): the per-STEP prefill token budget —
+    # how many prompt tokens may ride along the decode batch inside one
+    # device program (chunks of several prompts can share a step). 0
+    # resolves to two chunks' worth (2 × runner.prefill_chunk_tokens) at
+    # engine init. Brownout's chunk_cap rung halves the effective value
+    # (qos.effective_chunk_budget); the loop latches it once per step
+    # boundary so a mid-step ladder transition never re-slices a chunk
+    # already being packed.
+    chunk_budget: int = field(
+        default_factory=lambda: int(os.environ.get("DYN_CHUNK_BUDGET", "0"))
+    )
+    # Master toggle for the mixed stepper. Off restores the alternating
+    # chunk-then-decode loop; the output streams are bit-identical either
+    # way (the token-identity parity test pins this), only the step
+    # schedule — and with it the phase bubble — changes.
+    mixed_step: bool = field(
+        default_factory=lambda: str(
+            os.environ.get("DYN_MIXED_STEP", "1")
+        ).lower() not in ("0", "false", "no", "off")
+    )
 
 
 @dataclass
@@ -367,6 +387,30 @@ class JaxEngine:
         # long prompts being prefilled one chunk at a time; the loop runs
         # one chunk then a decode step so decode never stalls > one chunk
         self._prefilling: list[_Sequence] = []
+        # unified mixed steps (ISSUE 16): resolved per-step prefill token
+        # budget (config 0 -> two chunks' worth) and the cap on chunk
+        # slots per mixed program (one compiled variant per slot count —
+        # tools/prebake_cache.py bakes the same range)
+        chunk_tokens = getattr(runner, "prefill_chunk_tokens", 0) or 0
+        base = self.config.chunk_budget
+        if base <= 0:
+            base = 2 * chunk_tokens
+        self._chunk_budget_base = base if chunk_tokens else 0
+        self._mixed_max_slots = (
+            max(1, -(-self._chunk_budget_base // chunk_tokens))
+            if chunk_tokens
+            else 0
+        )
+        self._mixed_enabled = (
+            self.config.mixed_step
+            and chunk_tokens > 0
+            and hasattr(runner, "mixed_step")
+        )
+        # budgets latched once per loop iteration (step boundary): a
+        # brownout transition landing while a dispatch is in flight takes
+        # effect at the NEXT boundary, never mid-pack
+        self._step_chunk_tokens = chunk_tokens
+        self._step_chunk_budget = self._chunk_budget_base
         self._seq_ids = itertools.count(1)
         self._admit_order: list[_Sequence] = []  # for LIFO preemption
         self._loop_task: Optional[asyncio.Task] = None
@@ -986,14 +1030,28 @@ class JaxEngine:
         self._spec_paused = self._brownout_level >= 2
         self.stats.brownout_level = self._brownout_level
 
-    def _chunk_budget(self) -> int:
-        """Prefill-chunk tokens per engine step; halved under brownout
-        chunk-cap (>= level 3) so decode lanes get the chip back — new
+    def _chunk_tokens(self) -> int:
+        """Tokens per individual prefill chunk (the compiled chunk
+        program's width); halved under brownout chunk-cap so the
+        phase-separated path's decode lanes get the chip back — new
         prompts' TTFT is sacrificed for admitted requests' ITL."""
         c = getattr(self.runner, "prefill_chunk_tokens", 0)
-        if c and self._brownout_level >= 3:
+        if c and dbrownout.chunk_capped(self._brownout_level):
             c = max(self.config.block_size, c // 2)
         return c
+
+    def _chunk_budget(self) -> int:
+        """Per-STEP prefill token budget: how many prompt tokens may ride
+        along one device step across every packed chunk (ISSUE 16).
+        Brownout's chunk_cap rung halves it via qos.effective_chunk_budget
+        (floored at one KV block so in-flight prefills keep progressing).
+        The loop latches the result once per step boundary — read
+        self._step_chunk_tokens / _step_chunk_budget inside an iteration."""
+        return qos.effective_chunk_budget(
+            self._chunk_budget_base,
+            chunk_cap=dbrownout.chunk_capped(self._brownout_level),
+            block_size=self.config.block_size,
+        )
 
     def _free_seq(self, seq: _Sequence, emit_remove: bool = True) -> None:
         if self._offload_queue is not None:
@@ -1324,7 +1382,31 @@ class JaxEngine:
             self._reap_cancelled()
             self._process_landed()
             await self._drain_offload()
+            # latch the QoS-degraded chunk size and per-step budget ONCE
+            # per iteration: apply_brownout can land from another task
+            # while a dispatch below is awaited, and a chunk_cap
+            # transition must wait for the next step boundary instead of
+            # re-slicing work already packed this iteration
+            self._step_chunk_tokens = self._chunk_tokens()
+            self._step_chunk_budget = self._chunk_budget()
             admitted = await self._admit_phase(loop)
+            if self._prefilling:
+                active = [
+                    s
+                    for s in self.slots
+                    if s is not None
+                    and not s.pending_remote
+                    and not s.prefilling
+                ]
+                if active and self._can_mix(active):
+                    # unified mixed step: every decode lane AND up to
+                    # _step_chunk_budget prefill tokens in ONE device
+                    # program — the alternating-phase bubble disappears
+                    await self._mixed_step_phase(loop, active)
+                    self._update_stats()
+                    if not admitted:
+                        await asyncio.sleep(0)
+                    continue
             # one chunk of at most one long prefill per iteration, so the
             # decode step below never waits longer than one chunk
             chunked = False
@@ -1431,7 +1513,7 @@ class JaxEngine:
     async def _admit_phase(self, loop) -> bool:
         admitted = False
         to_pack: list[_Sequence] = []
-        chunk_c = self._chunk_budget()
+        chunk_c = self._step_chunk_tokens
         can_pack = bool(chunk_c) and hasattr(
             self.runner, "prefill_packed_arrays"
         )
@@ -1674,7 +1756,7 @@ class JaxEngine:
             if seq in self._prefilling:
                 self._prefilling.remove(seq)
             return
-        c = self._chunk_budget()
+        c = self._step_chunk_tokens
         start = seq.prefill_pos
         total = len(seq.token_ids)
         chunk = seq.token_ids[start : start + c]
@@ -1715,6 +1797,156 @@ class JaxEngine:
             )
             self._emit_stored(seq)
             self._append_sample(seq, sample)
+
+    def _can_mix(self, active: list[_Sequence]) -> bool:
+        """One mixed program can replace this iteration's prefill-chunk +
+        decode pair. Gated off whenever the decode batch needs a program
+        the mixed step doesn't carry: speculative verify (unless the
+        brownout ladder paused drafting), multi-step horizons, and
+        full-history penalty lanes. The gate must stay read-only — e.g.
+        never probe _collect_drafts here, it mutates drafter state."""
+        if not self._mixed_enabled or not self._step_chunk_budget:
+            return False
+        if self.drafter is not None and not self._spec_paused:
+            return False
+        if self.config.decode_horizon > 1:
+            return False
+        if any(s.has_penalties for s in active):
+            return False
+        return True
+
+    async def _mixed_step_phase(
+        self, loop, active: list[_Sequence]
+    ) -> None:
+        """ONE device program for the whole iteration: every active decode
+        lane plus prefill chunks packed in priority order up to the
+        latched per-step token budget (several chunks of one prompt, or
+        chunks of several prompts, may share a step). A single
+        fetch_sample round trip syncs the decode samples together with the
+        samples of any chunk that finished its prompt."""
+        C = self._step_chunk_tokens
+        budget = self._step_chunk_budget
+        # -- pack prefill chunks (decode lanes are already committed) ----
+        chunks: list[tuple] = []
+        packed: list[tuple[_Sequence, int, int]] = []  # (seq, start, n)
+        plan: list[tuple[_Sequence, int]] = []  # per-seq total advance
+        for seq in sorted(self._prefilling, key=self._queue_key):
+            if seq.slot is None:  # freed while queued
+                self._prefilling.remove(seq)
+                continue
+            if budget <= 0 or len(chunks) >= self._mixed_max_slots:
+                break
+            total = len(seq.token_ids)
+            pos = seq.prefill_pos
+            advanced = 0
+            key_row = self._key_row(seq)
+            while (
+                pos < total
+                and budget > 0
+                and len(chunks) < self._mixed_max_slots
+            ):
+                n = min(C, total - pos, budget)
+                chunks.append((
+                    seq.token_ids[pos : pos + n], pos, total,
+                    seq.block_ids, seq.temperature, seq.top_p, seq.top_k,
+                    seq.rep_pen, key_row, seq.eos_row,
+                    seq.needs_eos_suppress,
+                ))
+                packed.append((seq, pos, n))
+                pos += n
+                budget -= n
+                advanced += n
+            if advanced:
+                plan.append((seq, advanced))
+        if not chunks:
+            # every in-flight prefill vanished under us; plain decode
+            await self._decode_single_phase(loop, active)
+            return
+        # -- fill the decode lanes (single-step semantics; the eos-mask
+        # variant always runs — neutral rows are a bitwise no-op) --------
+        from dynamo_tpu.ops.sampling import MAX_EOS_IDS
+
+        B = self.config.max_batch
+        self._block_tables.fill(0)
+        self._positions.fill(0)
+        self._slot_indices.fill(0)  # null block slot 0
+        self._temps.fill(0.0)
+        self._top_ps.fill(1.0)
+        self._top_ks.fill(0)
+        bs = self.config.block_size
+        eos_ids = np.full((B, MAX_EOS_IDS), -1, np.int32)
+        eos_sup = np.zeros(B, bool)
+        for seq in active:
+            pos = self._fill_lane(seq)
+            self._slot_indices[seq.slot] = (
+                seq.block_ids[pos // bs] * bs + pos % bs
+            )
+            eos_ids[seq.slot] = seq.eos_row
+            eos_sup[seq.slot] = seq.needs_eos_suppress
+        # chunk slots whose sample is consumed (prompt finishes there)
+        final_slots = [
+            i for i, (seq, start, n) in enumerate(packed)
+            if start + n >= len(seq.token_ids)
+        ]
+        k = len(chunks)
+        tokens_packed = sum(n for _, _, n in packed)
+        async with self._device_lock:
+
+            def run_mixed():
+                chunk_outs, d_out = self.runner.mixed_step(
+                    chunks, self._tokens, self._positions,
+                    self._block_tables, self._slot_indices, self._keys,
+                    self._temps, self._top_ps, self._top_ks,
+                    eos_ids=eos_ids, eos_suppress=eos_sup,
+                )
+                fetch: list = []
+                for i in final_slots:
+                    fetch.extend(chunk_outs[i])
+                fetch.extend(d_out)
+                return self.runner.fetch_sample(tuple(fetch))
+
+            out = await self._dispatch(
+                f"mixed_step@c{k}", run_mixed,
+                lanes=len(active), capacity=B, tokens=tokens_packed,
+            )
+        final_samples = {
+            slot: out[4 * j : 4 * j + 4]
+            for j, slot in enumerate(final_slots)
+        }
+        d_sample = out[4 * len(final_slots) :]
+        # -- prefill bookkeeping (chunk events, advance, finalize) -------
+        for seq, start, n in packed:
+            if seq.spans:
+                sp = seq.spans.get("prefill")
+                if sp is not None and len(sp.events) < 64:
+                    sp.event("prefill_chunk", pos=start, tokens=n)
+        for seq, advanced in plan:
+            if seq.slot is None:  # cancelled during the device call
+                continue
+            total = len(seq.token_ids)
+            seq.prefill_pos = min(seq.prefill_pos + advanced, total)
+            if seq.prefill_pos >= total:
+                self._prefilling.remove(seq)
+                seq.prefilling = False
+                seq.hash_seq = seq.pending_chain or TokenBlockSequence(
+                    list(seq.token_ids), self.config.block_size
+                )
+                self._emit_stored(seq)
+        for i, (seq, start, n) in enumerate(packed):
+            if i in final_samples and seq.slot is not None:
+                self._append_sample(seq, final_samples[i])
+        # -- decode bookkeeping ------------------------------------------
+        if dtrace.enabled():
+            self._sp_batch_event(active, "decode_step", batch=len(active))
+        toks, lps, tids, tlps = d_sample
+        for seq in active:
+            if seq.slot is None:
+                continue  # finished/cancelled concurrently
+            i = seq.slot
+            self._append_token(
+                seq, int(toks[i]), lp=float(lps[i]),
+                top_ids=tids[i], top_lps=tlps[i],
+            )
 
     def _process_landed(self) -> None:
         """Complete landed remote prefills on the engine loop (serialized

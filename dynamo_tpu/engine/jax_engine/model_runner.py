@@ -349,6 +349,12 @@ class ModelRunner:
             donate_argnums=(1, 2),  # k_cache, v_cache
             **jit_kwargs,
         )
+        # unified mixed step (Sarathi/POD-style): k prefill chunks ride
+        # along the full decode batch in ONE device program, so the two
+        # phases stop alternating as separate dispatches (the phase
+        # bubble). One compiled variant per k — the engine's per-step
+        # token budget bounds k, and tools/prebake_cache.py bakes each.
+        self._mixed_jits: dict[int, Any] = {}
         # Disagg KV movement (NIXL/block_copy.cu replacement): gather whole
         # blocks out of the paged cache / scatter received blocks in. Block
         # counts are padded to bucket sizes so each compiles once per
@@ -907,6 +913,132 @@ class ModelRunner:
         logits = mask_eos_logits(logits, eos_ids, eos_suppress)
         out = sample_tokens_full(logits, None, temps, top_ps, top_ks, keys=keys)
         return out, k_cache, v_cache
+
+    @staticmethod
+    def _mixed_impl(
+        cfg, attn_mesh, attn_head_axis,
+        params, k_cache, v_cache,
+        chunk_args,  # tuple of per-chunk arg tuples (see mixed_step)
+        tokens, positions, block_tables, slot_indices, keys, temps,
+        top_ps, top_ks, eos_ids, eos_suppress,
+    ):
+        """One packed device step: k chunked-prefill sub-computations
+        followed by the full decode batch, threading the donated KV caches
+        through in program order. Running the chunks FIRST mirrors the
+        phase-separated loop's dispatch order, and every sub-computation
+        touches disjoint KV blocks, so the packed step is bit-identical to
+        the separate programs (the token-identity parity test pins this).
+        The decode half always runs the eos-masked variant: with all-(-1)
+        ids and suppress=False the mask is a bitwise no-op, keeping one
+        compiled program per k instead of per sampling-feature set."""
+        outs = []
+        for (c_tokens, c_start, c_valid, c_table, c_key, c_temp, c_top_p,
+             c_top_k, c_rep, c_eos, c_sup) in chunk_args:
+            c_out, k_cache, v_cache = ModelRunner._prefill_chunk_impl(
+                cfg, attn_mesh, params, k_cache, v_cache, c_tokens, c_start,
+                c_valid, c_table, c_key, c_temp, c_top_p, c_top_k, c_rep,
+                c_eos, c_sup,
+            )
+            outs.extend(c_out)
+        d_out, k_cache, v_cache = ModelRunner._decode_eos_impl(
+            cfg, attn_mesh, attn_head_axis, params, k_cache, v_cache,
+            tokens, positions, block_tables, slot_indices, keys, temps,
+            top_ps, top_ks, eos_ids, eos_suppress,
+        )
+        outs.extend(d_out)
+        return tuple(outs), k_cache, v_cache
+
+    def _mixed_jit_for(self, k: int):
+        """The jitted mixed program for k chunk slots (built on first use;
+        the jit object is cheap, XLA compiles on first dispatch)."""
+        fn = self._mixed_jits.get(k)
+        if fn is None:
+            kw: dict[str, Any] = {}
+            if self._kv_sharding is not None:
+                kw["out_shardings"] = (
+                    (self._repl,) * (4 * k + 4),
+                    self._kv_shard_tree,
+                    self._kv_shard_tree,
+                )
+            fn = jax.jit(
+                functools.partial(
+                    self._mixed_impl, self.config,
+                    self.mesh, self._attn_head_axis,
+                ),
+                donate_argnums=(1, 2),  # k_cache, v_cache
+                **kw,
+            )
+            self._mixed_jits[k] = fn
+        return fn
+
+    def mixed_step(
+        self,
+        chunks,  # list of (token_chunk, chunk_start, total_len, block_ids,
+                 #          temperature, top_p, top_k, rep_pen, key_data,
+                 #          eos_ids, eos_suppress) — one per prefill slot
+        tokens, positions, block_tables, slot_indices, keys, temps,
+        top_ps, top_ks,
+        eos_ids: Optional[np.ndarray] = None,  # [B, MAX_EOS_IDS] i32
+        eos_suppress: Optional[np.ndarray] = None,  # [B] bool
+    ) -> tuple[tuple, tuple]:
+        """One unified mixed step: the decode batch plus ``chunks`` packed
+        prefill-chunk slots in a single dispatch. Chunks of one sequence
+        must arrive in order (two slots of the SAME sequence in one step
+        are fine — slots execute in list order inside the program).
+
+        Chunk block tables here are max_model_len-wide (one compiled
+        program per slot COUNT instead of per length bucket, so the whole
+        mixed family prebakes exactly). That trades the bucketed table's
+        smaller attention gather window for a closed program set; keep
+        ``chunk_budget`` modest on long-context TPU deployments.
+
+        Returns (chunk_outs, decode_out): a (token, logprob, top_ids,
+        top_logprobs) tuple per chunk slot (meaningful only on a final
+        chunk) and one for the decode batch."""
+        C = self.prefill_chunk_tokens
+        dev_chunks = []
+        for (token_chunk, chunk_start, total_len, block_ids, temperature,
+             top_p, top_k, rep_pen, key_data, c_eos_ids,
+             c_eos_suppress) in chunks:
+            n = len(token_chunk)
+            ctoks = np.zeros(C, np.int32)
+            ctoks[:n] = token_chunk
+            table = np.zeros(self.max_blocks_per_seq, np.int32)
+            table[: len(block_ids)] = block_ids
+            if key_data is None:
+                key_data = self._next_key_data()
+            if c_eos_ids is None:
+                c_eos_ids = np.full(MAX_EOS_IDS, -1, np.int32)
+            dev_chunks.append((
+                self._to_dev(ctoks),
+                self._to_dev(np.int32(chunk_start)),
+                self._to_dev(np.int32(total_len)),
+                self._to_dev(table),
+                self._to_dev(key_data),
+                self._to_dev(np.float32(temperature)),
+                self._to_dev(np.float32(top_p)),
+                self._to_dev(np.int32(top_k)),
+                self._to_dev(np.float32(rep_pen)),
+                self._to_dev(np.asarray(c_eos_ids, np.int32)),
+                self._to_dev(np.bool_(c_eos_suppress)),
+            ))
+        B = len(np.asarray(tokens))
+        if eos_ids is None:
+            eos_ids = np.full((B, MAX_EOS_IDS), -1, np.int32)
+        if eos_suppress is None:
+            eos_suppress = np.zeros(B, bool)
+        k = len(dev_chunks)
+        out, self.k_cache, self.v_cache = self._mixed_jit_for(k)(
+            self.params, self.k_cache, self.v_cache, tuple(dev_chunks),
+            self._to_dev(tokens), self._to_dev(positions),
+            self._to_dev(block_tables), self._to_dev(slot_indices),
+            self._to_dev(keys), self._to_dev(temps),
+            self._to_dev(top_ps), self._to_dev(top_ks),
+            self._to_dev(np.asarray(eos_ids, np.int32)),
+            self._to_dev(np.asarray(eos_suppress, bool)),
+        )
+        chunk_outs = tuple(out[4 * i: 4 * i + 4] for i in range(k))
+        return chunk_outs, tuple(out[4 * k: 4 * k + 4])
 
     def fetch_sample(self, out: tuple) -> tuple[np.ndarray, ...]:
         """Fetch a (tokens, logprobs, top_ids, top_lps) output tuple with

@@ -99,6 +99,52 @@ def decode_hbm_bytes_per_token(
     )
 
 
+def mixed_step_hbm_bytes_per_token(
+    config,
+    *,
+    decode_lanes: int,
+    chunk_tokens: int,
+    context: float,
+    block_size: int = 16,
+    weights_int8: bool = False,
+    kv_int8: bool = False,
+    fused: bool = False,
+) -> DecodeBytesBreakdown:
+    """Modeled HBM bytes per token for a unified mixed prefill+decode
+    device step (ISSUE 16).
+
+    Why mixed steps win on paper, in one number: the weight stream — the
+    dominant term at small batch — is paid ONCE per device step, so
+    riding `chunk_tokens` prefill tokens along the decode batch amortizes
+    it over (decode_lanes + chunk_tokens) tokens instead of decode_lanes.
+    A phase-separated schedule streams weights once for the decode step
+    AND once for the prefill chunk; the unified step halves that traffic
+    whenever both halves are non-empty. KV and activation round-trips are
+    charged per decode token as in `decode_hbm_bytes_per_token` (prefill
+    chunk tokens write fresh KV but read none of the live context, and
+    their activations run at chunk width so the per-token boundary cost
+    is the same expression).
+    """
+    base = decode_hbm_bytes_per_token(
+        config,
+        batch=max(1, decode_lanes),
+        context=context,
+        block_size=block_size,
+        weights_int8=weights_int8,
+        kv_int8=kv_int8,
+        fused=fused,
+    )
+    tokens = max(1, decode_lanes + chunk_tokens)
+    return DecodeBytesBreakdown(
+        weight_bytes_per_token=base.weight_bytes_per_token
+        * max(1, decode_lanes)
+        / tokens,
+        kv_bytes_per_token=base.kv_bytes_per_token,
+        kv_scale_bytes_per_token=base.kv_scale_bytes_per_token,
+        activation_bytes_per_token=base.activation_bytes_per_token,
+    )
+
+
 def mfu_decode_est(
     config, tok_s_per_chip: float, peak_flops: float = DEFAULT_PEAK_FLOPS
 ) -> float:

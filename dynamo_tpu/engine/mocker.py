@@ -57,6 +57,13 @@ class MockEngineArgs:
     prefill_linear_s: float = 0.0001
     prefill_quadratic_s: float = 1e-8
     decode_per_token_s: float = 0.01
+    # Unified mixed steps (ISSUE 16, parity with JaxEngineConfig): per-
+    # iteration prefill token budget riding along the decode batch in one
+    # simulated dispatch (cost = the slower of the two halves — the chunk
+    # hides behind decode or vice versa). 0 = legacy whole-prompt prefill
+    # at admission; brownout's chunk_cap rung halves the effective value,
+    # latched once per iteration.
+    chunk_budget: int = 0
     dp_rank: Optional[int] = None
     # preemption-storm guard (parity with JaxEngineConfig)
     max_preemptions: int = field(
@@ -200,6 +207,7 @@ class _MockSeq:
     acquired_hashes: list[int] = field(default_factory=list)
     unique_blocks: int = 1
     remote_prefilled: bool = False  # KV arrived from the prefill fleet
+    prefill_remaining: int = 0  # unprefilled prompt tokens (mixed-step mode)
     spans: dict = field(default_factory=dict)  # open telemetry phase spans
     # QoS plane (parity with JaxEngine._Sequence)
     priority: str = qos.DEFAULT_CLASS
@@ -590,11 +598,19 @@ class MockEngine:
                 n_prefill = max(0, len(seq.request.token_ids)
                                 - cached * self.args.block_size)
             self.prefilled_tokens += n_prefill
-            n_prefill_total += n_prefill
-            cost += (
-                self.args.prefill_linear_s * n_prefill
-                + self.args.prefill_quadratic_s * n_prefill * n_prefill
-            )
+            if self.args.chunk_budget > 0:
+                # mixed-step mode: prefill compute rides along future
+                # decode iterations chunk-by-chunk instead of blocking
+                # the whole batch at admission (always assigned: a
+                # preempted victim re-admitted fully-cached must clear
+                # any stale remainder)
+                seq.prefill_remaining = n_prefill
+            else:
+                n_prefill_total += n_prefill
+                cost += (
+                    self.args.prefill_linear_s * n_prefill
+                    + self.args.prefill_quadratic_s * n_prefill * n_prefill
+                )
             if seq.spans:
                 self._sp_finish(
                     seq, "queue_wait", cached_blocks=cached
@@ -611,16 +627,31 @@ class MockEngine:
             )
         return cost
 
+    def _chunk_budget(self) -> int:
+        """Per-iteration prefill token budget (mixed-step mode).
+
+        Brownout's chunk_cap rung halves it (floored at one KV block);
+        the caller latches the value ONCE at the top of each loop
+        iteration — parity with JaxEngine's step-boundary latch, so a
+        brownout transition landing mid-iteration never re-slices work
+        the iteration already planned."""
+        return qos.effective_chunk_budget(
+            self.args.chunk_budget,
+            chunk_cap=dbrownout.chunk_capped(self.brownout_level),
+            block_size=self.args.block_size,
+        )
+
     async def _run(self) -> None:
         while True:
             if not self.active and not self.waiting:
                 self._wake.clear()
                 await self._wake.wait()
+            chunk_budget = self._chunk_budget()  # step-boundary latch
             prefill_cost = self._admit()
             if prefill_cost:
                 await self._sim_sleep(prefill_cost)
             for seq in self.active:
-                if "prefill" in seq.spans:
+                if "prefill" in seq.spans and not seq.prefill_remaining:
                     self._sp_finish(seq, "prefill")
                     self._sp_begin(seq, "decode")
             if not self.active:
@@ -628,6 +659,32 @@ class MockEngine:
                 if self.waiting:
                     await asyncio.sleep(0.001)
                 continue
+            # mixed-step packing: decode lanes keep stepping while queued
+            # prefill work drains chunk-by-chunk under the latched budget
+            # (priority order — same key the admission queue sorts by)
+            decoding = [s for s in self.active if not s.prefill_remaining]
+            prefilling = sorted(
+                (s for s in self.active if s.prefill_remaining > 0),
+                key=self._queue_key,
+            )
+            chunk_tokens = 0
+            slots = 0
+            budget = chunk_budget
+            for seq in prefilling:
+                if budget <= 0:
+                    break
+                n = min(seq.prefill_remaining, budget)
+                seq.prefill_remaining -= n
+                budget -= n
+                chunk_tokens += n
+                slots += 1
+                if not seq.prefill_remaining and "prefill" in seq.spans:
+                    self._sp_finish(seq, "prefill")
+                    self._sp_begin(seq, "decode")
+            chunk_cost = (
+                self.args.prefill_linear_s * chunk_tokens
+                + self.args.prefill_quadratic_s * chunk_tokens * chunk_tokens
+            )
             # one decode iteration for the whole batch (a gray-worker
             # fault stretches the simulated step: slow, never dead)
             step_s = self.args.decode_per_token_s
@@ -636,13 +693,32 @@ class MockEngine:
                 if inj is not None:
                     await inj.on_dispatch()
                     step_s *= inj.dispatch_slow_factor()
-            await self._sim_sleep(step_s)
-            self.goodput.record_step(
-                "decode",
-                step_s,
-                lanes=len(self.active),
-                capacity=self.args.max_batch,
-            )
+            if decoding and chunk_tokens:
+                # unified device step: the chunk hides behind the decode
+                # half (or vice versa) — cost is the slower of the two
+                step_s = max(step_s, chunk_cost)
+                await self._sim_sleep(step_s)
+                self.goodput.record_step(
+                    f"mixed_step@c{slots}",
+                    step_s,
+                    lanes=len(decoding),
+                    capacity=self.args.max_batch,
+                    prefill_tokens=chunk_tokens,
+                )
+            elif chunk_tokens:
+                await self._sim_sleep(chunk_cost)
+                self.goodput.record_step(
+                    "prefill_chunk", chunk_cost,
+                    prefill_tokens=chunk_tokens,
+                )
+            else:
+                await self._sim_sleep(step_s)
+                self.goodput.record_step(
+                    "decode",
+                    step_s,
+                    lanes=len(decoding),
+                    capacity=self.args.max_batch,
+                )
             # deadline expiry mid-generation: cancel + structured error
             for seq in [
                 s for s in list(self.active) if s.context.expired()
@@ -662,7 +738,8 @@ class MockEngine:
                         "deadline_exceeded",
                     )
                 )
-            for seq in list(self.active):
+            for seq in decoding:
+                # lanes still mid-prefill emit no tokens this iteration
                 self._step_seq(seq)
 
     def _abort_all(self, cause: str, code: str = "injected_fault") -> None:

@@ -118,6 +118,25 @@ def rank_of(priority: Optional[str]) -> int:
     return CLASS_RANK.get(priority or DEFAULT_CLASS, CLASS_RANK[DEFAULT_CLASS])
 
 
+def effective_chunk_budget(
+    base: int, *, chunk_cap: bool, block_size: int
+) -> int:
+    """The per-step prefill token budget after QoS degradation.
+
+    ``base`` is the engine's configured ``chunk_budget`` (tokens of prefill
+    allowed to ride along each device step; 0 = chunking disabled).  The
+    brownout ladder's ``chunk_cap`` rung halves it — decode lanes get the
+    chip back at the cost of new-prompt TTFT — but never below one KV
+    block, so an in-flight prefill always keeps making forward progress.
+    Engines latch the result once per step boundary (mid-step ladder
+    transitions must not re-slice a chunk already being packed)."""
+    if not base:
+        return 0
+    if chunk_cap:
+        return max(block_size, base // 2)
+    return base
+
+
 def stamp_priority(pre: Any, ctx: Any) -> str:
     """Mirror the Context's resolved class onto the wire request (and
     resolve from the request ext stamp / env default when the Context

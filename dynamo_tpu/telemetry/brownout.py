@@ -57,6 +57,13 @@ def shed_classes_for(level: int) -> frozenset[str]:
     return frozenset(out)
 
 
+def chunk_capped(level: int) -> bool:
+    """True when the ladder asks engines to halve the shared per-step
+    prefill token budget (``qos.effective_chunk_budget`` applies it; the
+    engine latches the result once per step boundary)."""
+    return level >= LADDER.index("chunk_cap")
+
+
 @dataclass
 class BrownoutConfig:
     enabled: bool = True
@@ -172,7 +179,7 @@ class BrownoutController:
         return {
             "shed_classes": sorted(shed_classes_for(self.level)),
             "spec_off": self.level >= 2,
-            "chunk_cap": self.level >= 3,
+            "chunk_cap": chunk_capped(self.level),
         }
 
     def status(self) -> dict[str, Any]:

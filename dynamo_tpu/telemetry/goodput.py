@@ -49,7 +49,11 @@ import os
 import time
 from typing import Any, Optional
 
-from dynamo_tpu.telemetry.histogram import PhaseHistograms
+from dynamo_tpu.telemetry.histogram import (
+    PhaseHistogram,
+    PhaseHistograms,
+    bucket_index,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -88,6 +92,11 @@ class GoodputStats:
         "step_hists",
         "steps_total",
         "bubble_s_total",
+        "busy_s_total",
+        "phase_gap_s_total",
+        "mixed_steps",
+        "mixed_prefill_tokens",
+        "mixed_decode_tokens",
         "lane_steps",
         "lane_capacity_steps",
         "prefill_tokens",
@@ -108,6 +117,20 @@ class GoodputStats:
         # next while work was in flight — the "phase bubble" the unified
         # mixed-step ROADMAP item wants to close
         self.bubble_s_total = 0.0
+        # device-attributed dispatch seconds (denominator for the bubble
+        # fraction: wall ~ busy + bubble while work is in flight)
+        self.busy_s_total = 0.0
+        # the subset of bubble time accrued at PHASE TRANSITIONS (a
+        # prefill-family dispatch followed by a decode-family one or vice
+        # versa). Mixed steps are one phase by construction, so a unified
+        # stepper drives this to ~0 while bubble_s_total keeps counting
+        # ordinary inter-step host gaps.
+        self.phase_gap_s_total = 0.0
+        # mixed-step occupancy split: how many device steps carried both
+        # phases, and how many prefill tokens / decode lanes rode them
+        self.mixed_steps = 0
+        self.mixed_prefill_tokens = 0
+        self.mixed_decode_tokens = 0
         # occupancy: sum of lanes occupied / lane capacity per decode-
         # family step (occupancy = lane_steps / lane_capacity_steps)
         self.lane_steps = 0
@@ -132,6 +155,15 @@ class GoodputStats:
         if not self.lane_capacity_steps:
             return 0.0
         return self.lane_steps / self.lane_capacity_steps
+
+    @property
+    def phase_bubble_fraction(self) -> float:
+        """Share of in-flight wall time lost at phase-transition
+        boundaries. The headline number the mixed stepper collapses."""
+        total = self.busy_s_total + self.bubble_s_total
+        if total <= 0:
+            return 0.0
+        return self.phase_gap_s_total / total
 
     @property
     def mfu_achieved(self) -> float:
@@ -162,6 +194,11 @@ class GoodputStats:
         self.step_hists.merge(other.step_hists)
         self.steps_total += other.steps_total
         self.bubble_s_total += other.bubble_s_total
+        self.busy_s_total += other.busy_s_total
+        self.phase_gap_s_total += other.phase_gap_s_total
+        self.mixed_steps += other.mixed_steps
+        self.mixed_prefill_tokens += other.mixed_prefill_tokens
+        self.mixed_decode_tokens += other.mixed_decode_tokens
         self.lane_steps += other.lane_steps
         self.lane_capacity_steps += other.lane_capacity_steps
         self.prefill_tokens += other.prefill_tokens
@@ -193,6 +230,11 @@ class GoodputStats:
             "sh": self.step_hists.to_dict(),
             "st": self.steps_total,
             "bub": round(self.bubble_s_total, 6),
+            "bus": round(self.busy_s_total, 6),
+            "pg": round(self.phase_gap_s_total, 6),
+            "ms": self.mixed_steps,
+            "mpt": self.mixed_prefill_tokens,
+            "mdt": self.mixed_decode_tokens,
             "ls": self.lane_steps,
             "lc": self.lane_capacity_steps,
             "pt": self.prefill_tokens,
@@ -213,6 +255,11 @@ class GoodputStats:
         out.step_hists = PhaseHistograms.from_dict(d.get("sh") or {})
         out.steps_total = int(d.get("st") or 0)
         out.bubble_s_total = float(d.get("bub") or 0.0)
+        out.busy_s_total = float(d.get("bus") or 0.0)
+        out.phase_gap_s_total = float(d.get("pg") or 0.0)
+        out.mixed_steps = int(d.get("ms") or 0)
+        out.mixed_prefill_tokens = int(d.get("mpt") or 0)
+        out.mixed_decode_tokens = int(d.get("mdt") or 0)
         out.lane_steps = int(d.get("ls") or 0)
         out.lane_capacity_steps = int(d.get("lc") or 0)
         out.prefill_tokens = int(d.get("pt") or 0)
@@ -246,6 +293,12 @@ class GoodputStats:
             "steps_by_label": steps,
             "occupancy": round(self.occupancy, 4),
             "phase_bubble_s": round(self.bubble_s_total, 4),
+            "busy_s": round(self.busy_s_total, 4),
+            "phase_gap_s": round(self.phase_gap_s_total, 4),
+            "phase_bubble_fraction": round(self.phase_bubble_fraction, 5),
+            "mixed_steps": self.mixed_steps,
+            "mixed_prefill_tokens": self.mixed_prefill_tokens,
+            "mixed_decode_tokens": self.mixed_decode_tokens,
             "prefill_tokens": self.prefill_tokens,
             "decode_tokens": self.decode_tokens,
             "tokens_wasted": {
@@ -271,12 +324,13 @@ class GoodputLedger(GoodputStats):
     MAX_LABELS cap on every label-keyed dict.
     """
 
-    __slots__ = ("enabled", "_last_end")
+    __slots__ = ("enabled", "_last_end", "_last_phase")
 
     def __init__(self, enabled: Optional[bool] = None) -> None:
         super().__init__()
         self.enabled = enabled_from_env() if enabled is None else enabled
         self._last_end: Optional[float] = None
+        self._last_phase: Optional[str] = None
 
     def record_step(
         self,
@@ -294,19 +348,52 @@ class GoodputLedger(GoodputStats):
         if not self.enabled:
             return
         self.steps_total += 1
-        if len(self.step_hists.phases) < MAX_LABELS or (
-            label in self.step_hists.phases
-        ):
-            self.step_hists.observe(label, elapsed_s * 1e3)
+        self.busy_s_total += elapsed_s
+        # inlined step_hists.observe(): every dispatch lands here, and
+        # the two method hops + the per-call MAX_LABELS len() probe cost
+        # more than the bucket math itself (the cap check only needs to
+        # run for a label we haven't seen)
+        phases = self.step_hists.phases
+        h = phases.get(label)
+        if h is None and len(phases) < MAX_LABELS:
+            h = phases[label] = PhaseHistogram()
+        if h is not None:
+            ms = elapsed_s * 1e3 if elapsed_s > 0 else 0.0
+            h.counts[bucket_index(ms)] += 1
+            h.count += 1
+            h.sum_ms += ms
         if capacity > 0:
             self.lane_steps += lanes
             self.lane_capacity_steps += capacity
         if prefill_tokens > 0:
             self.prefill_tokens += prefill_tokens
+        # inline fast path of step_phase(): one dict probe per call (the
+        # function-call fallback only runs once per distinct label)
+        phase = _PHASE_CACHE.get(label)
+        if phase is None:
+            phase = step_phase(label)
+        if phase == "mixed":
+            self.mixed_steps += 1
+            self.mixed_prefill_tokens += prefill_tokens
+            self.mixed_decode_tokens += lanes
         if t_start is not None:
             if self._last_end is not None and t_start > self._last_end:
-                self.bubble_s_total += t_start - self._last_end
+                gap = t_start - self._last_end
+                self.bubble_s_total += gap
+                # only a gap at a boundary CROSSING the prefill family is
+                # the phase bubble: a pure-prefill program carries no
+                # decode lane, so every lane sits serialized behind it.
+                # decode->decode, mixed->mixed AND decode<->mixed
+                # boundaries are ordinary host bookkeeping — the decode
+                # lanes ride inside both step kinds, nothing is waiting
+                if (
+                    self._last_phase is not None
+                    and phase != self._last_phase
+                    and "prefill" in (phase, self._last_phase)
+                ):
+                    self.phase_gap_s_total += gap
             self._last_end = t_start + elapsed_s
+            self._last_phase = phase
 
     def record_decode_tokens(self, n: int = 1) -> None:
         if self.enabled:
@@ -363,6 +450,7 @@ class GoodputLedger(GoodputStats):
         """Nothing in flight: the next dispatch's gap is idleness, not a
         phase bubble. Resets the bubble baseline."""
         self._last_end = None
+        self._last_phase = None
 
 
 class RecompileDetector:
@@ -401,6 +489,32 @@ def normalize_label(label: str) -> str:
     while the engine dispatches under the base label."""
     base = label.split("@", 1)[0]
     return "decode" if base == "decode_eos" else base
+
+
+# label -> phase memo: record_step runs on EVERY dispatch and the label
+# set is tiny and closed, so the string work happens once per label
+_PHASE_CACHE: dict[str, str] = {}
+
+
+def step_phase(label: str) -> str:
+    """Phase family of a dispatch label for bubble attribution: every
+    prefill-shaped program is "prefill", every token-producing one is
+    "decode", and a unified step is its own "mixed" phase (it contains
+    both, so it never forms a phase boundary with itself)."""
+    phase = _PHASE_CACHE.get(label)
+    if phase is None:
+        base = normalize_label(label)
+        if base.startswith("prefill"):
+            phase = "prefill"
+        elif base in ("decode", "decode_multi", "spec_verify"):
+            phase = "decode"
+        elif base == "mixed_step":
+            phase = "mixed"
+        else:
+            phase = base
+        if len(_PHASE_CACHE) < 4096:  # unbounded labels must not leak
+            _PHASE_CACHE[label] = phase
+    return phase
 
 
 PREBAKE_MANIFEST = "prebake_manifest.json"

@@ -327,6 +327,14 @@ class SimConfig:
     prompt_len: tuple = (3, 20)
     max_tokens: tuple = (4, 32)
     disagg: bool = True
+    # mixed-step mode (ISSUE 16): per-iteration prefill token budget the
+    # mock engines pack alongside their decode batches (0 = legacy
+    # whole-prompt-at-admission prefill)
+    chunk_budget: int = 0
+    # deterministic brownout waves: (t_s, level) pairs applied to every
+    # live worker at sim time t0+t_s — exercises the chunk_cap rung
+    # against the mixed stepper under chaos
+    brownout_waves: tuple = ()
     hedge: bool = False
     planner: bool = False
     planner_interval_s: float = 5.0
@@ -345,6 +353,7 @@ class SimConfig:
         d = asdict(self)
         d["prompt_len"] = list(self.prompt_len)
         d["max_tokens"] = list(self.max_tokens)
+        d["brownout_waves"] = [list(w) for w in self.brownout_waves]
         d["schedule"] = self.schedule.to_json() if self.schedule else None
         return d
 
@@ -356,6 +365,10 @@ class SimConfig:
         for k in ("prompt_len", "max_tokens"):
             if k in d:
                 d[k] = tuple(d[k])
+        if "brownout_waves" in d:
+            d["brownout_waves"] = tuple(
+                tuple(w) for w in d["brownout_waves"]
+            )
         known = {f for f in cls.__dataclass_fields__}  # noqa: C416
         return cls(**{k: v for k, v in d.items() if k in known})
 
@@ -491,6 +504,7 @@ class SimFleet:
             out[f"tokens/{w.name}"] = e.generated_tokens
             out[f"prefilled/{w.name}"] = e.prefilled_tokens
             out[f"remote_prefills/{w.name}"] = e.remote_prefills
+            out[f"mixed_steps/{w.name}"] = e.goodput.mixed_steps
         if self.scorer is not None:
             out["ejections"] = sum(self.scorer.ejections_total.values())
         if self.hedger is not None:
@@ -514,6 +528,7 @@ class SimFleet:
             decode_per_token_s=cfg.decode_per_token_s,
             prefill_linear_s=1e-4,
             prefill_quadratic_s=0.0,
+            chunk_budget=cfg.chunk_budget,
         )
 
     def _make_handler(self, worker: _Worker) -> Callable:
@@ -856,6 +871,19 @@ class SimFleet:
                 self._clear_spec(ev.duration_s, abort_after_tokens=0)
             )
 
+    async def _brownout_waves_loop(self) -> None:
+        """Walk cfg.brownout_waves deterministically: at sim time t0+t_s
+        apply `level` to every live worker (a respawned incarnation boots
+        at level 0 and inherits the next wave, same as real QoS pushes
+        re-asserting on reconnect)."""
+        for t_s, level in sorted(self.cfg.brownout_waves):
+            delay = (self.t0 + t_s) - dclock.now()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            for w in self._live.values():
+                if not w.engine.fenced:
+                    w.engine.apply_brownout(int(level))
+
     async def _respawn(self, idx: int, delay_s: float) -> None:
         await asyncio.sleep(delay_s)
         # a blackout may be open when the replacement boots: retry the
@@ -973,6 +1001,8 @@ class SimFleet:
             self._spawn_bg(self._planner_loop())
         if self.cfg.schedule is not None:
             self._spawn_bg(self._apply_schedule(self.cfg.schedule))
+        if self.cfg.brownout_waves:
+            self._spawn_bg(self._brownout_waves_loop())
         workload = asyncio.get_running_loop().create_task(self._workload())
         stopper = asyncio.get_running_loop().create_task(
             self.violation_stop.wait()
@@ -1099,6 +1129,46 @@ def chaos_scenario(
         schedule=schedule,
         **overrides,
     )
+
+
+def mixed_step_chaos_scenario(
+    seed: int,
+    sim_minutes: float = 2.0,
+    n_workers: int = 4,
+    **overrides: Any,
+) -> SimConfig:
+    """Mixed-priority traffic through the mixed prefill+decode stepper
+    (ISSUE 16): chunk_budget turns on chunked-prefill packing in every
+    mock engine, worker-kill events force migration replays through the
+    chunked admission path, and brownout waves ride the ladder through
+    the chunk_cap rung (halved budget) and back — all six invariants must
+    stay green and the run must be digest-deterministic."""
+    waves = ((20.0, 3), (35.0, 0), (60.0, 4), (75.0, 0))
+    events = [
+        FaultEvent(t=15.0, action="worker_kill", target=1, duration_s=5.0),
+        FaultEvent(t=40.0, action="gray_straggler", target=2,
+                   duration_s=10.0, param=3.0),
+        FaultEvent(t=65.0, action="worker_kill", target=0, duration_s=5.0),
+        FaultEvent(t=90.0, action="fabric_blackout", target=-1,
+                   duration_s=1.0),
+    ]
+    base = dict(
+        seed=seed,
+        sim_minutes=sim_minutes,
+        n_workers=n_workers,
+        chunk_budget=8,
+        disagg=False,  # aggregated serving: ALL prefill runs locally,
+        # chunk-by-chunk alongside the decode lanes (the regime where
+        # phase bubbles live)
+        request_interval_s=0.25,  # dense enough that decode lanes and
+        # prefilling lanes genuinely coexist in one engine iteration
+        prompt_len=(3, 40),  # long prompts: several chunks per prefill
+        max_tokens=(16, 64),
+        brownout_waves=waves,
+        schedule=FaultSchedule(events),
+    )
+    base.update(overrides)
+    return SimConfig(**base)
 
 
 def planted_fence_bug_scenario(

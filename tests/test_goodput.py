@@ -11,6 +11,7 @@ hedge_loser overlay, fleet-vs-direct /debug/goodput agreement within the
 histogram's documented error, and the always-on overhead guard."""
 
 import asyncio
+import gc
 import json
 import logging
 import math
@@ -593,14 +594,24 @@ async def test_mock_worker_metrics_publishes_goodput():
 def test_always_on_step_observe_overhead():
     """The ledger stays always-on in the dispatch hot path: one
     record_step must cost ~1 us (budget doubled for CI-scheduler
-    jitter, matching the PR 5 trace-overhead guard's bound)."""
+    jitter, matching the PR 5 trace-overhead guard's bound). Best of
+    three trials: scheduler preemption and GC only ever INFLATE a
+    sample, so the min is the honest estimate of the steady-state cost
+    — a single trial gates on whatever else the CI box was doing."""
     gp = GoodputLedger(enabled=True)
     iters = 50_000
-    t = 100.0
-    t0 = time.perf_counter()
-    for i in range(iters):
-        gp.record_step("decode", 0.004, lanes=5, capacity=8, t_start=t)
-        t += 0.005
-    per_op_ns = (time.perf_counter() - t0) / iters * 1e9
-    assert gp.steps_total == iters
+    per_op_ns = float("inf")
+    for _ in range(3):
+        gc.collect()
+        t = 100.0
+        t0 = time.perf_counter()
+        for i in range(iters):
+            gp.record_step(
+                "decode", 0.004, lanes=5, capacity=8, t_start=t
+            )
+            t += 0.005
+        per_op_ns = min(
+            per_op_ns, (time.perf_counter() - t0) / iters * 1e9
+        )
+    assert gp.steps_total == 3 * iters
     assert per_op_ns < 2000, f"record_step cost {per_op_ns:.0f}ns/op"

@@ -205,7 +205,7 @@ async def test_close_releases_inflight_consumers():
     assert reason is FinishReason.ERROR
 
 
-def make_chunked_engine(chunk_tokens, **kw):
+def make_chunked_engine(chunk_tokens, mixed_step=False, **kw):
     cfg = L.LlamaConfig.tiny(vocab_size=64)
     params = L.init_params(cfg, jax.random.PRNGKey(0))
     runner = ModelRunner(
@@ -223,6 +223,8 @@ def make_chunked_engine(chunk_tokens, **kw):
             max_batch=4, block_size=4,
             num_blocks=kw.get("num_blocks", 64),
             max_model_len=64, watermark_blocks=2,
+            mixed_step=mixed_step,
+            chunk_budget=kw.get("chunk_budget", 0),
         ),
     )
 

@@ -86,6 +86,10 @@ INTENTIONALLY_SHARED = {
     "dyn_llm_step_occupancy",
     "dyn_llm_phase_bubble_seconds",
     "dyn_llm_device_tokens",
+    # unified mixed prefill+decode steps (ISSUE 16) ride the same
+    # shared goodput surface
+    "dyn_llm_mixed_steps",
+    "dyn_llm_mixed_step_tokens",
     "dyn_llm_tokens_wasted",
     "dyn_llm_recompiles",
     "dyn_llm_compile_seconds",

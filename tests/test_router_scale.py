@@ -9,6 +9,7 @@ load with floors loose enough for a busy CI machine but tight enough that
 an accidental O(n^2) or per-query allocation storm fails loudly.
 """
 
+import gc
 import random
 import time
 
@@ -88,13 +89,20 @@ def test_indexer_scale_floors():
     find p99 ~55us) are recorded in benchmarks/router_bench_single.json.
     """
     chains, events = _events(workers=16, chains_per_worker=20)
-    idx = KvIndexer(BS)
-    t0 = time.perf_counter()
-    for ev in events:
-        idx.apply_event(ev)
-    ingest = time.perf_counter() - t0
+    # best of two trials on a fresh indexer each: mid-suite this test
+    # inherits whatever garbage the preceding ~200 tests accumulated,
+    # and a GC pass landing inside the timed loop gates on the collector
+    # rather than the indexer (noise only ever inflates a sample)
     blocks = len(events) * 32
-    assert blocks / ingest > 20_000, f"ingest too slow: {blocks/ingest:.0f}/s"
+    rate = 0.0
+    for _ in range(2):
+        gc.collect()
+        idx = KvIndexer(BS)
+        t0 = time.perf_counter()
+        for ev in events:
+            idx.apply_event(ev)
+        rate = max(rate, blocks / (time.perf_counter() - t0))
+    assert rate > 20_000, f"ingest too slow: {rate:.0f}/s"
 
     rng = random.Random(2)
     lat = []

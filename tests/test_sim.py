@@ -31,6 +31,7 @@ from dynamo_tpu.testing.sim import (
     bank_artifact,
     chaos_scenario,
     load_artifact,
+    mixed_step_chaos_scenario,
     planted_fence_bug_scenario,
     run_sim,
     shrink_schedule,
@@ -186,6 +187,46 @@ def test_sim_ten_minutes_mixed_chaos_bit_identical():
     r2 = run_sim(cfg)
     assert r2.digest == r1.digest, "same seed, different run"
     assert r2.n_requests == r1.n_requests
+
+
+def test_sim_mixed_stepper_chaos_invariants_green():
+    """ISSUE 16 pinned-seed scenario: mixed-priority traffic through the
+    unified mixed prefill+decode stepper (chunk_budget on every mock
+    engine), with worker-kill waves forcing migration replays through
+    the chunked admission path and brownout waves riding through the
+    chunk_cap rung (halved budget) and back.  All six invariants must
+    stay green continuously, mixed steps must actually have run on every
+    worker, and the run must be bit-identical on replay."""
+    cfg = mixed_step_chaos_scenario(seed=21)
+    assert cfg.chunk_budget == 8
+    assert any(level == 3 for _, level in cfg.brownout_waves)
+    r1 = run_sim(cfg)
+    assert r1.ok, r1.violations
+    assert r1.sim_seconds >= 120.0
+    # the stepper genuinely packed prefill chunks alongside decode lanes
+    mixed = {
+        k: v for k, v in r1.counters.items()
+        if k.startswith("mixed_steps/")
+    }
+    # every long-lived incarnation ran mixed steps; an incarnation killed
+    # moments after boot may legitimately log none, so assert fleet-wide
+    assert sum(mixed.values()) >= 4 * cfg.n_workers, r1.counters
+    nonzero = sum(1 for v in mixed.values() if v > 0)
+    assert nonzero >= cfg.n_workers, r1.counters
+    # migration replays went through the chunked admission path
+    assert r1.fault_fired.get("worker_kill", 0) >= 2
+    # shed bulk requests during the chunk_cap wave are structured
+    # errors, never stuck streams — completed traffic dominates
+    assert r1.outcomes["ok"] > 50
+    for name, st in r1.invariant_stats.items():
+        assert st["evals"] > 50, (name, st)
+        assert st["violations"] == 0, (name, st)
+    r2 = run_sim(cfg)
+    assert r2.digest == r1.digest, "same seed, different run"
+    # the scenario config round-trips through JSON (artifact path)
+    clone = SimConfig.from_json(json.loads(json.dumps(cfg.to_json())))
+    assert clone.chunk_budget == cfg.chunk_budget
+    assert clone.brownout_waves == cfg.brownout_waves
 
 
 # --------------------------------------- planted bug + shrink + replay

@@ -1,0 +1,146 @@
+"""Mixed-step regression gate (ISSUE 16): the banked phase-bubble
+numbers are a FLOOR, not a souvenir.
+
+Re-runs ``benchmarks.mixed_load_sweep`` fresh and compares it against the
+banked artifact (``benchmarks/mixed_load_sweep.json``). The gate fails
+loudly (exit 1) when the unified stepper's win erodes:
+
+  * correctness is absolute — fresh run must be token-identical across
+    modes, with zero steady-state recompiles in BOTH modes (the mixed
+    program family stayed closed);
+  * mixed-mode ``phase_bubble_fraction`` must not exceed the banked
+    value by more than --tolerance (relative, default 10%);
+  * the phase-bubble REDUCTION (separated/mixed) must retain at least
+    (1 - tolerance) of the banked ratio and never drop below the
+    acceptance bar of 3x;
+  * the p50 TTFT delta (mixed vs separated, negative = better) must not
+    worsen past the banked value by more than tolerance x 100
+    percentage points — and must never go positive (mixed TTFT worse
+    than separated).
+
+Wall-clock noise note: fractions and ratios are compared, not absolute
+seconds, so the gate is stable across machines of different speeds; the
+benchmark itself reports the median-TTFT drive of N repeats, so one
+unlucky asyncio schedule cannot fail the gate on its own.
+
+    JAX_PLATFORMS=cpu python -m tools.mixed_gate
+
+(No reduced-workload mode: warmup compiles dominate the runtime, so a
+smaller drive saves nothing and loses the statistics the bars need.)
+
+``--update`` re-banks the fresh run as the new reference after an
+intentional scheduler change.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+from benchmarks.mixed_load_sweep import run_bench
+
+BANKED = "benchmarks/mixed_load_sweep.json"
+
+
+def gate(fresh: dict, banked: dict, tolerance: float) -> list[str]:
+    """Return the list of failures (empty = gate passes)."""
+    fails: list[str] = []
+    if not fresh["token_identical"]:
+        fails.append("token streams diverged between modes")
+    for mode in ("separated", "mixed"):
+        n = fresh[mode]["steady_state_recompiles"]
+        if n:
+            fails.append(f"{mode}: {n} steady-state recompiles (want 0)")
+    if fresh["mixed"]["mixed_steps"] <= 0:
+        fails.append("no mixed steps packed — unified stepper inactive")
+
+    frac_new = fresh["mixed"]["phase_bubble_fraction"]
+    frac_old = banked["mixed"]["phase_bubble_fraction"]
+    if frac_new > frac_old * (1 + tolerance) + 1e-4:
+        fails.append(
+            "mixed phase_bubble_fraction regressed: "
+            f"{frac_new:.5f} vs banked {frac_old:.5f} "
+            f"(+{tolerance:.0%} allowed)"
+        )
+
+    red_new = fresh["phase_bubble_reduction"]
+    red_old = banked["phase_bubble_reduction"]
+    if red_new < red_old * (1 - tolerance) and red_new < 3.0:
+        fails.append(
+            "phase-bubble reduction collapsed: "
+            f"{red_new:.1f}x vs banked {red_old:.1f}x (floor 3x)"
+        )
+
+    # banked delta is negative (mixed is faster); a regression shrinks
+    # the improvement toward / past zero. Allowance is in percentage
+    # POINTS (tolerance 0.10 -> 10pp): a relative bar on a ratio whose
+    # run-to-run spread exceeds 10% would gate on scheduler jitter, not
+    # on the code
+    d_new = fresh["ttft_p50_delta_pct"]
+    d_old = banked["ttft_p50_delta_pct"]
+    allow_pp = 100.0 * tolerance
+    if d_new > 0.0:
+        fails.append(
+            f"mixed p50 TTFT WORSE than separated ({d_new:+.1f}%)"
+        )
+    elif d_new > d_old + allow_pp:
+        fails.append(
+            "p50 TTFT improvement eroded: "
+            f"{d_new:+.1f}% vs banked {d_old:+.1f}% "
+            f"(+{allow_pp:.0f}pp allowed)"
+        )
+    return fails
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--banked", default=BANKED)
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed relative regression (default 0.10)")
+    ap.add_argument("--update", action="store_true",
+                    help="re-bank the fresh run as the new reference")
+    args = ap.parse_args(argv)
+
+    banked_path = Path(args.banked)
+    if not banked_path.exists() and not args.update:
+        print(f"mixed_gate: no banked artifact at {banked_path} "
+              "(run with --update to create it)")
+        return 1
+
+    fresh = run_bench()
+
+    for mode in ("separated", "mixed"):
+        print(json.dumps(fresh[mode]))
+    print(json.dumps({
+        "token_identical": fresh["token_identical"],
+        "phase_bubble_reduction": fresh["phase_bubble_reduction"],
+        "ttft_p50_delta_pct": fresh["ttft_p50_delta_pct"],
+    }))
+
+    if args.update:
+        with open(banked_path, "w") as f:
+            json.dump(fresh, f, indent=1)
+            f.write("\n")
+        print(f"mixed_gate: banked {banked_path}")
+        return 0
+
+    with open(banked_path) as f:
+        banked = json.load(f)
+    fails = gate(fresh, banked, args.tolerance)
+    if fails:
+        for msg in fails:
+            print(f"mixed_gate FAIL: {msg}")
+        return 1
+    print(
+        "mixed_gate OK: reduction "
+        f"{fresh['phase_bubble_reduction']:.1f}x "
+        f"(banked {banked['phase_bubble_reduction']:.1f}x), "
+        f"ttft_p50 {fresh['ttft_p50_delta_pct']:+.1f}% "
+        f"(banked {banked['ttft_p50_delta_pct']:+.1f}%)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

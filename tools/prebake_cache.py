@@ -125,6 +125,36 @@ def prebake(args) -> dict:
                 [1, 2], 0.0, 1.0, 0,
             ),
         )
+        # unified mixed prefill+decode steps: one program per chunk-slot
+        # count k=1..K, where K mirrors JaxEngine's _mixed_max_slots
+        # (ceil(chunk_budget / chunk_tokens); budget defaults to twice
+        # the chunk size). Chunk tables are max_blocks_per_seq-wide by
+        # construction, so the family is closed — serving never compiles
+        # a mixed shape this loop didn't bake.
+        budget = args.chunk_budget
+        if budget <= 0:
+            budget = 2 * runner.prefill_chunk_tokens
+        K = max(1, -(-budget // runner.prefill_chunk_tokens))
+        chunk = (
+            [1] * min(runner.prefill_chunk_tokens, bs), 0, bs + 1,
+            [1, 2], 0.0, 1.0, 0, 1.0,
+            np.zeros(2, np.uint32),
+            np.full(MAX_EOS_IDS, -1, np.int32), False,
+        )
+        dkeys = np.zeros((B, 2), np.uint32)
+        for k in range(1, K + 1):
+            bake(
+                f"mixed_step@c{k}",
+                lambda n=k: runner.mixed_step(
+                    [chunk] * n,
+                    np.zeros(B, np.int32), np.zeros(B, np.int32), tables,
+                    np.zeros(B, np.int32), dkeys,
+                    np.zeros(B, np.float32), np.ones(B, np.float32),
+                    np.zeros(B, np.int32),
+                    eos_ids=np.full((B, MAX_EOS_IDS), -1, np.int32),
+                    eos_suppress=np.zeros(B, bool),
+                ),
+            )
     zeros_i = np.zeros(B, np.int32)
     zeros_f = np.zeros(B, np.float32)
     ones_f = np.ones(B, np.float32)
@@ -207,6 +237,10 @@ def main(argv=None) -> dict:
     ap.add_argument("--decode-horizon", type=int, default=None)
     ap.add_argument("--spec-k", type=int,
                     default=int(os.environ.get("DYN_SPEC_K", "0") or 0))
+    ap.add_argument("--chunk-budget", type=int,
+                    default=int(os.environ.get("DYN_CHUNK_BUDGET", "0") or 0),
+                    help="per-step mixed prefill token budget (0 = twice "
+                    "the chunk size, JaxEngine's default)")
     ap.add_argument("--json", default=None)
     args = ap.parse_args(argv)
     if not args.tiny and not args.model_path:
