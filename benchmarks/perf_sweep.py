@@ -260,7 +260,7 @@ def main() -> None:
         choices=[
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
             "priority", "integrity", "decode_mfu", "blackout", "planner",
-            "tail", "goodput", "sim", "mixed",
+            "tail", "goodput", "sim", "mixed", "prefix",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -324,7 +324,13 @@ def main() -> None:
         "prefill+decode device steps vs the phase-separated scheduler on "
         "the same workload: phase-bubble fraction, TTFT/ITL, dispatch "
         "count, token-identity, zero steady-state recompiles; banked "
-        "artifact benchmarks/mixed_load_sweep.json)",
+        "artifact benchmarks/mixed_load_sweep.json). "
+        "prefix = delegates to benchmarks.prefix_sweep (fleet prefix "
+        "cache A/B on a Zipf multi-tenant chat trace with thousands of "
+        "distinct system prompts: KV-aware routing alone vs + peer-pull "
+        "prefix reuse — prefill tokens/request, p50 TTFT, token-identity, "
+        "pulled blocks by outcome with deterministic pull failures; "
+        "banked artifact benchmarks/prefix_sweep.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -438,6 +444,16 @@ def main() -> None:
 
         mixed_load_sweep.main(
             ["--json", args.json or "benchmarks/mixed_load_sweep.json"]
+        )
+        return
+    if args.preset == "prefix":
+        # fleet-prefix-cache A/B runs on the mocker fleet + real KvRouter
+        # directly (no HTTP frontend) — one entry point for every banked
+        # curve stays `perf_sweep --preset X`
+        from benchmarks import prefix_sweep
+
+        prefix_sweep.main(
+            ["--json", args.json or "benchmarks/prefix_sweep.json"]
         )
         return
     if args.preset == "slo":

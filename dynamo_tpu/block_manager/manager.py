@@ -142,6 +142,17 @@ class TieredBlockManager:
         self.quarantine_after = max(
             1, int(os.environ.get("DYN_QUARANTINE_AFTER", "2") or 2)
         )
+        # fleet-reuse eviction plane: per-hash fleet access frequency fed
+        # from router pull plans (the radix tree's recent_uses counts), so
+        # a block hot fleet-wide out-survives a locally-idle one when the
+        # host arena evicts. Bounded table; coldest entries drop first.
+        self._fleet_heat: dict[int, float] = {}
+        self._fleet_heat_max = max(
+            1, int(os.environ.get("DYN_FLEET_HEAT_MAX", "65536") or 65536)
+        )
+        self.eviction_scan = max(
+            1, int(os.environ.get("DYN_EVICT_SCAN", "8") or 8)
+        )
         # engine calls arrive from run_in_executor threads; all tier state
         # (arenas, LRU dicts, free list) is guarded by one coarse lock —
         # the hot paths are short and the big copies stay outside jit
@@ -309,13 +320,37 @@ class TieredBlockManager:
             np.moveaxis(v, 0, 2), np.moveaxis(vs, 0, 2),
         )
 
+    def note_fleet_heat(
+        self, seq_hashes: list[int], frequencies: list
+    ) -> None:
+        """Record the router's fleet-wide access counts for these hashes
+        (ride-along on prefix-pull plans). Consulted at eviction time."""
+        with self._lock:
+            for h, f in zip(seq_hashes, frequencies):
+                self._fleet_heat[h] = float(f)
+            overflow = len(self._fleet_heat) - self._fleet_heat_max
+            if overflow > 0:
+                for h, _ in sorted(
+                    self._fleet_heat.items(), key=lambda kv: kv[1]
+                )[:overflow]:
+                    del self._fleet_heat[h]
+
     def _alloc_host_slot(self) -> Optional[int]:
         if self._free_slots:
             return self._free_slots.pop()
-        # LRU-evict the oldest host block (spill to disk if configured)
+        # Evict from the host arena (spill to disk if configured). Among
+        # the K oldest (LRU-front) candidates, pick the one coldest
+        # fleet-wide — min() is stable, so equal-heat blocks fall back to
+        # pure LRU order (heatless operation is exactly the old LRU).
         if not self._host:
             return None
-        old_hash, old = self._host.popitem(last=False)
+        cands: list[int] = []
+        for h in self._host:
+            cands.append(h)
+            if len(cands) >= self.eviction_scan:
+                break
+        old_hash = min(cands, key=lambda h: self._fleet_heat.get(h, 0.0))
+        old = self._host.pop(old_hash)
         if self.disk_dir:
             self._spill_to_disk(old_hash, old)
         elif self.on_event:

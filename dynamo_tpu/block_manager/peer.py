@@ -20,6 +20,7 @@ instead.
 from __future__ import annotations
 
 import asyncio
+import os
 from typing import Any, Optional
 
 import msgpack
@@ -32,6 +33,18 @@ from dynamo_tpu.testing import faults
 logger = get_logger("dynamo_tpu.block_manager.peer")
 
 _ADVERT_PREFIX = "kvbm/adverts"
+
+# Outcome keys for pulled-block accounting — the wire-name contract shared
+# with WorkerStats.kv_pulled_blocks_by_outcome and the
+# dyn_llm_kv_pulled_blocks_total{outcome} metric family.
+PULL_OUTCOMES = (
+    "pulled",
+    "fallback_miss",
+    "fallback_timeout",
+    "fallback_integrity",
+    "fallback_fenced",
+    "fallback_error",
+)
 
 
 def _advert_key(namespace: str, instance_id: int) -> str:
@@ -47,11 +60,17 @@ class PeerBlockService:
         namespace: str,
         manager: Any,  # TieredBlockManager
         publish_interval_s: float = 1.0,
+        worker_id: Optional[int] = None,
     ) -> None:
         self.drt = drt
         self.namespace = namespace
         self.manager = manager
         self.publish_interval_s = publish_interval_s
+        # generate-endpoint worker id (the router's id space) — tagged
+        # into the advert so a router-attached pull plan (whose `src` is a
+        # router worker id) can be resolved to this service's
+        # pull-endpoint instance id
+        self.worker_id = worker_id
         self.endpoint = (
             drt.namespace(namespace).component("kvbm").endpoint("pull")
         )
@@ -98,9 +117,13 @@ class PeerBlockService:
             try:
                 # epoch-stamped advert container (legacy plain-list adverts
                 # are still parsed by older clients' lookup)
-                advert = msgpack.packb(
-                    {"stamp": self._stamp(), "h": self._inventory()}
-                )
+                advert_d: dict = {
+                    "stamp": self._stamp(),
+                    "h": self._inventory(),
+                }
+                if self.worker_id is not None:
+                    advert_d["wid"] = self.worker_id
+                advert = msgpack.packb(advert_d)
                 if advert != self._last_advert:
                     await self.drt.fabric.kv_put(
                         _advert_key(self.namespace, self.instance_id),
@@ -160,6 +183,14 @@ class PeerBlockClient:
         self.own_instance_id: Optional[int] = None  # skip self-pulls
         self.fetched_blocks = 0
         self.fetched_bytes = 0  # wire bytes pulled (post-codec)
+        # per-outcome block counts (PULL_OUTCOMES keys), monotonic
+        self.pull_outcomes: dict[str, int] = {k: 0 for k in PULL_OUTCOMES}
+
+    def _note(self, outcome: str, blocks: int) -> None:
+        if blocks > 0:
+            self.pull_outcomes[outcome] = (
+                self.pull_outcomes.get(outcome, 0) + blocks
+            )
 
     async def _ensure_client(self):
         if self._client is None:
@@ -175,50 +206,106 @@ class PeerBlockClient:
         except Exception:  # noqa: BLE001 — fencing is an upgrade, not a gate
             return None
 
-    async def lookup(self, seq_hashes: list[int]) -> tuple[Optional[int], int]:
-        """(best peer instance, longest advertised prefix length)."""
+    async def _adverts(
+        self,
+    ) -> tuple[list[tuple[int, set, Optional[int]]], set]:
+        """Parsed live adverts [(instance_id, held_hashes, worker_id)],
+        plus the worker ids whose adverts were dropped for a fenced stamp
+        (zombie incarnations — a directed pull from one must fall back)."""
         adverts = await self.drt.fabric.kv_get_prefix(
             f"{_ADVERT_PREFIX}/{self.namespace}/"
         )
         fences = await self._fences()
-        best, best_n = None, 0
+        entries: list[tuple[int, set, Optional[int]]] = []
+        fenced_wids: set = set()
         for key, raw in adverts.items():
             iid = int(key.rsplit("/", 1)[1])
             if iid == self.own_instance_id:
                 continue
             try:
                 d = msgpack.unpackb(raw)
+                wid = None
                 if isinstance(d, dict):
+                    wid = d.get("wid")
                     if fences is not None and fences.check_stamp(
                         d.get("stamp"), "peer"
                     ):
                         # advert from a fenced epoch (zombie worker whose
                         # lease-bound key hasn't aged out yet): skip it
+                        if wid is not None:
+                            fenced_wids.add(wid)
                         continue
                     held = set(d.get("h", []))
                 else:
                     held = set(d)  # legacy plain-list advert
             except Exception:  # noqa: BLE001 — skip malformed advert
                 continue
-            n = 0
-            for h in seq_hashes:
-                if h in held:
-                    n += 1
-                else:
-                    break
+            entries.append((iid, held, wid))
+        return entries, fenced_wids
+
+    @staticmethod
+    def _prefix_len(seq_hashes: list[int], held: set) -> int:
+        n = 0
+        for h in seq_hashes:
+            if h in held:
+                n += 1
+            else:
+                break
+        return n
+
+    async def lookup(self, seq_hashes: list[int]) -> tuple[Optional[int], int]:
+        """(best peer instance, longest advertised prefix length)."""
+        entries, _ = await self._adverts()
+        best, best_n = None, 0
+        for iid, held, _wid in entries:
+            n = self._prefix_len(seq_hashes, held)
             if n > best_n:
                 best, best_n = iid, n
         return best, best_n
 
-    async def fetch_remote_prefix(self, seq_hashes: list[int]) -> int:
+    async def fetch_remote_prefix(
+        self, seq_hashes: list[int], plan: Optional[dict] = None
+    ) -> int:
         """Pull the longest remotely-held prefix into the LOCAL manager;
-        returns the number of blocks landed (0 on miss/failure)."""
+        returns the number of blocks landed (0 on miss/failure).
+
+        With a router-attached `plan` ({"src": worker_id, "blocks": n,
+        "hashes": [...], "avoid": [...]}) the pull is DIRECTED: the
+        planned source's advert (matched via its "wid" tag) is preferred,
+        and avoid-listed workers (dead/ejected/suspect at plan time) are
+        never pulled from. The plan is advisory — any failure falls back
+        to local compute, with blocks counted by outcome in
+        `pull_outcomes`."""
+        planned = int(plan.get("blocks", 0)) if plan else 0
         missing_from = self.manager.lookup_prefix(seq_hashes)
         want = seq_hashes[missing_from:]
         if not want:
             return 0
-        peer, n = await self.lookup(seq_hashes)
+        entries, fenced_wids = await self._adverts()
+        avoid = set(plan.get("avoid", [])) if plan else set()
+        peer, n = None, 0
+        if plan is not None:
+            src = plan.get("src")
+            if src in fenced_wids:
+                self._note("fallback_fenced", planned)
+                return 0
+            for iid, held, wid in entries:
+                if wid is not None and wid == src:
+                    peer, n = iid, self._prefix_len(seq_hashes, held)
+                    break
         if peer is None or n <= missing_from:
+            # undirected scan: opportunistic path, or the planned source
+            # advert is gone/stale — still skip avoid-listed workers
+            best, best_n = None, 0
+            for iid, held, wid in entries:
+                if wid is not None and wid in avoid:
+                    continue
+                m = self._prefix_len(seq_hashes, held)
+                if m > best_n:
+                    best, best_n = iid, m
+            peer, n = best, best_n
+        if peer is None or n <= missing_from:
+            self._note("fallback_miss", planned)
             return 0
         pull = seq_hashes[missing_from:n]
         # never pull a quarantined hash back in: cap the span at the
@@ -233,19 +320,27 @@ class PeerBlockClient:
             return 0
         try:
             client = await self._ensure_client()
-            stream = await client.direct(
-                {"hashes": pull}, peer, Context()
-            )
-            reply = None
-            async for item in stream:
-                reply = item
+            timeout = float(os.environ.get("DYN_PULL_TIMEOUT_S", "5.0"))
+            try:
+                reply = await asyncio.wait_for(
+                    self._pull_from(client, pull, peer), timeout
+                )
+            except asyncio.TimeoutError:
+                self._note("fallback_timeout", len(pull))
+                logger.warning(
+                    "peer block pull timed out after %.1fs; recomputing",
+                    timeout,
+                )
+                return 0
             data = reply.data if hasattr(reply, "data") else reply
             if not data or not data.get("hashes") or not data.get("payload"):
+                self._note("fallback_miss", len(pull))
                 return 0
             fences = await self._fences()
             if fences is not None and fences.check_stamp(
                 data.get("stamp"), "peer"
             ):
+                self._note("fallback_fenced", len(pull))
                 return 0  # pulled from a zombie: refuse, recompute
             from dynamo_tpu.disagg.protocols import KvBlockPayload
 
@@ -258,13 +353,23 @@ class PeerBlockClient:
                 k, v = payload.decode()
             except integrity.IntegrityError as e:
                 integrity.COUNTERS.integrity_failure("peer_pull", str(e))
+                self._note("fallback_integrity", len(pull))
                 return 0
             loop = asyncio.get_running_loop()
             stored = await loop.run_in_executor(
                 None, self.manager.store_blocks, list(data["hashes"]), k, v
             )
             self.fetched_blocks += stored
+            self._note("pulled", stored)
             return stored
         except Exception as e:  # noqa: BLE001 — fall back to recompute
+            self._note("fallback_error", len(pull))
             logger.warning("peer block fetch failed (%s); recomputing", e)
             return 0
+
+    async def _pull_from(self, client, hashes: list[int], peer: int):
+        stream = await client.direct({"hashes": hashes}, peer, Context())
+        reply = None
+        async for item in stream:
+            reply = item
+        return reply

@@ -144,6 +144,27 @@ class _FleetCollector:
         for cls, v in sorted(by_class.items()):
             preempt.add_metric([str(cls)], float(v))
         yield preempt
+        # fleet prefix cache (ISSUE 17): engine-side truth for the
+        # router's pull plans — blocks resolved by peer pull vs the
+        # fallback-to-local-compute reasons
+        pulled = CounterMetricFamily(
+            f"{PREFIX}_kv_pulled_blocks",
+            "Prefix blocks the engines pulled from peers (or fell back "
+            "to recomputing locally), by outcome (fleet sum)",
+            labels=["outcome"],
+        )
+        from dynamo_tpu.block_manager.peer import PULL_OUTCOMES
+
+        by_outcome = dict.fromkeys(PULL_OUTCOMES, 0)
+        by_outcome.update(
+            (
+                agg.worker_stats.kv_pulled_blocks_by_outcome
+                if agg is not None else None
+            ) or {}
+        )
+        for outcome, v in sorted(by_outcome.items()):
+            pulled.add_metric([str(outcome)], float(v))
+        yield pulled
         # integrity plane (ISSUE 8): checksum failures by data-plane path,
         # quarantined poison blocks, epoch-fencing rejects by plane
         integ = CounterMetricFamily(
@@ -622,10 +643,21 @@ class MetricsComponent:
             "Prefill blocks served from a routed worker's cache",
             registry=self.registry,
         )
+        # fleet prefix cache (ISSUE 17): best-anywhere match rate; the
+        # gap to kv_hit_rate is the prefill compute peer pulls can close
+        self.g_event_fleet = g(
+            "kv_hit_fleet_blocks", "Last event fleet-best matched blocks"
+        )
+        self.g_kv_fleet_hit_rate = g(
+            "kv_fleet_hit_rate",
+            "Fleet-best KV match rate: best matched / required prefill "
+            "blocks held anywhere in the fleet",
+        )
         # counter-semantics + histogram + SLO families (scrape-time)
         self.registry.register(_FleetCollector(self))
         self._isl_sum = 0
         self._overlap_sum = 0
+        self._fleet_sum = 0
         self._tasks: list[asyncio.Task] = []
         self.last: Optional[ForwardPassMetrics] = None
         # latest per-worker scrape, kept for /debug/goodput's per-worker
@@ -770,18 +802,22 @@ class MetricsComponent:
                 data = msgpack.unpackb(payload, raw=False)
                 isl = int(data.get("isl_blocks", 0))
                 overlap = int(data.get("overlap_blocks", 0))
+                fleet = int(data.get("fleet_blocks", 0))
             except (TypeError, AttributeError, ValueError):
                 continue
             self.c_hit_events.inc()
             self.c_matched_blocks.inc(max(0, overlap))
             self.g_event_isl.set(isl)
             self.g_event_overlap.set(overlap)
+            self.g_event_fleet.set(fleet)
             self._isl_sum += isl
             self._overlap_sum += overlap
+            self._fleet_sum += fleet
             if self._isl_sum:
                 rate = self._overlap_sum / self._isl_sum
                 self.g_cumulative_hit_rate.set(rate)
                 self.g_kv_hit_rate.set(rate)
+                self.g_kv_fleet_hit_rate.set(self._fleet_sum / self._isl_sum)
 
 
 class MockWorkerMetrics:

@@ -205,6 +205,10 @@ class EngineStats:
     preempted_too_often: int = 0
     shed_brownout: int = 0
     brownout_level: int = 0  # gauge
+    # fleet prefix cache: prefix blocks pulled from peers instead of
+    # recomputed, by outcome (peer.PULL_OUTCOMES keys; monotonic) —
+    # mirrored from PeerBlockClient.pull_outcomes each stats refresh
+    kv_pull_outcomes: dict = field(default_factory=dict)
     # always-on per-phase latency distributions (queue_wait / prefill /
     # ttft / inter_token / e2e) on the shared fixed-log bucket grid;
     # shipped on ForwardPassMetrics and merged fleet-wide by bucket
@@ -1557,20 +1561,36 @@ class JaxEngine:
                 seq.cached_prefix_blocks = self.block_manager.lookup_prefix(
                     seq.prefix_hashes
                 )
+                plan = seq.ctx.metadata.get("prefix_pull")
+                if plan and plan.get("freq"):
+                    # fleet heat rides the pull plan (the radix tree's
+                    # recent_uses counts): feed eviction scoring so a
+                    # fleet-hot block out-survives a locally-idle one
+                    note = getattr(
+                        self.block_manager, "note_fleet_heat", None
+                    )
+                    if note is not None:
+                        note(
+                            [int(h) for h in plan.get("hashes", [])],
+                            plan["freq"],
+                        )
                 if (
                     self.peer_block_client is not None
                     and seq.cached_prefix_blocks < len(seq.prefix_hashes)
                 ):
-                    # G4-lite: a peer may hold the rest of the prefix
+                    # G4-lite: a peer may hold the rest of the prefix —
+                    # directed by the router's plan when one is attached,
+                    # opportunistic otherwise
                     with dtrace.span(
                         "peer_fetch", ctx=seq.ctx, proc=self.trace_proc,
                         blocks_missing=(
                             len(seq.prefix_hashes) - seq.cached_prefix_blocks
                         ),
+                        planned=bool(plan),
                     ):
                         fetched = (
                             await self.peer_block_client.fetch_remote_prefix(
-                                seq.prefix_hashes
+                                seq.prefix_hashes, plan=plan
                             )
                         )
                     if fetched:
@@ -3196,6 +3216,9 @@ class JaxEngine:
         self.stats.used_blocks = (
             self.config.num_blocks - 1 - self.allocator.free_count
         )
+        outcomes = getattr(self.peer_block_client, "pull_outcomes", None)
+        if outcomes:
+            self.stats.kv_pull_outcomes = dict(outcomes)
         self._update_perf_gauges()
 
     def _update_perf_gauges(self) -> None:

@@ -226,6 +226,71 @@ class _MockSeq:
         return self.request.token_ids[: self.prompt_len]
 
 
+class MockFleetPrefixRegistry:
+    """Zero-chip twin of the PeerBlockService/Client advert plane (fleet
+    prefix cache): each registered MockEngine's _SimKvCache IS its
+    advertised block inventory, and a "pull" is a simulated transfer
+    (`pull_block_s` per block) that lets the pulling engine skip
+    recomputing those prefix tokens. Fenced peers are never pulled from
+    (counted as the fenced fallback when they were the only holder), and
+    `fail_every` fails every Nth pull attempt deterministically — no RNG,
+    so replay stays bit-identical — exercising the fallback-to-recompute
+    path. Only prefill ACCOUNTING changes on any outcome; the token
+    stream is identical either way (token-identity invariant)."""
+
+    def __init__(
+        self, pull_block_s: float = 0.0005, fail_every: int = 0
+    ) -> None:
+        self.engines: list["MockEngine"] = []
+        self.pull_block_s = pull_block_s
+        self.fail_every = max(0, int(fail_every))
+        self._attempts = 0
+        self.pulled_blocks = 0
+        self.pull_outcomes: dict[str, int] = {}
+
+    def register(self, engine: "MockEngine") -> None:
+        self.engines.append(engine)
+        engine.peer_registry = self
+
+    def _note(self, engine: "MockEngine", outcome: str, blocks: int) -> None:
+        if blocks <= 0:
+            return
+        self.pull_outcomes[outcome] = (
+            self.pull_outcomes.get(outcome, 0) + blocks
+        )
+        engine.pull_outcomes[outcome] = (
+            engine.pull_outcomes.get(outcome, 0) + blocks
+        )
+
+    def pull(
+        self, engine: "MockEngine", hashes: list[int], cached: int
+    ) -> tuple[int, float]:
+        """(blocks pulled past `engine`'s local `cached` prefix, simulated
+        transfer cost). 0 blocks on miss/failure — the engine recomputes."""
+        best = fenced_best = 0
+        for peer in self.engines:
+            if peer is engine:
+                continue
+            n = peer.cache.cached_prefix_blocks(hashes)
+            if peer.fenced:
+                fenced_best = max(fenced_best, n)
+            else:
+                best = max(best, n)
+        gap = best - cached
+        if gap <= 0:
+            if fenced_best > cached:
+                # the only holder is fenced: never pull from a zombie
+                self._note(engine, "fallback_fenced", fenced_best - cached)
+            return 0, 0.0
+        self._attempts += 1
+        if self.fail_every and self._attempts % self.fail_every == 0:
+            self._note(engine, "fallback_error", gap)
+            return 0, 0.0
+        self.pulled_blocks += gap
+        self._note(engine, "pulled", gap)
+        return gap, gap * self.pull_block_s
+
+
 class MockEngine:
     """AsyncEngine-compatible: generate(request, context) -> LLMEngineOutput
     stream, same surface as JaxEngine/EchoEngine."""
@@ -237,6 +302,7 @@ class MockEngine:
         on_blocks_removed: Optional[Callable[[list[int]], None]] = None,
         remote_prefill_client: Optional[Any] = None,
         disagg_threshold: Optional[int] = None,
+        peer_registry: Optional[MockFleetPrefixRegistry] = None,
     ) -> None:
         self.args = args or MockEngineArgs()
         self.cache = _SimKvCache(self.args, on_blocks_stored, on_blocks_removed)
@@ -266,6 +332,12 @@ class MockEngine:
         self.disagg_threshold = disagg_threshold or 2 * self.args.block_size
         self.remote_prefills = 0
         self.kv_frames_rx = 0
+        # fleet prefix cache (zero-chip): pulls ride the shared registry
+        self.peer_registry = peer_registry
+        if peer_registry is not None and self not in peer_registry.engines:
+            peer_registry.engines.append(self)
+        self.kv_pulled_blocks = 0
+        self.pull_outcomes: dict[str, int] = {}
         # always-on per-phase latency distributions (same instrumentation
         # points as the DYN_TRACE spans, but distribution-valued and never
         # gated) — ride stats() -> ForwardPassMetrics to the fleet planes
@@ -501,6 +573,8 @@ class MockEngine:
             "shed_brownout": self.shed_brownout,
             "brownout_level": self.brownout_level,
             "goodput": self.goodput,
+            "kv_pulled_blocks": self.kv_pulled_blocks,
+            "kv_pull_outcomes": dict(self.pull_outcomes),
         }
 
     def apply_brownout(self, level: int) -> None:
@@ -590,13 +664,29 @@ class MockEngine:
                 )
             seq.acquired_hashes = list(hashes)
             self.active.append(seq)
+            pulled = 0
+            if (
+                self.peer_registry is not None
+                and not seq.remote_prefilled
+                and cached < len(hashes)
+            ):
+                # fleet prefix pull: a peer's cache may hold the rest of
+                # the prefix — pulled blocks skip prefill compute; the
+                # simulated transfer cost joins this admission's dispatch
+                # (so a kill/blackout wave can land MID-pull)
+                pulled, pull_cost = self.peer_registry.pull(
+                    self, hashes, cached
+                )
+                if pulled:
+                    self.kv_pulled_blocks += pulled
+                    cost += pull_cost
             if seq.remote_prefilled:
                 # KV already arrived over the streaming data plane — no
                 # local prefill compute to simulate
                 n_prefill = 0
             else:
                 n_prefill = max(0, len(seq.request.token_ids)
-                                - cached * self.args.block_size)
+                                - (cached + pulled) * self.args.block_size)
             self.prefilled_tokens += n_prefill
             if self.args.chunk_budget > 0:
                 # mixed-step mode: prefill compute rides along future

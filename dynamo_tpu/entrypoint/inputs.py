@@ -663,6 +663,11 @@ async def run_endpoint(
                 fenced_rejects_by_plane=(
                     integ["fenced_rejects_by_plane"] or None
                 ),
+                # fleet prefix cache: realized peer-pull outcomes (both
+                # engines publish the dict under "kv_pull_outcomes")
+                kv_pulled_blocks_by_outcome=(
+                    dict(d.get("kv_pull_outcomes") or {}) or None
+                ),
                 decode_hbm_bytes_per_token=d.get(
                     "decode_hbm_bytes_per_token", 0.0
                 ),

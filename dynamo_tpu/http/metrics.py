@@ -513,13 +513,18 @@ class ServiceMetrics:
             lambda: controller.transitions,
         )
 
-    def attach_kv_hit_stats(self, scheduler) -> None:
+    def attach_kv_hit_stats(self, scheduler, pull_outcomes_fn=None) -> None:
         """Surface an in-process KV router's per-decision hit accounting
         (KvScheduler.hit_stats) on this frontend's /metrics: the fraction
         of prefill blocks served from a routed worker's cache and the
         running matched-blocks total. Lazy gauges — read at scrape time.
         First router wins: one frontend registry can't carry the series
-        twice (a second discovered endpoint keeps its own /metrics)."""
+        twice (a second discovered endpoint keeps its own /metrics).
+
+        `pull_outcomes_fn` optionally feeds realized peer-pull outcomes
+        (a colocated engine's `pull_outcomes` dict); without it the
+        outcome family stays as stable zero-valued series — realized
+        outcomes are engine-side and ride the metrics component."""
         if getattr(self, "_kv_hit_attached", False):
             return
         self._kv_hit_attached = True
@@ -537,6 +542,39 @@ class ServiceMetrics:
             "Prefill blocks served from a routed worker's cache",
             lambda: scheduler.hit_stats["matched_blocks"],
         )
+        # fleet prefix cache (ISSUE 17): the best match held ANYWHERE in
+        # the fleet — the gap to dyn_llm_kv_hit_rate is the prefill
+        # compute the peer-pull plane can still close
+        g_fleet = Gauge(
+            "dyn_llm_kv_fleet_hit_rate",
+            "Fleet-best KV match rate: best matched / required prefill "
+            "blocks held anywhere in the fleet",
+            registry=self.registry,
+        )
+        g_fleet.set_function(lambda: scheduler.fleet_hit_rate)
+        from dynamo_tpu.block_manager.peer import PULL_OUTCOMES
+
+        outcomes_fn = pull_outcomes_fn or (lambda: {})
+
+        class _PullCollector:
+            def describe(self):
+                return []  # dynamic family; registry probes collect()
+
+            def collect(self):
+                fam = CounterMetricFamily(
+                    "dyn_llm_kv_pulled_blocks",
+                    "Prefix blocks resolved by peer pull (or fallen back "
+                    "to local compute), by outcome",
+                    labels=["outcome"],
+                )
+                got = outcomes_fn() or {}
+                # every outcome as a stable zero-valued series: dashboards
+                # must not see label churn on the first fallback
+                for key in PULL_OUTCOMES:
+                    fam.add_metric([key], float(got.get(key, 0)))
+                yield fam
+
+        self.registry.register(_PullCollector())
 
     @contextmanager
     def track(self, model: str, endpoint: str):

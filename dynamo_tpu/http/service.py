@@ -9,6 +9,7 @@ per-model execution chains, /health and Prometheus /metrics.
 from __future__ import annotations
 
 import asyncio
+import collections
 import contextlib
 import json
 import math
@@ -122,6 +123,38 @@ def _parse_class_fractions(raw: Optional[str]) -> dict[str, float]:
     return out
 
 
+def _prefix_sig(text: str) -> Optional[int]:
+    """Cheap request-prefix signature for admission heat: a hash of the
+    leading characters — the system-prompt/template region most likely to
+    be a fleet-shared prefix. Process-local (str hashing is salted); the
+    heat it keys is learned from the router's radix match, so the sig only
+    needs to be stable within this frontend."""
+    if not text:
+        return None
+    return hash(text[:256])
+
+
+def _chat_prefix_sig(chat_req) -> Optional[int]:
+    try:
+        m = chat_req.messages[0]
+        c = m.content
+        if not isinstance(c, str):
+            c = json.dumps(c, sort_keys=True, default=str)
+        return _prefix_sig(f"{m.role}:{c}")
+    except Exception:  # noqa: BLE001 — heat is advisory
+        return None
+
+
+def _completion_prefix_sig(comp_req) -> Optional[int]:
+    try:
+        p = comp_req.prompt
+        if isinstance(p, list):
+            p = ",".join(str(t) for t in p[:64])
+        return _prefix_sig(str(p))
+    except Exception:  # noqa: BLE001 — heat is advisory
+        return None
+
+
 class AdmissionController:
     """Frontend admission control and load shedding (reference: Dynamo's
     serving fabric owns graceful backpressure; Llumnix-style bounded
@@ -169,6 +202,19 @@ class AdmissionController:
         self._capacity_fns: dict[str, Callable[[], Optional[int]]] = {}
         self.shed_total = 0
         self.shed_by_class: dict[str, int] = {}
+        # fleet prefix heat (cache-aware admission): EWMA of the router's
+        # fleet-matched fraction per (model, request-prefix signature). A
+        # KNOWN-cold bulk prefix sheds at a reduced watermark — cold-
+        # prefix bulk gives way before hot-prefix traffic when the queue
+        # fills. First-seen prefixes are never penalized (no heat entry).
+        self.heat_max = max(
+            1, int(env.get("DYN_ADMISSION_HEAT_MAX", "4096") or 4096)
+        )
+        self.cold_prefix_fraction = float(
+            env.get("DYN_COLD_PREFIX_FRACTION", "0.6")
+        )
+        self.cold_prefix_heat = float(env.get("DYN_COLD_PREFIX_HEAT", "0.25"))
+        self._prefix_heat: collections.OrderedDict = collections.OrderedDict()
 
     def set_capacity_fn(
         self, model: str, fn: Callable[[], Optional[int]]
@@ -211,8 +257,32 @@ class AdmissionController:
             self.metrics.class_shed.labels(model, priority, reason).inc()
         return self.drain.retry_after_s(max(1, excess), self.retry_after_s)
 
+    def note_prefix_heat(
+        self, model: str, prefix_sig: Optional[int], frac: float
+    ) -> None:
+        """Learn the router's fleet-matched fraction for this request's
+        prefix signature (EWMA, LRU-capped table)."""
+        if prefix_sig is None:
+            return
+        key = (model, prefix_sig)
+        prev = self._prefix_heat.pop(key, None)
+        heat = (
+            float(frac) if prev is None else 0.5 * prev + 0.5 * float(frac)
+        )
+        self._prefix_heat[key] = heat
+        while len(self._prefix_heat) > self.heat_max:
+            self._prefix_heat.popitem(last=False)
+
+    def prefix_heat(self, model: str, prefix_sig: Optional[int]) -> Optional[float]:
+        if prefix_sig is None:
+            return None
+        return self._prefix_heat.get((model, prefix_sig))
+
     def try_acquire(
-        self, model: str, priority: str = qos.DEFAULT_CLASS
+        self,
+        model: str,
+        priority: str = qos.DEFAULT_CLASS,
+        prefix_sig: Optional[int] = None,
     ) -> Optional[float]:
         """None = admitted (caller must release()); else shed — the value
         is the Retry-After hint in seconds (drain-rate derived)."""
@@ -221,6 +291,19 @@ class AdmissionController:
             return self._shed_one(model, priority, "brownout", 1)
         wm = self.class_watermark(model, priority)
         cur = self._inflight.get(model, 0)
+        if wm is not None and priority == "bulk":
+            heat = self.prefix_heat(model, prefix_sig)
+            if heat is not None and heat < self.cold_prefix_heat:
+                # KNOWN-cold bulk prefix: shed earlier than the class
+                # fraction — it reuses no fleet KV, so under pressure it
+                # costs full prefill compute that hot-prefix traffic skips
+                cold_wm = max(
+                    1, int(math.ceil(wm * self.cold_prefix_fraction))
+                )
+                if cur >= cold_wm:
+                    return self._shed_one(
+                        model, priority, "cold_prefix", cur - cold_wm + 1
+                    )
         if wm is not None and cur >= wm:
             return self._shed_one(
                 model, priority, "watermark", cur - wm + 1
@@ -977,7 +1060,10 @@ class HttpService:
             chat_req.ext.priority if chat_req.ext else None,
             chat_req.model,
         )
-        retry_after = self.admission.try_acquire(chat_req.model, prio)
+        sig = _chat_prefix_sig(chat_req)
+        retry_after = self.admission.try_acquire(
+            chat_req.model, prio, prefix_sig=sig
+        )
         if retry_after is not None:
             return self._shed(chat_req.model, retry_after)
         ctx = self._request_ctx(request)
@@ -1009,6 +1095,9 @@ class HttpService:
                 self._attach_timing(d, ctx)
                 return web.json_response(d, headers=self._resp_headers(ctx))
         finally:
+            frac = ctx.metadata.get("kv_fleet_frac")
+            if frac is not None:
+                self.admission.note_prefix_heat(chat_req.model, sig, frac)
             self.admission.release(chat_req.model)
             self._finish_trace(ctx, model=chat_req.model, timer=timer)
 
@@ -1030,7 +1119,10 @@ class HttpService:
             comp_req.ext.priority if comp_req.ext else None,
             comp_req.model,
         )
-        retry_after = self.admission.try_acquire(comp_req.model, prio)
+        sig = _completion_prefix_sig(comp_req)
+        retry_after = self.admission.try_acquire(
+            comp_req.model, prio, prefix_sig=sig
+        )
         if retry_after is not None:
             return self._shed(comp_req.model, retry_after)
         ctx = self._request_ctx(request)
@@ -1058,6 +1150,9 @@ class HttpService:
                 self._attach_timing(d, ctx)
                 return web.json_response(d, headers=self._resp_headers(ctx))
         finally:
+            frac = ctx.metadata.get("kv_fleet_frac")
+            if frac is not None:
+                self.admission.note_prefix_heat(comp_req.model, sig, frac)
             self.admission.release(comp_req.model)
             self._finish_trace(ctx, model=comp_req.model, timer=timer)
 
@@ -1172,7 +1267,10 @@ class HttpService:
             chat_req.ext.priority if chat_req.ext else None,
             chat_req.model,
         )
-        retry_after = self.admission.try_acquire(chat_req.model, prio)
+        sig = _chat_prefix_sig(chat_req)
+        retry_after = self.admission.try_acquire(
+            chat_req.model, prio, prefix_sig=sig
+        )
         if retry_after is not None:
             return self._shed(chat_req.model, retry_after)
         ctx = self._request_ctx(request)
@@ -1192,6 +1290,9 @@ class HttpService:
                         agg.add(ChatCompletionChunk.model_validate(item.data))
                 chat_resp = agg.finish()
         finally:
+            frac = ctx.metadata.get("kv_fleet_frac")
+            if frac is not None:
+                self.admission.note_prefix_heat(chat_req.model, sig, frac)
             self.admission.release(chat_req.model)
             self._finish_trace(ctx, model=chat_req.model, timer=timer)
         content = ""

@@ -12,15 +12,15 @@ serialization, so apply/find are plain methods and the channel vanishes.
 from __future__ import annotations
 
 import collections
-import time
 from dataclasses import dataclass, field
-from typing import Deque, Optional
+from typing import Callable, Deque, Optional
 
 from dynamo_tpu.kv_router.protocols import (
     KvCacheEvent,
     KvCacheStoredBlock,
     RouterEvent,
 )
+from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.tokens import compute_seq_hash_chain
 
@@ -70,17 +70,24 @@ class RadixTree:
     (reference indexer.rs:196-203).
     """
 
-    def __init__(self, expiration_duration: Optional[float] = None) -> None:
+    def __init__(
+        self,
+        expiration_duration: Optional[float] = None,
+        now_fn: Callable[[], float] = dclock.now,
+    ) -> None:
         self.root = _Node()
         self.lookup: dict[int, dict[int, _Node]] = {}
         self.expiration_duration = expiration_duration
+        # injectable clock seam (PR 14): the expiration/frequency plane
+        # must read the deterministic sim's virtual clock, not wall time
+        self._now = now_fn
 
     def find_matches(
         self, sequence: list[int], early_exit: bool = False
     ) -> OverlapScores:
         scores = OverlapScores()
         current = self.root
-        now = time.monotonic()
+        now = self._now()
         for block_hash in sequence:
             nxt = current.children.get(block_hash)
             if nxt is None:
@@ -207,9 +214,10 @@ class KvIndexer(_ChainQuery):
         self,
         block_size: int,
         expiration_duration: Optional[float] = None,
+        now_fn: Callable[[], float] = dclock.now,
     ) -> None:
         self._block_size = block_size
-        self.tree = RadixTree(expiration_duration)
+        self.tree = RadixTree(expiration_duration, now_fn=now_fn)
 
     def apply_event(self, event: RouterEvent) -> None:
         self.tree.apply_event(event)
@@ -242,12 +250,13 @@ class ShardedKvIndexer(_ChainQuery):
         block_size: int,
         num_shards: int = 8,
         expiration_duration: Optional[float] = None,
+        now_fn: Callable[[], float] = dclock.now,
     ) -> None:
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
         self._block_size = block_size
         self.shards = [
-            KvIndexer(block_size, expiration_duration)
+            KvIndexer(block_size, expiration_duration, now_fn=now_fn)
             for _ in range(num_shards)
         ]
 
@@ -287,15 +296,21 @@ class ApproxKvIndexer(_ChainQuery):
     can't emit cache events.
     """
 
-    def __init__(self, block_size: int, ttl: float = 120.0) -> None:
+    def __init__(
+        self,
+        block_size: int,
+        ttl: float = 120.0,
+        now_fn: Callable[[], float] = dclock.now,
+    ) -> None:
         self._block_size = block_size
         self.ttl = ttl
-        self.tree = RadixTree()
+        self.tree = RadixTree(now_fn=now_fn)
+        self._now = now_fn
         # (expiry, worker_id, block_hash) min-heap by expiry; lazily purged.
         self._expiries: dict[tuple[int, int], float] = {}
 
     def _purge(self) -> None:
-        now = time.monotonic()
+        now = self._now()
         expired = [k for k, t in self._expiries.items() if t <= now]
         removed_by_worker: dict[int, list[int]] = {}
         for worker_id, block_hash in expired:
@@ -314,7 +329,7 @@ class ApproxKvIndexer(_ChainQuery):
         self, token_ids: list[int], worker_id: int
     ) -> None:
         chain = compute_seq_hash_chain(token_ids, self._block_size)
-        expiry = time.monotonic() + self.ttl
+        expiry = self._now() + self.ttl
         blocks = [KvCacheStoredBlock(h) for h in chain]
         self.tree.apply_event(
             RouterEvent(worker_id, KvCacheEvent.stored_event(0, None, blocks))

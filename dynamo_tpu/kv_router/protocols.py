@@ -143,6 +143,11 @@ class WorkerStats:
     # decode-MFU estimate (engine/jax_engine/perf_model.py)
     decode_hbm_bytes_per_token: float = 0.0
     mfu_decode_est: float = 0.0
+    # fleet prefix cache (ISSUE 17): prefix blocks this worker pulled
+    # from peers instead of recomputing, by outcome (pulled /
+    # fallback_miss / fallback_timeout / fallback_integrity /
+    # fallback_fenced / fallback_error) — monotonic
+    kv_pulled_blocks_by_outcome: Optional[dict[str, int]] = None
 
 
 @dataclass
@@ -279,6 +284,10 @@ class KVHitRateEvent:
     worker_id: int
     isl_blocks: int
     overlap_blocks: int
+    # best overlap any live worker held for this request (the fleet-best
+    # match the scheduler routed toward or planned a pull from); the
+    # routed-vs-fleet gap is the prefill compute a pull can still save
+    fleet_blocks: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return self.__dict__

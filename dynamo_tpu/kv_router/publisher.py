@@ -291,6 +291,16 @@ class KvMetricsAggregator:
                         agg.worker_stats.fenced_rejects_by_plane.get(p, 0)
                         + v
                     )
+            # fleet prefix cache: realized peer-pull outcomes merge by
+            # key addition (same contract as the per-class preemptions)
+            if m.worker_stats.kv_pulled_blocks_by_outcome:
+                if agg.worker_stats.kv_pulled_blocks_by_outcome is None:
+                    agg.worker_stats.kv_pulled_blocks_by_outcome = {}
+                d = agg.worker_stats.kv_pulled_blocks_by_outcome
+                for o, v in (
+                    m.worker_stats.kv_pulled_blocks_by_outcome.items()
+                ):
+                    d[o] = d.get(o, 0) + v
             agg.kv_stats.kv_active_blocks += m.kv_stats.kv_active_blocks
             agg.kv_stats.kv_total_blocks += m.kv_stats.kv_total_blocks
             agg.kv_stats.gpu_cache_usage_perc += m.kv_stats.gpu_cache_usage_perc

@@ -20,6 +20,7 @@ from dynamo_tpu.kv_router.protocols import RouterEvent
 from dynamo_tpu.kv_router.scheduler import (
     KvRouterConfig,
     KvScheduler,
+    WorkerSelectionResult,
     WorkerSelector,
 )
 from dynamo_tpu.pipeline.context import Context
@@ -49,7 +50,12 @@ class KvRouter:
         self.block_size = block_size
         self.config = config or KvRouterConfig()
         if self.config.use_kv_events:
-            self.indexer: KvIndexer | ApproxKvIndexer = KvIndexer(block_size)
+            # frequency horizon turns on the radix recent_uses plane: the
+            # per-block fleet heat that rides pull plans into eviction
+            horizon = self.config.frequency_horizon_s or None
+            self.indexer: KvIndexer | ApproxKvIndexer = KvIndexer(
+                block_size, expiration_duration=horizon
+            )
         else:
             self.indexer = ApproxKvIndexer(block_size, self.config.ttl_secs)
         if selector is None:
@@ -57,7 +63,10 @@ class KvRouter:
 
             selector = DefaultWorkerSelector(self.config)
         self.scheduler = KvScheduler(
-            block_size, selector, on_hit_rate_event=self._queue_hit_rate_event
+            block_size,
+            selector,
+            on_hit_rate_event=self._queue_hit_rate_event,
+            config=self.config,
         )
         self._tasks: list[asyncio.Task] = []
         self._known_workers: set[int] = set()
@@ -128,10 +137,12 @@ class KvRouter:
 
     # ------------------------------------------------------------- routing
 
-    async def find_best_match(
+    async def route(
         self, token_ids: list[int], request_id: Optional[str] = None
-    ) -> tuple[int, int]:
-        """Returns (worker_id, overlap_blocks)."""
+    ) -> WorkerSelectionResult:
+        """Full routing decision: chosen worker, its local overlap, the
+        fleet-best overlap, and (when the gap clears the pull-cost
+        threshold) the prefix-pull plan for the dispatch to carry."""
         if not self._started:
             await self.start()
         self._sync_workers()
@@ -144,6 +155,13 @@ class KvRouter:
             self.indexer.process_routing_decision_for_request(
                 token_ids, result.worker_id
             )
+        return result
+
+    async def find_best_match(
+        self, token_ids: list[int], request_id: Optional[str] = None
+    ) -> tuple[int, int]:
+        """Returns (worker_id, overlap_blocks)."""
+        result = await self.route(token_ids, request_id=request_id)
         return result.worker_id, result.overlap_blocks
 
     def free(self, request_id: str) -> None:
@@ -160,10 +178,17 @@ class KvPushRouter:
     async def select_worker(
         self, token_ids: list[int], context: Context
     ) -> tuple[int, float]:
-        worker_id, overlap = await self.router.find_best_match(
-            token_ids, request_id=context.id
-        )
-        return worker_id, float(overlap)
+        result = await self.router.route(token_ids, request_id=context.id)
+        # plan + fleet match ride Context.metadata (the same wire hop the
+        # priority class crosses): the engine reads the plan, admission
+        # learns prefix heat from the fleet-matched fraction
+        if result.pull_plan is not None:
+            context.metadata["prefix_pull"] = result.pull_plan
+        if result.required_blocks:
+            context.metadata["kv_fleet_frac"] = round(
+                result.fleet_blocks / result.required_blocks, 4
+            )
+        return result.worker_id, float(result.overlap_blocks)
 
     def on_request_complete(self, context: Context) -> None:
         self.router.free(context.id)

@@ -9,6 +9,7 @@ logit = overlap_score_weight * prefill_blocks + potential_active_blocks
 from __future__ import annotations
 
 import math
+import os
 import random
 from dataclasses import dataclass, field
 from typing import Optional, Protocol
@@ -29,6 +30,30 @@ class KvRouterConfig:
     router_temperature: float = 0.5
     use_kv_events: bool = True
     ttl_secs: float = 120.0  # ApproxKvIndexer TTL when use_kv_events=False
+    # fleet prefix cache (ISSUE 17): when the chosen worker's local
+    # overlap trails the fleet-best match by at least this many blocks,
+    # the dispatch carries a prefix-pull plan so the engine fetches the
+    # gap over the peer path instead of recomputing it. The threshold IS
+    # the pull-cost model: below it, recomputing a few blocks locally is
+    # cheaper than a peer round trip.
+    prefix_pull: bool = field(
+        default_factory=lambda: str(
+            os.environ.get("DYN_PREFIX_PULL", "1")
+        ).lower() not in ("0", "false", "no", "off")
+    )
+    prefix_pull_min_blocks: int = field(
+        default_factory=lambda: int(
+            os.environ.get("DYN_PREFIX_PULL_MIN_BLOCKS", "4")
+        )
+    )
+    # sliding window for the radix frequency plane (recent_uses): per-
+    # block fleet-wide access counts ride pull plans into worker eviction
+    # scoring. 0 disables frequency tracking.
+    frequency_horizon_s: float = field(
+        default_factory=lambda: float(
+            os.environ.get("DYN_PREFIX_FREQ_HORIZON_S", "600")
+        )
+    )
 
 
 @dataclass
@@ -48,6 +73,14 @@ class WorkerSelectionResult:
     worker_id: int
     required_blocks: int
     overlap_blocks: int
+    # best (capped) overlap held anywhere in the fleet for this request
+    fleet_blocks: int = 0
+    # prefix-pull plan attached when the routed worker trails the fleet
+    # best by more than the pull-cost threshold: {"src": worker_id,
+    # "blocks": n, "hashes": chain[:n], "avoid": [worker_id, ...],
+    # "freq": [per-depth recent-use counts]} — advisory; the engine
+    # resolves the peer from adverts and falls back to local compute
+    pull_plan: Optional[dict] = None
 
 
 class NoEndpointsError(RuntimeError):
@@ -167,11 +200,17 @@ class KvScheduler:
         block_size: int,
         selector: Optional[WorkerSelector] = None,
         on_hit_rate_event=None,
+        config: Optional[KvRouterConfig] = None,
     ) -> None:
         from dynamo_tpu.kv_router.sequence import ActiveSequencesMultiWorker
 
         self.block_size = block_size
         self.selector = selector or DefaultWorkerSelector()
+        self.config = (
+            config
+            or getattr(self.selector, "config", None)
+            or KvRouterConfig()
+        )
         self.sequences = ActiveSequencesMultiWorker(block_size, [])
         self.on_hit_rate_event = on_hit_rate_event
         # tail-tolerance plane (telemetry/health.HealthScorer, optional):
@@ -187,6 +226,15 @@ class KvScheduler:
             "decisions": 0,
             "isl_blocks": 0,
             "matched_blocks": 0,
+            # fleet-best matched blocks per decision: the gap between this
+            # and matched_blocks is prefill compute a pull can still save
+            "fleet_blocks": 0,
+        }
+        # prefix-pull planning counters (router-side view; the engines
+        # report realized pull outcomes through their own WorkerStats)
+        self.pull_stats: dict[str, int] = {
+            "plans": 0,
+            "planned_blocks": 0,
         }
 
     @property
@@ -194,6 +242,14 @@ class KvScheduler:
         """Cumulative matched/ISL blocks over every routing decision."""
         isl = self.hit_stats["isl_blocks"]
         return self.hit_stats["matched_blocks"] / isl if isl else 0.0
+
+    @property
+    def fleet_hit_rate(self) -> float:
+        """Cumulative fleet-best matched/ISL blocks: the hit rate the
+        fleet could reach if every request landed on (or pulled from)
+        its best-matching holder."""
+        isl = self.hit_stats["isl_blocks"]
+        return self.hit_stats["fleet_blocks"] / isl if isl else 0.0
 
     def update_workers(self, worker_ids: list[int]) -> None:
         self.sequences.update_workers(worker_ids)
@@ -231,21 +287,90 @@ class KvScheduler:
         result = self.selector.select_worker(
             worker_ids, request, self.block_size
         )
+        result.fleet_blocks = min(
+            result.required_blocks,
+            max(overlap.scores.values(), default=0),
+        )
+        result.pull_plan = self._plan_pull(
+            result, overlap, chain, set(worker_ids), health_factors
+        )
         self.sequences.add_request_chain(
             result.worker_id, chain, partial, request_id
         )
         self.hit_stats["decisions"] += 1
         self.hit_stats["isl_blocks"] += result.required_blocks
         self.hit_stats["matched_blocks"] += result.overlap_blocks
+        self.hit_stats["fleet_blocks"] += result.fleet_blocks
         if self.on_hit_rate_event is not None:
             self.on_hit_rate_event(
                 KVHitRateEvent(
                     worker_id=result.worker_id,
                     isl_blocks=result.required_blocks,
                     overlap_blocks=result.overlap_blocks,
+                    fleet_blocks=result.fleet_blocks,
                 )
             )
         return result
+
+    def _plan_pull(
+        self,
+        result: WorkerSelectionResult,
+        overlap: OverlapScores,
+        chain: list[int],
+        live: set[int],
+        health_factors: dict[int, float],
+    ) -> Optional[dict]:
+        """Build a prefix-pull plan when the routed worker's local overlap
+        trails the fleet-best match by at least the pull-cost threshold.
+
+        Source choice composes with the tail plane: a healthy holder beats
+        a SUSPECT (deweighted) one beats an ejected/fenced one — an
+        unhealthy source is pulled-from last, and rides the plan's avoid
+        list so the engine's advert resolution also deprioritizes it."""
+        cfg = self.config
+        gap = result.fleet_blocks - result.overlap_blocks
+        if (
+            not cfg.prefix_pull
+            or not chain
+            or gap < max(1, cfg.prefix_pull_min_blocks)
+        ):
+            return None
+        suspects = {w for w, f in health_factors.items() if f > 1.0}
+        candidates = [
+            w
+            for w, s in overlap.scores.items()
+            if w != result.worker_id
+            and min(s, result.required_blocks) > result.overlap_blocks
+        ]
+        if not candidates:
+            return None
+        candidates.sort(
+            key=lambda w: (
+                2 if w not in live else (1 if w in suspects else 0),
+                -min(overlap.scores[w], result.required_blocks),
+                w,
+            )
+        )
+        src = candidates[0]
+        n = min(overlap.scores[src], result.required_blocks)
+        plan: dict = {
+            "src": src,
+            "blocks": n,
+            "hashes": list(chain[:n]),
+            "avoid": sorted(
+                w
+                for w in set(overlap.scores) - live | suspects
+                if w != src
+            ),
+        }
+        if overlap.frequencies:
+            # per-depth fleet access counts along the matched path: the
+            # destination folds these into eviction scoring so a block
+            # hot fleet-wide out-survives a locally idle one
+            plan["freq"] = list(overlap.frequencies[:n])
+        self.pull_stats["plans"] += 1
+        self.pull_stats["planned_blocks"] += n - result.overlap_blocks
+        return plan
 
     def free(self, request_id: str) -> None:
         self.sequences.free(request_id)

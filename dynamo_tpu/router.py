@@ -93,6 +93,46 @@ def build_router_registry(scheduler, decisions_fn, shed_fn, health=None):
         "Prefill blocks served from a routed worker's cache",
         lambda: scheduler.hit_stats["matched_blocks"],
     )
+    # fleet prefix cache (ISSUE 17): fleet-best match rate plus the
+    # router-side pull-planning counters; realized outcomes are
+    # engine-side, so the outcome family here stays zero-stable
+    g_fleet = Gauge(
+        "dyn_llm_kv_fleet_hit_rate",
+        "Fleet-best KV match rate: best matched / required prefill "
+        "blocks held anywhere in the fleet",
+        registry=registry,
+    )
+    g_fleet.set_function(lambda: scheduler.fleet_hit_rate)
+    CallbackCounter(
+        registry,
+        "dyn_llm_kv_pull_plans_total",
+        "Prefix-pull plans attached to routing decisions",
+        lambda: scheduler.pull_stats["plans"],
+    )
+    CallbackCounter(
+        registry,
+        "dyn_llm_kv_pull_planned_blocks_total",
+        "Prefix blocks the router planned to pull from peers",
+        lambda: scheduler.pull_stats["planned_blocks"],
+    )
+    from dynamo_tpu.block_manager.peer import PULL_OUTCOMES
+
+    class _PullCollector:
+        def describe(self):
+            return []
+
+        def collect(self):
+            fam = CounterMetricFamily(
+                "dyn_llm_kv_pulled_blocks",
+                "Prefix blocks resolved by peer pull (or fallen back "
+                "to local compute), by outcome",
+                labels=["outcome"],
+            )
+            for key in PULL_OUTCOMES:
+                fam.add_metric([key], 0.0)
+            yield fam
+
+    registry.register(_PullCollector())
     CallbackCounter(
         registry,
         "dyn_llm_router_decisions_total",
@@ -270,12 +310,23 @@ class StandaloneRouter:
                 return
             tokens = request.get("token_ids") or request.get("tokens") or []
             request_id = str(request.get("request_id", ""))
-            worker_id, overlap = await self.router.find_best_match(
+            result = await self.router.route(
                 list(tokens), request_id=request_id or None
             )
+            worker_id = result.worker_id
+            overlap = result.overlap_blocks
             self.decisions_total += 1
             rsp.set(worker=f"{worker_id:x}", overlap_blocks=overlap)
         out = {"worker_id": worker_id, "overlap_blocks": overlap}
+        # fleet prefix cache (ISSUE 17): the caller's dispatch path stashes
+        # these on Context.metadata so the chosen engine can pull the
+        # missing prefix from its best-matching holder before prefill
+        if result.pull_plan is not None:
+            out["prefix_pull"] = result.pull_plan
+        if result.required_blocks:
+            out["fleet_frac"] = round(
+                result.fleet_blocks / result.required_blocks, 4
+            )
         if rsp.trace_id:
             out["trace"] = dtrace.export_for_trace(
                 rsp.trace_id, include_remote=False

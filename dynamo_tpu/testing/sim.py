@@ -348,11 +348,24 @@ class SimConfig:
     # stamp check, re-opening the zombie double-serve window that the
     # no_double_serve invariant must then catch
     disable_fence_check: bool = False
+    # fleet prefix cache (ISSUE 17): share a MockFleetPrefixRegistry
+    # across the workers so engines opportunistically pull missing prefix
+    # blocks from peers at admission; pull_fail_every injects a
+    # deterministic pull failure every Nth attempt (fallback coverage)
+    fleet_prefix: bool = False
+    pull_fail_every: int = 0
+    # Zipf multi-tenant traffic: each request opens with one of
+    # zipf_tenants shared tenant prefixes (rank-weighted 1/(k+1)^alpha)
+    # followed by a per-request suffix. 0 tenants = legacy random prompts.
+    zipf_tenants: int = 0
+    zipf_alpha: float = 1.1
+    prefix_len: tuple = (8, 24)
 
     def to_json(self) -> dict:
         d = asdict(self)
         d["prompt_len"] = list(self.prompt_len)
         d["max_tokens"] = list(self.max_tokens)
+        d["prefix_len"] = list(self.prefix_len)
         d["brownout_waves"] = [list(w) for w in self.brownout_waves]
         d["schedule"] = self.schedule.to_json() if self.schedule else None
         return d
@@ -362,7 +375,7 @@ class SimConfig:
         d = dict(d)
         if d.get("schedule") is not None:
             d["schedule"] = FaultSchedule.from_json(d["schedule"])
-        for k in ("prompt_len", "max_tokens"):
+        for k in ("prompt_len", "max_tokens", "prefix_len"):
             if k in d:
                 d[k] = tuple(d[k])
         if "brownout_waves" in d:
@@ -469,6 +482,7 @@ class SimFleet:
         self.hedger = None
         self.prefill_service = None
         self.prefill_client = None
+        self.prefix_registry = None
         self._stats_reads: dict[str, int] = {}
         self._bg: list[asyncio.Task] = []
 
@@ -505,6 +519,12 @@ class SimFleet:
             out[f"prefilled/{w.name}"] = e.prefilled_tokens
             out[f"remote_prefills/{w.name}"] = e.remote_prefills
             out[f"mixed_steps/{w.name}"] = e.goodput.mixed_steps
+            if self.prefix_registry is not None:
+                out[f"pulled/{w.name}"] = e.kv_pulled_blocks
+        if self.prefix_registry is not None:
+            out["pulled_blocks"] = self.prefix_registry.pulled_blocks
+            for k, v in sorted(self.prefix_registry.pull_outcomes.items()):
+                out[f"pull/{k}"] = v
         if self.scorer is not None:
             out["ejections"] = sum(self.scorer.ejections_total.values())
         if self.hedger is not None:
@@ -573,6 +593,19 @@ class SimFleet:
             else None,
             disagg_threshold=2 * self.cfg.block_size,
         )
+        if self.cfg.fleet_prefix:
+            # fleet prefix cache: every incarnation joins the shared
+            # registry; fenced incarnations stay listed but are never
+            # pulled from (the registry checks `engine.fenced`)
+            if self.prefix_registry is None:
+                from dynamo_tpu.engine.mocker import (
+                    MockFleetPrefixRegistry,
+                )
+
+                self.prefix_registry = MockFleetPrefixRegistry(
+                    fail_every=self.cfg.pull_fail_every
+                )
+            self.prefix_registry.register(engine)
         drt.on_fence(engine.fence)
         ep = (
             drt.namespace(self.NS).component("worker").endpoint("generate")
@@ -960,9 +993,27 @@ class SimFleet:
         t_end = self.t0 + cfg.sim_minutes * 60.0
         pending: list[asyncio.Task] = []
         i = 0
+        # Zipf multi-tenant traffic (fleet prefix cache): tenant k gets
+        # weight 1/(k+1)^alpha and a fixed shared prefix — hot tenants
+        # recur often enough that peer pulls and fleet-heat eviction have
+        # something to bite on, cold tenants keep the tail realistic
+        tenant_prefixes: list[list[int]] = []
+        tenant_weights: list[float] = []
+        if cfg.zipf_tenants:
+            for k in range(cfg.zipf_tenants):
+                plen = rng.randint(*cfg.prefix_len)
+                tenant_prefixes.append(
+                    [rng.randint(1, 63) for _ in range(plen)]
+                )
+                tenant_weights.append(1.0 / (k + 1) ** cfg.zipf_alpha)
         while dclock.now() < t_end and not self.violation_stop.is_set():
             n = rng.randint(*cfg.prompt_len)
             prompt = [rng.randint(1, 63) for _ in range(n)]
+            if tenant_prefixes:
+                tid = rng.choices(
+                    range(len(tenant_prefixes)), weights=tenant_weights
+                )[0]
+                prompt = tenant_prefixes[tid] + prompt
             priority = "interactive" if i % 3 == 0 else "bulk"
             m = (
                 rng.randint(cfg.max_tokens[0],
@@ -974,7 +1025,9 @@ class SimFleet:
                 rid=f"r{i:05d}",
                 priority=priority,
                 prompt=prompt,
-                expected=[prompt[j % n] for j in range(m)],
+                expected=[
+                    prompt[j % len(prompt)] for j in range(m)
+                ],
                 last_progress_t=dclock.now(),
             )
             self._tracks.append(track)
@@ -1165,6 +1218,48 @@ def mixed_step_chaos_scenario(
         prompt_len=(3, 40),  # long prompts: several chunks per prefill
         max_tokens=(16, 64),
         brownout_waves=waves,
+        schedule=FaultSchedule(events),
+    )
+    base.update(overrides)
+    return SimConfig(**base)
+
+
+def prefix_chaos_scenario(
+    seed: int,
+    sim_minutes: float = 2.0,
+    n_workers: int = 4,
+    **overrides: Any,
+) -> SimConfig:
+    """Zipf multi-tenant traffic over the fleet prefix cache (ISSUE 17):
+    every engine shares a MockFleetPrefixRegistry, so requests landing on
+    a cold worker pull the tenant prefix from its best-matching holder at
+    admission. Kill/blackout waves land while transfers are in flight
+    (pull cost joins the admission dispatch cost), a straggler grays one
+    source, and every Nth pull fails outright — the fallback paths must
+    produce token-identical streams, all six invariants must stay green,
+    and the run must be digest-deterministic."""
+    events = [
+        FaultEvent(t=12.0, action="worker_kill", target=1, duration_s=5.0),
+        FaultEvent(t=25.0, action="fabric_blackout", target=-1,
+                   duration_s=1.0),
+        FaultEvent(t=40.0, action="gray_straggler", target=2,
+                   duration_s=10.0, param=3.0),
+        FaultEvent(t=55.0, action="worker_kill", target=0, duration_s=5.0),
+        FaultEvent(t=80.0, action="worker_kill", target=3, duration_s=5.0),
+    ]
+    base = dict(
+        seed=seed,
+        sim_minutes=sim_minutes,
+        n_workers=n_workers,
+        fleet_prefix=True,
+        pull_fail_every=7,  # deterministic fallback coverage
+        zipf_tenants=12,
+        prefix_len=(8, 24),  # shared tenant system prompts (2-6 blocks)
+        prompt_len=(3, 16),  # per-request suffix
+        max_tokens=(8, 32),
+        request_interval_s=0.25,
+        disagg=False,  # aggregated serving: prefill (and thus the pull
+        # path) runs on whichever worker admission lands on
         schedule=FaultSchedule(events),
     )
     base.update(overrides)

@@ -36,6 +36,7 @@ from dynamo_tpu.kv_router.scheduler import (
     NoEndpointsError,
     OverlapScores,
     SchedulingRequest,
+    WorkerSelectionResult,
     softmax_sample,
 )
 from dynamo_tpu.kv_router.sequence import (
@@ -134,6 +135,83 @@ def test_radix_shared_block_removal_keeps_other_worker():
     t.apply_event(RouterEvent(1, KvCacheEvent.removed_event(0, [8])))
     s = t.find_matches([7, 8])
     assert s.scores == {1: 1, 2: 2}
+
+
+def _count_nodes(node):
+    return 1 + sum(_count_nodes(c) for c in node.children.values())
+
+
+def test_radix_worker_churn_empties_jump_table():
+    """Removed/fenced-worker teardown must empty the jump table AND
+    detach emptied nodes — both are leak planes on a long-running router
+    now that the tree doubles as the fleet prefix cache's directory."""
+    t = RadixTree()
+    t.apply_event(stored(1, [10, 11, 12]))
+    t.apply_event(stored(2, [10, 11]))
+    t.remove_worker(1)
+    assert t.worker_block_count(1) == 0
+    assert 1 not in t.workers()
+    # shared prefix survives for worker 2; the worker-1-only tail is gone
+    assert t.find_matches([10, 11, 12]).scores == {2: 2}
+    assert _count_nodes(t.root) == 1 + 2
+    # a cleared event (fenced-incarnation cache flush) empties the jump
+    # table in place without dropping the worker's registration
+    t.apply_event(RouterEvent(2, KvCacheEvent.cleared_event(9)))
+    assert t.worker_block_count(2) == 0
+    assert _count_nodes(t.root) == 1
+
+
+def test_radix_reregistered_worker_does_not_resurrect_stale_offers():
+    """A re-registered worker incarnation starts from an empty cache: the
+    tree must not offer the previous incarnation's blocks, and stores
+    chained under a pre-churn parent must be dropped, not grafted —
+    otherwise pull plans would name prefixes the worker no longer holds."""
+    t = RadixTree()
+    t.apply_event(stored(1, [10, 11, 12]))
+    t.remove_worker(1)
+    assert t.find_matches([10, 11, 12]).scores == {}
+    # the new incarnation replays a store under a parent only the OLD
+    # incarnation held -> unknown parent, dropped (no resurrection)
+    t.apply_event(stored(1, [12], parent=11, eid=1))
+    assert t.find_matches([10, 11, 12]).scores == {}
+    assert t.worker_block_count(1) == 0
+    # stale removes from the old incarnation are ignored without crashing
+    t.apply_event(RouterEvent(1, KvCacheEvent.removed_event(2, [10])))
+    # a fresh root-anchored store from the new incarnation works normally
+    t.apply_event(stored(1, [20, 21], eid=3))
+    assert t.find_matches([20, 21]).scores == {1: 2}
+    assert _count_nodes(t.root) == 1 + 2
+
+
+def test_pull_plan_source_ranking_live_over_suspect_over_dead():
+    """_plan_pull composes with the tail plane: healthy holders beat
+    SUSPECT (deweighted) holders beat dead/ejected ones, and every
+    non-source unhealthy holder rides the avoid list."""
+    sched = KvScheduler(
+        block_size=BS,
+        config=KvRouterConfig(prefix_pull=True, prefix_pull_min_blocks=1),
+    )
+    res = sched._plan_pull(
+        result=WorkerSelectionResult(
+            worker_id=1, required_blocks=8, overlap_blocks=0, fleet_blocks=8
+        ),
+        overlap=_overlap({2: 6, 3: 6, 9: 8}),
+        chain=list(range(8)),
+        live={1, 2, 3},
+        health_factors={3: 2.0},  # 3 is a SUSPECT; 9 is dead
+    )
+    # 2 (healthy, 6 blocks) beats 3 (suspect, 6) beats 9 (dead, 8)
+    assert res["src"] == 2
+    assert res["blocks"] == 6
+    assert res["hashes"] == list(range(6))
+    assert res["avoid"] == [3, 9]
+    assert sched.pull_stats == {"plans": 1, "planned_blocks": 6}
+
+
+def _overlap(scores: dict) -> OverlapScores:
+    ov = OverlapScores()
+    ov.scores.update(scores)
+    return ov
 
 
 def test_indexer_token_api():

@@ -37,6 +37,11 @@ INTENTIONALLY_SHARED = {
     # component (event plane), standalone router (own scheduler)
     "dyn_llm_kv_hit_rate",
     "dyn_llm_kv_matched_blocks",
+    # fleet prefix cache (ISSUE 17): fleet-best match rate and realized
+    # peer-pull outcomes — frontend (attach), metrics component (fleet
+    # scrape truth), standalone router (zero-stable planning side)
+    "dyn_llm_kv_fleet_hit_rate",
+    "dyn_llm_kv_pulled_blocks",
     # admission-control sheds: frontend and standalone router
     "dyn_llm_requests_shed",
     # deadline expiries: frontend observation vs fleet-summed worker count
@@ -101,8 +106,11 @@ UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
 
 
 class _StubScheduler:
-    hit_stats = {"decisions": 0, "isl_blocks": 0, "matched_blocks": 0}
+    hit_stats = {"decisions": 0, "isl_blocks": 0, "matched_blocks": 0,
+                 "fleet_blocks": 0}
     hit_rate = 0.0
+    fleet_hit_rate = 0.0
+    pull_stats = {"plans": 0, "planned_blocks": 0}
 
 
 class _StubHealth:
@@ -387,6 +395,32 @@ def test_goodput_families_present_with_correct_types():
         causes = {s.labels.get("cause") for s in fam.samples}
         for cause in WASTE_CAUSES:
             assert cause in causes, (role, cause)
+
+
+def test_prefix_cache_families_present_with_correct_types():
+    """ISSUE 17: the fleet-prefix-cache families must exist with the
+    right semantics — fleet hit rate as a gauge, pulled-blocks-by-outcome
+    as a counter family with every outcome as a stable zero-valued
+    series — on every role that exports them."""
+    from dynamo_tpu.block_manager.peer import PULL_OUTCOMES
+
+    regs = _all_registries()
+    by_role = {
+        role: {f.name: f for f in _families(reg)}
+        for role, reg in regs.items()
+    }
+    for role in ("frontend", "component", "router"):
+        fam = by_role[role].get("dyn_llm_kv_fleet_hit_rate")
+        assert fam is not None and fam.type == "gauge", role
+        fam = by_role[role].get("dyn_llm_kv_pulled_blocks")
+        assert fam is not None and fam.type == "counter", role
+        outcomes = {s.labels.get("outcome") for s in fam.samples}
+        for key in PULL_OUTCOMES:
+            assert key in outcomes, (role, key)
+    # the router additionally exports its pull-planning counters
+    for name in ("dyn_llm_kv_pull_plans", "dyn_llm_kv_pull_planned_blocks"):
+        fam = by_role["router"].get(name)
+        assert fam is not None and fam.type == "counter", name
 
 
 def test_every_family_has_help_text():

@@ -558,8 +558,11 @@ def test_frontend_metrics_expose_kv_hit_rate():
     from dynamo_tpu.http.metrics import ServiceMetrics
 
     class FakeSched:
-        hit_stats = {"decisions": 3, "isl_blocks": 10, "matched_blocks": 4}
+        hit_stats = {"decisions": 3, "isl_blocks": 10, "matched_blocks": 4,
+                     "fleet_blocks": 7}
         hit_rate = 0.4
+        fleet_hit_rate = 0.7
+        pull_stats = {"plans": 1, "planned_blocks": 3}
 
     m = ServiceMetrics()
     m.attach_kv_hit_stats(FakeSched())
@@ -567,6 +570,8 @@ def test_frontend_metrics_expose_kv_hit_rate():
     text = m.render().decode()
     assert "dyn_llm_kv_hit_rate 0.4" in text
     assert "dyn_llm_kv_matched_blocks_total 4.0" in text
+    assert "dyn_llm_kv_fleet_hit_rate 0.7" in text
+    assert 'dyn_llm_kv_pulled_blocks_total{outcome="pulled"} 0.0' in text
 
 
 async def test_standalone_router_trace_and_metrics(traced):
