@@ -260,7 +260,7 @@ def main() -> None:
         choices=[
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
             "priority", "integrity", "decode_mfu", "blackout", "planner",
-            "tail", "goodput", "sim", "mixed", "prefix",
+            "tail", "goodput", "sim", "mixed", "prefix", "upgrade",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -330,7 +330,14 @@ def main() -> None:
         "distinct system prompts: KV-aware routing alone vs + peer-pull "
         "prefix reuse — prefill tokens/request, p50 TTFT, token-identity, "
         "pulled blocks by outcome with deterministic pull failures; "
-        "banked artifact benchmarks/prefix_sweep.json)",
+        "banked artifact benchmarks/prefix_sweep.json). "
+        "upgrade = delegates to benchmarks.upgrade_sweep (zero-downtime "
+        "rolling upgrade on the virtual-clock sim fleet: live-KV-handoff "
+        "rollout vs cold rolling restart — successor prefill recompute "
+        "ratio, rollout-window p50 TTFT vs steady state, zero dropped "
+        "streams — plus the forced successor-crash halt+rollback drill; "
+        "banked artifact benchmarks/upgrade_sweep.json, gated by "
+        "tools/upgrade_gate.py)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -446,6 +453,15 @@ def main() -> None:
             ["--json", args.json or "benchmarks/mixed_load_sweep.json"]
         )
         return
+    if args.preset == "upgrade":
+        # rolling-upgrade A/B runs the whole fleet on a virtual clock
+        # (no HTTP frontend, no wall-clock sleeps) — one entry point for
+        # every banked curve stays `perf_sweep --preset X`
+        from benchmarks import upgrade_sweep
+
+        raise SystemExit(upgrade_sweep.main(
+            ["--json", args.json or "benchmarks/upgrade_sweep.json"]
+        ))
     if args.preset == "prefix":
         # fleet-prefix-cache A/B runs on the mocker fleet + real KvRouter
         # directly (no HTTP frontend) — one entry point for every banked

@@ -1,0 +1,180 @@
+"""Zero-downtime rolling-upgrade sweep (ISSUE 18): the deterministic
+A/B that prices the live KV handoff and proves the rollout never costs
+a stream.
+
+Three arms, all on the virtual-clock sim fleet (a real
+UpgradeCoordinator walks a real 8-worker fleet — leases, fencing,
+discovery watches, migration — so the numbers are the state machine's,
+not a model's):
+
+  * **rollout** — the full system: surge -> probation -> live KV
+    handoff (predecessor caches transplant into successors at pull
+    cost) -> graceful drain -> retire, under Zipf hot-tenant traffic
+    shaped so the prefix dominates the prompt (the regime the handoff
+    exists for).
+  * **cold** — the classic cold rolling restart at identical load:
+    no handoff, no peer KV sharing; every successor re-warms every
+    tenant prefix from tokens.
+  * **rollback_drill** — a successor is killed during probation: the
+    coordinator must halt, retire the sick successor, release the
+    maintenance latch, and leave the old fleet serving (zero dropped
+    streams through the failed rollout too).
+
+Banked metrics (``benchmarks/upgrade_sweep.json``, gated by
+``tools/upgrade_gate.py``): zero dropped/diverged streams in every arm
+(digests are bit-identical on replay), successor prefill recompute
+ratio cold/rollout >= 5x, rollout-window p50 TTFT within 25% of steady
+state, and the drill's halt+rollback counters.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.upgrade_sweep
+    JAX_PLATFORMS=cpu python -m benchmarks.perf_sweep --preset upgrade
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+from dynamo_tpu.testing.sim import (
+    FaultEvent,
+    FaultSchedule,
+    SimResult,
+    rolling_upgrade_scenario,
+    run_sim,
+)
+
+SEED = 18
+
+# prefix-dominated traffic: ~40-token shared tenant prefixes over 1-2
+# token suffixes, so successor prefill is almost entirely re-warm cost —
+# exactly what the handoff removes
+AB_OVERRIDES = dict(
+    sim_minutes=1.2,
+    request_interval_s=0.2,
+    prefix_len=(32, 48),
+    prompt_len=(1, 2),
+    max_tokens=(4, 8),
+    upgrade_start_s=12.0,
+    upgrade_probation_s=1.5,
+    schedule=FaultSchedule([]),  # clean measurement; chaos coverage is
+    # the tier-1 scenario's job (tests/test_sim.py)
+)
+
+
+def _p50(xs: list) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    return float(s[len(s) // 2])
+
+
+def _arm(res: SimResult, upgrade_start_s: float) -> dict:
+    c = res.counters
+    # prefill run by successor incarnations (every .g1+ spawned by the
+    # rollout; the A/B arms run a fault-free schedule so no other
+    # incarnations exist)
+    succ_prefill = sum(
+        v for k, v in c.items()
+        if k.startswith("prefilled/") and not k.endswith(".g0")
+    )
+    end_rel = c.get("upgrade/end_t_rel", upgrade_start_s + 30.0)
+    steady = [
+        r[1] for r in res.request_log
+        if 2.0 <= r[0] < upgrade_start_s and r[1] >= 0
+    ]
+    during = [
+        r[1] for r in res.request_log
+        if upgrade_start_s <= r[0] <= end_rel and r[1] >= 0
+    ]
+    p_steady, p_during = _p50(steady), _p50(during)
+    return {
+        "ok": res.ok,
+        "violations": len(res.violations),
+        "n_requests": res.n_requests,
+        "dropped_streams": res.outcomes.get("error", 0),
+        "digest": res.digest,
+        "replaced": c.get("upgrade/replaced", 0),
+        "rollbacks": c.get("upgrade/rollbacks", 0),
+        "done": c.get("upgrade/done", 0),
+        "rollout_seconds": round(end_rel - upgrade_start_s, 3),
+        "handoff_blocks_pulled": c.get("upgrade/handoff/pulled", 0),
+        "successor_prefill_tokens": succ_prefill,
+        "ttft_p50_steady_s": round(p_steady, 5),
+        "ttft_p50_rollout_s": round(p_during, 5),
+        "ttft_rollout_delta_pct": round(
+            100.0 * (p_during - p_steady) / max(1e-9, p_steady), 1
+        ),
+    }
+
+
+def run_bench(seed: int = SEED) -> dict:
+    rollout_cfg = rolling_upgrade_scenario(seed, **AB_OVERRIDES)
+    rollout = _arm(run_sim(rollout_cfg), rollout_cfg.upgrade_start_s)
+
+    cold_cfg = rolling_upgrade_scenario(
+        seed, upgrade_handoff=False, fleet_prefix=False, **AB_OVERRIDES
+    )
+    cold = _arm(run_sim(cold_cfg), cold_cfg.upgrade_start_s)
+
+    # forced successor crash-loop: the kill lands on w0's successor
+    # while it is still on probation — the coordinator must halt and
+    # roll back, and the old fleet must keep serving untouched
+    drill_cfg = rolling_upgrade_scenario(
+        seed,
+        sim_minutes=0.8,
+        request_interval_s=0.2,
+        upgrade_start_s=12.0,
+        upgrade_probation_s=3.0,
+        schedule=FaultSchedule([
+            FaultEvent(t=13.0, action="worker_kill", target=0,
+                       duration_s=5.0),
+        ]),
+    )
+    drill_res = run_sim(drill_cfg)
+    dc = drill_res.counters
+    drill = {
+        "ok": drill_res.ok,
+        "dropped_streams": drill_res.outcomes.get("error", 0),
+        "digest": drill_res.digest,
+        "halted": dc.get("upgrade/done", 0) == 0.0,
+        "rollbacks": dc.get("upgrade/rollbacks", 0),
+        "replaced": dc.get("upgrade/replaced", 0),
+    }
+
+    ratio = cold["successor_prefill_tokens"] / max(
+        1.0, rollout["successor_prefill_tokens"]
+    )
+    return {
+        "bench": "upgrade_sweep",
+        "seed": seed,
+        "rollout": rollout,
+        "cold": cold,
+        "rollback_drill": drill,
+        "prefill_recompute_ratio": round(ratio, 2),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--seed", type=int, default=SEED)
+    ap.add_argument("--json", default="benchmarks/upgrade_sweep.json")
+    args = ap.parse_args(argv)
+    doc = run_bench(seed=args.seed)
+    if args.json:
+        Path(args.json).parent.mkdir(parents=True, exist_ok=True)
+        Path(args.json).write_text(json.dumps(doc, indent=1) + "\n")
+    print(json.dumps({
+        "prefill_recompute_ratio": doc["prefill_recompute_ratio"],
+        "rollout_ttft_delta_pct":
+            doc["rollout"]["ttft_rollout_delta_pct"],
+        "dropped_streams": doc["rollout"]["dropped_streams"]
+        + doc["cold"]["dropped_streams"]
+        + doc["rollback_drill"]["dropped_streams"],
+        "drill_halted": doc["rollback_drill"]["halted"],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -652,17 +652,30 @@ class TieredBlockManager:
                     if self.wire_codec == "int8":
                         f.write(self._k_scales[hnd.index].tobytes())
                         f.write(self._v_scales[hnd.index].tobytes())
-                blocks.append(self._manifest_entry(h, k_sum, v_sum))
+                entry = self._manifest_entry(h, k_sum, v_sum)
+                entry["tier"] = "host"
+                blocks.append(entry)
             for h, src in self._disk.items():
                 entry = self._checkpoint_disk_page(
                     h, src, pages_dir, half, snum
                 )
                 if entry is not None:
+                    entry["tier"] = "disk"
                     blocks.append(entry)
-        manifest = {
-            "version": 1,
+        tier_fp = {
             "wire_codec": self.wire_codec,
             "layout": self._layout_fingerprint(),
+        }
+        manifest = {
+            # v2: per-tier fingerprints + per-block "tier", so a reader
+            # whose disk tier changed shape can still salvage the host
+            # tier. Top-level layout/wire_codec kept for v1 readers
+            # (which compare exactly these — identical values, so a v1
+            # reader accepts a v2 manifest it is compatible with).
+            "version": 2,
+            "wire_codec": self.wire_codec,
+            "layout": self._layout_fingerprint(),
+            "tiers": {"host": dict(tier_fp), "disk": dict(tier_fp)},
             "blocks": blocks,
         }
         tmp = os.path.join(directory, self.MANIFEST + ".tmp")
@@ -721,12 +734,16 @@ class TieredBlockManager:
 
     def restore(self, directory: str) -> dict:
         """Load a checkpoint written by `checkpoint()`: verify the layout
-        fingerprint + codec (mismatch refuses the WHOLE checkpoint — a
-        different model/geometry must never be decoded), then verify each
-        page's checksums and land the good ones host-first (no eviction of
-        live blocks), overflowing to the disk tier when configured.
-        Corrupt/truncated pages are refused and counted; the prefix they
-        named simply recomputes."""
+        fingerprint + codec PER TIER (a v2 manifest carries one
+        fingerprint per tier — only the mismatched tier's blocks are
+        refused, so a restore whose disk spill format changed still
+        salvages the host tier; a v1 manifest, or a mismatch on every
+        tier, refuses the whole checkpoint — a different model/geometry
+        must never be decoded), then verify each page's checksums and
+        land the good ones host-first (no eviction of live blocks),
+        overflowing to the disk tier when configured. Corrupt/truncated
+        pages and mismatched-tier pages are refused and counted
+        (`warm_refused`); the prefix they named simply recomputes."""
         summary = {"restored": 0, "refused": 0, "skipped": 0}
         manifest_path = os.path.join(directory, self.MANIFEST)
         try:
@@ -734,19 +751,62 @@ class TieredBlockManager:
                 manifest = json.load(f)
         except (OSError, ValueError):
             return summary
-        if (
-            manifest.get("layout") != self._layout_fingerprint()
-            or manifest.get("wire_codec") != self.wire_codec
-        ):
+        try:
+            m_version = int(manifest.get("version", 1))
+        except (TypeError, ValueError):
+            m_version = 0
+        if m_version > 2:
+            # a future writer may have changed entry/page semantics this
+            # reader cannot see: refuse the whole checkpoint rather than
+            # decode on guesswork (version-skewed restore)
             logger.warning(
-                "warm-restart checkpoint at %s has layout/codec %s/%s; "
-                "this manager is %s/%s — refusing whole checkpoint",
-                directory, manifest.get("layout"),
-                manifest.get("wire_codec"),
-                self._layout_fingerprint(), self.wire_codec,
+                "warm-restart checkpoint at %s is manifest v%s; this "
+                "build reads <= v2 — refusing whole checkpoint",
+                directory, manifest.get("version"),
             )
-            summary["refused_layout"] = True
+            summary["refused_version"] = True
             return summary
+        my_layout = self._layout_fingerprint()
+        tiers = manifest.get("tiers")
+        if isinstance(tiers, dict) and tiers:
+            bad_tiers = {
+                t for t, tfp in tiers.items()
+                if not isinstance(tfp, dict)
+                or tfp.get("layout") != my_layout
+                or tfp.get("wire_codec") != self.wire_codec
+            }
+            if bad_tiers >= set(tiers):
+                logger.warning(
+                    "warm-restart checkpoint at %s matches NO tier of "
+                    "this manager (%s/%s) — refusing whole checkpoint",
+                    directory, my_layout, self.wire_codec,
+                )
+                summary["refused_layout"] = True
+                return summary
+            if bad_tiers:
+                summary["refused_tiers"] = sorted(bad_tiers)
+                logger.warning(
+                    "warm-restart checkpoint at %s: tier(s) %s have a "
+                    "mismatched layout/codec — refusing their blocks, "
+                    "salvaging the compatible tier(s)",
+                    directory, sorted(bad_tiers),
+                )
+        else:
+            bad_tiers = set()
+            if (
+                manifest.get("layout") != my_layout
+                or manifest.get("wire_codec") != self.wire_codec
+            ):
+                logger.warning(
+                    "warm-restart checkpoint at %s has layout/codec "
+                    "%s/%s; this manager is %s/%s — refusing whole "
+                    "checkpoint",
+                    directory, manifest.get("layout"),
+                    manifest.get("wire_codec"),
+                    my_layout, self.wire_codec,
+                )
+                summary["refused_layout"] = True
+                return summary
         half, snum = self._page_body_nbytes()
         body = 2 * half + 2 * snum
         int8 = self.wire_codec == "int8"
@@ -757,6 +817,12 @@ class TieredBlockManager:
                 try:
                     h = int(entry["hash"], 16)
                 except (KeyError, ValueError):
+                    summary["refused"] += 1
+                    continue
+                if entry.get("tier", "host") in bad_tiers:
+                    # the tier this page was written under changed shape:
+                    # its bytes cannot be decoded by this manager
+                    self.stats.warm_refused += 1
                     summary["refused"] += 1
                     continue
                 if h in self._host or h in self._disk or h in self._quarantined:

@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import json
 import math
 from typing import Optional
 
@@ -218,6 +219,7 @@ class _FleetCollector:
         yield from self._health_families()
         yield from self._slo_families()
         yield from planner_families(self.component.planner_status)
+        yield from fleet_upgrade_families(self.component.upgrade_status)
 
     def _health_families(self):
         """Tail-tolerance plane from the component's own scorer (fed by
@@ -446,6 +448,49 @@ def goodput_families(
     )
 
 
+def fleet_upgrade_families(status: Optional[dict]):
+    """Scrape-time `dyn_fleet_upgrade_*` families from the rollout
+    status snapshot the UpgradeCoordinator publishes under
+    UPGRADE_STATUS_KEY (UpgradeStatus.to_wire() form) — the dashboard's
+    view of a zero-downtime rolling upgrade in flight."""
+    from dynamo_tpu.fleet.upgrade import PHASES
+
+    status = status or {}
+    phase = GaugeMetricFamily(
+        "dyn_fleet_upgrade_phase",
+        "Rolling-upgrade state machine position, one-hot by phase "
+        "(surging/probation/handoff/draining/retiring/rolling_back/"
+        "halted/done; idle when no rollout is active)",
+        labels=["phase"],
+    )
+    current = str(status.get("phase", "idle") or "idle")
+    for p in PHASES:
+        phase.add_metric([p], 1.0 if p == current else 0.0)
+    yield phase
+    handoff = CounterMetricFamily(
+        "dyn_fleet_upgrade_handoff_blocks_total",
+        "KV blocks moved by the live handoff during rollouts, by "
+        "peer-pull outcome (pulled = actually transplanted; fallback_* "
+        "= successor will re-warm from tokens)",
+        labels=["outcome"],
+    )
+    for outcome, v in sorted((status.get("handoff_blocks") or {}).items()):
+        handoff.add_metric([str(outcome)], float(v))
+    yield handoff
+    yield CounterMetricFamily(
+        "dyn_fleet_upgrade_rollbacks_total",
+        "Rollouts automatically halted and rolled back (successor "
+        "crash-loop, failed probation, or SLO burn)",
+        value=float(status.get("rollbacks_total", 0) or 0),
+    )
+    yield GaugeMetricFamily(
+        "dyn_fleet_upgrade_replaced",
+        "Workers replaced so far in the current rollout (resets with "
+        "each new upgrade intent)",
+        value=float(status.get("replaced", 0) or 0),
+    )
+
+
 def planner_families(status: Optional[dict]):
     """Scrape-time `dyn_planner_*` / `dyn_supervisor_*` families from a
     planner-published status dict (Planner.status() wire form under
@@ -666,6 +711,9 @@ class MetricsComponent:
         # latest planner-published status (PLANNER_STATUS_KEY), refreshed
         # by the poll loop; renders as dyn_planner_*/dyn_supervisor_*
         self.planner_status: dict = {}
+        # latest rollout snapshot (UPGRADE_STATUS_KEY, JSON), refreshed
+        # by the poll loop; renders as dyn_fleet_upgrade_*
+        self.upgrade_status: dict = {}
 
     async def start(self) -> int:
         port = await self.server.start()
@@ -792,6 +840,19 @@ class MetricsComponent:
                     )
                     if raw:
                         self.planner_status = msgpack.unpackb(raw, raw=False)
+                # rolling-upgrade status (fleet change plane): the
+                # coordinator publishes JSON snapshots on every phase
+                # transition — absent key keeps the last-seen view
+                with contextlib.suppress(Exception):
+                    from dynamo_tpu.fleet.upgrade import (
+                        UPGRADE_STATUS_KEY,
+                    )
+
+                    raw = await self.component.drt.fabric.kv_get(
+                        UPGRADE_STATUS_KEY
+                    )
+                    if raw:
+                        self.upgrade_status = json.loads(raw.decode())
             except Exception:  # noqa: BLE001 — scrape failures are transient
                 logger.exception("metrics poll failed")
             await asyncio.sleep(self.poll_interval)

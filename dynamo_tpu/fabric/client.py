@@ -170,6 +170,11 @@ class FabricClient:
         self._write_lock = asyncio.Lock()
         self._conn_lost = False
         self.addr: str = ""
+        # per-connection negotiated wire version (hello handshake); reset
+        # to the floor on every fresh connection so the hello itself — and
+        # everything sent to a legacy peer that never negotiates — is
+        # parseable by any server in our supported range
+        self.wire_version = wire.WIRE_VERSION
         # HA failover: all known fabric addresses (comma-separated in
         # DYN_FABRIC_ADDR); on connection loss the client hunts for the
         # promoted primary and transparently re-establishes watches/subs
@@ -247,9 +252,11 @@ class FabricClient:
         self._reader, self._writer = reader, writer
         self.addr = addr
         self._conn_lost = False
+        self.wire_version = wire.WIRE_VERSION  # hello goes at the floor
         self._read_task = asyncio.get_running_loop().create_task(
             self._read_loop()
         )
+        await self._negotiate_version(addr, writer)
         if len(self._addrs) > 1:
             try:
                 role = await self._call_raw("role")
@@ -262,6 +269,32 @@ class FabricClient:
                     await writer.wait_closed()
                 raise ConnectionError(f"{addr} is a {role}, not the primary")
         self._conn_ready.set()
+
+    async def _negotiate_version(
+        self, addr: str, writer: asyncio.StreamWriter
+    ) -> None:
+        """Hello handshake: offer [WIRE_MIN, WIRE_MAX], pin the highest
+        common version. A legacy server answers `unknown op` — pin the
+        floor and proceed (that IS the legacy protocol). Disjoint ranges
+        surface the server's structured WireVersionError: close the
+        connection and fail loudly rather than mis-framing."""
+        try:
+            resp = await self._call_raw(
+                "hello", min=wire.WIRE_MIN, max=wire.WIRE_MAX
+            )
+        except RuntimeError as e:
+            if "unknown op" in str(e):
+                self.wire_version = wire.WIRE_MIN
+                return
+            if self._read_task is not None:
+                self._read_task.cancel()
+            with contextlib.suppress(Exception):
+                writer.close()
+                await writer.wait_closed()
+            if "WireVersionError" in str(e):
+                raise wire.WireVersionError(wire.WIRE_MAX) from e
+            raise ConnectionError(f"hello to {addr} failed: {e}") from e
+        self.wire_version = int(resp["version"]) if resp else wire.WIRE_MIN
 
     @property
     def is_remote(self) -> bool:
@@ -322,6 +355,7 @@ class FabricClient:
             "buffered_publishes": self.buffered_publishes,
             "flushed_publishes": self.flushed_publishes,
             "dropped_publishes": self.dropped_publishes,
+            "wire_version": self.wire_version,
         }
 
     def on_reconnect(self, cb: Callable) -> None:
@@ -512,9 +546,11 @@ class FabricClient:
         try:
             while True:
                 msg = await wire.read_frame(self._reader)
+                # ignore-unknown-trailing-fields contract: a newer server
+                # may append fields to response/push bodies
                 req_id = msg[0]
                 if req_id == 0:  # push
-                    _, _, stream_id, payload = msg
+                    stream_id, payload = msg[2], msg[3]
                     target = self._streams.get(stream_id)
                     if target is None:
                         self._early_pushes.setdefault(stream_id, []).append(
@@ -679,7 +715,9 @@ class FabricClient:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[req_id] = fut
         async with self._write_lock:
-            self._writer.write(wire.pack([req_id, op, kwargs]))
+            self._writer.write(
+                wire.pack([req_id, op, kwargs], version=self.wire_version)
+            )
             await self._writer.drain()
         return await fut
 

@@ -46,10 +46,13 @@ class _Conn:
         self.sub_tasks: dict[int, asyncio.Task] = {}
         self.leases: set[int] = set()
         self.write_lock = asyncio.Lock()
+        # pinned by the hello handshake; stays at the floor for clients
+        # too old to negotiate (they never send hello)
+        self.version = wire.WIRE_VERSION
 
     async def send(self, msg: Any) -> None:
         async with self.write_lock:
-            self.writer.write(wire.pack(msg))
+            self.writer.write(wire.pack(msg, version=self.version))
             await self.writer.drain()
 
 
@@ -303,11 +306,13 @@ class FabricServer:
             while True:
                 try:
                     msg = await wire.read_frame(reader)
-                    req_id, op, kwargs = msg
+                    # ignore-unknown-trailing-fields contract: a newer
+                    # client may append fields to the request body
+                    req_id, op, kwargs = msg[0], msg[1], msg[2]
                 except wire.WireVersionError as e:
-                    # version-skewed peer: fail loudly with the structured
-                    # mismatch (rolling upgrade caught at handshake) rather
-                    # than mis-parsing its framing as garbage lengths
+                    # peer outside our whole negotiable range: fail loudly
+                    # with the structured mismatch rather than mis-parsing
+                    # its framing as garbage lengths
                     logger.error("rejecting version-skewed peer: %s", e)
                     break
                 except (
@@ -346,6 +351,22 @@ class FabricServer:
         st = self.state
         if op == "ping":
             return "pong"
+        if op == "hello":
+            # wire-version negotiation (sent packed at the floor so any
+            # server in the peer's range can parse it): pin this
+            # connection to the highest common version. Disjoint ranges
+            # raise WireVersionError -> structured "err" reply. Answered
+            # even on a standby so probing clients negotiate too.
+            try:
+                conn.version = wire.negotiate(
+                    a.get("min", wire.WIRE_MIN), a.get("max", wire.WIRE_MIN)
+                )
+            except wire.WireVersionError as e:
+                # re-raise outside the ConnectionError hierarchy so the
+                # structured mismatch is REPLIED to the peer (run_one
+                # treats ConnectionError as "peer already gone")
+                raise RuntimeError(f"WireVersionError: {e}") from e
+            return {"version": conn.version}
         if op == "role":
             return self.role
         if op == "repl_subscribe":

@@ -1,0 +1,22 @@
+"""Fleet operations: coordinated zero-downtime change (ISSUE 18).
+
+Everything in this package is about PLANNED change — rolling upgrades,
+config hot-reload — as opposed to the unplanned-failure planes (lifeguard,
+fencing, blackout tolerance) the rest of the runtime defends."""
+
+from dynamo_tpu.fleet.upgrade import (  # noqa: F401
+    PHASES,
+    UPGRADE_INTENT_KEY,
+    UPGRADE_STATUS_KEY,
+    SupervisorWorkerPool,
+    UpgradeCoordinator,
+    UpgradePlan,
+    UpgradeStatus,
+    live_handoff,
+)
+from dynamo_tpu.fleet.config_reload import (  # noqa: F401
+    CONFIG_INTENT_KEY,
+    CONFIG_STATUS_KEY,
+    ConfigReloader,
+    validate_config_payload,
+)

@@ -1,0 +1,230 @@
+"""Validated config hot-reload over the fabric (ISSUE 18 tentpole #3).
+
+Operators write a JSON payload of runtime knobs under the
+``fleet/config-intent`` fabric key; every host running a
+:class:`ConfigReloader` validates it against the knob schema, STAGES it,
+and applies it atomically at its next step boundary (engines already
+latch their chunk budget once per loop iteration — this rides the same
+contract, so a half-applied config is never observable mid-step).
+
+Invalid payloads are refused whole — no partial application — and the
+refusal (with per-knob errors) is reported back under
+``fleet/config-status`` so the operator sees WHY, not a silent no-op.
+
+Supported knobs (the degradation/robustness surface, deliberately small):
+
+    brownout_max_level          int 0..4  — ladder ceiling (telemetry.brownout)
+    admission_class_fractions   {class: 0..1} — shed thresholds (http.service)
+    hedge_budget_fraction       float 0..1 — extra-dispatch budget (health)
+    chunk_budget                int >= 1  — per-step prefill token budget
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+from typing import Any, Callable, Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry.brownout import MAX_LEVEL
+
+logger = get_logger("dynamo_tpu.fleet.config_reload")
+
+CONFIG_INTENT_KEY = "fleet/config-intent"
+CONFIG_STATUS_KEY = "fleet/config-status"
+
+_CLASSES = ("bulk", "standard", "interactive")
+
+
+def _check_fraction(name: str, v: Any, errors: list[str]) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        errors.append(f"{name}: expected number in [0,1], got {v!r}")
+        return None
+    f = float(v)
+    if not 0.0 <= f <= 1.0:
+        errors.append(f"{name}: {f} outside [0,1]")
+        return None
+    return f
+
+
+def validate_config_payload(payload: Any) -> tuple[dict, list[str]]:
+    """Schema-check a config-intent payload.
+
+    Returns ``(clean, errors)``; a non-empty ``errors`` means the WHOLE
+    payload must be refused (atomicity: an operator typo never applies
+    the half they spelled right). Unknown keys are errors too — this key
+    is operator intent, and silently dropping a misspelled knob is how
+    "I turned the hedges off" outages happen."""
+    clean: dict = {}
+    errors: list[str] = []
+    if not isinstance(payload, dict):
+        return {}, [f"payload must be an object, got {type(payload).__name__}"]
+    for key, v in payload.items():
+        if key == "brownout_max_level":
+            if isinstance(v, bool) or not isinstance(v, int):
+                errors.append(f"{key}: expected int 0..{MAX_LEVEL}, got {v!r}")
+            elif not 0 <= v <= MAX_LEVEL:
+                errors.append(f"{key}: {v} outside 0..{MAX_LEVEL}")
+            else:
+                clean[key] = v
+        elif key == "admission_class_fractions":
+            if not isinstance(v, dict) or not v:
+                errors.append(f"{key}: expected non-empty object of class->fraction")
+                continue
+            fracs: dict[str, float] = {}
+            for cls, frac in v.items():
+                if cls not in _CLASSES:
+                    errors.append(f"{key}.{cls}: unknown class (want one of {_CLASSES})")
+                    continue
+                f = _check_fraction(f"{key}.{cls}", frac, errors)
+                if f is not None:
+                    fracs[cls] = f
+            if fracs and not errors:
+                clean[key] = fracs
+        elif key == "hedge_budget_fraction":
+            f = _check_fraction(key, v, errors)
+            if f is not None:
+                clean[key] = f
+        elif key == "chunk_budget":
+            if isinstance(v, bool) or not isinstance(v, int):
+                errors.append(f"{key}: expected int >= 1, got {v!r}")
+            elif v < 1:
+                errors.append(f"{key}: {v} < 1")
+            else:
+                clean[key] = v
+        else:
+            errors.append(f"{key}: unknown knob")
+    if errors:
+        return {}, errors
+    return clean, []
+
+
+class ConfigReloader:
+    """Stage validated knobs, apply them atomically at step boundaries.
+
+    Hosts ``register(knob, fn)`` an applier per knob they own (a frontend
+    registers admission + hedge, a worker registers brownout + chunk
+    budget; knobs nobody registered are staged but inert on this host —
+    the payload is still fleet-valid or fleet-refused identically
+    everywhere, so the status key never disagrees between hosts). The
+    host's step loop calls :meth:`apply_pending` at its boundary; with a
+    fabric, :meth:`start` watches the intent key so operator writes land
+    without any host-side plumbing."""
+
+    def __init__(self, fabric: Optional[Any] = None, host: str = "") -> None:
+        self.fabric = fabric
+        self.host = host
+        self._appliers: dict[str, Callable[[Any], None]] = {}
+        self._pending: Optional[dict] = None
+        self.current: dict = {}  # last applied clean payload, merged
+        self.applied_total = 0
+        self.refused_total = 0
+        self.last_errors: list[str] = []
+        self._watch_task: Optional[asyncio.Task] = None
+        self._watch: Optional[Any] = None
+
+    def register(self, knob: str, fn: Callable[[Any], None]) -> None:
+        self._appliers[knob] = fn
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, payload: Any) -> bool:
+        """Validate and stage one payload; False = refused (reported)."""
+        clean, errors = validate_config_payload(payload)
+        if errors:
+            self.refused_total += 1
+            self.last_errors = errors
+            logger.warning("config-intent REFUSED: %s", "; ".join(errors))
+            self._report("refused", errors=errors)
+            return False
+        self._pending = clean
+        self.last_errors = []
+        return True
+
+    # -------------------------------------------------- step-boundary apply
+
+    def apply_pending(self) -> Optional[dict]:
+        """Apply the staged payload, if any — call ONLY at a step
+        boundary. All knobs land in one synchronous pass (no awaits), so
+        concurrent steps never observe a torn config. Returns what was
+        applied, or None."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return None
+        for knob, value in pending.items():
+            fn = self._appliers.get(knob)
+            if fn is None:
+                continue
+            try:
+                fn(value)
+            except Exception:  # noqa: BLE001 — one bad applier can't tear the rest
+                logger.exception("config applier for %s failed", knob)
+        self.current.update(pending)
+        self.applied_total += 1
+        logger.info("config applied at step boundary: %s", pending)
+        self._report("applied", applied=pending)
+        return pending
+
+    # ------------------------------------------------------------ fabric IO
+
+    def _report(self, outcome: str, **extra: Any) -> None:
+        self.last_report = {
+            "outcome": outcome,
+            "host": self.host,
+            "applied_total": self.applied_total,
+            "refused_total": self.refused_total,
+            **extra,
+        }
+        if self.fabric is None:
+            return
+
+        async def _put() -> None:
+            with contextlib.suppress(Exception):
+                await self.fabric.kv_put(
+                    CONFIG_STATUS_KEY, json.dumps(self.last_report).encode()
+                )
+
+        try:
+            asyncio.get_running_loop().create_task(_put())
+        except RuntimeError:  # no loop — sync caller in tests
+            pass
+
+    async def start(self) -> None:
+        """Watch the intent key: existing value is submitted immediately,
+        every subsequent operator write is validated + staged as it
+        lands (and applied at the host's next boundary)."""
+        if self.fabric is None or self._watch_task is not None:
+            return
+        self._watch = await self.fabric.watch_prefix(CONFIG_INTENT_KEY)
+        for ev in self._watch.initial:
+            self._submit_raw(ev.value)
+
+        async def _pump() -> None:
+            with contextlib.suppress(asyncio.CancelledError):
+                async for ev in self._watch:
+                    if ev.type == "put" and ev.key == CONFIG_INTENT_KEY:
+                        self._submit_raw(ev.value)
+
+        self._watch_task = asyncio.get_running_loop().create_task(_pump())
+
+    def _submit_raw(self, raw: bytes) -> None:
+        try:
+            payload = json.loads(raw.decode() or "{}")
+        except (ValueError, UnicodeDecodeError) as e:
+            self.refused_total += 1
+            self.last_errors = [f"payload is not JSON: {e}"]
+            self._report("refused", errors=self.last_errors)
+            return
+        self.submit(payload)
+
+    async def stop(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._watch_task
+            self._watch_task = None
+        if self._watch is not None:
+            with contextlib.suppress(Exception):
+                await self._watch.cancel()
+            self._watch = None

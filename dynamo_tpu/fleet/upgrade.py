@@ -1,0 +1,479 @@
+"""Coordinated rolling upgrades with live KV handoff (ISSUE 18 tentpole).
+
+Role-equivalent of the reference Dynamo's Go k8s operator rolling-update
+semantics (SURVEY: operator layer) — which our TPU build has no equivalent
+for — rebuilt on the primitives sixteen PRs of fault tolerance already
+ship: surge spawning rides the supervisor/connector plane, the KV handoff
+rides the checksummed PeerBlockClient plane (directed, fence-stamped,
+quarantine-respecting pulls), retirement rides the graceful SIGTERM drain
+(NOT fencing — fencing.py's contract: drained workers chose to stop,
+their frames stay valid), and the planner is latched via
+`Planner.note_maintenance` so self-healing neither fights the surge nor
+scales down mid-rollout.
+
+Per-worker state machine (one surge batch at a time):
+
+    surging ──► probation ──► handoff ──► draining ──► retiring
+       │            │
+       └── successor crash-loops / stays unhealthy / SLO burn ──►
+           rolling_back (retire sick successor, respawn old role,
+           un-latch planner, HALT the rollout)
+
+The coordinator publishes its intent under ``fleet/upgrade-intent`` and a
+live status snapshot under ``fleet/upgrade-status`` so planners and
+dashboards in OTHER processes observe the rollout; in-process planners
+are latched directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from dynamo_tpu.runtime.logging import get_logger
+
+logger = get_logger("dynamo_tpu.fleet.upgrade")
+
+UPGRADE_INTENT_KEY = "fleet/upgrade-intent"
+UPGRADE_STATUS_KEY = "fleet/upgrade-status"
+
+# Phase names — the wire contract of dyn_fleet_upgrade_phase (metrics) and
+# of the UPGRADE_STATUS_KEY snapshots.
+PHASES = (
+    "idle",
+    "surging",
+    "probation",
+    "handoff",
+    "draining",
+    "retiring",
+    "rolling_back",
+    "halted",
+    "done",
+)
+
+
+@dataclass
+class UpgradePlan:
+    """What to roll and how carefully.
+
+    `new_env` is what makes the successor the NEW version (env/flags the
+    spawner applies — binary paths, feature gates, DYN_* knobs). The
+    coordinator itself is version-agnostic: mid-rollout wire skew is the
+    negotiated handshake's problem (fabric/wire.py), not ours."""
+
+    components: list[str] = field(default_factory=list)
+    surge: int = 1  # successors spawned per batch (also retires per batch)
+    probation_s: float = 5.0  # successor must stay healthy this long
+    drain_timeout_s: float = 10.0
+    handoff: bool = True  # live KV handoff predecessor -> successor
+    new_env: dict = field(default_factory=dict)
+    # probation failure bars: either trips the automatic halt + rollback
+    crash_loop_threshold: int = 2  # successor restarts during probation
+    slo_burn_limit: float = 0.0  # pool.slo_burn() above this = breach; 0=off
+
+    def to_wire(self) -> dict:
+        return {
+            "components": list(self.components),
+            "surge": self.surge,
+            "probation_s": self.probation_s,
+            "drain_timeout_s": self.drain_timeout_s,
+            "handoff": self.handoff,
+            "new_env": dict(self.new_env),
+            "crash_loop_threshold": self.crash_loop_threshold,
+            "slo_burn_limit": self.slo_burn_limit,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "UpgradePlan":
+        known = {f for f in cls.__dataclass_fields__}  # skew-safe
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class UpgradeStatus:
+    """Live rollout snapshot (UPGRADE_STATUS_KEY + metrics source)."""
+
+    phase: str = "idle"
+    component: str = ""
+    replaced: int = 0
+    total: int = 0
+    rollbacks_total: int = 0
+    halted_reason: Optional[str] = None
+    # peer-plane handoff accounting, by PULL_OUTCOMES key
+    handoff_blocks: dict = field(default_factory=dict)
+
+    def to_wire(self) -> dict:
+        return {
+            "phase": self.phase,
+            "component": self.component,
+            "replaced": self.replaced,
+            "total": self.total,
+            "rollbacks_total": self.rollbacks_total,
+            "halted_reason": self.halted_reason,
+            "handoff_blocks": dict(self.handoff_blocks),
+        }
+
+
+async def live_handoff(
+    dst_client: Any,  # PeerBlockClient of the successor
+    inventory: list[dict],  # predecessor advert_blocks() (parents first)
+    src_wid: Optional[int] = None,
+    chunk: int = 32,
+) -> dict:
+    """Pull the predecessor's hot inventory (prefix index + host/disk
+    tiers) into the successor's manager over the checksummed peer plane.
+
+    The inventory rides in `advert_blocks()` chain order (parents before
+    children), chunked so a kill/blackout wave landing mid-handoff loses
+    at most one chunk — every chunk is an independent, integrity-verified,
+    fence-stamped pull. With `src_wid` the pulls are DIRECTED at the
+    predecessor (plan={"src": wid, ...}); quarantined hashes are refused
+    by the puller as always. Returns per-outcome block counts (the
+    dyn_fleet_upgrade_handoff_blocks_total{outcome} source)."""
+    hashes = [a["block_hash"] for a in inventory]
+    before = dict(dst_client.pull_outcomes)
+    landed = 0
+    for i in range(0, len(hashes), max(1, chunk)):
+        span = hashes[i: i + max(1, chunk)]
+        plan = None
+        if src_wid is not None:
+            plan = {"src": src_wid, "blocks": len(span), "hashes": span}
+        try:
+            landed += await dst_client.fetch_remote_prefix(span, plan=plan)
+        except Exception:  # noqa: BLE001 — handoff is an optimization
+            logger.exception("handoff chunk failed; continuing")
+    outcomes = {
+        k: v - before.get(k, 0)
+        for k, v in dst_client.pull_outcomes.items()
+        if v - before.get(k, 0) > 0
+    }
+    outcomes.setdefault("pulled", 0)
+    logger.info(
+        "live KV handoff: %d/%d block(s) landed (%s)",
+        landed, len(hashes), outcomes,
+    )
+    return outcomes
+
+
+class UpgradeCoordinator:
+    """Walk a fleet one surge batch at a time, replacing every worker.
+
+    `pool` is the actuation surface (duck-typed so the supervisor-backed
+    fleet, the k8s fleet and the deterministic sim share one coordinator):
+
+      * ``workers(component) -> list[str]``       oldest-first names
+      * ``await spawn_successor(component, env) -> str``
+      * ``await wait_healthy(name, timeout_s) -> bool``
+      * ``crash_count(name) -> int``              restarts since spawn
+      * ``await handoff(src, dst) -> dict``       outcome->blocks (peer plane)
+      * ``await drain(name, timeout_s)``          stop admission, finish work
+      * ``await retire(name)``                    planned exit (budget-exempt)
+      * ``slo_burn() -> float``                   optional, 0..1 burn fraction
+
+    `planner` (optional) is latched via note_maintenance for the whole
+    rollout; `fabric` (optional) carries the intent/status keys."""
+
+    def __init__(
+        self,
+        pool: Any,
+        plan: UpgradePlan,
+        planner: Optional[Any] = None,
+        fabric: Optional[Any] = None,
+        on_phase: Optional[Callable[[str], None]] = None,
+    ) -> None:
+        self.pool = pool
+        self.plan = plan
+        self.planner = planner
+        self.fabric = fabric
+        self.on_phase = on_phase
+        self.status = UpgradeStatus()
+        self.phase_log: list[str] = []  # every transition, in order
+
+    # ------------------------------------------------------------ plumbing
+
+    def _set_phase(self, phase: str, component: str = "") -> None:
+        assert phase in PHASES, phase
+        self.status.phase = phase
+        if component:
+            self.status.component = component
+        self.phase_log.append(phase)
+        if self.on_phase is not None:
+            with contextlib.suppress(Exception):
+                self.on_phase(phase)
+
+    def _latch(self, active: bool) -> None:
+        if self.planner is not None:
+            note = getattr(self.planner, "note_maintenance", None)
+            if note is not None:
+                note(active, reason="rolling_upgrade")
+
+    async def _publish(self) -> None:
+        if self.fabric is None:
+            return
+        with contextlib.suppress(Exception):
+            await self.fabric.kv_put(
+                UPGRADE_STATUS_KEY,
+                json.dumps(self.status.to_wire()).encode(),
+            )
+
+    async def _publish_intent(self, active: bool) -> None:
+        if self.fabric is None:
+            return
+        with contextlib.suppress(Exception):
+            if active:
+                await self.fabric.kv_put(
+                    UPGRADE_INTENT_KEY,
+                    json.dumps(self.plan.to_wire()).encode(),
+                )
+            else:
+                await self.fabric.kv_delete(UPGRADE_INTENT_KEY)
+
+    def _note_handoff(self, outcomes: dict) -> None:
+        for k, v in outcomes.items():
+            self.status.handoff_blocks[k] = (
+                self.status.handoff_blocks.get(k, 0) + int(v)
+            )
+
+    # ---------------------------------------------------------------- run
+
+    async def run(self) -> UpgradeStatus:
+        """Execute the whole rollout; returns the final status (phase is
+        "done", or "halted" after an automatic rollback). The planner
+        latch is ALWAYS released on exit — success, rollback or crash."""
+        plan = self.plan
+        olds: dict[str, list[str]] = {
+            c: list(self.pool.workers(c)) for c in plan.components
+        }
+        self.status.total = sum(len(v) for v in olds.values())
+        self._latch(True)
+        await self._publish_intent(True)
+        try:
+            for component in plan.components:
+                batch: list[str] = []
+                for old in olds[component]:
+                    batch.append(old)
+                    if len(batch) < max(1, plan.surge):
+                        continue
+                    if not await self._replace_batch(component, batch):
+                        return self.status
+                    batch = []
+                if batch and not await self._replace_batch(component, batch):
+                    return self.status
+            self._set_phase("done")
+            await self._publish()
+            logger.info(
+                "rolling upgrade complete: %d worker(s) replaced, "
+                "handoff=%s", self.status.replaced,
+                self.status.handoff_blocks,
+            )
+            return self.status
+        finally:
+            self._latch(False)
+            await self._publish_intent(False)
+            await self._publish()
+
+    async def _replace_batch(
+        self, component: str, batch: list[str]
+    ) -> bool:
+        """Replace one surge batch; False = halted (rollback done)."""
+        plan = self.plan
+        # 1) surge: spawn every successor of the batch first — capacity
+        # never dips below the pre-rollout fleet size
+        self._set_phase("surging", component)
+        await self._publish()
+        succs: list[str] = []
+        for _ in batch:
+            succs.append(
+                await self.pool.spawn_successor(component, dict(plan.new_env))
+            )
+        # 2) probation: each successor must come up healthy, not crash-
+        # loop, and not breach the SLO burn bar before we touch the olds
+        self._set_phase("probation", component)
+        await self._publish()
+        for succ in succs:
+            healthy = await self.pool.wait_healthy(succ, plan.probation_s)
+            crashes = int(self.pool.crash_count(succ))
+            breach = self._slo_breached()
+            if healthy and crashes < plan.crash_loop_threshold and not breach:
+                continue
+            reason = (
+                f"successor {succ} crash-looped ({crashes} restarts)"
+                if crashes >= plan.crash_loop_threshold
+                else f"slo burn breached during probation of {succ}"
+                if breach
+                else f"successor {succ} never became healthy"
+            )
+            await self._rollback(component, succs, reason)
+            return False
+        # 3..5) hand off, drain, retire each predecessor of the batch
+        for old, succ in zip(batch, succs):
+            if plan.handoff:
+                self._set_phase("handoff", component)
+                await self._publish()
+                try:
+                    outcomes = await self.pool.handoff(old, succ)
+                except Exception:  # noqa: BLE001 — optimization, not a gate
+                    logger.exception(
+                        "KV handoff %s -> %s failed; predecessor still "
+                        "drains (prefixes recompute)", old, succ,
+                    )
+                    outcomes = {}
+                self._note_handoff(outcomes or {})
+            self._set_phase("draining", component)
+            await self._publish()
+            await self.pool.drain(old, plan.drain_timeout_s)
+            self._set_phase("retiring", component)
+            await self._publish()
+            await self.pool.retire(old)
+            self.status.replaced += 1
+        return True
+
+    def _slo_breached(self) -> bool:
+        if self.plan.slo_burn_limit <= 0:
+            return False
+        burn_fn = getattr(self.pool, "slo_burn", None)
+        if burn_fn is None:
+            return False
+        try:
+            return float(burn_fn()) > self.plan.slo_burn_limit
+        except Exception:  # noqa: BLE001 — a broken probe never halts
+            return False
+
+    async def _rollback(
+        self, component: str, succs: list[str], reason: str
+    ) -> None:
+        """Automatic halt + rollback: retire every successor of the sick
+        batch, respawn the OLD role (empty env = the running version) for
+        each, and halt the rollout. Predecessors were never touched —
+        they are still serving — so capacity is whole throughout."""
+        self._set_phase("rolling_back", component)
+        self.status.rollbacks_total += 1
+        await self._publish()
+        logger.error("rolling upgrade HALTED: %s — rolling back", reason)
+        for succ in succs:
+            with contextlib.suppress(Exception):
+                await self.pool.retire(succ)
+        # restore any capacity the (possibly crash-looping) successors
+        # were meant to carry: respawn the old role so observed replicas
+        # match pre-rollout intent once the latch releases
+        respawn = getattr(self.pool, "respawn_old", None)
+        if respawn is not None:
+            with contextlib.suppress(Exception):
+                await respawn(component, len(succs))
+        self.status.halted_reason = reason
+        self._set_phase("halted", component)
+        await self._publish()
+
+
+class SupervisorWorkerPool:
+    """WorkerPool over a planner SupervisorConnector: real OS processes
+    under crash-restart discipline (sdk/supervisor.py).
+
+    Surge spawns bump the connector's INTENT (targets) so a concurrently
+    running planner — which is latched anyway — could never read the
+    surge as drift to "heal" away; retirement decrements it back. KV
+    handoff is delegated: the coordinator publishes a directive under
+    ``fleet/handoff-intent`` naming (src, dst) and workers holding a
+    PeerBlockClient honor it with directed pulls — this pool only
+    actuates processes, it cannot reach into their address spaces."""
+
+    HANDOFF_INTENT_KEY = "fleet/handoff-intent"
+
+    def __init__(self, connector: Any, fabric: Optional[Any] = None) -> None:
+        self.conn = connector
+        self.fabric = fabric
+
+    def workers(self, component: str) -> list[str]:
+        return [
+            p.name
+            for p in self.conn._procs.get(component, [])
+            if p.state in ("running", "backoff")
+        ]
+
+    async def spawn_successor(self, component: str, env: dict) -> str:
+        from dynamo_tpu.sdk.supervisor import ManagedProcess
+
+        conn = self.conn
+        conn.targets[component] = conn.targets.get(component, 0) + 1
+        idx = conn._seq[component] = conn._seq.get(component, 0) + 1
+        name = f"{component}-{idx}"
+        proc = ManagedProcess(
+            conn.commands[component],
+            name=name,
+            env={
+                **__import__("os").environ, **conn.env, **env,
+                "DYN_REPLICA_INDEX": str(idx),
+            },
+            on_giveup=(
+                (lambda pname, c=component: conn.on_giveup(c, pname))
+                if conn.on_giveup is not None
+                else None
+            ),
+            **conn.proc_kwargs,
+        )
+        conn.supervisor.procs.pop(name, None)
+        conn.supervisor.add(proc)
+        await proc.start()
+        conn._procs.setdefault(component, []).append(proc)
+        logger.info("surged %s -> %s (pid %s)", component, name, proc.pid)
+        return name
+
+    def _proc(self, name: str) -> Any:
+        return self.conn.supervisor.procs.get(name)
+
+    async def wait_healthy(self, name: str, timeout_s: float) -> bool:
+        """Watch the successor for the WHOLE probation window — a worker
+        that comes up, then crash-loops into quarantine at t+2s must
+        fail probation, not pass it on the first green sample."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + max(0.0, timeout_s)
+        proc = self._proc(name)
+        while proc is not None and loop.time() < deadline:
+            if proc.quarantined:
+                return False
+            await asyncio.sleep(0.05)
+        return proc is not None and proc.running and not proc.quarantined
+
+    def crash_count(self, name: str) -> int:
+        proc = self._proc(name)
+        return len(proc._crash_times) if proc is not None else 0
+
+    async def handoff(self, src: str, dst: str) -> dict:
+        if self.fabric is None:
+            return {}
+        with contextlib.suppress(Exception):
+            await self.fabric.kv_put(
+                self.HANDOFF_INTENT_KEY,
+                json.dumps({"src": src, "dst": dst}).encode(),
+            )
+        return {}
+
+    async def drain(self, name: str, timeout_s: float) -> None:
+        """Graceful SIGTERM drain: the runner stops admission, finishes
+        in-flight work, writes its warm KV checkpoint, exits."""
+        proc = self._proc(name)
+        if proc is not None:
+            await proc.stop(timeout_s)
+
+    async def retire(self, name: str) -> None:
+        proc = self._proc(name)
+        if proc is None:
+            return
+        if proc.state != "stopped":  # rollback path: never drained
+            proc.mark_planned_exit()
+            await proc.stop(2.0)
+        self.conn.supervisor.procs.pop(name, None)
+        for component, procs in self.conn._procs.items():
+            if proc in procs:
+                procs.remove(proc)
+                self.conn.targets[component] = max(
+                    0, self.conn.targets.get(component, 1) - 1
+                )
+                break
+
+    async def respawn_old(self, component: str, n: int) -> None:
+        for _ in range(max(0, n)):
+            await self.spawn_successor(component, {})

@@ -267,6 +267,7 @@ class Planner:
         self._streak: dict[str, tuple[str, int]] = {}  # role -> (dir, n)
         self._gap_accum: dict[str, int] = {}  # streak-summed |desired-cur|
         self._mismatch_streak = 0
+        self._maintenance: Optional[str] = None  # note_maintenance latch
 
     # ---------------------------------------------------- external signals
 
@@ -280,6 +281,22 @@ class Planner:
         the next interval substitutes capacity by re-asserting intent."""
         self._heal_requests.add(role)
 
+    def note_maintenance(
+        self, active: bool, reason: str = "rolling_upgrade"
+    ) -> None:
+        """Maintenance latch (ISSUE 18): while set, the planner HOLDS —
+        no scale decisions, no heals, no intent-mismatch freeze — so a
+        rolling-upgrade coordinator's surge batches (observed > intent)
+        and planned retirements (observed < intent) are never fought by
+        the self-healing loop or scaled down mid-rollout. The coordinator
+        latches before the first surge and releases after the last retire
+        (or after rollback)."""
+        self._maintenance = reason if active else None
+        if not active:
+            # a rollout's transient skews must not pre-charge the
+            # intent-mismatch freeze once normal planning resumes
+            self._mismatch_streak = 0
+
     @property
     def frozen(self) -> bool:
         return bool(self.metrics.frozen)
@@ -289,6 +306,7 @@ class Planner:
         plane) — decision counters, frozen state, target vs actual."""
         out = self.metrics.status()
         out["brownout_level"] = self._brownout_level
+        out["maintenance"] = self._maintenance
         sup_stats = getattr(self.connector, "stats", None)
         if callable(sup_stats):
             with contextlib.suppress(Exception):
@@ -543,6 +561,25 @@ class Planner:
         if m.replicas_actual is not None:
             self.metrics.replicas_actual.update(m.replicas_actual)
         brownout = max(self._brownout_level, m.brownout_level)
+
+        # ---- layer 0: maintenance latch (rolling upgrade in progress) —
+        # hold everything: a surge batch reads as observed > intent
+        # (would trip intent_mismatch), a draining predecessor as
+        # observed < intent (would trigger a fighting heal/respawn), and
+        # any scale-down could retire the successor mid-probation
+        if self._maintenance is not None:
+            self._mismatch_streak = 0
+            self._heal_requests.clear()
+            self.metrics.count("hold", "maintenance")
+            decision = ScaleDecision(
+                prefill=current[PREFILL], decode=current[DECODE],
+                reason=f"maintenance:{self._maintenance}",
+                direction="hold",
+            )
+            self.decisions.append(decision)
+            if self.on_decision is not None:
+                self.on_decision(decision)
+            return decision
 
         # ---- layer 1: fail static
         frozen_why = self._frozen_reason(m)

@@ -97,6 +97,12 @@ class ManagedProcess:
         self.quarantined = False
         self.health_kills = 0  # children killed by failed health probes
         self._injected_kills = 0  # pending budget-exempt kills (kill())
+        # pending planned terminations (mark_planned_exit()): the next
+        # exit — however delivered (external SIGTERM from an upgrade
+        # coordinator, drain-deadline SIGKILL) — is a retirement, not a
+        # crash: budget exempt, no restart, no quarantine
+        self._planned_exits = 0
+        self.planned_exits_total = 0
         self._crash_times: list[float] = []
         self._stopping = False
         self._monitor_task: Optional[asyncio.Task] = None
@@ -147,6 +153,21 @@ class ManagedProcess:
                 except Exception:  # noqa: BLE001 — callback is advisory
                     logger.exception("[%s] on_exit callback failed", self.name)
             if self._stopping:
+                return
+            if self._planned_exits > 0:
+                # planned termination (scale-down / rolling upgrade): a
+                # clean retirement — even when the drain deadline ended in
+                # SIGKILL — must not feed the crash-loop quarantine budget
+                # or fight the coordinator with an unwanted respawn
+                self._planned_exits -= 1
+                self.planned_exits_total += 1
+                self._stopping = True  # retired: state=stopped, probes off
+                if self._health_task is not None:
+                    self._health_task.cancel()
+                logger.info(
+                    "[%s] planned termination rc=%d — budget exempt, "
+                    "not restarting", self.name, rc,
+                )
                 return
             if not self.restart:
                 logger.info("[%s] exited rc=%d (no restart)", self.name, rc)
@@ -312,6 +333,15 @@ class ManagedProcess:
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
 
+    def mark_planned_exit(self) -> None:
+        """Declare the NEXT exit of this child a planned termination
+        (rolling-upgrade drain, planner scale-down delivered by external
+        signal rather than stop()): the monitor treats it as a clean
+        retirement — crash budget untouched, no restart, no quarantine —
+        exactly as injected kills are budget-exempt. Idempotent per exit:
+        each call covers one exit."""
+        self._planned_exits += 1
+
     def kill(self) -> None:
         """SIGKILL without marking stopped — the monitor restarts it.
         This is the fault-injection hook the FT tests use; injected
@@ -409,6 +439,9 @@ class Supervisor:
             ),
             "health_kills_total": sum(
                 p.health_kills for p in self.procs.values()
+            ),
+            "planned_exits_total": sum(
+                p.planned_exits_total for p in self.procs.values()
             ),
         }
 

@@ -67,6 +67,9 @@ __all__ = [
     "SimResult",
     "run_sim",
     "chaos_scenario",
+    "mixed_step_chaos_scenario",
+    "prefix_chaos_scenario",
+    "rolling_upgrade_scenario",
     "planted_fence_bug_scenario",
     "bank_artifact",
     "load_artifact",
@@ -360,6 +363,19 @@ class SimConfig:
     zipf_tenants: int = 0
     zipf_alpha: float = 1.1
     prefix_len: tuple = (8, 24)
+    # rolling upgrade (ISSUE 18): at t0+upgrade_start_s a real
+    # UpgradeCoordinator walks the whole fleet — surge-spawn a successor
+    # incarnation, probation, live KV handoff (the predecessor's cached
+    # blocks transplant into the successor at registry pull cost), then
+    # graceful drain + retire (lease REVOKED, not expired: no fence
+    # tombstone, frames from a draining worker stay valid to the last
+    # token). upgrade_handoff=False is the cold-restart A/B arm.
+    upgrade: bool = False
+    upgrade_start_s: float = 20.0
+    upgrade_surge: int = 1
+    upgrade_probation_s: float = 2.0
+    upgrade_drain_s: float = 30.0
+    upgrade_handoff: bool = True
 
     def to_json(self) -> dict:
         d = asdict(self)
@@ -401,6 +417,10 @@ class SimResult:
     n_requests: int
     fault_classes: list[str]
     config: dict
+    # per-request [t_start_rel, ttft_s, priority] rows (sim-relative
+    # seconds; ttft -1 = no token ever) — benchmarks slice these by
+    # rollout window to prove TTFT held through the upgrade
+    request_log: list = field(default_factory=list)
 
     @property
     def sim_min_per_wall_s(self) -> float:
@@ -429,6 +449,8 @@ class _Track:
     error: Optional[dict] = None
     worker: str = ""
     last_progress_t: float = 0.0
+    t_start: float = 0.0  # dispatch time (TTFT numerator for benchmarks)
+    t_first: float = 0.0  # first accepted token time (0 = never)
 
 
 class _Worker:
@@ -483,6 +505,9 @@ class SimFleet:
         self.prefill_service = None
         self.prefill_client = None
         self.prefix_registry = None
+        self.upgrade_coord = None  # set by _upgrade_loop (cfg.upgrade)
+        self.upgrade_end_rel = None  # sim-relative t the rollout finished
+        self._planner = None  # set by _planner_loop (cfg.planner)
         self._stats_reads: dict[str, int] = {}
         self._bg: list[asyncio.Task] = []
 
@@ -531,6 +556,21 @@ class SimFleet:
             out["hedges"] = self.hedger.hedges
         if self.front is not None:
             out["blackouts"] = self.front.fabric.blackouts_total
+        if self.upgrade_coord is not None:
+            # everything exported here must be monotone (the
+            # MonotoneCounters invariant reads this surface every tick)
+            st = self.upgrade_coord.status
+            out["upgrade/replaced"] = st.replaced
+            out["upgrade/rollbacks"] = st.rollbacks_total
+            out["upgrade/phase_transitions"] = len(
+                self.upgrade_coord.phase_log
+            )
+            out["upgrade/done"] = 1.0 if st.phase == "done" else 0.0
+            for k, v in sorted(st.handoff_blocks.items()):
+                out[f"upgrade/handoff/{k}"] = v
+            if self.upgrade_end_rel is not None:
+                # appears once, then constant: monotone by construction
+                out["upgrade/end_t_rel"] = self.upgrade_end_rel
         out.update(self._stats_reads)
         return out
 
@@ -829,6 +869,7 @@ class SimFleet:
             conn,
             now_fn=dclock.now,
         )
+        self._planner = planner  # the upgrade loop latches maintenance here
         while True:
             await asyncio.sleep(cfg.planner_interval_s)
             with contextlib.suppress(ConnectionError):
@@ -917,6 +958,42 @@ class SimFleet:
                 if not w.engine.fenced:
                     w.engine.apply_brownout(int(level))
 
+    async def _upgrade_loop(self) -> None:
+        """Drive a real UpgradeCoordinator over the live fleet: the same
+        state machine the supervisor-backed pool runs in production walks
+        every sim worker through surge -> probation -> handoff -> drain ->
+        retire, mid-chaos, with the planner latched for the duration."""
+        from dynamo_tpu.fleet.upgrade import UpgradeCoordinator, UpgradePlan
+
+        cfg = self.cfg
+        delay = (self.t0 + cfg.upgrade_start_s) - dclock.now()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        fleet = self
+
+        class _Latch:
+            # forwards to the planner the planner-loop built (if any);
+            # duck-typed so a planner-less sim latches into the void
+            def note_maintenance(self, active, reason=""):
+                if fleet._planner is not None:
+                    fleet._planner.note_maintenance(active, reason=reason)
+
+        coord = UpgradeCoordinator(
+            _SimUpgradePool(self),
+            UpgradePlan(
+                components=["decode_worker"],
+                surge=cfg.upgrade_surge,
+                probation_s=cfg.upgrade_probation_s,
+                drain_timeout_s=cfg.upgrade_drain_s,
+                handoff=cfg.upgrade_handoff,
+            ),
+            planner=_Latch(),
+            fabric=self.front.fabric,
+        )
+        self.upgrade_coord = coord
+        await coord.run()
+        self.upgrade_end_rel = round(dclock.now() - self.t0, 3)
+
     async def _respawn(self, idx: int, delay_s: float) -> None:
         await asyncio.sleep(delay_s)
         # a blackout may be open when the replacement boots: retry the
@@ -954,10 +1031,13 @@ class SimFleet:
         )
         req.extra["priority"] = track.priority
         ctx = Context()
+        track.t_start = dclock.now()
         try:
             async for out in self.remote(req, ctx):
                 now = dclock.now()
                 if out.token_ids:
+                    if not track.t_first:
+                        track.t_first = now
                     track.got.extend(out.token_ids)
                     track.last_progress_t = now
                     worker = out.text or "?"
@@ -1056,6 +1136,8 @@ class SimFleet:
             self._spawn_bg(self._apply_schedule(self.cfg.schedule))
         if self.cfg.brownout_waves:
             self._spawn_bg(self._brownout_waves_loop())
+        if self.cfg.upgrade:
+            self._spawn_bg(self._upgrade_loop())
         workload = asyncio.get_running_loop().create_task(self._workload())
         stopper = asyncio.get_running_loop().create_task(
             self.violation_stop.wait()
@@ -1082,6 +1164,103 @@ class SimFleet:
             h.update(line.encode())
             h.update(b"\n")
         return h.hexdigest()
+
+
+class _SimUpgradePool:
+    """The UpgradeCoordinator's worker-pool surface over the sim fleet.
+
+    Successors spawn through the normal worker factory (next incarnation
+    at the same index — the predecessor keeps serving until drained), the
+    live KV handoff transplants the predecessor's cached blocks into the
+    successor at the registry's per-block pull cost, and drain/retire is
+    the GRACEFUL path: endpoint deregistration + lease revoke, never a
+    fence tombstone — frames from a draining predecessor stay valid to
+    the last token, which is exactly what the no-double-serve and
+    token-identity invariants then prove."""
+
+    def __init__(self, fleet: SimFleet) -> None:
+        self.fleet = fleet
+        self._by_name: dict[str, tuple] = {}  # name -> (idx, _Worker)
+        self._pending_idx: list = []
+        reg = fleet.prefix_registry
+        self.handoff_block_s = reg.pull_block_s if reg is not None else 5e-4
+
+    def workers(self, component: str) -> list:
+        items = sorted(self.fleet._live.items())
+        self._pending_idx = [i for i, _ in items]
+        self._by_name = {w.name: (i, w) for i, w in items}
+        return [w.name for _, w in items]
+
+    async def spawn_successor(self, component: str, env: dict) -> str:
+        idx = self._pending_idx.pop(0)
+        while True:
+            try:
+                succ = await self.fleet._spawn_worker(idx)
+                break
+            except ConnectionError:
+                # the surge landed inside a fabric blackout: retry the
+                # lease grant, same as a killed worker's respawn does
+                await asyncio.sleep(0.5)
+        self._by_name[succ.name] = (idx, succ)
+        return succ.name
+
+    async def wait_healthy(self, name: str, timeout_s: float) -> bool:
+        await asyncio.sleep(timeout_s)  # probation window (virtual time)
+        _, w = self._by_name[name]
+        return not w.engine.fenced
+
+    def crash_count(self, name: str) -> int:
+        _, w = self._by_name[name]
+        return 1 if w.engine.fenced else 0
+
+    async def handoff(self, src: str, dst: str) -> dict:
+        _, s = self._by_name[src]
+        _, d = self._by_name[dst]
+        if s.engine.fenced:
+            return {}  # never pull KV out of a fenced incarnation
+        dcache = d.engine.cache
+        moved = 0
+        # refs iteration order is chain-insertion order (parents admitted
+        # before children), so transplanted entries stay prefix-matchable
+        for h in list(s.engine.cache.refs.keys()):
+            if h in dcache.refs:
+                continue
+            if dcache.free_blocks <= 0 and not dcache._evict(1):
+                break
+            # cached (0-ref) entry: kv_conservation needs free -= 1 for
+            # every refs entry added
+            dcache.refs[h] = 0
+            dcache.free_blocks -= 1
+            dcache.lru[h] = None
+            moved += 1
+        if moved:
+            await asyncio.sleep(moved * self.handoff_block_s)
+        return {"pulled": moved}
+
+    async def drain(self, name: str, timeout_s: float) -> None:
+        _, w = self._by_name[name]
+        # deregister from discovery; the frontend's local short-circuit
+        # handler stays in place so dispatches racing the watch-delete
+        # still land on the (live, draining) engine instead of falling
+        # through to a real socket — the idle-wait below covers them
+        with contextlib.suppress(Exception):
+            await w.service.stop(drain=True)
+        await asyncio.sleep(0.25)  # let the instance watch-delete land
+        deadline = dclock.now() + timeout_s
+        while dclock.now() < deadline and (
+            w.engine.active or w.engine.waiting
+        ):
+            await asyncio.sleep(0.25)
+
+    async def retire(self, name: str) -> None:
+        _, w = self._by_name[name]
+        reg = self.fleet.prefix_registry
+        if reg is not None and w.engine in reg.engines:
+            # a retired worker's adverts vanish with its lease: peers
+            # must not try to pull from a gone incarnation
+            reg.engines.remove(w.engine)
+        with contextlib.suppress(Exception):
+            await w.drt.close()  # graceful revoke — no fence tombstone
 
 
 # ---------------------------------------------------------------- run_sim
@@ -1155,6 +1334,14 @@ def run_sim(cfg: SimConfig) -> SimResult:
             cfg.schedule.classes() if cfg.schedule else []
         ),
         config=cfg.to_json(),
+        request_log=[
+            [
+                round(t.t_start - fleet.t0, 4),
+                round(t.t_first - t.t_start, 4) if t.t_first else -1.0,
+                t.priority,
+            ]
+            for t in fleet._tracks
+        ],
     )
 
 
@@ -1260,6 +1447,67 @@ def prefix_chaos_scenario(
         request_interval_s=0.25,
         disagg=False,  # aggregated serving: prefill (and thus the pull
         # path) runs on whichever worker admission lands on
+        schedule=FaultSchedule(events),
+    )
+    base.update(overrides)
+    return SimConfig(**base)
+
+
+def rolling_upgrade_scenario(
+    seed: int,
+    sim_minutes: float = 2.5,
+    n_workers: int = 8,
+    **overrides: Any,
+) -> SimConfig:
+    """Zero-downtime fleet upgrade under chaos (ISSUE 18): an 8-worker
+    fleet serving mixed-priority Zipf tenant traffic is FULLY replaced by
+    a real UpgradeCoordinator mid-run — surge spawn, probation, live KV
+    handoff (predecessor caches transplant into successors at pull
+    cost), graceful drain, retire — while a kill wave lands on
+    already-replaced successors and a fabric blackout opens mid-rollout.
+    All six invariants must stay green, zero streams may drop, and the
+    run must be digest-deterministic.
+
+    The kill wave deliberately targets indices the rollout has already
+    passed (idx 0/1 are replaced within the first ~8 simulated seconds
+    of the rollout): a kill landing on an incarnation still awaiting
+    replacement would leave its auto-respawned (old-version) successor
+    outside the coordinator's snapshot, and "fully replaced" is exactly
+    the property the scenario exists to prove. Kills landing on the
+    under-probation successor itself are the halt+rollback drill —
+    benchmarks/upgrade_sweep.py runs that arm separately."""
+    events = [
+        # pre-rollout churn: a kill + heal cycle before the upgrade
+        # starts, so the rollout begins from a respawned-incarnation mix
+        FaultEvent(t=8.0, action="worker_kill", target=6, duration_s=4.0),
+        # mid-rollout kill wave on already-replaced workers
+        FaultEvent(t=32.0, action="worker_kill", target=0, duration_s=4.0),
+        FaultEvent(t=36.0, action="worker_kill", target=1, duration_s=4.0),
+        # control-plane blackout while successors are still being rolled
+        FaultEvent(t=40.0, action="fabric_blackout", target=-1,
+                   duration_s=1.0),
+        # post-rollout straggler: the upgraded fleet still absorbs gray
+        # failure
+        FaultEvent(t=75.0, action="gray_straggler", target=2,
+                   duration_s=8.0, param=3.0),
+    ]
+    base = dict(
+        seed=seed,
+        sim_minutes=sim_minutes,
+        n_workers=n_workers,
+        fleet_prefix=True,
+        zipf_tenants=12,
+        prefix_len=(8, 24),
+        prompt_len=(3, 16),
+        max_tokens=(8, 32),
+        request_interval_s=0.25,
+        disagg=False,  # aggregated serving: prefill runs wherever
+        # admission lands, so the handoff benefit is visible in prefill
+        # token counts
+        upgrade=True,
+        upgrade_start_s=20.0,
+        upgrade_probation_s=2.0,
+        upgrade_drain_s=30.0,
         schedule=FaultSchedule(events),
     )
     base.update(overrides)

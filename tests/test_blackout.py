@@ -529,6 +529,137 @@ def test_checkpoint_includes_disk_tier_pages(tmp_path):
     assert bm2.lookup_prefix(hashes) == len(hashes)
 
 
+def test_warm_restore_salvages_host_tier_on_disk_fingerprint_skew(tmp_path):
+    """ISSUE 18 satellite: the manifest fingerprint is split PER TIER —
+    when only the disk tier's layout changed (a newer writer reshaped its
+    spill format), the host-tier blocks still restore; the disk-tier
+    blocks are refused and counted under warm_refused."""
+    import json as _json
+
+    bm = TieredBlockManager(
+        LAYOUT, host_blocks=2, disk_dir=str(tmp_path / "spill")
+    )
+    hashes, _, _ = _fill_manager(bm)  # 6 blocks: 2 host + 4 spilled
+    bm.checkpoint(str(tmp_path / "ckpt"))
+    mpath = tmp_path / "ckpt" / "manifest.json"
+    manifest = _json.loads(mpath.read_text())
+    assert manifest["version"] == 2
+    by_tier = {"host": [], "disk": []}
+    for e in manifest["blocks"]:
+        by_tier[e["tier"]].append(int(e["hash"], 16))
+    assert by_tier["host"] and by_tier["disk"]
+    # simulate a writer whose DISK tier changed shape
+    manifest["tiers"]["disk"]["layout"] = dict(
+        manifest["tiers"]["disk"]["layout"], page_size=999
+    )
+    mpath.write_text(_json.dumps(manifest))
+
+    bm2 = TieredBlockManager(LAYOUT, host_blocks=16)
+    out = bm2.restore(str(tmp_path / "ckpt"))
+    assert out.get("refused_tiers") == ["disk"]
+    assert out["restored"] == len(by_tier["host"])
+    assert out["refused"] == len(by_tier["disk"])
+    assert bm2.stats.warm_refused == len(by_tier["disk"])
+    for h in by_tier["host"]:
+        assert h in bm2
+    for h in by_tier["disk"]:
+        assert h not in bm2
+    # every-tier mismatch still refuses the WHOLE checkpoint
+    manifest["tiers"]["host"]["wire_codec"] = "int8"
+    mpath.write_text(_json.dumps(manifest))
+    bm3 = TieredBlockManager(LAYOUT, host_blocks=16)
+    out = bm3.restore(str(tmp_path / "ckpt"))
+    assert out.get("refused_layout") and out["restored"] == 0
+
+
+def test_warm_restore_version_skewed_manifest_refused(tmp_path):
+    """A manifest from a FUTURE writer (version > 2) is refused whole —
+    entry semantics this reader cannot see must never be decoded on
+    guesswork; a v1 manifest (no per-tier fingerprints) keeps the legacy
+    whole-checkpoint compatibility rule in both directions."""
+    import json as _json
+
+    bm = TieredBlockManager(LAYOUT, host_blocks=16)
+    hashes, _, _ = _fill_manager(bm)
+    bm.checkpoint(str(tmp_path))
+    mpath = tmp_path / "manifest.json"
+    manifest = _json.loads(mpath.read_text())
+
+    future = dict(manifest, version=3)
+    mpath.write_text(_json.dumps(future))
+    bm2 = TieredBlockManager(LAYOUT, host_blocks=16)
+    out = bm2.restore(str(tmp_path))
+    assert out.get("refused_version") and out["restored"] == 0
+
+    # v1 manifest (pre-split writer): compatible manager restores all...
+    v1 = {k: v for k, v in manifest.items() if k != "tiers"}
+    v1["version"] = 1
+    mpath.write_text(_json.dumps(v1))
+    bm3 = TieredBlockManager(LAYOUT, host_blocks=16)
+    out = bm3.restore(str(tmp_path))
+    assert out["restored"] == len(hashes) and out["refused"] == 0
+    # ...and a codec-mismatched manager refuses it whole (legacy rule)
+    bm4 = TieredBlockManager(LAYOUT, host_blocks=16, wire_codec="int8")
+    out = bm4.restore(str(tmp_path))
+    assert out.get("refused_layout") and out["restored"] == 0
+
+
+def test_warm_checkpoint_under_concurrent_traffic(tmp_path):
+    """ISSUE 18 satellite: a checkpoint raced by in-flight writes (the
+    drain path checkpoints while traffic is still landing blocks) must
+    round-trip with KV conservation — every manifest entry either
+    restores bit-identically or is refused, zero torn pages — and the
+    restored subset always forms valid, verifiable pages."""
+    import threading
+
+    bm = TieredBlockManager(
+        LAYOUT, host_blocks=256, disk_dir=str(tmp_path / "spill")
+    )
+    stop = threading.Event()
+    stored_batches: list[list[int]] = []
+
+    def writer(tid: int) -> None:
+        i = 0
+        while not stop.is_set() and i < 40:
+            n = 4
+            k, v = rand_blocks(n, seed=100 * tid + i)
+            hs = [0x5000 + 1000 * tid + n * i + j for j in range(n)]
+            bm.store_blocks(hs, k, v)
+            stored_batches.append(hs)
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(3)]
+    for t in threads:
+        t.start()
+    summaries = []
+    try:
+        # several checkpoints racing the writers
+        for round_ in range(3):
+            summaries.append(bm.checkpoint(str(tmp_path / "ckpt")))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert summaries[-1]["blocks"] > 0
+    # final quiesced checkpoint (the drain takes one after admission stops)
+    final = bm.checkpoint(str(tmp_path / "ckpt"))
+    all_hashes = [h for hs in stored_batches for h in hs]
+    assert final["blocks"] == len(set(all_hashes))
+
+    bm2 = TieredBlockManager(
+        LAYOUT, host_blocks=256, disk_dir=str(tmp_path / "spill2")
+    )
+    out = bm2.restore(str(tmp_path / "ckpt"))
+    # zero torn pages: every page written under the race verifies
+    assert out["refused"] == 0, f"torn pages in racing checkpoint: {out}"
+    assert out["restored"] == final["blocks"]
+    # KV conservation: restored bytes are bit-identical to the source
+    k2, v2 = bm2.load_blocks(all_hashes)
+    ko, vo = bm.load_blocks(all_hashes)
+    np.testing.assert_array_equal(k2, ko)
+    np.testing.assert_array_equal(v2, vo)
+
+
 # ------------------------------------------- warm restart: engine-level
 
 

@@ -320,6 +320,67 @@ async def test_supervisor_injected_kills_exempt_from_crash_budget():
         await proc.stop()
 
 
+async def test_supervisor_planned_exit_exempt_from_crash_budget():
+    """A planned termination (rolling-upgrade drain / scale-down delivered
+    by external signal, including a drain-deadline SIGKILL) must be
+    budget-exempt like injected kills: no crash counted, no quarantine,
+    and NO respawn fighting the coordinator (ISSUE 18 satellite)."""
+    import os
+    import signal as _signal
+    import sys
+
+    from dynamo_tpu.sdk.supervisor import ManagedProcess
+
+    proc = ManagedProcess(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        name="retiree",
+        max_restarts=2,
+        backoff_s=0.05,
+        restart_window_s=60,
+        forward_output=False,
+    )
+    await proc.start()
+    try:
+        proc.mark_planned_exit()
+        # external SIGTERM — NOT via stop(): the coordinator path
+        os.kill(proc.pid, _signal.SIGTERM)
+        for _ in range(600):
+            if not proc.running and proc._monitor_task.done():
+                break
+            await asyncio.sleep(0.05)
+        assert proc._monitor_task.done(), "monitor must retire, not respawn"
+        assert proc.restarts == 0, "planned exit must not restart"
+        assert not proc.quarantined
+        assert proc._crash_times == [], "crash budget must be untouched"
+        assert proc.planned_exits_total == 1
+        assert proc.state == "stopped"
+    finally:
+        await proc.stop()
+
+    # the drain-deadline SIGKILL leg: same exemption for an unclean rc
+    proc2 = ManagedProcess(
+        [sys.executable, "-c", "import time; time.sleep(60)"],
+        name="retiree2",
+        max_restarts=2,
+        backoff_s=0.05,
+        restart_window_s=60,
+        forward_output=False,
+    )
+    await proc2.start()
+    try:
+        proc2.mark_planned_exit()
+        os.kill(proc2.pid, _signal.SIGKILL)
+        for _ in range(600):
+            if proc2._monitor_task.done():
+                break
+            await asyncio.sleep(0.05)
+        assert proc2.restarts == 0 and not proc2.quarantined
+        assert proc2._crash_times == []
+        assert proc2.planned_exits_total == 1
+    finally:
+        await proc2.stop()
+
+
 async def test_midstream_kill_under_dyn_fault_migrates_stream():
     """Acceptance: a decode worker SIGKILLed by DYN_FAULT mid-stream
     (kill_after_tokens) must not kill the SSE stream — the frontend

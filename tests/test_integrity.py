@@ -605,9 +605,10 @@ async def test_wire_version_mismatch_is_structured():
     reader.feed_data(wire.pack([1, "op", {}], version=9))
     with pytest.raises(wire.WireVersionError) as ei:
         await wire.read_frame(reader)
-    assert ei.value.got == 9 and ei.value.want == wire.WIRE_VERSION
+    assert ei.value.got == 9
+    assert ei.value.want == (wire.WIRE_MIN, wire.WIRE_MAX)
     msg = str(ei.value)
-    assert "v9" in msg and f"v{wire.WIRE_VERSION}" in msg
+    assert "v9" in msg and f"v{wire.WIRE_MIN}..v{wire.WIRE_MAX}" in msg
     assert "mismatch" in msg
     # same-version frames still round-trip
     reader2 = asyncio.StreamReader()
@@ -616,24 +617,22 @@ async def test_wire_version_mismatch_is_structured():
 
 
 async def test_skewed_peer_fails_handshake_with_friendly_error():
-    """A fabric server speaking a newer wire version: the client's first
-    reply read raises the structured mismatch, and the in-flight call
-    surfaces it (no hang, no failover spin)."""
+    """A fabric server speaking a wire version outside our negotiable
+    range: the client's handshake raises the structured mismatch at
+    connect time (no hang, no failover spin, no call ever dispatched)."""
 
     async def skewed_server(reader, writer):
         with contextlib.suppress(Exception):
-            await wire.read_frame(reader)  # accept the request
+            await wire.read_frame(reader)  # accept the hello
         writer.write(wire.pack([1, "ok", 42], version=9))
         with contextlib.suppress(Exception):
             await writer.drain()
 
     server = await asyncio.start_server(skewed_server, "127.0.0.1", 0)
     port = server.sockets[0].getsockname()[1]
-    client = await FabricClient.connect(f"127.0.0.1:{port}")
     with pytest.raises(ConnectionError) as ei:
-        await client.lease_grant(5.0)
+        await FabricClient.connect(f"127.0.0.1:{port}")
     assert "mismatch" in str(ei.value) and "v9" in str(ei.value)
-    await client.close()
     server.close()
     await server.wait_closed()
 

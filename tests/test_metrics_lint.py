@@ -337,6 +337,39 @@ def test_planner_families_present_with_correct_types():
             assert fam is not None and fam.type == typ, (role, name)
 
 
+def test_fleet_upgrade_families_present_with_correct_types():
+    """ISSUE 18: the rolling-upgrade families must exist with the right
+    semantics on the metrics component (fabric scrape of the
+    coordinator's ``fleet/upgrade-status`` key) — phase as a one-hot
+    gauge over every coordinator phase, handoff blocks and rollbacks
+    with counter semantics, replaced-count as a gauge. They are
+    component-only: the coordinator publishes to the fabric, nothing
+    attaches them to the frontend."""
+    from dynamo_tpu.fleet.upgrade import PHASES
+
+    regs = _all_registries()
+    by_role = {
+        role: {f.name: f for f in _families(reg)}
+        for role, reg in regs.items()
+    }
+    for name, typ in (
+        ("dyn_fleet_upgrade_phase", "gauge"),
+        ("dyn_fleet_upgrade_handoff_blocks", "counter"),
+        ("dyn_fleet_upgrade_rollbacks", "counter"),
+        ("dyn_fleet_upgrade_replaced", "gauge"),
+    ):
+        fam = by_role["component"].get(name)
+        assert fam is not None and fam.type == typ, (name, typ)
+        for role in ("frontend", "router"):
+            assert name not in by_role[role], (role, name)
+    # the phase gauge is one-hot over the coordinator's state machine:
+    # every phase labelled, exactly one sample set
+    phase = by_role["component"]["dyn_fleet_upgrade_phase"]
+    seen = {s.labels["phase"]: s.value for s in phase.samples}
+    assert set(seen) == set(PHASES), seen
+    assert sum(seen.values()) == 1.0, seen
+
+
 def test_tail_families_present_with_correct_types():
     """ISSUE 12: the tail-tolerance families must exist with the right
     semantics — score/ejected as gauges, ejections/hedges/wasted-tokens

@@ -1,0 +1,537 @@
+"""Zero-downtime rolling upgrades (ISSUE 18): coordinator state machine,
+automatic halt + rollback, planner maintenance latch, live KV handoff
+over the real peer plane, and validated config hot-reload."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.fabric.client import FabricClient
+from dynamo_tpu.fabric.state import FabricState
+from dynamo_tpu.fleet.config_reload import (
+    CONFIG_INTENT_KEY,
+    CONFIG_STATUS_KEY,
+    ConfigReloader,
+    validate_config_payload,
+)
+from dynamo_tpu.fleet.upgrade import (
+    UPGRADE_INTENT_KEY,
+    UPGRADE_STATUS_KEY,
+    UpgradeCoordinator,
+    UpgradePlan,
+)
+
+
+class FakePool:
+    """Scripted WorkerPool: records every actuation in order."""
+
+    def __init__(
+        self,
+        fleet=None,
+        default_crashes=0,
+        healthy=True,
+        burn=0.0,
+        handoff_outcomes=None,
+    ):
+        self.fleet = fleet or {
+            "decode_worker": ["decode_worker-1", "decode_worker-2",
+                              "decode_worker-3"]
+        }
+        self.default_crashes = default_crashes
+        self.healthy = healthy
+        self.burn = burn
+        self.handoff_outcomes = handoff_outcomes or {
+            "pulled": 7, "fallback_miss": 1,
+        }
+        self.events: list[tuple] = []
+        self.spawned: list[tuple[str, dict]] = []
+        self._seq = 100
+
+    def workers(self, component):
+        return list(self.fleet.get(component, []))
+
+    async def spawn_successor(self, component, env):
+        self._seq += 1
+        name = f"{component}-{self._seq}"
+        self.spawned.append((name, dict(env)))
+        self.events.append(("spawn", name))
+        return name
+
+    async def wait_healthy(self, name, timeout_s):
+        self.events.append(("wait_healthy", name))
+        return self.healthy
+
+    def crash_count(self, name):
+        return self.default_crashes
+
+    async def handoff(self, src, dst):
+        self.events.append(("handoff", src, dst))
+        return dict(self.handoff_outcomes)
+
+    async def drain(self, name, timeout_s):
+        self.events.append(("drain", name))
+
+    async def retire(self, name):
+        self.events.append(("retire", name))
+
+    async def respawn_old(self, component, n):
+        self.events.append(("respawn_old", component, n))
+
+    def slo_burn(self):
+        return self.burn
+
+
+class FakePlanner:
+    def __init__(self):
+        self.latch_calls: list[tuple[bool, str]] = []
+
+    def note_maintenance(self, active, reason=""):
+        self.latch_calls.append((bool(active), reason))
+
+
+# --------------------------------------------------- coordinator: happy path
+
+
+async def test_rollout_replaces_every_worker_in_order():
+    pool = FakePool()
+    planner = FakePlanner()
+    coord = UpgradeCoordinator(
+        pool, UpgradePlan(components=["decode_worker"], probation_s=0.01),
+        planner=planner,
+    )
+    status = await coord.run()
+
+    assert status.phase == "done"
+    assert status.replaced == 3 and status.total == 3
+    assert status.rollbacks_total == 0 and status.halted_reason is None
+    # handoff outcomes accumulate across all three replacements
+    assert status.handoff_blocks == {"pulled": 21, "fallback_miss": 3}
+    # per-old sequencing: spawn -> probation -> handoff -> drain -> retire
+    olds = ["decode_worker-1", "decode_worker-2", "decode_worker-3"]
+    for old, (succ, _env) in zip(olds, pool.spawned):
+        i = pool.events.index(("spawn", succ))
+        assert pool.events[i + 1] == ("wait_healthy", succ)
+        assert pool.events[i + 2] == ("handoff", old, succ)
+        assert pool.events[i + 3] == ("drain", old)
+        assert pool.events[i + 4] == ("retire", old)
+    # planner latched for the whole rollout, released at the end
+    assert planner.latch_calls == [
+        (True, "rolling_upgrade"), (False, "rolling_upgrade"),
+    ]
+    # the state machine walked its advertised phases
+    assert coord.phase_log[0] == "surging"
+    assert coord.phase_log[-1] == "done"
+    assert "rolling_back" not in coord.phase_log
+
+
+async def test_surge_two_spawns_pairs_before_touching_olds():
+    pool = FakePool(fleet={"decode_worker": [f"decode_worker-{i}"
+                                             for i in range(1, 5)]})
+    coord = UpgradeCoordinator(
+        pool,
+        UpgradePlan(components=["decode_worker"], surge=2, probation_s=0.01),
+    )
+    status = await coord.run()
+    assert status.phase == "done" and status.replaced == 4
+    # both successors of a batch spawn before the batch's first drain
+    kinds = [e[0] for e in pool.events]
+    first_drain = kinds.index("drain")
+    assert kinds[:first_drain].count("spawn") == 2
+    assert kinds.count("spawn") == 4
+
+
+async def test_new_env_reaches_successors_only():
+    pool = FakePool()
+    coord = UpgradeCoordinator(
+        pool,
+        UpgradePlan(components=["decode_worker"], probation_s=0.01,
+                    new_env={"DYN_RELEASE": "v2"}),
+    )
+    await coord.run()
+    assert all(env == {"DYN_RELEASE": "v2"} for _, env in pool.spawned)
+
+
+# ------------------------------------------------ automatic halt + rollback
+
+
+async def test_crash_looping_successor_halts_and_rolls_back():
+    pool = FakePool(default_crashes=5)
+    planner = FakePlanner()
+    coord = UpgradeCoordinator(
+        pool,
+        UpgradePlan(components=["decode_worker"], probation_s=0.01,
+                    crash_loop_threshold=2),
+        planner=planner,
+    )
+    status = await coord.run()
+
+    assert status.phase == "halted"
+    assert status.rollbacks_total == 1
+    assert "crash-looped" in status.halted_reason
+    assert status.replaced == 0
+    # predecessors were never drained or retired — the old fleet serves on
+    drained = [e for e in pool.events if e[0] == "drain"]
+    retired = [e for e in pool.events if e[0] == "retire"]
+    assert drained == []
+    assert retired == [("retire", pool.spawned[0][0])]  # only the sick succ
+    # capacity the successor was meant to carry is respawned at the OLD role
+    assert ("respawn_old", "decode_worker", 1) in pool.events
+    # latch released despite the halt
+    assert planner.latch_calls[-1] == (False, "rolling_upgrade")
+    assert coord.phase_log[-1] == "halted"
+    assert "rolling_back" in coord.phase_log
+
+
+async def test_never_healthy_successor_rolls_back():
+    pool = FakePool(healthy=False)
+    coord = UpgradeCoordinator(
+        pool, UpgradePlan(components=["decode_worker"], probation_s=0.01),
+    )
+    status = await coord.run()
+    assert status.phase == "halted"
+    assert "never became healthy" in status.halted_reason
+    assert status.replaced == 0
+
+
+async def test_slo_burn_breach_during_probation_rolls_back():
+    pool = FakePool(burn=0.9)
+    coord = UpgradeCoordinator(
+        pool,
+        UpgradePlan(components=["decode_worker"], probation_s=0.01,
+                    slo_burn_limit=0.5),
+    )
+    status = await coord.run()
+    assert status.phase == "halted"
+    assert "slo burn" in status.halted_reason
+    # burn under the bar (or bar disabled) never halts
+    ok_pool = FakePool(burn=0.9)
+    coord2 = UpgradeCoordinator(
+        ok_pool, UpgradePlan(components=["decode_worker"], probation_s=0.01),
+    )
+    assert (await coord2.run()).phase == "done"
+
+
+async def test_handoff_failure_is_not_fatal():
+    class FlakyPool(FakePool):
+        async def handoff(self, src, dst):
+            raise RuntimeError("peer plane down")
+
+    pool = FlakyPool()
+    coord = UpgradeCoordinator(
+        pool, UpgradePlan(components=["decode_worker"], probation_s=0.01),
+    )
+    status = await coord.run()
+    # prefixes recompute on the successor; the rollout itself completes
+    assert status.phase == "done" and status.replaced == 3
+    assert status.handoff_blocks == {}
+
+
+# ------------------------------------------------------ fabric status keys
+
+
+async def test_intent_and_status_published_on_fabric():
+    fabric = FabricClient.in_process(FabricState())
+    seen_intent: list = []
+
+    pool = FakePool()
+
+    async def snoop(phase):
+        seen_intent.append(await fabric.kv_get(UPGRADE_INTENT_KEY))
+
+    # sample the intent key mid-rollout from the phase hook
+    coord = UpgradeCoordinator(
+        pool, UpgradePlan(components=["decode_worker"], probation_s=0.01),
+        fabric=fabric,
+    )
+    orig = coord._publish
+
+    async def publish_and_snoop():
+        await orig()
+        seen_intent.append(await fabric.kv_get(UPGRADE_INTENT_KEY))
+
+    coord._publish = publish_and_snoop
+    status = await coord.run()
+    assert status.phase == "done"
+
+    # mid-rollout the intent key carried the plan
+    mid = [v for v in seen_intent[:-1] if v is not None]
+    assert mid and json.loads(mid[0].decode())["components"] == [
+        "decode_worker"
+    ]
+    # after completion: intent withdrawn, final status persisted
+    assert await fabric.kv_get(UPGRADE_INTENT_KEY) is None
+    final = json.loads((await fabric.kv_get(UPGRADE_STATUS_KEY)).decode())
+    assert final["phase"] == "done" and final["replaced"] == 3
+    await fabric.close()
+
+
+def test_upgrade_plan_wire_roundtrip_ignores_unknown_fields():
+    plan = UpgradePlan(components=["a"], surge=2, new_env={"X": "1"})
+    wire = plan.to_wire()
+    wire["from_the_future"] = {"nested": True}  # N+1 writer, N reader
+    back = UpgradePlan.from_wire(wire)
+    assert back.components == ["a"] and back.surge == 2
+    assert back.new_env == {"X": "1"}
+
+
+# ------------------------------------------------ planner maintenance latch
+
+
+async def test_planner_maintenance_latch_holds_then_releases():
+    from dynamo_tpu.planner import Planner, PlannerConfig, VirtualConnector
+    from dynamo_tpu.planner.planner_core import ObservedMetrics
+
+    hot = ObservedMetrics(kv_usage=0.9, queue_depth=6)
+
+    async def sample():
+        return hot
+
+    conn = VirtualConnector()
+    planner = Planner(
+        PlannerConfig(mode="load", max_prefill=4, max_decode=4),
+        sample, conn,
+    )
+    planner.note_maintenance(True, reason="rolling_upgrade")
+    for _ in range(3):
+        d = await planner.step()
+        assert d.direction == "hold"
+        assert d.reason == "maintenance:rolling_upgrade"
+    # no actuation happened while latched
+    assert conn.history == []
+    assert planner.status()["maintenance"] == "rolling_upgrade"
+
+    planner.note_maintenance(False)
+    assert planner.status()["maintenance"] is None
+    d = await planner.step()
+    # pressure acts again the moment the latch releases
+    assert d.direction == "up"
+    assert conn.history != []
+
+
+# ------------------------------------- live KV handoff over the peer plane
+
+
+async def test_live_handoff_pulls_predecessor_inventory(tmp_path):
+    from dynamo_tpu.block_manager.peer import PeerBlockClient, PeerBlockService
+    from dynamo_tpu.fleet.upgrade import live_handoff
+    from dynamo_tpu.runtime.distributed import DistributedRuntime
+
+    from tests.test_colocated_disagg import BLOCK
+    from tests.test_peer_blocks import make_manager
+
+    drt = await DistributedRuntime.detached()
+    try:
+        m_old = make_manager(tmp_path, "old")
+        m_new = make_manager(tmp_path, "new")
+        hashes = list(range(0x7000, 0x7000 + 6))
+        shape = (2, 2, len(hashes), BLOCK, 16)
+        rng = np.random.default_rng(7)
+        k = rng.integers(0, 2**16, size=shape).astype(np.uint16)
+        v = rng.integers(0, 2**16, size=shape).astype(np.uint16)
+        m_old.store_blocks(hashes, k, v)
+
+        svc = PeerBlockService(drt, "up", m_old, publish_interval_s=0.05)
+        await svc.start()
+        client = PeerBlockClient(drt, "up", m_new)
+        await asyncio.sleep(0.2)  # advert publishes
+
+        inventory = m_old.advert_blocks()
+        assert len(inventory) == len(hashes)
+        outcomes = await live_handoff(client, inventory, chunk=2)
+        assert outcomes["pulled"] == len(hashes)
+        assert m_new.lookup_prefix(hashes) == len(hashes)
+        # byte-identical KV landed (checksummed pulls)
+        kb, vb = m_new.load_blocks(hashes)
+        np.testing.assert_array_equal(kb, k)
+        np.testing.assert_array_equal(vb, v)
+        # idempotent: a second handoff pulls nothing new
+        again = await live_handoff(client, inventory, chunk=4)
+        assert again["pulled"] == 0
+        await svc.close()
+    finally:
+        await drt.close()
+
+
+# ------------------------------------------------------- config hot-reload
+
+
+def test_validate_config_payload_accepts_known_knobs():
+    clean, errors = validate_config_payload({
+        "brownout_max_level": 3,
+        "admission_class_fractions": {"bulk": 0.4, "standard": 0.9},
+        "hedge_budget_fraction": 0.02,
+        "chunk_budget": 2048,
+    })
+    assert errors == []
+    assert clean["brownout_max_level"] == 3
+    assert clean["admission_class_fractions"] == {"bulk": 0.4, "standard": 0.9}
+    assert clean["hedge_budget_fraction"] == 0.02
+    assert clean["chunk_budget"] == 2048
+
+
+@pytest.mark.parametrize("payload,needle", [
+    ({"brownout_max_level": 9}, "outside"),
+    ({"brownout_max_level": True}, "expected int"),
+    ({"admission_class_fractions": {"bulk": 1.5}}, "outside [0,1]"),
+    ({"admission_class_fractions": {"vip": 0.5}}, "unknown class"),
+    ({"admission_class_fractions": {}}, "non-empty"),
+    ({"hedge_budget_fraction": "lots"}, "expected number"),
+    ({"chunk_budget": 0}, "< 1"),
+    ({"chunk_budget": 1.5}, "expected int"),
+    ({"turbo_mode": 1}, "unknown knob"),
+    ("not a dict", "must be an object"),
+])
+def test_validate_config_payload_refuses_bad_payloads(payload, needle):
+    clean, errors = validate_config_payload(payload)
+    assert clean == {}  # refusal is WHOLE — nothing survives
+    assert any(needle in e for e in errors)
+
+
+def test_validate_config_payload_refusal_is_atomic():
+    # one good knob + one bad knob -> nothing applies
+    clean, errors = validate_config_payload({
+        "chunk_budget": 1024, "brownout_max_level": 99,
+    })
+    assert clean == {} and errors
+
+
+def test_config_reloader_applies_at_step_boundary_only():
+    applied: dict = {}
+    r = ConfigReloader()
+    r.register("chunk_budget", lambda v: applied.__setitem__("chunk", v))
+    r.register(
+        "hedge_budget_fraction", lambda v: applied.__setitem__("hedge", v)
+    )
+
+    assert r.submit({"chunk_budget": 512, "hedge_budget_fraction": 0.1})
+    assert applied == {}  # staged, NOT applied mid-step
+    out = r.apply_pending()
+    assert out == {"chunk_budget": 512, "hedge_budget_fraction": 0.1}
+    assert applied == {"chunk": 512, "hedge": 0.1}
+    assert r.applied_total == 1 and r.current["chunk_budget"] == 512
+    assert r.apply_pending() is None  # one payload applies once
+
+    # refused payloads never stage anything
+    assert not r.submit({"chunk_budget": -5})
+    assert r.refused_total == 1 and r.last_errors
+    assert r.apply_pending() is None
+    assert applied["chunk"] == 512  # untouched
+
+
+async def test_config_reloader_over_fabric_watch():
+    fabric = FabricClient.in_process(FabricState())
+    applied: list = []
+    r = ConfigReloader(fabric=fabric, host="w0")
+    r.register("brownout_max_level", applied.append)
+    await r.start()
+
+    await fabric.kv_put(
+        CONFIG_INTENT_KEY, json.dumps({"brownout_max_level": 2}).encode()
+    )
+    await asyncio.sleep(0.1)  # watch pump delivers
+    assert r.apply_pending() == {"brownout_max_level": 2}
+    assert applied == [2]
+    await asyncio.sleep(0.05)
+    status = json.loads((await fabric.kv_get(CONFIG_STATUS_KEY)).decode())
+    assert status["outcome"] == "applied" and status["host"] == "w0"
+
+    # an operator typo is refused AND reported, not silently dropped
+    await fabric.kv_put(
+        CONFIG_INTENT_KEY, json.dumps({"brownout_maxlevel": 2}).encode()
+    )
+    await asyncio.sleep(0.1)
+    assert r.apply_pending() is None
+    await asyncio.sleep(0.05)
+    status = json.loads((await fabric.kv_get(CONFIG_STATUS_KEY)).decode())
+    assert status["outcome"] == "refused"
+    assert any("unknown knob" in e for e in status["errors"])
+
+    # garbage bytes refuse too (never crashes the watcher)
+    await fabric.kv_put(CONFIG_INTENT_KEY, b"\xff{not json")
+    await asyncio.sleep(0.1)
+    assert r.refused_total == 2
+    assert applied == [2]
+
+    await r.stop()
+    await fabric.close()
+
+
+# ------------------------------------------------------------ gate logic
+
+
+def _gate_doc():
+    arm = {
+        "ok": True,
+        "dropped_streams": 0,
+        "digest": "d" * 64,
+        "replaced": 8.0,
+        "rollbacks": 0.0,
+        "done": 1.0,
+        "handoff_blocks_pulled": 594.0,
+        "successor_prefill_tokens": 500.0,
+        "ttft_rollout_delta_pct": -20.0,
+    }
+    return {
+        "rollout": dict(arm),
+        "cold": dict(arm, handoff_blocks_pulled=0,
+                     successor_prefill_tokens=3500.0),
+        "rollback_drill": {
+            "ok": True, "dropped_streams": 0, "digest": "d" * 64,
+            "halted": True, "rollbacks": 1.0, "replaced": 0.0,
+        },
+        "prefill_recompute_ratio": 7.0,
+    }
+
+
+def test_upgrade_gate_passes_on_banked_numbers():
+    from tools.upgrade_gate import gate
+
+    doc = _gate_doc()
+    assert gate(doc, doc, tolerance=0.10) == []
+
+
+@pytest.mark.parametrize(
+    "mutate,needle",
+    [
+        (lambda d: d["rollout"].update(dropped_streams=2), "dropped"),
+        (lambda d: d["rollout"].update(digest="e" * 64), "diverged"),
+        (lambda d: d["rollout"].update(handoff_blocks_pulled=0),
+         "handoff inactive"),
+        (lambda d: d.update(prefill_recompute_ratio=4.0), "floor"),
+        (lambda d: d["rollout"].update(ttft_rollout_delta_pct=30.0),
+         "TTFT"),
+        (lambda d: d["rollback_drill"].update(halted=False,
+                                              rollbacks=0.0),
+         "halt"),
+        (lambda d: d["rollback_drill"].update(replaced=3.0), "despite"),
+        (lambda d: d["rollout"].update(done=0.0, rollbacks=1.0),
+         "did not complete"),
+    ],
+)
+def test_upgrade_gate_catches_regressions(mutate, needle):
+    from tools.upgrade_gate import gate
+
+    banked = _gate_doc()
+    fresh = _gate_doc()
+    mutate(fresh)
+    fails = gate(fresh, banked, tolerance=0.10)
+    assert fails and any(needle in f for f in fails), (needle, fails)
+
+
+def test_upgrade_gate_erosion_within_tolerance_passes():
+    from tools.upgrade_gate import gate
+
+    banked = _gate_doc()
+    fresh = _gate_doc()
+    # 5% erosion of the ratio and +5pp TTFT drift stay inside tolerance
+    fresh["prefill_recompute_ratio"] = 6.65
+    fresh["rollout"]["ttft_rollout_delta_pct"] = -15.0
+    assert gate(fresh, banked, tolerance=0.10) == []
+    # but the same erosion past tolerance fails
+    fresh["prefill_recompute_ratio"] = 5.5
+    fails = gate(fresh, banked, tolerance=0.10)
+    assert any("eroded" in f for f in fails), fails

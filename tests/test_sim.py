@@ -34,6 +34,7 @@ from dynamo_tpu.testing.sim import (
     mixed_step_chaos_scenario,
     prefix_chaos_scenario,
     planted_fence_bug_scenario,
+    rolling_upgrade_scenario,
     run_sim,
     shrink_schedule,
 )
@@ -264,6 +265,55 @@ def test_sim_fleet_prefix_chaos_invariants_green():
     # the scenario config round-trips through JSON (artifact path)
     clone = SimConfig.from_json(json.loads(json.dumps(cfg.to_json())))
     assert clone.fleet_prefix and clone.prefix_len == cfg.prefix_len
+
+
+def test_sim_rolling_upgrade_invariants_green():
+    """ISSUE 18 pinned-seed scenario: a real UpgradeCoordinator fully
+    replaces an 8-worker fleet mid-run — surge, probation, live KV
+    handoff, graceful drain, retire — under mixed-priority Zipf traffic
+    with a kill wave and a fabric blackout landing mid-rollout.  Every
+    pre-rollout incarnation must be retired (every index gains a
+    generation), the handoff must actually move blocks, zero streams may
+    drop, all six invariants must stay green continuously, and the run
+    must be bit-identical on replay."""
+    cfg = rolling_upgrade_scenario(seed=18)
+    assert cfg.upgrade and cfg.upgrade_handoff
+    r1 = run_sim(cfg)
+    assert r1.ok, r1.violations
+    assert r1.sim_seconds >= 120.0
+    # the rollout ran to completion: whole fleet replaced, no rollback
+    assert r1.counters.get("upgrade/done") == 1.0, r1.counters
+    assert r1.counters.get("upgrade/replaced") == cfg.n_workers
+    assert r1.counters.get("upgrade/rollbacks") == 0.0
+    # every index gained at least one incarnation (g1+ exists for all)
+    gens = {
+        k.split("/")[1] for k in r1.counters if k.startswith("tokens/")
+    }
+    for i in range(cfg.n_workers):
+        assert any(
+            g.startswith(f"w{i}.g") and not g.endswith(".g0") for g in gens
+        ), (i, sorted(gens))
+    # the live handoff genuinely moved KV into the successors
+    assert r1.counters.get("upgrade/handoff/pulled", 0) > 100, r1.counters
+    # chaos landed mid-rollout, and zero streams dropped through it all
+    assert r1.fault_fired.get("worker_kill", 0) >= 2
+    assert r1.fault_fired.get("fabric_blackout", 0) >= 1
+    assert r1.outcomes["ok"] > 100
+    assert r1.outcomes["error"] == 0
+    for name, st in r1.invariant_stats.items():
+        assert st["evals"] > 50, (name, st)
+        assert st["violations"] == 0, (name, st)
+    r2 = run_sim(cfg)
+    assert r2.digest == r1.digest, "same seed, different run"
+    # the scenario config round-trips through JSON (artifact path)
+    clone = SimConfig.from_json(json.loads(json.dumps(cfg.to_json())))
+    assert clone.upgrade and clone.upgrade_start_s == cfg.upgrade_start_s
+    cold = run_sim(
+        rolling_upgrade_scenario(seed=18, upgrade_handoff=False)
+    )
+    assert cold.ok, cold.violations
+    assert cold.counters.get("upgrade/replaced") == cfg.n_workers
+    assert "upgrade/handoff/pulled" not in cold.counters
 
 
 # --------------------------------------- planted bug + shrink + replay

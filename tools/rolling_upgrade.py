@@ -1,0 +1,140 @@
+"""Drive a zero-downtime rolling upgrade of a running fleet (ISSUE 18).
+
+Two modes:
+
+* **Coordinator mode** (default): spawn the fleet's workers under a
+  SupervisorConnector (one ManagedProcess per replica, crash-restart
+  discipline) and walk them through the surge → probation → handoff →
+  drain → retire state machine in THIS process:
+
+      python -m tools.rolling_upgrade \\
+          --cmd 'decode_worker=python -m dynamo_tpu.entrypoint ...' \\
+          --component decode_worker --surge 1 --probation-s 5 \\
+          --env DYN_RELEASE=v2 --fabric 127.0.0.1:4222
+
+* **Publish-only mode** (`--publish-only`): just write the validated
+  UpgradePlan under the ``fleet/upgrade-intent`` fabric key and exit —
+  for fleets whose resident control plane (planner host) runs the
+  coordinator itself.
+
+Exit code 0 = rollout done; 2 = halted (automatic rollback fired —
+the reason is printed and left under ``fleet/upgrade-status``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import shlex
+import sys
+
+from dynamo_tpu.fleet.upgrade import (
+    UPGRADE_INTENT_KEY,
+    SupervisorWorkerPool,
+    UpgradeCoordinator,
+    UpgradePlan,
+)
+
+
+def _parse_cmds(entries: list[str]) -> dict[str, list[str]]:
+    out: dict[str, list[str]] = {}
+    for entry in entries:
+        comp, _, cmd = entry.partition("=")
+        if not comp or not cmd:
+            raise SystemExit(f"--cmd wants component=command, got {entry!r}")
+        out[comp] = shlex.split(cmd)
+    return out
+
+
+def _parse_env(entries: list[str]) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for entry in entries:
+        k, _, v = entry.partition("=")
+        out[k] = v
+    return out
+
+
+async def _run(args: argparse.Namespace) -> int:
+    plan = UpgradePlan(
+        components=args.component,
+        surge=args.surge,
+        probation_s=args.probation_s,
+        drain_timeout_s=args.drain_timeout_s,
+        handoff=not args.no_handoff,
+        new_env=_parse_env(args.env),
+        crash_loop_threshold=args.crash_loop_threshold,
+        slo_burn_limit=args.slo_burn_limit,
+    )
+
+    fabric = None
+    if args.fabric:
+        from dynamo_tpu.fabric.client import FabricClient
+
+        fabric = await FabricClient.connect(args.fabric)
+
+    if args.publish_only:
+        if fabric is None:
+            raise SystemExit("--publish-only needs --fabric")
+        await fabric.kv_put(
+            UPGRADE_INTENT_KEY, json.dumps(plan.to_wire()).encode()
+        )
+        print(f"upgrade intent published under {UPGRADE_INTENT_KEY}")
+        await fabric.close()
+        return 0
+
+    from dynamo_tpu.planner.connectors import SupervisorConnector
+
+    conn = SupervisorConnector(commands=_parse_cmds(args.cmd))
+    try:
+        for comp in plan.components:
+            await conn.set_replicas(comp, args.replicas)
+        pool = SupervisorWorkerPool(conn, fabric=fabric)
+        coord = UpgradeCoordinator(pool, plan, fabric=fabric)
+        status = await coord.run()
+        print(json.dumps(status.to_wire(), indent=2))
+        return 0 if status.phase == "done" else 2
+    finally:
+        if args.teardown:
+            await conn.close()
+        if fabric is not None:
+            await fabric.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--component", action="append", default=None,
+                    help="component to roll (repeatable; order = rollout "
+                    "order)")
+    ap.add_argument("--cmd", action="append", default=[],
+                    help="component=command template (repeatable)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="replicas per component to run before rolling")
+    ap.add_argument("--surge", type=int, default=1)
+    ap.add_argument("--probation-s", type=float, default=5.0)
+    ap.add_argument("--drain-timeout-s", type=float, default=10.0)
+    ap.add_argument("--no-handoff", action="store_true",
+                    help="cold rolling restart: skip the live KV handoff")
+    ap.add_argument("--env", action="append", default=[],
+                    help="KEY=VALUE applied to successors only — the new "
+                    "version (repeatable)")
+    ap.add_argument("--crash-loop-threshold", type=int, default=2)
+    ap.add_argument("--slo-burn-limit", type=float, default=0.0)
+    ap.add_argument("--fabric", default="",
+                    help="host:port of the fabric primary (status keys, "
+                    "handoff intents)")
+    ap.add_argument("--publish-only", action="store_true",
+                    help="write the plan under fleet/upgrade-intent and "
+                    "exit (resident coordinator executes it)")
+    ap.add_argument("--teardown", action="store_true",
+                    help="stop the whole fleet on exit (demo/CI runs)")
+    args = ap.parse_args(argv)
+    if not args.component:
+        ap.error("at least one --component is required")
+    if not args.publish_only and not args.cmd:
+        ap.error("coordinator mode needs --cmd for every --component")
+    return asyncio.run(_run(args))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

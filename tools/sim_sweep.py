@@ -29,19 +29,35 @@ from pathlib import Path
 from dynamo_tpu.testing.sim import (
     bank_artifact,
     chaos_scenario,
+    mixed_step_chaos_scenario,
+    prefix_chaos_scenario,
+    rolling_upgrade_scenario,
     run_sim,
     shrink_schedule,
 )
+
+SCENARIOS = {
+    "chaos": chaos_scenario,
+    "mixed": mixed_step_chaos_scenario,
+    "prefix": prefix_chaos_scenario,
+    "upgrade": rolling_upgrade_scenario,
+}
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
     ap.add_argument("--seeds", type=int, default=8,
                     help="number of seeds to sweep (0..N-1)")
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS), default="chaos",
+                    help="pinned scenario builder to sweep (upgrade = "
+                    "full-fleet rolling upgrade under chaos, ISSUE 18)")
     ap.add_argument("--sim-minutes", type=float, default=5.0)
-    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=4,
+                    help="fleet size (the upgrade scenario defaults to 8 "
+                    "unless overridden)")
     ap.add_argument("--density", type=float, default=1.0,
-                    help="extra fault events per simulated minute")
+                    help="extra fault events per simulated minute "
+                    "(chaos scenario only)")
     ap.add_argument("--json", default="benchmarks/sim_sweep.json")
     ap.add_argument("--failures-dir", default="benchmarks/sim_failures")
     ap.add_argument("--no-shrink", action="store_true",
@@ -52,12 +68,15 @@ def main(argv=None) -> int:
     eval_totals: dict[str, int] = {}
     failures = 0
     for seed in range(args.seeds):
-        cfg = chaos_scenario(
+        builder = SCENARIOS[args.scenario]
+        kwargs = dict(
             seed=seed,
             sim_minutes=args.sim_minutes,
             n_workers=args.workers,
-            density=args.density,
         )
+        if args.scenario == "chaos":
+            kwargs["density"] = args.density
+        cfg = builder(**kwargs)
         res = run_sim(cfg)
         row = {
             "seed": seed,
@@ -104,6 +123,7 @@ def main(argv=None) -> int:
     total_wall = sum(r["wall_seconds"] for r in results)
     doc = {
         "bench": "sim_sweep",
+        "scenario": args.scenario,
         "seeds": args.seeds,
         "sim_minutes_per_seed": args.sim_minutes,
         "workers": args.workers,

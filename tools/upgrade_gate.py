@@ -1,0 +1,164 @@
+"""Rolling-upgrade regression gate (ISSUE 18): the banked zero-downtime
+numbers are a FLOOR, not a souvenir.
+
+Re-runs ``benchmarks.upgrade_sweep`` fresh and compares it against the
+banked artifact (``benchmarks/upgrade_sweep.json``). The gate fails
+loudly (exit 1) when the rollout's guarantees erode:
+
+  * correctness is absolute — zero dropped streams in EVERY arm
+    (rollout, cold restart, rollback drill), all invariant suites
+    green, and each arm's digest bit-identical to the banked run (the
+    sim is a deterministic virtual-clock replay: ANY divergence means
+    tokens moved);
+  * the rollout must actually roll: full fleet replaced, zero
+    rollbacks, and the live KV handoff must have moved blocks — a
+    handoff-inactive rollout is a silent cold restart and fails;
+  * the successor prefill recompute ratio (cold/rollout) must stay
+    >= the 5x acceptance floor and retain (1 - tolerance) of the
+    banked value;
+  * rollout-window p50 TTFT must stay within 25% of steady state and
+    must not worsen past the banked delta by more than
+    tolerance x 100 percentage points;
+  * the rollback drill must still halt: exactly one rollback, zero
+    workers replaced, old fleet serving throughout.
+
+    JAX_PLATFORMS=cpu python -m tools.upgrade_gate
+
+``--update`` re-banks the fresh run as the new reference after an
+intentional scheduler/coordinator change.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+from benchmarks.upgrade_sweep import run_bench
+
+BANKED = "benchmarks/upgrade_sweep.json"
+RATIO_FLOOR = 5.0
+TTFT_BAR_PCT = 25.0
+
+
+def gate(fresh: dict, banked: dict, tolerance: float) -> list[str]:
+    """Return the list of failures (empty = gate passes)."""
+    fails: list[str] = []
+    for arm in ("rollout", "cold", "rollback_drill"):
+        f = fresh[arm]
+        if f["dropped_streams"]:
+            fails.append(f"{arm}: {f['dropped_streams']} dropped streams "
+                         "(want 0)")
+        if not f["ok"]:
+            fails.append(f"{arm}: invariant violations during the run")
+        if f["digest"] != banked[arm]["digest"]:
+            fails.append(
+                f"{arm}: token stream diverged from banked replay "
+                f"({f['digest'][:12]} vs {banked[arm]['digest'][:12]})"
+            )
+
+    r = fresh["rollout"]
+    if r["done"] != 1.0 or r["rollbacks"]:
+        fails.append("rollout did not complete cleanly "
+                     f"(done={r['done']}, rollbacks={r['rollbacks']})")
+    if r["replaced"] != fresh["cold"]["replaced"]:
+        fails.append("rollout and cold arms replaced different counts")
+    if r["handoff_blocks_pulled"] <= 0:
+        fails.append("live KV handoff inactive — zero blocks moved "
+                     "during the rollout")
+
+    ratio_new = fresh["prefill_recompute_ratio"]
+    ratio_old = banked["prefill_recompute_ratio"]
+    if ratio_new < RATIO_FLOOR:
+        fails.append(
+            f"prefill recompute ratio {ratio_new:.2f}x below the "
+            f"{RATIO_FLOOR:.0f}x acceptance floor"
+        )
+    elif ratio_new < ratio_old * (1 - tolerance):
+        fails.append(
+            "prefill recompute ratio eroded: "
+            f"{ratio_new:.2f}x vs banked {ratio_old:.2f}x "
+            f"(-{tolerance:.0%} allowed)"
+        )
+
+    # allowance in percentage POINTS, same rationale as mixed_gate: a
+    # relative bar on a small ratio would gate on jitter, not code
+    d_new = r["ttft_rollout_delta_pct"]
+    d_old = banked["rollout"]["ttft_rollout_delta_pct"]
+    allow_pp = 100.0 * tolerance
+    if d_new > TTFT_BAR_PCT:
+        fails.append(
+            f"rollout p50 TTFT {d_new:+.1f}% off steady state "
+            f"(bar {TTFT_BAR_PCT:.0f}%)"
+        )
+    elif d_new > d_old + allow_pp:
+        fails.append(
+            "rollout TTFT delta worsened: "
+            f"{d_new:+.1f}% vs banked {d_old:+.1f}% "
+            f"(+{allow_pp:.0f}pp allowed)"
+        )
+
+    drill = fresh["rollback_drill"]
+    if not drill["halted"] or drill["rollbacks"] != 1.0:
+        fails.append(
+            "rollback drill failed to halt+rollback "
+            f"(halted={drill['halted']}, rollbacks={drill['rollbacks']})"
+        )
+    if drill["replaced"]:
+        fails.append(
+            f"rollback drill replaced {drill['replaced']} workers "
+            "despite the halt (want 0)"
+        )
+    return fails
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--banked", default=BANKED)
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed relative regression (default 0.10)")
+    ap.add_argument("--update", action="store_true",
+                    help="re-bank the fresh run as the new reference")
+    args = ap.parse_args(argv)
+
+    banked_path = Path(args.banked)
+    if not banked_path.exists() and not args.update:
+        print(f"upgrade_gate: no banked artifact at {banked_path} "
+              "(run with --update to create it)")
+        return 1
+
+    fresh = run_bench()
+
+    for arm in ("rollout", "cold", "rollback_drill"):
+        print(json.dumps({arm: fresh[arm]}))
+    print(json.dumps({
+        "prefill_recompute_ratio": fresh["prefill_recompute_ratio"],
+    }))
+
+    if args.update:
+        with open(banked_path, "w") as f:
+            json.dump(fresh, f, indent=1)
+            f.write("\n")
+        print(f"upgrade_gate: banked {banked_path}")
+        return 0
+
+    with open(banked_path) as f:
+        banked = json.load(f)
+    fails = gate(fresh, banked, args.tolerance)
+    if fails:
+        for msg in fails:
+            print(f"upgrade_gate FAIL: {msg}")
+        return 1
+    print(
+        "upgrade_gate OK: recompute ratio "
+        f"{fresh['prefill_recompute_ratio']:.2f}x "
+        f"(banked {banked['prefill_recompute_ratio']:.2f}x), "
+        f"rollout ttft {fresh['rollout']['ttft_rollout_delta_pct']:+.1f}%"
+        f" (banked {banked['rollout']['ttft_rollout_delta_pct']:+.1f}%), "
+        "0 dropped streams in all arms"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
