@@ -17,17 +17,41 @@ Two halves, one artifact (`benchmarks/decode_mfu.json`, also reachable as
     greedy token streams, asserting fused-vs-unfused bit-identity and
     recording which quantization cells stay token-identical.
 
+A third arm (ISSUE 19) runs the MESHED matrix — tp in {1, 2, 4} x
+{fused, unfused} x {plain psum, collective overlap} — through both
+halves: `perf_model.meshed_decode_hbm_bytes_per_token` on the llama3-8b
+serve shape (per-chip HBM bytes/token + tp-axis collective bytes/step),
+and real decode steps on tp-sharded tiny runners (tp=4 uses a 4-kv-head
+tiny variant so the Megatron head split divides). `tools/mfu_gate.py`
+holds the bars against the banked artifact.
+
 Usage:
-    python -m benchmarks.decode_mfu_bench --json benchmarks/decode_mfu.json
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m benchmarks.decode_mfu_bench --json benchmarks/decode_mfu.json
 """
 
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+
+def _ensure_devices(n: int = 8) -> None:
+    """Force n virtual CPU devices for the meshed arm (no-op once jax is
+    imported, or when the flag is already set — e.g. under pytest)."""
+    if "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip()
+        )
 
 
 def modeled_matrix(batch: int = 64, context: int = 3328) -> dict:
@@ -55,31 +79,48 @@ def modeled_matrix(batch: int = 64, context: int = 3328) -> dict:
     }
 
 
-def _build_runner(quantize_weights: bool, kv_dtype: str, fused: bool):
+def _build_runner(
+    quantize_weights: bool, kv_dtype: str, fused: bool,
+    *, tp: int = 1, overlap: bool = False,
+):
     import jax
 
     from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
     from dynamo_tpu.models import llama
 
     cfg = llama.LlamaConfig.tiny()
+    if tp > cfg.num_kv_heads:
+        # tp=4 cell: the Megatron split needs kv_heads % tp == 0
+        cfg = dataclasses.replace(cfg, num_kv_heads=tp)
     params = llama.init_params(
         cfg, jax.random.PRNGKey(7), quantize=quantize_weights
     )
+    mesh = kv_sharding = None
+    if tp > 1:
+        from dynamo_tpu.parallel.mesh import build_mesh
+        from dynamo_tpu.parallel.sharding import shard_llama
+
+        mesh = build_mesh(tp=tp, dp=1)
+        params, kv_sharding = shard_llama(mesh, cfg, params)
     return ModelRunner(
         cfg, params,
         num_blocks=256, block_size=16, max_batch=8, max_model_len=512,
-        kv_dtype=kv_dtype, fused_decode=fused,
+        kv_dtype=kv_dtype, fused_decode=fused, collective_overlap=overlap,
+        mesh=mesh, kv_sharding=kv_sharding,
     )
 
 
 def measure_cell(
     quantize_weights: bool, kv_dtype: str, fused: bool,
     *, batch: int = 8, prompt: int = 96, steps: int = 32,
+    tp: int = 1, overlap: bool = False,
 ) -> dict:
     """Real decode steps on the tiny model: prefill `batch` identical
     prompts, run `steps` greedy decode steps, return tok/s + the token
     stream of lane 0 (for cross-cell identity checks)."""
-    runner = _build_runner(quantize_weights, kv_dtype, fused)
+    runner = _build_runner(
+        quantize_weights, kv_dtype, fused, tp=tp, overlap=overlap
+    )
     bs = runner.block_size
     rng = np.random.default_rng(3)
     prompt_ids = rng.integers(5, 250, prompt).tolist()
@@ -118,13 +159,17 @@ def measure_cell(
         stream.append(int(tokens[0]))
     dt = time.perf_counter() - t0
     timed_tokens = (len(stream) - timed_from) * batch
-    return {
+    out = {
         "weights": "int8" if quantize_weights else "bf16",
         "kv": kv_dtype,
         "fused": fused,
         "tok_s": round(timed_tokens / dt, 1),
         "stream": stream,
     }
+    if tp > 1 or overlap:
+        out["tp"] = tp
+        out["overlap"] = overlap
+    return out
 
 
 def measured_matrix(steps: int = 32) -> dict:
@@ -172,6 +217,123 @@ def measured_matrix(steps: int = 32) -> dict:
     }
 
 
+def meshed_modeled_matrix(batch: int = 64, context: int = 3328) -> dict:
+    """The meshed decode model on the production int8w+int8kv path:
+    per-chip HBM bytes/token and tp-axis collective bytes/step across
+    tp x {fused, unfused} x {psum, overlap}. Overlap cells only exist on
+    the fused tp>1 path (the gate in models/llama._use_overlap_tail)."""
+    from dynamo_tpu.engine.jax_engine import perf_model
+    from dynamo_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.llama3_8b()
+    cells = {}
+    for tp in (1, 2, 4):
+        for ftag, fused in (("unfused", False), ("fused", True)):
+            for otag, overlap in (("psum", False), ("overlap", True)):
+                if overlap and (not fused or tp == 1):
+                    continue
+                mb = perf_model.meshed_decode_hbm_bytes_per_token(
+                    cfg, batch=batch, context=context, block_size=16,
+                    tp=tp, weights_int8=True, kv_int8=True,
+                    fused=fused, overlap=overlap,
+                )
+                cells[f"tp{tp}+{ftag}+{otag}"] = mb.to_dict()
+    fused_le_unfused = {
+        f"tp{t}": (
+            cells[f"tp{t}+fused+psum"]["total_bytes_per_token"]
+            <= cells[f"tp{t}+unfused+psum"]["total_bytes_per_token"]
+        )
+        for t in (1, 2, 4)
+    }
+    overlap_hidden = {
+        f"tp{t}": cells[f"tp{t}+fused+overlap"]["overlap_hidden_fraction"]
+        for t in (2, 4)
+    }
+    collective_cut = {
+        f"tp{t}": round(
+            cells[f"tp{t}+fused+psum"]["tp_collective_bytes_per_step"]
+            / cells[f"tp{t}+fused+overlap"]["tp_collective_bytes_per_step"],
+            3,
+        )
+        for t in (2, 4)
+    }
+    return {
+        "model": "llama3-8b",
+        "batch": batch,
+        "context": context,
+        "weights": "int8",
+        "kv": "int8",
+        "cells": cells,
+        "fused_bytes_le_unfused": fused_le_unfused,
+        "overlap_hidden_fraction": overlap_hidden,
+        "collective_bytes_cut_overlap_vs_psum": collective_cut,
+    }
+
+
+def meshed_measured_matrix(steps: int = 32) -> dict:
+    """Real tp-sharded decode steps on the production int8w+int8kv cell:
+    greedy token identity fused-vs-unfused and overlap-vs-psum per tp,
+    plus whether the fused pallas programs actually traced under the
+    mesh (kernel-entry counted)."""
+    import jax
+
+    from dynamo_tpu.ops import linear as lin
+
+    ndev = len(jax.devices())
+    cells = []
+    kernel_entries = {}
+    for tp in (1, 2, 4):
+        if tp > ndev:
+            continue
+        for fused in (False, True):
+            variants = [(fused, False)]
+            if fused and tp > 1:
+                variants.append((fused, True))
+            for f, ov in variants:
+                lin.reset_fused_kernel_entries()
+                cells.append(
+                    measure_cell(True, "int8", f, tp=tp, overlap=ov,
+                                 steps=steps)
+                )
+                if f:
+                    e = dict(lin.FUSED_KERNEL_ENTRIES)
+                    tag = f"tp{tp}" + ("+overlap" if ov else "")
+                    kernel_entries[tag] = e
+
+    def _cell(tp, fused, overlap=False):
+        return next(
+            c for c in cells
+            if c.get("tp", 1) == tp and c["fused"] == fused
+            and c.get("overlap", False) == overlap
+        )
+
+    token_identical = {}
+    overlap_identical = {}
+    for tp in (1, 2, 4):
+        if tp > ndev:
+            continue
+        token_identical[f"tp{tp}"] = (
+            _cell(tp, False)["stream"] == _cell(tp, True)["stream"]
+        )
+        if tp > 1:
+            overlap_identical[f"tp{tp}"] = (
+                _cell(tp, True)["stream"]
+                == _cell(tp, True, overlap=True)["stream"]
+            )
+    for c in cells:
+        del c["stream"]
+    return {
+        "harness": "tiny-llama CPU (4 kv heads at tp=4), B=8, greedy, "
+        "int8 weights + int8 KV",
+        "steps": steps,
+        "devices": ndev,
+        "cells": cells,
+        "fused_token_identical": token_identical,
+        "overlap_token_identical": overlap_identical,
+        "fused_kernel_entries": kernel_entries,
+    }
+
+
 def main(argv=None) -> dict:
     ap = argparse.ArgumentParser()
     ap.add_argument("--json", default=None)
@@ -181,10 +343,13 @@ def main(argv=None) -> dict:
     ap.add_argument("--context", type=int, default=3328,
                     help="modeled serve-shape context")
     args = ap.parse_args(argv)
+    _ensure_devices()
     doc = {
         "bench": "decode_mfu",
         "modeled": modeled_matrix(args.batch, args.context),
         "measured": measured_matrix(args.steps),
+        "meshed_modeled": meshed_modeled_matrix(args.batch, args.context),
+        "meshed_measured": meshed_measured_matrix(args.steps),
     }
     # The fused kernels are bit-identical to the unfused ops in isolation
     # (tests/test_fused_decode.py proves it per-op); under ONE enclosing
@@ -195,10 +360,29 @@ def main(argv=None) -> dict:
     assert ident["int8+bf16"] and ident["int8+int8"], (
         f"fused int8-weights decode diverged from unfused: {ident}"
     )
+    # meshed bars (ISSUE 19): fused-vs-unfused and overlap-vs-psum must
+    # stay greedy-identical under every measured tp, and the fused
+    # programs must actually trace under the mesh
+    mm = doc["meshed_measured"]
+    assert all(mm["fused_token_identical"].values()), (
+        f"meshed fused decode diverged: {mm['fused_token_identical']}"
+    )
+    assert all(mm["overlap_token_identical"].values()), (
+        f"collective-overlap decode diverged: {mm['overlap_token_identical']}"
+    )
+    assert all(
+        e["qkv_rope"] > 0 and e["attn_out"] > 0
+        for e in mm["fused_kernel_entries"].values()
+    ), f"fused kernels inactive under mesh: {mm['fused_kernel_entries']}"
     print(json.dumps({
         "bytes_cut": doc["modeled"]["bytes_cut_vs_int8_weights_path"],
         "speedup": doc["measured"]["speedup_vs_int8_weights_path"],
         "fused_identical": doc["measured"]["fused_bit_identical"],
+        "meshed_fused_identical": mm["fused_token_identical"],
+        "overlap_identical": mm["overlap_token_identical"],
+        "overlap_hidden_fraction": doc["meshed_modeled"][
+            "overlap_hidden_fraction"
+        ],
     }))
     if args.json:
         with open(args.json, "w") as f:

@@ -621,6 +621,13 @@ class MetricsComponent:
             "mfu_decode_est",
             "Estimated decode MFU from windowed token rate (fleet mean)",
         )
+        # meshed decode (ISSUE 19): modeled tp-axis collective bytes per
+        # decode step (perf_model.tp_collective_bytes_per_step; 0 when
+        # unmeshed/tp=1)
+        self.g_tp_collective_bytes = g(
+            "tp_collective_bytes_per_step",
+            "Modeled tp-axis collective bytes per decode step (fleet mean)",
+        )
         # control-plane health of THIS process's fabric client (degraded-
         # mode data plane): same families every frontend exports for its
         # own client — federation distinguishes the processes by instance
@@ -820,6 +827,9 @@ class MetricsComponent:
                     agg.worker_stats.decode_hbm_bytes_per_token
                 )
                 self.g_mfu_decode.set(agg.worker_stats.mfu_decode_est)
+                self.g_tp_collective_bytes.set(
+                    agg.worker_stats.tp_collective_bytes_per_step
+                )
                 # burn-rate windows advance on every poll, with or without
                 # fresh phase data (recovery to ok needs empty ticks too)
                 self.slo.observe(

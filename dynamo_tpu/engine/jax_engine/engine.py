@@ -198,6 +198,9 @@ class EngineStats:
     # (engine/jax_engine/perf_model.py); both gauges
     decode_hbm_bytes_per_token: float = 0.0
     mfu_decode_est: float = 0.0
+    # meshed decode (ISSUE 19): modeled tp-axis collective bytes each
+    # decode step moves (0 off-mesh / tp=1); gauge
+    tp_collective_bytes_per_step: float = 0.0
     # QoS plane (ISSUE 7): per-class preemption counts (class-aware
     # KV-preserving preemption — bulk absorbs pressure first), storm-guard
     # kills, engine-side brownout sheds, and the live brownout rung
@@ -3244,16 +3247,24 @@ class JaxEngine:
             if isinstance(params, dict):
                 layers = params.get("layers") or [{}]
                 quant_w = isinstance(layers[0].get("wq"), dict)
-            bb = perf_model.decode_hbm_bytes_per_token(
+            mesh = getattr(self.runner, "mesh", None)
+            tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+            mb = perf_model.meshed_decode_hbm_bytes_per_token(
                 mcfg,
                 batch=len(active),
                 context=mean_ctx,
                 block_size=self.config.block_size,
+                tp=tp,
                 weights_int8=quant_w,
                 kv_int8=getattr(self.runner, "kv_quantized", False),
                 fused=getattr(mcfg, "fused_decode", False),
+                overlap=getattr(mcfg, "collective_overlap", False),
             )
-            self.stats.decode_hbm_bytes_per_token = bb.total
+            # per-CHIP bytes/token: tp=1 degenerates to the old model
+            self.stats.decode_hbm_bytes_per_token = mb.per_chip.total
+            self.stats.tp_collective_bytes_per_step = (
+                mb.tp_collective_bytes_per_step
+            )
         dt = now - win[0][0]
         if dt > 0.5:
             rate = (self.stats.generated_tokens - win[0][1]) / dt

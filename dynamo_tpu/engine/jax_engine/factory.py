@@ -81,6 +81,18 @@ async def build_jax_engine(
         quantize = os.environ.get("DYN_JAX_QUANTIZE_INT8", "0") in ("1", "true")
     kv_dtype = kv_dtype_from_env()
     fused_decode = fused_decode_from_env()
+    collective_overlap = collective_overlap_from_env()
+    if kv_dtype == "int8" and kv_block_size < 32:
+        # Mosaic's int8 sublane tile is (32, 128): a smaller block makes
+        # `_pallas_tileable(kv_bits=8)` silently route every serve-time
+        # decode through the XLA gather path, quietly forfeiting the
+        # int8-KV bandwidth win. Retune instead of degrading.
+        logger.warning(
+            "DYN_KV_DTYPE=int8 needs kv_block_size >= 32 for the pallas "
+            "int8 (32, 128) sublane tile; retuning kv_block_size %d -> 32",
+            kv_block_size,
+        )
+        kv_block_size = 32
     gguf_file = None
     if model_path.endswith(".gguf"):
         # GGUF weights+config (lib/llm/src/gguf/ equivalent); tokenizer
@@ -147,6 +159,7 @@ async def build_jax_engine(
         rng_seed=rng_seed,
         kv_dtype=kv_dtype,
         fused_decode=fused_decode,
+        collective_overlap=collective_overlap,
         mesh=mesh,
         kv_sharding=kv_sharding,
         global_arrays=is_multihost,
@@ -267,9 +280,21 @@ def kv_dtype_from_env() -> str:
 
 def fused_decode_from_env() -> bool:
     """DYN_FUSED_DECODE=1: fuse the decode step's norm+QKV+rope and
-    attn-out+O-proj+residual into one pallas program each (ops/linear.py).
-    Off by default until parity is proven per deployment."""
+    attn-out+O-proj+residual into one pallas program each (ops/linear.py;
+    shard_map'd over tp under a mesh — ops/collective.py). Off by default
+    until parity is proven per deployment."""
     return os.environ.get("DYN_FUSED_DECODE", "0") in ("1", "true", "yes")
+
+
+def collective_overlap_from_env() -> bool:
+    """DYN_COLLECTIVE_OVERLAP=1: decompose the meshed fused decode step's
+    tp all-reduces into reduce-scatter/all-gather rings pipelined against
+    the o-proj/MLP matmul chunks (ops/collective.fused_tail_overlap).
+    Token-identical to the plain psum path, not bit-identical (ring
+    summation order); inert without fused decode + a tp>1 mesh."""
+    return os.environ.get("DYN_COLLECTIVE_OVERLAP", "0") in (
+        "1", "true", "yes",
+    )
 
 
 def spec_decode_settings() -> dict:

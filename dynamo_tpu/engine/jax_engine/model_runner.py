@@ -91,6 +91,10 @@ class ModelRunner:
         # bf16/f32 cache dtype
         kv_dtype=jnp.bfloat16,
         fused_decode: bool = False,
+        # DYN_COLLECTIVE_OVERLAP: decomposed collective-matmul tail for
+        # the meshed fused decode step (ops/collective.fused_tail_overlap);
+        # inert without a tp>1 mesh + fused_decode
+        collective_overlap: bool = False,
         mesh: Optional[jax.sharding.Mesh] = None,
         kv_sharding: Optional[jax.sharding.NamedSharding] = None,
         attn_impl: str = "auto",
@@ -146,6 +150,8 @@ class ModelRunner:
         config = dataclasses.replace(
             config, attn_impl=attn_impl,
             fused_decode=bool(fused_decode) or config.fused_decode,
+            collective_overlap=bool(collective_overlap)
+            or config.collective_overlap,
         )
         self.config = config
         self.params = params

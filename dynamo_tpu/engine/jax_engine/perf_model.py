@@ -145,6 +145,109 @@ def mixed_step_hbm_bytes_per_token(
     )
 
 
+# Decomposed-collective byte accounting (ISSUE 19), in units of
+# u = (tp-1)/tp * B * hidden per layer: the plain psum path all-reduces
+# the o-proj and down-proj outputs in f32 (2 * 4u bytes each); the
+# overlap path decomposes each into a reduce-scatter + all-gather ring —
+# f32 scatter halves (4u) hidden behind the per-chunk o-proj/down-proj
+# matmuls, the bf16 normed-chunk gather (2u) hidden behind the gate/up
+# chunks, and only the final bf16 output gather (2u) exposed
+# (ops/collective.fused_tail_overlap mirrors exactly this schedule).
+_PLAIN_PSUM_UNITS = 16.0  # 2 all-reduces x 2 ring passes x 4 bytes
+_OVERLAP_UNITS = 12.0  # 4+2 (o-proj) + 4+2 (down-proj)
+_OVERLAP_HIDDEN_UNITS = 10.0  # all but the final output all-gather
+
+
+@dataclass
+class MeshedDecodeBreakdown:
+    """Per-chip decode traffic under a tp mesh + the tp-axis collective
+    stream (the `dyn_llm_tp_collective_bytes_per_step` gauge)."""
+
+    per_chip: DecodeBytesBreakdown
+    tp: int
+    tp_collective_bytes_per_step: float
+    overlap_hidden_fraction: float
+
+    @property
+    def exposed_collective_bytes_per_step(self) -> float:
+        return self.tp_collective_bytes_per_step * (
+            1.0 - self.overlap_hidden_fraction
+        )
+
+    def to_dict(self) -> dict:
+        d = self.per_chip.to_dict()
+        d.update(
+            tp=self.tp,
+            tp_collective_bytes_per_step=self.tp_collective_bytes_per_step,
+            overlap_hidden_fraction=self.overlap_hidden_fraction,
+            exposed_collective_bytes_per_step=(
+                self.exposed_collective_bytes_per_step
+            ),
+        )
+        return d
+
+
+def tp_collective_bytes_per_step(
+    config, *, batch: int, tp: int, overlap: bool = False
+) -> tuple[float, float]:
+    """(bytes, hidden_fraction) the tp axis moves per decode STEP (whole
+    batch). Plain psum: two f32 all-reduces of [B, hidden] per layer,
+    nothing hidden. Decomposed (DYN_COLLECTIVE_OVERLAP): fewer bytes
+    (bf16 gather halves) and ~10/12 of them pipelined behind matmul
+    chunks — see the unit accounting above."""
+    if tp <= 1:
+        return 0.0, 0.0
+    u = (tp - 1) / tp * batch * config.hidden_size
+    per_layer = (_OVERLAP_UNITS if overlap else _PLAIN_PSUM_UNITS) * u
+    hidden = (
+        _OVERLAP_HIDDEN_UNITS / _OVERLAP_UNITS if overlap else 0.0
+    )
+    return config.num_layers * per_layer, hidden
+
+
+def meshed_decode_hbm_bytes_per_token(
+    config,
+    *,
+    batch: int,
+    context: float,
+    block_size: int = 16,
+    tp: int = 1,
+    weights_int8: bool = False,
+    kv_int8: bool = False,
+    fused: bool = False,
+    overlap: bool = False,
+) -> MeshedDecodeBreakdown:
+    """The meshed decode model: per-chip HBM bytes/token (the Megatron
+    split divides weight and KV streams by tp; the replicated activation
+    round-trips do not divide) plus the tp-axis collective bytes/step.
+    tp=1 degenerates to `decode_hbm_bytes_per_token` exactly."""
+    base = decode_hbm_bytes_per_token(
+        config,
+        batch=batch,
+        context=context,
+        block_size=block_size,
+        weights_int8=weights_int8,
+        kv_int8=kv_int8,
+        fused=fused,
+    )
+    t = max(1, tp)
+    per_chip = DecodeBytesBreakdown(
+        weight_bytes_per_token=base.weight_bytes_per_token / t,
+        kv_bytes_per_token=base.kv_bytes_per_token / t,
+        kv_scale_bytes_per_token=base.kv_scale_bytes_per_token / t,
+        activation_bytes_per_token=base.activation_bytes_per_token,
+    )
+    coll, hidden = tp_collective_bytes_per_step(
+        config, batch=batch, tp=t, overlap=overlap
+    )
+    return MeshedDecodeBreakdown(
+        per_chip=per_chip,
+        tp=t,
+        tp_collective_bytes_per_step=coll,
+        overlap_hidden_fraction=hidden,
+    )
+
+
 def mfu_decode_est(
     config, tok_s_per_chip: float, peak_flops: float = DEFAULT_PEAK_FLOPS
 ) -> float:

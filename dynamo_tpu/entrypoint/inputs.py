@@ -672,6 +672,9 @@ async def run_endpoint(
                     "decode_hbm_bytes_per_token", 0.0
                 ),
                 mfu_decode_est=d.get("mfu_decode_est", 0.0),
+                tp_collective_bytes_per_step=d.get(
+                    "tp_collective_bytes_per_step", 0.0
+                ),
             ),
             kv_stats=KvStats(
                 kv_active_blocks=used,

@@ -143,6 +143,9 @@ class WorkerStats:
     # decode-MFU estimate (engine/jax_engine/perf_model.py)
     decode_hbm_bytes_per_token: float = 0.0
     mfu_decode_est: float = 0.0
+    # meshed decode (ISSUE 19, gauge): modeled tp-axis collective bytes
+    # per decode step (0 off-mesh / tp=1)
+    tp_collective_bytes_per_step: float = 0.0
     # fleet prefix cache (ISSUE 17): prefix blocks this worker pulled
     # from peers instead of recomputing, by outcome (pulled /
     # fallback_miss / fallback_timeout / fallback_integrity /

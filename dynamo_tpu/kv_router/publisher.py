@@ -263,6 +263,9 @@ class KvMetricsAggregator:
                 m.worker_stats.decode_hbm_bytes_per_token
             )
             agg.worker_stats.mfu_decode_est += m.worker_stats.mfu_decode_est
+            agg.worker_stats.tp_collective_bytes_per_step += (
+                m.worker_stats.tp_collective_bytes_per_step
+            )
             if m.worker_stats.preemptions_by_class:
                 if agg.worker_stats.preemptions_by_class is None:
                     agg.worker_stats.preemptions_by_class = {}
@@ -334,4 +337,5 @@ class KvMetricsAggregator:
             agg.kv_stats.gpu_prefix_cache_hit_rate /= n
             agg.worker_stats.decode_hbm_bytes_per_token /= n
             agg.worker_stats.mfu_decode_est /= n
+            agg.worker_stats.tp_collective_bytes_per_step /= n
         return agg

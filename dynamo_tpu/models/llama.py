@@ -77,7 +77,16 @@ class LlamaConfig:
     # unsharded decode path of plain/bias models (qk-norm and sandwich
     # norms fall back to the unfused head); bit-identical by construction
     # (ops/linear.py fused kernels mirror the unfused op sequence).
+    # Under a mesh (ISSUE 19) the fused programs run per-shard via
+    # shard_map over the tp axis (ops/collective.py) whenever the head
+    # counts divide tp; qk-norm and sandwich-norm layers still fall back.
     fused_decode: bool = False
+    # DYN_COLLECTIVE_OVERLAP: decompose the meshed decode step's two
+    # per-layer tp all-reduces into reduce-scatter/all-gather rings
+    # pipelined against the o-proj/MLP matmul chunks
+    # (ops/collective.fused_tail_overlap). Token-identical to the plain
+    # psum path (ring summation reorders f32 adds); inert off-mesh.
+    collective_overlap: bool = False
     # Sliding-window attention (Mistral / Gemma2 / Gemma3 local layers):
     # token i attends to (i-window, i]. None = full attention. The paged
     # cache still stores every position (the mask, not a rolling buffer,
@@ -386,15 +395,45 @@ def _attn_prefill(x, layer, cfg, inv_freqs, positions, valid_len, k_cache_l, v_c
 
 
 def _use_fused_decode(cfg, layer, mesh) -> bool:
-    """Fused decode applies when enabled, for the unsharded path, and for
-    layers the fused heads cover exactly (no per-head qk-norm, no
-    sandwich post-attention norm). Independent of the attention kernel
-    choice — the fused projections are their own pallas programs."""
-    return (
-        cfg.fused_decode
-        and mesh is None
-        and "q_norm" not in layer
-        and "post_attn_norm" not in layer
+    """Fused decode applies when enabled and for layers the fused heads
+    cover exactly (no per-head qk-norm, no sandwich post-attention norm).
+    Independent of the attention kernel choice — the fused projections
+    are their own pallas programs. Under a mesh (ISSUE 19) the fused
+    programs run per-shard via shard_map over the tp axis whenever the
+    Megatron head split divides evenly; a mesh without a tp axis (or with
+    indivisible heads) falls back unfused."""
+    if (
+        not cfg.fused_decode
+        or "q_norm" in layer
+        or "post_attn_norm" in layer
+    ):
+        return False
+    if mesh is None:
+        return True
+    tp = mesh.shape.get("tp", 0)
+    return bool(
+        tp and cfg.num_heads % tp == 0 and cfg.num_kv_heads % tp == 0
+    )
+
+
+def _use_overlap_tail(cfg, layer, mesh) -> bool:
+    """The decomposed collective-matmul tail replaces BOTH the fused
+    o-proj and the dense MLP, so it needs a real tp axis, a plain dense
+    FFN (no MoE router, no Gemma post-MLP sandwich norm), and evenly
+    divisible feature dims for the ring chunks."""
+    if not (
+        cfg.collective_overlap
+        and mesh is not None
+        and _use_fused_decode(cfg, layer, mesh)
+        and "router" not in layer
+        and "post_mlp_norm" not in layer
+    ):
+        return False
+    tp = mesh.shape.get("tp", 0)
+    return bool(
+        tp > 1
+        and cfg.hidden_size % tp == 0
+        and cfg.intermediate_size % tp == 0
     )
 
 
@@ -407,23 +446,58 @@ def _fused_interpret(cfg) -> bool:
     )
 
 
-def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, block_tables, slot_indices, mesh=None, head_axis=None, li=0):
+def _fused_qkv_dispatch(x, layer, cfg, inv_freqs, positions, mesh):
+    """The fused norm+QKV+RoPE program, shard_map'd over tp under a mesh
+    (ops/collective.py) and direct otherwise. cos/sin are computed
+    exactly as apply_rope's angle formula; the rotation itself runs
+    inside the fused program."""
+    interp = _fused_interpret(cfg)
+    angles = positions[..., None].astype(jnp.float32) * inv_freqs
+    kwargs = dict(
+        eps=cfg.rms_eps,
+        num_heads=cfg.num_heads,
+        num_kv_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        bq=layer.get("bq"), bk=layer.get("bk"), bv=layer.get("bv"),
+        interpret=interp,
+    )
+    if mesh is not None:
+        from dynamo_tpu.ops.collective import fused_qkv_rope_meshed
+
+        return fused_qkv_rope_meshed(
+            mesh, x, layer["attn_norm"],
+            layer["wq"], layer["wk"], layer["wv"],
+            jnp.cos(angles), jnp.sin(angles), **kwargs,
+        )
+    return fused_qkv_rope(
+        x, layer["attn_norm"], layer["wq"], layer["wk"], layer["wv"],
+        jnp.cos(angles), jnp.sin(angles), **kwargs,
+    )
+
+
+def _fused_out_dispatch(attn_flat, layer, cfg, x, mesh):
+    """The fused o-proj+residual program, meshed (f32 psum before the
+    scale/cast/residual) or direct."""
+    if mesh is not None:
+        from dynamo_tpu.ops.collective import fused_attn_out_residual_meshed
+
+        return fused_attn_out_residual_meshed(
+            mesh, attn_flat, layer["wo"], x,
+            interpret=_fused_interpret(cfg),
+        )
+    return fused_attn_out_residual(
+        attn_flat, layer["wo"], x, interpret=_fused_interpret(cfg)
+    )
+
+
+def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, block_tables, slot_indices, mesh=None, head_axis=None, li=0, overlap_tail=False):
+    """One layer's decode attention. With ``overlap_tail`` (gated by
+    `_use_overlap_tail`) the layer's whole post-attention tail — o-proj,
+    residual, MLP — runs as the decomposed collective-matmul program and
+    the returned x is already post-MLP (the caller skips `_mlp`)."""
     fused = _use_fused_decode(cfg, layer, mesh)
     if fused:
-        interp = _fused_interpret(cfg)
-        # cos/sin computed exactly as apply_rope's angle formula; the
-        # rotation itself runs inside the fused program
-        angles = positions[..., None].astype(jnp.float32) * inv_freqs
-        q, k, v = fused_qkv_rope(
-            x, layer["attn_norm"], layer["wq"], layer["wk"], layer["wv"],
-            jnp.cos(angles), jnp.sin(angles),
-            eps=cfg.rms_eps,
-            num_heads=cfg.num_heads,
-            num_kv_heads=cfg.num_kv_heads,
-            head_dim=cfg.head_dim,
-            bq=layer.get("bq"), bk=layer.get("bk"), bv=layer.get("bv"),
-            interpret=interp,
-        )
+        q, k, v = _fused_qkv_dispatch(x, layer, cfg, inv_freqs, positions, mesh)
     else:
         q, k, v = _qkv(x, layer, cfg, inv_freqs, positions)
     k_cache_l, v_cache_l = write_decode_kv(k_cache_l, v_cache_l, k, v, slot_indices)
@@ -434,10 +508,18 @@ def _attn_decode(x, layer, cfg, inv_freqs, positions, k_cache_l, v_cache_l, bloc
         logit_softcap=cfg.attn_logit_softcap,
     )
     if fused:
-        out = fused_attn_out_residual(
-            attn.reshape(x.shape[0], cfg.q_dim), layer["wo"], x,
-            interpret=_fused_interpret(cfg),
-        )
+        attn_flat = attn.reshape(x.shape[0], cfg.q_dim)
+        if overlap_tail:
+            from dynamo_tpu.ops.collective import fused_tail_overlap
+
+            out = fused_tail_overlap(
+                mesh, attn_flat, layer["wo"], x, layer["mlp_norm"],
+                layer["wg"], layer["wu"], layer["wd"],
+                eps=cfg.rms_eps, mlp_act=cfg.mlp_act,
+                interpret=_fused_interpret(cfg),
+            )
+        else:
+            out = _fused_out_dispatch(attn_flat, layer, cfg, x, mesh)
         return out, k_cache_l, v_cache_l
     return _attn_out(attn, x, layer, cfg), k_cache_l, v_cache_l
 
@@ -790,7 +872,15 @@ def decode_verify(
     slots_flat = slot_indices.reshape(-1)
     x = _embed(params, cfg, tokens.reshape(-1))  # [B*S, hidden]
     for i, layer in enumerate(params["layers"]):
-        q, k, v = _qkv(x, layer, cfg, _layer_freqs(cfg, i, freqs), pos_flat)
+        fused = _use_fused_decode(cfg, layer, mesh)
+        lf = _layer_freqs(cfg, i, freqs)
+        if fused:
+            # the fused kernels are row-count generic: the verify window's
+            # flat [B*S] rows ride the same norm+QKV+RoPE program decode
+            # uses (meshed via shard_map under a mesh)
+            q, k, v = _fused_qkv_dispatch(x, layer, cfg, lf, pos_flat, mesh)
+        else:
+            q, k, v = _qkv(x, layer, cfg, lf, pos_flat)
         kc, vc = write_decode_kv(
             cache_layer(k_cache, i), cache_layer(v_cache, i), k, v,
             slots_flat,
@@ -802,7 +892,12 @@ def decode_verify(
             logit_softcap=cfg.attn_logit_softcap,
             impl=cfg.attn_impl, mesh=mesh, head_axis=attn_head_axis,
         )
-        x = _attn_out(attn.reshape(B * S, cfg.num_heads, cfg.head_dim), x, layer, cfg)
+        if fused:
+            x = _fused_out_dispatch(
+                attn.reshape(B * S, cfg.q_dim), layer, cfg, x, mesh
+            )
+        else:
+            x = _attn_out(attn.reshape(B * S, cfg.num_heads, cfg.head_dim), x, layer, cfg)
         x = _mlp(x, layer, cfg, mesh)
         k_cache = cache_set_layer(k_cache, i, kc)
         v_cache = cache_set_layer(v_cache, i, vc)
@@ -826,13 +921,16 @@ def decode(
     freqs = _rope_pair(cfg)
     x = _embed(params, cfg, tokens)
     for i, layer in enumerate(params["layers"]):
+        overlap = _use_overlap_tail(cfg, layer, mesh)
         x, kc, vc = _attn_decode(
             x, layer, cfg, _layer_freqs(cfg, i, freqs), positions,
             cache_layer(k_cache, i), cache_layer(v_cache, i),
             block_tables, slot_indices,
             mesh=mesh, head_axis=attn_head_axis, li=i,
+            overlap_tail=overlap,
         )
         k_cache = cache_set_layer(k_cache, i, kc)
         v_cache = cache_set_layer(v_cache, i, vc)
-        x = _mlp(x, layer, cfg, mesh)
+        if not overlap:  # the overlap tail already ran the MLP
+            x = _mlp(x, layer, cfg, mesh)
     return _logits(x, params, cfg), k_cache, v_cache

@@ -82,6 +82,18 @@ def maybe_quantize(w: jax.Array, quantize: bool) -> Params:
 # o-proj -> residual (2+). These two kernels collapse them; the weight
 # dequant rides the operand load exactly like the unfused path.
 
+# Trace-time fused-kernel entry counters: bumped every time a fused
+# wrapper is TRACED into a program (once per compile, not per step — jit
+# caches traces). tests/test_meshed_fused.py and tools/mfu_gate.py reset
+# then read these to prove a meshed decode program actually contains the
+# fused kernels instead of silently falling back to the unfused op chain.
+FUSED_KERNEL_ENTRIES: dict = {"qkv_rope": 0, "attn_out": 0}
+
+
+def reset_fused_kernel_entries() -> None:
+    for key in FUSED_KERNEL_ENTRIES:
+        FUSED_KERNEL_ENTRIES[key] = 0
+
 
 def _wq_parts(w: Params):
     """(mantissas/weights, scale | None) for a maybe-quantized weight."""
@@ -213,6 +225,7 @@ def fused_qkv_rope(
     Returns (q [B, Hq, D], k [B, Hkv, D], v [B, Hkv, D]) — exactly what
     ops/layers.qkv_head produces for non-qk-norm models, bit-identical
     when block_in covers the whole hidden dim (the default)."""
+    FUSED_KERNEL_ENTRIES["qkv_rope"] += 1
     B, H = x.shape
     q_dim = num_heads * head_dim
     kv_dim = num_kv_heads * head_dim
@@ -284,12 +297,13 @@ def _fused_out_kernel(
     quantized: bool,
     n_tiles: int,
     block_in: int,
+    partial_out: bool,
 ):
     it = iter(refs)
     a_ref = next(it)  # [B, q_dim] attention output (flat)
     wo_ref = next(it)  # [blk, hidden]
     so_ref = next(it) if quantized else None
-    x_ref = next(it)  # [B, hidden] residual input
+    x_ref = None if partial_out else next(it)  # [B, hidden] residual input
     o_ref = next(it)  # [B, hidden]
     acc = next(it)
 
@@ -304,30 +318,45 @@ def _fused_out_kernel(
 
     @pl.when(j == n_tiles - 1)
     def _emit():
-        y = _finish(
-            acc[...], so_ref[...] if quantized else None, None, x_ref.dtype
-        )
-        o_ref[...] = x_ref[...] + y
+        if partial_out:
+            # raw f32 partial product: the meshed caller reduces across
+            # the tp axis BEFORE the scale/cast/residual elementwise,
+            # mirroring where GSPMD places the all-reduce
+            o_ref[...] = acc[...]
+        else:
+            y = _finish(
+                acc[...], so_ref[...] if quantized else None, None,
+                x_ref.dtype,
+            )
+            o_ref[...] = x_ref[...] + y
 
 
 def fused_attn_out_residual(
     attn: jax.Array,  # [B, q_dim] flattened attention output
     wo: Params,
-    x: jax.Array,  # [B, hidden] residual stream
+    x: Optional[jax.Array] = None,  # [B, hidden] residual stream
     *,
+    partial_out: bool = False,
     block_in: Optional[int] = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Attention-output projection + residual add in ONE pallas program
     (ops/layers.attn_out for non-sandwich-norm models); bit-identical with
-    a single contraction tile."""
+    a single contraction tile.
+
+    With ``partial_out=True`` (the meshed tensor-parallel path) the kernel
+    emits the RAW f32 partial product — no scale, no residual — and the
+    caller psums/reduce-scatters across the tp axis before finishing.
+    ``x`` is unused in that mode (the residual adds after the reduction)
+    and the int8 scale, being per-output-channel, also applies after."""
+    FUSED_KERNEL_ENTRIES["attn_out"] += 1
     B, q_dim = attn.shape
-    H = x.shape[1]
+    wo_q, wo_s = _wq_parts(wo)
+    H = wo_q.shape[1]
     blk = q_dim if block_in is None else min(block_in, q_dim)
     assert q_dim % blk == 0, (q_dim, blk)
     n_tiles = q_dim // blk
-    wo_q, wo_s = _wq_parts(wo)
-    quantized = wo_s is not None
+    quantized = wo_s is not None and not partial_out
 
     full = lambda shape: pl.BlockSpec(shape, lambda j: (0,) * len(shape))
     in_specs = [
@@ -338,8 +367,10 @@ def fused_attn_out_residual(
     if quantized:
         in_specs.append(full((H,)))
         args.append(wo_s)
-    in_specs.append(full((B, H)))
-    args.append(x)
+    if not partial_out:
+        in_specs.append(full((B, H)))
+        args.append(x)
+    out_dtype = jnp.float32 if partial_out else x.dtype
 
     from jax.experimental.pallas import tpu as pltpu
 
@@ -349,11 +380,12 @@ def fused_attn_out_residual(
             quantized=quantized,
             n_tiles=n_tiles,
             block_in=blk,
+            partial_out=partial_out,
         ),
         grid=(n_tiles,),
         in_specs=in_specs,
         out_specs=full((B, H)),
-        out_shape=jax.ShapeDtypeStruct((B, H), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H), out_dtype),
         scratch_shapes=[pltpu.VMEM((B, H), jnp.float32)],
         interpret=interpret,
     )

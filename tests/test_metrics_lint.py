@@ -456,6 +456,28 @@ def test_prefix_cache_families_present_with_correct_types():
         assert fam is not None and fam.type == "counter", name
 
 
+def test_meshed_decode_families_present_with_correct_types():
+    """ISSUE 19: the meshed-decode bandwidth families must exist with the
+    right semantics on the metrics component (fleet merge of the per-worker
+    perf model) — all three are modeled gauges. The tp-collective gauge is
+    component-only: it is derived from worker stats, never from frontend
+    dispatch or router state."""
+    regs = _all_registries()
+    by_role = {
+        role: {f.name: f for f in _families(reg)}
+        for role, reg in regs.items()
+    }
+    for name in (
+        "dyn_llm_decode_hbm_bytes_per_token",
+        "dyn_llm_mfu_decode_est",
+        "dyn_llm_tp_collective_bytes_per_step",
+    ):
+        fam = by_role["component"].get(name)
+        assert fam is not None and fam.type == "gauge", name
+    for role in ("frontend", "router"):
+        assert "dyn_llm_tp_collective_bytes_per_step" not in by_role[role], role
+
+
 def test_every_family_has_help_text():
     problems = []
     for role, registry in _all_registries().items():

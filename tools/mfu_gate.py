@@ -1,0 +1,162 @@
+"""Decode-MFU regression gate (ISSUE 19): the banked decode-bandwidth
+matrix is a FLOOR, not a souvenir.
+
+Re-runs ``benchmarks.decode_mfu_bench`` fresh and compares it against the
+banked artifact (``benchmarks/decode_mfu.json``). The gate fails loudly
+(exit 1) when the meshed fused decode win erodes:
+
+  * correctness is absolute — fused-vs-unfused greedy streams must stay
+    identical on the int8-weights cells (single-device AND every measured
+    tp), and overlap-vs-psum must stay identical per tp;
+  * the meshed fused path must be ACTIVE — each measured fused tp cell
+    must have traced both fused pallas programs (kernel-entry counted);
+    a silent fall-back to the unfused chain is exactly the regression the
+    old `mesh is None` gate shipped;
+  * the modeled per-chip HBM bytes/token of every meshed cell must not
+    exceed its banked value by more than --tolerance (relative, default
+    10%), and meshed-fused must never model MORE per-chip bytes/token
+    than unfused-meshed at the same tp;
+  * the modeled overlap path must keep >= 50% of the tp collective
+    bytes/step hidden behind matmul chunks, and must not move MORE
+    collective bytes than the plain-psum path it replaces.
+
+Modeled numbers are deterministic functions of the config, so their bars
+are machine-stable; measured tok/s is recorded but NOT gated (CPU
+interpret-mode throughput says nothing about TPU decode bandwidth).
+
+    JAX_PLATFORMS=cpu python -m tools.mfu_gate
+
+``--update`` re-banks the fresh run as the new reference after an
+intentional perf-model or kernel change.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from pathlib import Path
+
+BANKED = "benchmarks/decode_mfu.json"
+
+
+def gate(fresh: dict, banked: dict, tolerance: float) -> list[str]:
+    """Return the list of failures (empty = gate passes)."""
+    fails: list[str] = []
+
+    # --- token identity (absolute) -------------------------------------
+    ident = fresh["measured"]["fused_bit_identical"]
+    for cell in ("int8+bf16", "int8+int8"):
+        if not ident.get(cell):
+            fails.append(f"fused decode diverged on {cell}")
+    mm = fresh["meshed_measured"]
+    for tp, ok in mm["fused_token_identical"].items():
+        if not ok:
+            fails.append(f"meshed fused decode diverged at {tp}")
+    for tp, ok in mm["overlap_token_identical"].items():
+        if not ok:
+            fails.append(f"collective-overlap decode diverged at {tp}")
+
+    # --- fused path active under the mesh (absolute) -------------------
+    entries = mm.get("fused_kernel_entries", {})
+    if not entries:
+        fails.append("no fused kernel entries recorded — meshed fused "
+                     "path never traced")
+    for tag, e in entries.items():
+        if e.get("qkv_rope", 0) <= 0 or e.get("attn_out", 0) <= 0:
+            fails.append(f"meshed fused path inactive at {tag}: {e}")
+
+    # --- modeled per-chip bytes/token vs banked ------------------------
+    fresh_cells = fresh["meshed_modeled"]["cells"]
+    banked_cells = banked["meshed_modeled"]["cells"]
+    for name, cell in fresh_cells.items():
+        old = banked_cells.get(name)
+        if old is None:
+            continue
+        new_b = cell["total_bytes_per_token"]
+        old_b = old["total_bytes_per_token"]
+        if new_b > old_b * (1 + tolerance):
+            fails.append(
+                f"modeled per-chip bytes/token regressed at {name}: "
+                f"{new_b:.3e} vs banked {old_b:.3e} "
+                f"(+{tolerance:.0%} allowed)"
+            )
+    for tp, ok in fresh["meshed_modeled"]["fused_bytes_le_unfused"].items():
+        if not ok:
+            fails.append(
+                f"meshed fused models MORE per-chip bytes/token than "
+                f"unfused at {tp}"
+            )
+
+    # --- collective overlap bars ---------------------------------------
+    for tp, frac in fresh["meshed_modeled"]["overlap_hidden_fraction"].items():
+        if frac < 0.5:
+            fails.append(
+                f"overlap hides only {frac:.0%} of tp collective "
+                f"bytes/step at {tp} (bar: 50%)"
+            )
+    for tp, cut in fresh["meshed_modeled"][
+        "collective_bytes_cut_overlap_vs_psum"
+    ].items():
+        if cut < 1.0:
+            fails.append(
+                f"decomposed overlap moves MORE collective bytes than "
+                f"plain psum at {tp} ({cut}x)"
+            )
+
+    # --- the headline single-device ratio must not erode ---------------
+    cut_new = fresh["modeled"]["bytes_cut_vs_int8_weights_path"]
+    cut_old = banked["modeled"]["bytes_cut_vs_int8_weights_path"]
+    if cut_new < cut_old * (1 - tolerance) and cut_new < 1.6:
+        fails.append(
+            f"bytes_cut_vs_int8_weights_path collapsed: {cut_new} vs "
+            f"banked {cut_old} (floor 1.6x)"
+        )
+    return fails
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--banked", default=BANKED)
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed relative regression (default 0.10)")
+    ap.add_argument("--update", action="store_true",
+                    help="re-bank the fresh run as the new reference")
+    ap.add_argument("--steps", type=int, default=32)
+    args = ap.parse_args(argv)
+
+    banked_path = Path(args.banked)
+    if not banked_path.exists() and not args.update:
+        print(f"mfu_gate: no banked artifact at {banked_path} "
+              "(run with --update to create it)")
+        return 1
+
+    from benchmarks.decode_mfu_bench import main as bench_main
+
+    fresh = bench_main(["--steps", str(args.steps)])
+
+    if args.update:
+        with open(banked_path, "w") as f:
+            json.dump(fresh, f, indent=1)
+            f.write("\n")
+        print(f"mfu_gate: banked {banked_path}")
+        return 0
+
+    with open(banked_path) as f:
+        banked = json.load(f)
+    fails = gate(fresh, banked, args.tolerance)
+    if fails:
+        for msg in fails:
+            print(f"mfu_gate FAIL: {msg}")
+        return 1
+    mm = fresh["meshed_modeled"]
+    print(
+        "mfu_gate OK: bytes_cut "
+        f"{fresh['modeled']['bytes_cut_vs_int8_weights_path']}x, "
+        f"meshed fused identical {fresh['meshed_measured']['fused_token_identical']}, "
+        f"overlap hidden {mm['overlap_hidden_fraction']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

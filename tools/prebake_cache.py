@@ -40,6 +40,7 @@ def _build_runner(args):
     import jax
 
     from dynamo_tpu.engine.jax_engine.factory import (
+        collective_overlap_from_env,
         fused_decode_from_env,
         kv_dtype_from_env,
     )
@@ -62,6 +63,18 @@ def _build_runner(args):
             args.context_length or config.max_position_embeddings,
             config.max_position_embeddings,
         )
+    # meshed bake (ISSUE 19): --tp/--dp compile the SAME label set over the
+    # serving mesh — sharded params, sharded KV, and (with DYN_FUSED_DECODE /
+    # DYN_COLLECTIVE_OVERLAP) the shard_map'd fused decode programs. Labels
+    # are unchanged: the mesh changes the compiled artifact, not the
+    # taxonomy, so the prebake manifest stays closed.
+    mesh = kv_sharding = None
+    if args.tp > 1 or args.dp > 1:
+        from dynamo_tpu.parallel.mesh import build_mesh
+        from dynamo_tpu.parallel.sharding import shard_llama
+
+        mesh = build_mesh(tp=args.tp, dp=args.dp)
+        params, kv_sharding = shard_llama(mesh, config, params)
     return ModelRunner(
         config,
         params,
@@ -71,6 +84,9 @@ def _build_runner(args):
         max_model_len=max_len,
         kv_dtype=kv_dtype_from_env(),
         fused_decode=fused_decode_from_env(),
+        collective_overlap=collective_overlap_from_env(),
+        mesh=mesh,
+        kv_sharding=kv_sharding,
     )
 
 
@@ -234,6 +250,12 @@ def main(argv=None) -> dict:
     ap.add_argument("--kv-block-size", type=int, default=16)
     ap.add_argument("--num-blocks", type=int, default=128)
     ap.add_argument("--context-length", type=int, default=None)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="bake over a tp-axis mesh (sharded params/KV; "
+                    "with DYN_FUSED_DECODE=1 the shard_map'd fused "
+                    "decode programs)")
+    ap.add_argument("--dp", type=int, default=1,
+                    help="data-parallel mesh axis for the bake")
     ap.add_argument("--decode-horizon", type=int, default=None)
     ap.add_argument("--spec-k", type=int,
                     default=int(os.environ.get("DYN_SPEC_K", "0") or 0))
