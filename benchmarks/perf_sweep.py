@@ -261,6 +261,7 @@ def main() -> None:
             "canonical", "swa", "chaos", "disagg", "trace", "slo",
             "priority", "integrity", "decode_mfu", "blackout", "planner",
             "tail", "goodput", "sim", "mixed", "prefix", "upgrade",
+            "provenance",
         ],
         default=None,
         help="canonical = the reference's genai-perf workload "
@@ -337,7 +338,12 @@ def main() -> None:
         "ratio, rollout-window p50 TTFT vs steady state, zero dropped "
         "streams — plus the forced successor-crash halt+rollback drill; "
         "banked artifact benchmarks/upgrade_sweep.json, gated by "
-        "tools/upgrade_gate.py)",
+        "tools/upgrade_gate.py). "
+        "provenance = delegates to benchmarks.provenance_bench (decision-"
+        "ledger overhead: DYN_DECISIONS on/off throughput delta <=2%, "
+        "ns/decision on the enabled record path, disabled fast-path "
+        "ns/op, and decision completeness 1.0 over the four workload "
+        "kinds; banked artifact benchmarks/provenance_sweep.json)",
     )
     ap.add_argument("--json", default=None)
     args = ap.parse_args()
@@ -470,6 +476,16 @@ def main() -> None:
 
         prefix_sweep.main(
             ["--json", args.json or "benchmarks/prefix_sweep.json"]
+        )
+        return
+    if args.preset == "provenance":
+        # decision-ledger overhead sweep runs on the mocker + real
+        # admission/QoS surfaces directly (no HTTP frontend) — one entry
+        # point for every banked curve stays `perf_sweep --preset X`
+        from benchmarks import provenance_bench
+
+        provenance_bench.main(
+            ["--json", args.json or "benchmarks/provenance_sweep.json"]
         )
         return
     if args.preset == "slo":

@@ -1,0 +1,236 @@
+"""Decision-ledger overhead bench: throughput with DYN_DECISIONS off vs on.
+
+The provenance plane's contract mirrors the tracer's (ISSUE 13): every
+instrumentation point is one module-flag check, so `DYN_DECISIONS=0`
+must not measurably regress serving, and the always-on default must stay
+within a couple of percent. The workload runs mocker engines at a huge
+speedup ratio (simulated sleeps vanish; the measurement is host
+scheduling work — the path the ledger actually rides) through the REAL
+instrumented components: the frontend AdmissionController, the QoS
+priority stamp, and a deliberately KV-starved engine so preemption /
+re-admission decisions fire. This bench banks:
+
+  * token throughput with the ledger DISABLED vs ENABLED, and the
+    on/off delta (`enabled_overhead_frac`) — informational: wall-clock
+    A/B on a shared box carries scheduler noise far above the effect
+    size, so the ENFORCED ≤2% bar is `derived_overhead_frac`, the
+    fraction of the enabled run's wall time spent in `record()`
+    (decisions x measured ns/record / wall). Cost-per-record and wall
+    time slow down together under CPU contention, so the ratio is
+    stable where the raw delta is not;
+  * ns/decision on the enabled record path and ns/op on the disabled
+    fast path (`record()`, `enabled()` — the ≤2 µs tier-1 guard reads
+    these);
+  * decision completeness: of the four kinds the workload must produce
+    (admission/admit, qos/priority, engine/preempt, engine/readmit),
+    the fraction present in the ledger — 1.0 or the bench is not
+    exercising what it claims to measure.
+
+    JAX_PLATFORMS=cpu python -m benchmarks.provenance_bench \
+        --json benchmarks/provenance_sweep.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import time
+
+# the decision kinds the workload is constructed to produce; completeness
+# is |present| / |EXPECTED_KINDS| and must be 1.0 in enabled runs
+EXPECTED_KINDS = (
+    ("admission", "admit"),
+    ("qos", "priority"),
+    ("engine", "preempt"),
+    ("engine", "readmit"),
+)
+
+
+def _make_engine(starved: bool):
+    from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+
+    return MockEngine(
+        MockEngineArgs(
+            # starved: too few KV blocks for the batch -> decode growth
+            # hits OutOfBlocks -> real preempt/readmit decisions
+            num_blocks=48 if starved else 1024,
+            block_size=16,
+            max_batch=64,
+            speedup_ratio=1e6,  # sims collapse: host work only
+            decode_per_token_s=0.001,
+            preempt_backoff_ms=0.01,
+            max_preemptions=1_000_000,  # the storm guard is not under test
+        )
+    )
+
+
+async def _run_tokens(engine, requests: int, prompt: int, tokens: int):
+    from dynamo_tpu import qos
+    from dynamo_tpu.http.service import AdmissionController
+    from dynamo_tpu.pipeline.context import Context
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    adm = AdmissionController(max_inflight=requests * 2)
+    model = "bench"
+
+    async def one(i: int) -> int:
+        req = PreprocessedRequest(
+            token_ids=[(i + j) % 512 + 3 for j in range(prompt)],
+            sampling=SamplingOptions(greedy=True),
+            stop=StopConditions(max_tokens=tokens, ignore_eos=True),
+        )
+        ctx = Context()
+        # the real frontend surface: admission verdict + class stamp
+        # (both no-ops at the flag check when the ledger is disabled)
+        retry = adm.try_acquire(model, request_id=ctx.id)
+        assert retry is None, "bench watermark must never shed"
+        try:
+            qos.stamp_priority(req, ctx)
+            n = 0
+            async for out in engine.generate(req, ctx):
+                n += len(out.token_ids)
+            return n
+        finally:
+            adm.release(model)
+
+    t0 = time.monotonic()
+    counts = await asyncio.gather(*(one(i) for i in range(requests)))
+    dt = time.monotonic() - t0
+    return sum(counts), dt
+
+
+def measure_mode(
+    enabled: bool, requests: int, prompt: int, tokens: int,
+    starved: bool = False,
+) -> dict:
+    """One throughput run through the instrumented serve surfaces. The
+    A/B overhead comparison uses `starved=False` — a well-provisioned
+    engine whose wall time is deterministic host work, so the on/off
+    delta isolates the ledger tax. `starved=True` adds real preemption
+    storms (run-to-run variable by design — asyncio interleaving decides
+    who gets preempted) and exists to prove decision COMPLETENESS, not
+    to measure overhead."""
+    from dynamo_tpu.telemetry import provenance as dprov
+
+    dprov.set_enabled(enabled)
+    dprov.reset(proc="bench", ring=1 << 20)
+    try:
+        engine = _make_engine(starved=starved)
+        total, dt = asyncio.run(_run_tokens(engine, requests, prompt, tokens))
+        counts = dprov.counts()
+        present = sum(1 for k in EXPECTED_KINDS if counts.get(k, 0) > 0)
+        n_decisions = sum(counts.values())
+        return {
+            "enabled": enabled,
+            "tokens": total,
+            "seconds": round(dt, 4),
+            "tokens_per_s": round(total / dt, 1),
+            "decisions": n_decisions,
+            "ring_dropped": dprov.dropped_total(),
+            "completeness": (
+                round(present / len(EXPECTED_KINDS), 3) if enabled else None
+            ),
+        }
+    finally:
+        dprov.set_enabled(False)
+        dprov.reset()
+
+
+def measure_noop_ns(iters: int = 200_000) -> dict:
+    """ns/op of the disabled fast path's actual call surface."""
+    from dynamo_tpu.telemetry import provenance as dprov
+
+    dprov.set_enabled(False)
+    out = {}
+    for name, fn in (
+        ("record", lambda: dprov.record("router", "route", "w1")),
+        ("enabled", dprov.enabled),
+    ):
+        t0 = time.perf_counter_ns()
+        for _ in range(iters):
+            fn()
+        out[name] = round((time.perf_counter_ns() - t0) / iters, 1)
+    return out
+
+
+def measure_record_ns(iters: int = 100_000) -> float:
+    """ns/op of the ENABLED record path (ring append + counters)."""
+    from dynamo_tpu.telemetry import provenance as dprov
+
+    dprov.set_enabled(True)
+    dprov.reset(proc="bench", ring=4096)
+    try:
+        t0 = time.perf_counter_ns()
+        for i in range(iters):
+            dprov.record(
+                "router", "route", "w1", reason="overlap",
+                request_id=f"r{i & 1023}",
+            )
+        return round((time.perf_counter_ns() - t0) / iters, 1)
+    finally:
+        dprov.set_enabled(False)
+        dprov.reset()
+
+
+def main(argv=None) -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=64)
+    ap.add_argument("--prompt-tokens", type=int, default=64)
+    ap.add_argument("--max-tokens", type=int, default=64)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args(argv)
+
+    # interleave repeats and keep each mode's best (least-noisy) run
+    best = {}
+    for _ in range(args.repeats):
+        for enabled in (False, True):
+            r = measure_mode(
+                enabled, args.requests, args.prompt_tokens, args.max_tokens
+            )
+            k = "enabled" if enabled else "disabled"
+            if k not in best or r["tokens_per_s"] > best[k]["tokens_per_s"]:
+                best[k] = r
+    overhead = 1.0 - best["enabled"]["tokens_per_s"] / max(
+        1e-9, best["disabled"]["tokens_per_s"]
+    )
+    # completeness proof on the KV-starved engine: preempt/readmit must
+    # fire and be recorded alongside the admission/QoS kinds
+    starved = measure_mode(
+        True, args.requests, args.prompt_tokens, args.max_tokens,
+        starved=True,
+    )
+    record_ns = measure_record_ns()
+    derived = (
+        record_ns * best["enabled"]["decisions"]
+        / max(1e-9, best["enabled"]["seconds"] * 1e9)
+    )
+    doc = {
+        "bench": "provenance_overhead",
+        "requests": args.requests,
+        "prompt_tokens": args.prompt_tokens,
+        "max_tokens": args.max_tokens,
+        "disabled": best["disabled"],
+        "enabled": best["enabled"],
+        "enabled_overhead_frac": round(overhead, 4),
+        "derived_overhead_frac": round(derived, 5),
+        "starved_enabled": starved,
+        "completeness": starved["completeness"],
+        "record_ns_enabled": record_ns,
+        "noop_ns_per_op": measure_noop_ns(),
+    }
+    print(json.dumps(doc, indent=1))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+    return doc
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,7 @@ from dynamo_tpu.runtime.component import Component, Endpoint
 from dynamo_tpu.runtime.http_server import SystemStatusServer
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import EndpointId
+from dynamo_tpu.telemetry import provenance as dprov
 from dynamo_tpu.telemetry import slo as dslo
 from dynamo_tpu.telemetry.goodput import (
     WASTE_CAUSES,
@@ -220,6 +221,7 @@ class _FleetCollector:
         yield from self._slo_families()
         yield from planner_families(self.component.planner_status)
         yield from fleet_upgrade_families(self.component.upgrade_status)
+        yield from decision_families()
 
     def _health_families(self):
         """Tail-tolerance plane from the component's own scorer (fed by
@@ -541,6 +543,37 @@ def planner_families(status: Optional[dict]):
         "Children currently in crash-loop quarantine (slow-cadence "
         "retries; excluded from the healthy replica count)",
         value=float(sup.get("quarantined", 0) or 0),
+    )
+
+
+def decision_families():
+    """Scrape-time `dyn_llm_decisions` / ring-dropped families from this
+    process's provenance ledger (telemetry/provenance.py, ISSUE 20).
+    Shared between the metrics component, a frontend's attach_decisions,
+    and the standalone router registry — same names, same types; each
+    process exports its OWN ledger's counts (decisions are made where
+    they are recorded, so fleet totals come from summing scrapes, not
+    from merging rings). Every taxonomy (actor, kind) pair is pre-seeded
+    at 0 so rate() windows and absent-series alerts behave."""
+    dec = CounterMetricFamily(
+        f"{PREFIX}_decisions",
+        "Control-plane decisions recorded in the provenance ledger, by "
+        "deciding actor and decision kind (closed taxonomy)",
+        labels=["actor", "kind"],
+    )
+    counts = dprov.counts() if dprov.enabled() else {}
+    for actor, kinds in sorted(dprov.TAXONOMY.items()):
+        for kind in kinds:
+            dec.add_metric(
+                [actor, kind], float(counts.get((actor, kind), 0))
+            )
+    yield dec
+    yield CounterMetricFamily(
+        f"{PREFIX}_decision_ring_dropped",
+        "Decision records evicted from the bounded provenance ring "
+        "before any reader saw them (raise DYN_DECISIONS_RING if >0 "
+        "while debugging)",
+        value=float(dprov.dropped_total()),
     )
 
 

@@ -35,6 +35,7 @@ from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import MODEL_ROOT, EndpointId
 from dynamo_tpu.telemetry import health as dhealth
+from dynamo_tpu.telemetry import provenance as dprov
 from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.discovery")
@@ -225,6 +226,11 @@ class RemoteEngine:
             return _ResumedStream(stream, it, first_task)
         if not hedger.try_acquire():  # counts outcome=budget_denied
             dsp.set(hedge="budget_denied")
+            if dprov.enabled():
+                dprov.record(
+                    "remote", "hedge", None,
+                    reason="budget_denied", ctx=ctx,
+                )
             return _ResumedStream(stream, it, first_task)
         primary_wid = attempt_ctx.metadata.get("worker_instance_id")
         hx = set(exclude)
@@ -282,6 +288,22 @@ class RemoteEngine:
                 "hedge_won",
                 loser=f"{primary_wid:x}" if primary_wid is not None else None,
             )
+            if dprov.enabled():
+                dprov.record(
+                    "remote", "hedge",
+                    f"{hedge_wid:x}" if hedge_wid is not None else None,
+                    reason="won", ctx=ctx,
+                    alternatives=[
+                        {
+                            "worker": (
+                                f"{primary_wid:x}"
+                                if primary_wid is not None else None
+                            ),
+                            "outcome": "lost",
+                        }
+                    ],
+                    wasted_tokens=wasted,
+                )
             # downstream bookkeeping (failure exclusion, health
             # attribution) follows the worker actually serving the stream
             if hedge_wid is not None:
@@ -293,6 +315,22 @@ class RemoteEngine:
             await hstream.close()
         hedger.note_outcome("lost", wasted_tokens=wasted)
         dsp.set(hedge="lost")
+        if dprov.enabled():
+            dprov.record(
+                "remote", "hedge",
+                f"{primary_wid:x}" if primary_wid is not None else None,
+                reason="lost", ctx=ctx,
+                alternatives=[
+                    {
+                        "worker": (
+                            f"{hedge_wid:x}"
+                            if hedge_wid is not None else None
+                        ),
+                        "outcome": "cancelled",
+                    }
+                ],
+                wasted_tokens=wasted,
+            )
         return _ResumedStream(stream, it, first_task)
 
     async def __call__(
@@ -419,6 +457,12 @@ class RemoteEngine:
                                     # process's ring for trace assembly
                                     dtrace.ingest(out.trace)
                                     out.trace = None
+                                if out.decisions:
+                                    # same contract for decision records:
+                                    # the worker's why-ledger entries merge
+                                    # into the frontend's ledger
+                                    dprov.ingest(out.decisions)
+                                    out.decisions = None
                                 if out.token_ids:
                                     emitted.extend(out.token_ids)
                                     progressed = True
@@ -518,6 +562,14 @@ class RemoteEngine:
                 failed_worker=f"{bad:x}" if bad is not None else None,
                 emitted=len(emitted), cause=failure,
             )
+            if dprov.enabled():
+                dprov.record(
+                    "remote", "migrate",
+                    f"{bad:x}" if bad is not None else None,
+                    reason="worker_failed", ctx=ctx,
+                    emitted=len(emitted), cause=failure,
+                    attempt=failures,
+                )
             if emitted:
                 req_dict = dict(req_dict)
                 req_dict["token_ids"] = (
@@ -675,9 +727,12 @@ class ModelWatcher:
 
     async def _ensure_trace_ingest(self, namespace: str) -> None:
         """Subscribe (once per namespace) to the workers' trace-export
-        subject: the metrics-plane fallback for spans whose response
-        stream was torn down before the final frame could carry them."""
-        if not dtrace.enabled() or namespace in self._trace_subs:
+        subject: the metrics-plane fallback for spans (and decision
+        records) whose response stream was torn down before the final
+        frame could carry them."""
+        if namespace in self._trace_subs or not (
+            dtrace.enabled() or dprov.enabled()
+        ):
             return
         self._trace_subs.add(namespace)
         sub = await self.drt.namespace(namespace).subscribe_event(
@@ -690,7 +745,10 @@ class ModelWatcher:
             async for _subject, payload in sub:
                 try:
                     data = msgpack.unpackb(payload, raw=False)
-                    dtrace.ingest(data.get("trace") or [])
+                    if dtrace.enabled():
+                        dtrace.ingest(data.get("trace") or [])
+                    if dprov.enabled():
+                        dprov.ingest(data.get("decisions") or [])
                 except Exception:  # noqa: BLE001 — malformed export
                     continue
 

@@ -36,7 +36,7 @@ from dynamo_tpu.engine.jax_engine.kv_cache import (
     SequenceState,
 )
 from dynamo_tpu.engine.jax_engine.model_runner import ModelRunner
-from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.pipeline.context import Context, decisions_of
 from dynamo_tpu.protocols.common import (
     FinishReason,
     LLMEngineOutput,
@@ -44,6 +44,7 @@ from dynamo_tpu.protocols.common import (
 )
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry import profile as dprofile
+from dynamo_tpu.telemetry import provenance as dprov
 from dynamo_tpu.telemetry import trace as dtrace
 from dynamo_tpu.telemetry.goodput import (
     GoodputLedger,
@@ -1275,6 +1276,25 @@ class JaxEngine:
                     or victim.rank != rank
                 ):
                     continue
+                if dprov.enabled():
+                    dprov.record(
+                        "engine", "preempt", victim.priority,
+                        reason="class_rank",
+                        ctx=victim.ctx,
+                        proc=self.trace_proc,
+                        alternatives=[
+                            {
+                                "request": c.ctx.id,
+                                "class": c.priority,
+                                "rank": c.rank,
+                                "generated": c.num_generated,
+                            }
+                            for c in self._admit_order
+                            if c is not exclude and c.slot is not None
+                        ][:8],
+                        grower=exclude.ctx.id,
+                        grower_class=exclude.priority,
+                    )
                 self._preempt_seq(victim)
                 return True
         return False
@@ -1329,6 +1349,15 @@ class JaxEngine:
             / 1e3
             * (1 << (victim.preemptions - 1)),
         )
+        if dprov.enabled():
+            dprov.record(
+                "engine", "readmit", victim.priority,
+                reason="backoff",
+                ctx=victim.ctx,
+                proc=self.trace_proc,
+                backoff_ms=round(backoff_s * 1e3, 3),
+                preemptions=victim.preemptions,
+            )
         victim.requeue_after = time.monotonic() + backoff_s
         self._enqueue(victim)
 
@@ -1564,7 +1593,7 @@ class JaxEngine:
                 seq.cached_prefix_blocks = self.block_manager.lookup_prefix(
                     seq.prefix_hashes
                 )
-                plan = seq.ctx.metadata.get("prefix_pull")
+                plan = decisions_of(seq.ctx).pull_plan
                 if plan and plan.get("freq"):
                     # fleet heat rides the pull plan (the radix tree's
                     # recent_uses counts): feed eviction scoring so a
@@ -3163,6 +3192,15 @@ class JaxEngine:
                     # victim choice refused them all): the lower-class
                     # sequence yields ITSELF — KV spills to the host tier
                     # and it resumes via onboard when pressure clears
+                    if dprov.enabled():
+                        dprov.record(
+                            "engine", "preempt", seq.priority,
+                            reason="self_yield",
+                            ctx=seq.ctx,
+                            proc=self.trace_proc,
+                            grower=seq.ctx.id,
+                            grower_class=seq.priority,
+                        )
                     self._preempt_seq(seq)
                 else:
                     logger.error("seq %d: out of KV blocks", seq.seq_id)

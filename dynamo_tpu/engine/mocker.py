@@ -37,6 +37,7 @@ from dynamo_tpu.protocols.common import (
     LLMEngineOutput,
     PreprocessedRequest,
 )
+from dynamo_tpu.telemetry import provenance as dprov
 from dynamo_tpu.telemetry import trace as dtrace
 from dynamo_tpu.telemetry.goodput import GoodputLedger
 from dynamo_tpu.telemetry.histogram import PhaseHistograms
@@ -939,7 +940,27 @@ class MockEngine:
                 break
             if victim is not None:
                 break
-        self._preempt_seq(victim if victim is not None else seq)
+        chosen = victim if victim is not None else seq
+        if dprov.enabled():
+            dprov.record(
+                "engine", "preempt", chosen.priority,
+                reason="self_yield" if victim is None else "class_rank",
+                ctx=chosen.context,
+                proc=self.trace_proc,
+                alternatives=[
+                    {
+                        "request": c.context.id,
+                        "class": c.priority,
+                        "rank": c.rank,
+                        "generated": c.generated,
+                    }
+                    for c in self.active
+                    if c is not seq
+                ][:8],
+                grower=seq.context.id,
+                grower_class=seq.priority,
+            )
+        self._preempt_seq(chosen)
 
     def _preempt_seq(self, victim: _MockSeq) -> None:
         if victim in self.active:
@@ -978,6 +999,15 @@ class MockEngine:
             / 1e3
             * (1 << (victim.preemptions - 1)),
         )
+        if dprov.enabled():
+            dprov.record(
+                "engine", "readmit", victim.priority,
+                reason="backoff",
+                ctx=victim.context,
+                proc=self.trace_proc,
+                backoff_ms=round(backoff_s * 1e3, 3),
+                preemptions=victim.preemptions,
+            )
         victim.requeue_after = dclock.now() + backoff_s
         self._enqueue(victim)
 

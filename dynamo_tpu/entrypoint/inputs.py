@@ -27,6 +27,7 @@ from dynamo_tpu.protocols.openai import ChatCompletionRequest, ChatMessage
 from dynamo_tpu.runtime.distributed import DistributedRuntime
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.runtime.protocols import EndpointId
+from dynamo_tpu.telemetry import provenance as dprov
 from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.entrypoint")
@@ -57,7 +58,7 @@ def make_engine_handler(
 
     async def handler(request: dict, ctx: Context) -> AsyncIterator[dict]:
         pre = PreprocessedRequest.from_dict(request)
-        if not dtrace.enabled():
+        if not dtrace.enabled() and not dprov.enabled():
             async for out in engine.generate(pre, ctx):
                 d = out.to_dict()
                 if stamp is not None:
@@ -67,6 +68,7 @@ def make_engine_handler(
         label = proc_label or getattr(engine, "trace_proc", None)
         final_d: Optional[dict] = None
         shipped = False
+        shipped_dec = False
         agen = engine.generate(pre, ctx)
         try:
             with dtrace.process_scope(label), dtrace.span(
@@ -86,21 +88,37 @@ def make_engine_handler(
                 tid = dtrace.ctx_trace_id(ctx)
                 if tid:
                     final_d["trace"] = dtrace.export_for_trace(tid)
+                if dprov.enabled():
+                    # this worker's why-ledger entries ride the same final
+                    # frame so the frontend assembles one cross-process
+                    # decision timeline
+                    recs = dprov.export_for_request(ctx.id)
+                    if recs:
+                        final_d["decisions"] = recs
                 yield final_d
                 shipped = bool(final_d.get("trace"))
+                shipped_dec = bool(final_d.get("decisions"))
         finally:
             with contextlib.suppress(Exception):
                 await agen.aclose()
-            if not shipped and namespace is not None:
-                tid = dtrace.ctx_trace_id(ctx)
-                wire = dtrace.export_for_trace(tid) if tid else None
-                if wire:
+            if namespace is not None:
+                payload: dict = {}
+                if dtrace.enabled() and not shipped:
+                    tid = dtrace.ctx_trace_id(ctx)
+                    wire = dtrace.export_for_trace(tid) if tid else None
+                    if wire:
+                        payload["trace"] = wire
+                if dprov.enabled() and not shipped_dec:
+                    recs = dprov.export_for_request(ctx.id)
+                    if recs:
+                        payload["decisions"] = recs
+                if payload:
                     # stream gone (or never reached its final frame):
                     # fire-and-forget the export onto the event plane
-                    async def _publish(w=wire):
+                    async def _publish(p=payload):
                         with contextlib.suppress(Exception):
                             await namespace.publish_event(
-                                dtrace.EXPORT_SUBJECT, {"trace": w}
+                                dtrace.EXPORT_SUBJECT, p
                             )
 
                     asyncio.get_running_loop().create_task(_publish())

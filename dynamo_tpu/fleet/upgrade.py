@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import provenance as dprov
 
 logger = get_logger("dynamo_tpu.fleet.upgrade")
 
@@ -196,10 +197,19 @@ class UpgradeCoordinator:
 
     def _set_phase(self, phase: str, component: str = "") -> None:
         assert phase in PHASES, phase
+        prev = self.status.phase
         self.status.phase = phase
         if component:
             self.status.component = component
         self.phase_log.append(phase)
+        if dprov.enabled():
+            dprov.record(
+                "upgrade", "phase", phase,
+                reason=prev,  # the phase we edged out of
+                epoch=self.status.component or "fleet",
+                replaced=self.status.replaced,
+                rollbacks=self.status.rollbacks_total,
+            )
         if self.on_phase is not None:
             with contextlib.suppress(Exception):
                 self.on_phase(phase)

@@ -117,6 +117,10 @@ class ServiceMetrics:
         # process's requests only — fleet-true percentiles come from the
         # metrics component's merged per-worker histograms.
         self._phase_hist: dict[str, PhaseHistograms] = {}
+        # decision provenance plane (ISSUE 20): always attached — the
+        # ledger is process-global and the families pre-seed to zero, so
+        # there is no source object to wait for
+        self.attach_decisions()
 
     def phase_hist_for(self, model: str) -> PhaseHistograms:
         ph = self._phase_hist.get(model)
@@ -512,6 +516,29 @@ class ServiceMetrics:
             "Brownout ladder transitions (steps up + steps down)",
             lambda: controller.transitions,
         )
+
+    def attach_decisions(self) -> None:
+        """Surface this process's decision-provenance ledger (ISSUE 20)
+        on /metrics: `dyn_llm_decisions{actor,kind}` over the closed
+        taxonomy (pre-seeded to zero) and the ring-eviction counter.
+        Scrape-time reads of the process-global ledger; attach-once
+        guarded. Same family builder the metrics component and the
+        standalone router use — same names, same types; each process
+        exports only the decisions IT recorded."""
+        if getattr(self, "_decisions_attached", False):
+            return
+        self._decisions_attached = True
+
+        class _DecisionCollector:
+            def describe(self):
+                return []
+
+            def collect(self):
+                from dynamo_tpu.components.metrics import decision_families
+
+                yield from decision_families()
+
+        self.registry.register(_DecisionCollector())
 
     def attach_kv_hit_stats(self, scheduler, pull_outcomes_fn=None) -> None:
         """Surface an in-process KV router's per-decision hit accounting

@@ -47,6 +47,7 @@ from dynamo_tpu import qos
 from dynamo_tpu.runtime.logging import get_logger
 from dynamo_tpu.telemetry import brownout as dbrownout
 from dynamo_tpu.telemetry import profile as dprofile
+from dynamo_tpu.telemetry import provenance as dprov
 from dynamo_tpu.telemetry import slo as dslo
 from dynamo_tpu.telemetry import trace as dtrace
 
@@ -121,6 +122,18 @@ def _parse_class_fractions(raw: Optional[str]) -> dict[str, float]:
         except ValueError:
             continue
     return out
+
+
+def _usage_timing_block(ctx: Context) -> dict:
+    """The `usage.timing` payload for a finished request: the per-phase
+    trace breakdown plus (behind DYN_DECISIONS_USAGE=1) the request's
+    decision timeline."""
+    tb: dict = {}
+    if dtrace.enabled():
+        tb = dtrace.breakdown(dtrace.ctx_trace_id(ctx)) or {}
+    if dprov.enabled() and dprov.usage_enabled():
+        tb["decisions"] = dprov.timeline(ctx.id)
+    return tb
 
 
 def _prefix_sig(text: str) -> Optional[int]:
@@ -278,19 +291,49 @@ class AdmissionController:
             return None
         return self._prefix_heat.get((model, prefix_sig))
 
+    def _record_admission(
+        self,
+        kind: str,
+        model: str,
+        priority: str,
+        reason: str,
+        request_id: Optional[str],
+        **attrs: Any,
+    ) -> None:
+        """Provenance: the watermark math behind one admit/shed verdict."""
+        dprov.record(
+            "admission",
+            kind,
+            priority,
+            reason=reason,
+            request_id=request_id,
+            epoch=None if request_id else model,
+            model=model,
+            inflight=self._inflight.get(model, 0),
+            class_fraction=self.class_fractions.get(priority, 1.0),
+            **attrs,
+        )
+
     def try_acquire(
         self,
         model: str,
         priority: str = qos.DEFAULT_CLASS,
         prefix_sig: Optional[int] = None,
+        request_id: Optional[str] = None,
     ) -> Optional[float]:
         """None = admitted (caller must release()); else shed — the value
         is the Retry-After hint in seconds (drain-rate derived)."""
         priority = qos.normalize_priority(priority) or qos.DEFAULT_CLASS
+        prov = dprov.enabled()
         if priority in self.brownout_shed:
+            if prov:
+                self._record_admission(
+                    "shed", model, priority, "brownout", request_id,
+                )
             return self._shed_one(model, priority, "brownout", 1)
         wm = self.class_watermark(model, priority)
         cur = self._inflight.get(model, 0)
+        heat = None
         if wm is not None and priority == "bulk":
             heat = self.prefix_heat(model, prefix_sig)
             if heat is not None and heat < self.cold_prefix_heat:
@@ -301,12 +344,31 @@ class AdmissionController:
                     1, int(math.ceil(wm * self.cold_prefix_fraction))
                 )
                 if cur >= cold_wm:
+                    if prov:
+                        self._record_admission(
+                            "shed", model, priority, "cold_prefix",
+                            request_id, watermark=cold_wm,
+                            heat=round(heat, 4),
+                        )
                     return self._shed_one(
                         model, priority, "cold_prefix", cur - cold_wm + 1
                     )
         if wm is not None and cur >= wm:
+            if prov:
+                self._record_admission(
+                    "shed", model, priority, "watermark", request_id,
+                    watermark=wm,
+                )
             return self._shed_one(
                 model, priority, "watermark", cur - wm + 1
+            )
+        if prov:
+            self._record_admission(
+                "admit", model, priority,
+                "under_watermark" if wm is not None else "unbounded",
+                request_id,
+                watermark=wm,
+                heat=round(heat, 4) if heat is not None else None,
             )
         self._inflight[model] = cur + 1
         return None
@@ -530,12 +592,11 @@ class ModelExecution:
             chunk = gen.usage_chunk(
                 len(pre.token_ids), counters["completion"]
             ).model_dump(exclude_none=True)
-            if dtrace.enabled():
-                # final SSE chunk carries the per-request phase breakdown
-                # (worker spans arrived on the stream's final frame)
-                tb = dtrace.breakdown(dtrace.ctx_trace_id(ctx))
-                if tb and chunk.get("usage") is not None:
-                    chunk["usage"]["timing"] = tb
+            # final SSE chunk carries the per-request phase breakdown and
+            # decision timeline (worker records arrived on the final frame)
+            tb = _usage_timing_block(ctx)
+            if tb and chunk.get("usage") is not None:
+                chunk["usage"]["timing"] = tb
             yield Annotated.from_data(chunk)
 
     async def completion_stream(
@@ -577,10 +638,9 @@ class ModelExecution:
             chunk = gen.usage_chunk(
                 len(pre.token_ids), counters["completion"]
             ).model_dump(exclude_none=True)
-            if dtrace.enabled():
-                tb = dtrace.breakdown(dtrace.ctx_trace_id(ctx))
-                if tb and chunk.get("usage") is not None:
-                    chunk["usage"]["timing"] = tb
+            tb = _usage_timing_block(ctx)
+            if tb and chunk.get("usage") is not None:
+                chunk["usage"]["timing"] = tb
             yield Annotated.from_data(chunk)
 
 
@@ -659,6 +719,8 @@ class HttpService:
                 web.get("/debug/goodput", self._debug_goodput),
                 web.get("/debug/traces", self._debug_traces_list),
                 web.get("/debug/traces/{request_id}", self._debug_trace),
+                web.get("/debug/decisions/{request_id}", self._debug_decisions),
+                web.get("/debug/fleet", self._debug_fleet),
                 web.get("/debug/profile", self._debug_profile),
             ]
         )
@@ -686,6 +748,10 @@ class HttpService:
         # auxiliary background tasks (event subscriptions etc.) cancelled
         # on close; registered by the entrypoint wiring
         self._aux_tasks: list[asyncio.Task] = []
+        # pluggable fleet-state feeds for the merged /debug/fleet snapshot:
+        # label -> zero-arg fn returning a JSON-able blob (the entrypoint
+        # wiring registers health / planner-status / upgrade-status reads)
+        self.fleet_sources: dict[str, Callable[[], Any]] = {}
 
     # ---------------------------------------------------------- lifecycle
 
@@ -804,11 +870,30 @@ class HttpService:
         return h
 
     @staticmethod
+    def _resolve_priority_recorded(
+        request: web.Request, api_req: Any, model: str, ctx: Context
+    ) -> str:
+        """Resolve the QoS class at the edge and record which precedence
+        rung won (header > ext > env default) in the decision ledger."""
+        header = request.headers.get("x-dyn-priority")
+        ext = getattr(api_req, "ext", None)
+        ext_value = getattr(ext, "priority", None) if ext else None
+        prio = qos.resolve_priority(header, ext_value, model)
+        if dprov.enabled():
+            dprov.record(
+                "qos",
+                "priority",
+                prio,
+                reason=qos.priority_source(header, ext_value),
+                request_id=ctx.id,
+                model=model,
+            )
+        return prio
+
+    @staticmethod
     def _attach_timing(d: dict, ctx: Context) -> None:
         """Per-request timing breakdown onto a unary response's usage."""
-        if not dtrace.enabled():
-            return
-        tb = dtrace.breakdown(dtrace.ctx_trace_id(ctx))
+        tb = _usage_timing_block(ctx)
         if tb:
             usage = d.get("usage") or {}
             usage["timing"] = tb
@@ -915,6 +1000,7 @@ class HttpService:
         flight-recorder retention — keep the trace only when the request
         breached its SLO, errored / was deadline-killed, migrated across a
         worker death, or hit the 1-in-N sample (DYN_TRACE_SAMPLE)."""
+        self._finish_decisions(ctx, model=model, timer=timer)
         if not dtrace.enabled():
             return
         tid = dtrace.ctx_trace_id(ctx)
@@ -935,6 +1021,30 @@ class HttpService:
             rec.retain(tid, ctx.id, reason)
         else:
             rec.note_dropped()
+
+    def _finish_decisions(
+        self,
+        ctx: Context,
+        model: str = "",
+        timer: Optional[TokenTimer] = None,
+    ) -> None:
+        """DYN_DECISIONS=auto retention: keep a completed request's
+        decision records only under the flight-recorder rules (same
+        `dslo.retention_reason` verdict the trace plane uses)."""
+        if not (dprov.enabled() and dprov.auto()):
+            return
+        migrated = any(
+            r.actor == "remote" and r.kind == "migrate"
+            for r in dprov.records_for_request(ctx.id)
+        )
+        reason = dslo.retention_reason(
+            dslo.SloConfig.from_env(model) if model else None,
+            error_code=ctx.metadata.get("error_code"),
+            ttft_ms=getattr(timer, "ttft_ms", None),
+            max_itl_ms=getattr(timer, "max_itl_ms", None),
+            migrated=migrated,
+        )
+        dprov.maybe_retain(ctx.id, reason)
 
     def _shed(self, model: str, retry_after_s: float) -> web.Response:
         resp = self._error(
@@ -1055,19 +1165,17 @@ class HttpService:
                 501, "this model does not accept image input",
                 "not_implemented",
             )
-        prio = qos.resolve_priority(
-            request.headers.get("x-dyn-priority"),
-            chat_req.ext.priority if chat_req.ext else None,
-            chat_req.model,
+        ctx = self._request_ctx(request)
+        prio = self._resolve_priority_recorded(
+            request, chat_req, chat_req.model, ctx
         )
         sig = _chat_prefix_sig(chat_req)
         retry_after = self.admission.try_acquire(
-            chat_req.model, prio, prefix_sig=sig
+            chat_req.model, prio, prefix_sig=sig, request_id=ctx.id
         )
         if retry_after is not None:
             return self._shed(chat_req.model, retry_after)
-        ctx = self._request_ctx(request)
-        ctx.metadata["priority"] = prio
+        ctx.decisions().priority = prio
         try:
             self._arm_deadline(ctx, chat_req)
             timer = TokenTimer(self.metrics, chat_req.model)
@@ -1095,7 +1203,7 @@ class HttpService:
                 self._attach_timing(d, ctx)
                 return web.json_response(d, headers=self._resp_headers(ctx))
         finally:
-            frac = ctx.metadata.get("kv_fleet_frac")
+            frac = ctx.decisions().kv_fleet_frac
             if frac is not None:
                 self.admission.note_prefix_heat(chat_req.model, sig, frac)
             self.admission.release(chat_req.model)
@@ -1114,19 +1222,17 @@ class HttpService:
         execution = self.manager.get(comp_req.model)
         if execution is None:
             return self._error(404, f"model {comp_req.model!r} not found", "not_found_error")
-        prio = qos.resolve_priority(
-            request.headers.get("x-dyn-priority"),
-            comp_req.ext.priority if comp_req.ext else None,
-            comp_req.model,
+        ctx = self._request_ctx(request)
+        prio = self._resolve_priority_recorded(
+            request, comp_req, comp_req.model, ctx
         )
         sig = _completion_prefix_sig(comp_req)
         retry_after = self.admission.try_acquire(
-            comp_req.model, prio, prefix_sig=sig
+            comp_req.model, prio, prefix_sig=sig, request_id=ctx.id
         )
         if retry_after is not None:
             return self._shed(comp_req.model, retry_after)
-        ctx = self._request_ctx(request)
-        ctx.metadata["priority"] = prio
+        ctx.decisions().priority = prio
         try:
             self._arm_deadline(ctx, comp_req)
             timer = TokenTimer(self.metrics, comp_req.model)
@@ -1150,7 +1256,7 @@ class HttpService:
                 self._attach_timing(d, ctx)
                 return web.json_response(d, headers=self._resp_headers(ctx))
         finally:
-            frac = ctx.metadata.get("kv_fleet_frac")
+            frac = ctx.decisions().kv_fleet_frac
             if frac is not None:
                 self.admission.note_prefix_heat(comp_req.model, sig, frac)
             self.admission.release(comp_req.model)
@@ -1262,19 +1368,17 @@ class HttpService:
             return self._error(
                 404, f"model {chat_req.model!r} not found", "not_found_error"
             )
-        prio = qos.resolve_priority(
-            request.headers.get("x-dyn-priority"),
-            chat_req.ext.priority if chat_req.ext else None,
-            chat_req.model,
+        ctx = self._request_ctx(request)
+        prio = self._resolve_priority_recorded(
+            request, chat_req, chat_req.model, ctx
         )
         sig = _chat_prefix_sig(chat_req)
         retry_after = self.admission.try_acquire(
-            chat_req.model, prio, prefix_sig=sig
+            chat_req.model, prio, prefix_sig=sig, request_id=ctx.id
         )
         if retry_after is not None:
             return self._shed(chat_req.model, retry_after)
-        ctx = self._request_ctx(request)
-        ctx.metadata["priority"] = prio
+        ctx.decisions().priority = prio
         try:
             self._arm_deadline(ctx, chat_req)
             timer = TokenTimer(self.metrics, chat_req.model)
@@ -1290,7 +1394,7 @@ class HttpService:
                         agg.add(ChatCompletionChunk.model_validate(item.data))
                 chat_resp = agg.finish()
         finally:
-            frac = ctx.metadata.get("kv_fleet_frac")
+            frac = ctx.decisions().kv_fleet_frac
             if frac is not None:
                 self.admission.note_prefix_heat(chat_req.model, sig, frac)
             self.admission.release(chat_req.model)
@@ -1431,6 +1535,26 @@ class HttpService:
             }
         )
 
+    @staticmethod
+    async def _wait_assembled(probe: Callable[[], Any]) -> Any:
+        """Wait-bounded assembly (DYN_TRACE_ASSEMBLE_MS, default 250 ms):
+        spans/records that arrive only via the `trace-export` fallback
+        race the ModelWatcher's async ingest — re-poll `probe` until it
+        yields something or the budget lapses, instead of 404ing a
+        request whose evidence is milliseconds away."""
+        try:
+            budget_ms = float(
+                os.environ.get("DYN_TRACE_ASSEMBLE_MS", "250") or 250
+            )
+        except ValueError:
+            budget_ms = 250.0
+        deadline = time.monotonic() + max(0.0, budget_ms) / 1e3
+        out = probe()
+        while not out and time.monotonic() < deadline:
+            await asyncio.sleep(0.02)
+            out = probe()
+        return out
+
     async def _debug_trace(self, request: web.Request) -> web.Response:
         """Serve one request's assembled cross-process trace as Chrome
         trace-event JSON (load in Perfetto / chrome://tracing). Accepts
@@ -1440,16 +1564,118 @@ class HttpService:
                 404, "tracing is disabled (set DYN_TRACE=1)", "not_found_error"
             )
         rid = request.match_info["request_id"]
+        spans = await self._wait_assembled(
+            lambda: dtrace.spans_for_trace(dtrace.trace_for_request(rid) or rid)
+        )
         tid = dtrace.trace_for_request(rid) or rid
-        spans = dtrace.spans_for_trace(tid)
         if not spans:
-            return self._error(
-                404, f"no trace for request {rid!r}", "not_found_error"
+            if dtrace.trace_for_request(rid) is None:
+                return self._error(
+                    404, f"no trace for request {rid!r}", "not_found_error"
+                )
+            # the request is known (root was opened here) but its spans
+            # haven't landed within the assembly budget: partial, not 404
+            return web.json_response(
+                {
+                    "traceEvents": [],
+                    "displayTimeUnit": "ms",
+                    "otherData": {
+                        "trace_id": tid,
+                        "request_id": rid,
+                        "partial": True,
+                    },
+                }
             )
         doc = dtrace.chrome_trace(tid)
         doc["otherData"]["request_id"] = rid
         doc["otherData"]["breakdown"] = dtrace.breakdown(tid)
+        doc["otherData"]["partial"] = False
         return web.json_response(doc)
+
+    async def _debug_decisions(self, request: web.Request) -> web.Response:
+        """One request's cross-process decision timeline: every control-
+        plane choice (admission, QoS, routing, preemption, hedging,
+        migration, pulls) in causal order, assembled from local records
+        plus the worker records that rode the final frame / trace-export
+        fallback. Same wait-bounded path as /debug/traces."""
+        if not dprov.enabled():
+            return self._error(
+                404,
+                "decision ledger is disabled (set DYN_DECISIONS=1)",
+                "not_found_error",
+            )
+        rid = request.match_info["request_id"]
+        recs = await self._wait_assembled(
+            lambda: dprov.records_for_request(rid)
+        )
+        if not recs:
+            if dtrace.trace_for_request(rid) is None:
+                return self._error(
+                    404, f"no decisions for request {rid!r}", "not_found_error"
+                )
+            return web.json_response(
+                {"request_id": rid, "partial": True, "decisions": []}
+            )
+        return web.json_response(
+            {
+                "request_id": rid,
+                "partial": False,
+                "count": len(recs),
+                "procs": sorted({r.proc for r in recs}),
+                "decisions": dprov.timeline(rid),
+            }
+        )
+
+    async def _debug_fleet(self, request: web.Request) -> web.Response:
+        """One-stop fleet snapshot: models, admission state + prefix heat,
+        brownout rung, degraded/fence counters, recent fleet-scoped
+        decisions, and whatever fleet feeds the wiring registered
+        (health scores, planner intent/freeze, upgrade phase) — the
+        merged view that used to take five debug endpoints."""
+        from dynamo_tpu.integrity import COUNTERS as _icounters
+
+        adm = self.admission
+        models = self.manager.list_models()
+        heat = list(adm._prefix_heat.values())
+        body: dict[str, Any] = {
+            "models": models,
+            "admission": {
+                "inflight": {m: adm.inflight(m) for m in models},
+                "watermarks": {m: adm.watermark(m) for m in models},
+                "class_fractions": adm.class_fractions,
+                "shed_total": adm.shed_total,
+                "shed_by_class": dict(adm.shed_by_class),
+                "brownout_shed": sorted(adm.brownout_shed),
+                "prefix_heat": {
+                    "entries": len(heat),
+                    "mean": round(sum(heat) / len(heat), 4) if heat else None,
+                    "cold_threshold": adm.cold_prefix_heat,
+                },
+            },
+            "brownout": self.brownout.status(),
+            "slo": {
+                "local": self._local_slo_state,
+                "remote": self._remote_slo_state,
+            },
+            "integrity": _icounters.snapshot(),
+            "decisions": {
+                "enabled": dprov.enabled(),
+                "counts": {
+                    f"{a}/{k}": n for (a, k), n in sorted(
+                        dprov.counts().items()
+                    )
+                },
+                "ring_dropped": dprov.dropped_total(),
+                "fleet_recent": dprov.fleet_summary(limit=16),
+            },
+        }
+        for label, fn in self.fleet_sources.items():
+            try:
+                body[label] = fn()
+            except Exception as e:  # noqa: BLE001 — one stale feed must
+                # not take down the whole snapshot
+                body[label] = {"error": str(e)}
+        return web.json_response(body)
 
     async def _debug_profile(self, request: web.Request) -> web.Response:
         """Open an on-demand device profile window:

@@ -182,10 +182,11 @@ class KvPushRouter:
         # plan + fleet match ride Context.metadata (the same wire hop the
         # priority class crosses): the engine reads the plan, admission
         # learns prefix heat from the fleet-matched fraction
+        carrier = context.decisions()
         if result.pull_plan is not None:
-            context.metadata["prefix_pull"] = result.pull_plan
+            carrier.pull_plan = result.pull_plan
         if result.required_blocks:
-            context.metadata["kv_fleet_frac"] = round(
+            carrier.kv_fleet_frac = round(
                 result.fleet_blocks / result.required_blocks, 4
             )
         return result.worker_id, float(result.overlap_blocks)

@@ -17,6 +17,7 @@ from typing import Optional, Protocol
 from dynamo_tpu.kv_router.indexer import OverlapScores
 from dynamo_tpu.kv_router.protocols import KVHitRateEvent
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import provenance as dprov
 from dynamo_tpu.tokens import compute_seq_hash_chain
 
 logger = get_logger("dynamo_tpu.kv_router.scheduler")
@@ -294,6 +295,8 @@ class KvScheduler:
         result.pull_plan = self._plan_pull(
             result, overlap, chain, set(worker_ids), health_factors
         )
+        if dprov.enabled():
+            self._record_route(request_id, request, result, worker_ids)
         self.sequences.add_request_chain(
             result.worker_id, chain, partial, request_id
         )
@@ -311,6 +314,57 @@ class KvScheduler:
                 )
             )
         return result
+
+    def _record_route(
+        self,
+        request_id: Optional[str],
+        request: SchedulingRequest,
+        result: WorkerSelectionResult,
+        worker_ids: list[int],
+    ) -> None:
+        """Provenance: the per-candidate overlap/load/health score vector
+        behind this routing choice, plus the pull plan if one was built."""
+        cap = result.required_blocks
+        alts = [
+            {
+                "worker": w,
+                "overlap": min(int(request.overlap.scores.get(w, 0)), cap),
+                "load": int(request.potential_blocks.get(w, 0)),
+                "health": round(request.health_factors.get(w, 1.0), 4),
+            }
+            for w in sorted(worker_ids)
+        ]
+        if len(worker_ids) <= 1:
+            reason = "single_candidate"
+        elif result.overlap_blocks and (
+            result.overlap_blocks >= result.fleet_blocks
+        ):
+            reason = "overlap"
+        else:
+            reason = "load"
+        dprov.record(
+            "router",
+            "route",
+            result.worker_id,
+            reason=reason,
+            alternatives=alts,
+            request_id=request_id,
+            required_blocks=cap,
+            overlap_blocks=result.overlap_blocks,
+            fleet_blocks=result.fleet_blocks,
+        )
+        plan = result.pull_plan
+        if plan is not None:
+            dprov.record(
+                "router",
+                "prefix_pull",
+                plan["src"],
+                reason="gap_over_threshold",
+                request_id=request_id,
+                blocks=plan["blocks"],
+                gap=result.fleet_blocks - result.overlap_blocks,
+                avoid=list(plan.get("avoid") or []),
+            )
 
     def _plan_pull(
         self,

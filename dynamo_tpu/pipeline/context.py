@@ -129,3 +129,72 @@ class Context:
 
     def child(self) -> "Context":
         return Context(id=self.id, metadata=self.metadata, parent=self)
+
+    def decisions(self) -> "DecisionCarrier":
+        """Typed view over the decision metadata riding this context."""
+        return DecisionCarrier(self.metadata)
+
+
+class DecisionCarrier:
+    """Typed accessor for the per-request decision metadata that rides
+    ``Context.metadata`` across wire hops: the resolved QoS class, the
+    router's cross-worker prefix pull plan, and the fleet prefix-coverage
+    fraction. One carrier instead of three hand-rolled dict conventions;
+    the wire keys are unchanged, so headers stay compatible."""
+
+    PRIORITY = "priority"
+    PREFIX_PULL = "prefix_pull"
+    KV_FLEET_FRAC = "kv_fleet_frac"
+
+    __slots__ = ("_md",)
+
+    def __init__(self, metadata: Optional[dict[str, Any]]) -> None:
+        self._md: dict[str, Any] = metadata if metadata is not None else {}
+
+    # --- QoS class -----------------------------------------------------
+
+    @property
+    def priority(self) -> Optional[str]:
+        return self._md.get(self.PRIORITY)
+
+    @priority.setter
+    def priority(self, value: Optional[str]) -> None:
+        if value is None:
+            self._md.pop(self.PRIORITY, None)
+        else:
+            self._md[self.PRIORITY] = value
+
+    # --- router prefix pull plan ---------------------------------------
+
+    @property
+    def pull_plan(self) -> Optional[dict[str, Any]]:
+        return self._md.get(self.PREFIX_PULL)
+
+    @pull_plan.setter
+    def pull_plan(self, plan: Optional[dict[str, Any]]) -> None:
+        if plan is None:
+            self._md.pop(self.PREFIX_PULL, None)
+        else:
+            self._md[self.PREFIX_PULL] = plan
+
+    def take_pull_plan(self) -> Optional[dict[str, Any]]:
+        """Pop the pull plan (consumed exactly once, by the prefill edge)."""
+        return self._md.pop(self.PREFIX_PULL, None)
+
+    # --- fleet prefix coverage -----------------------------------------
+
+    @property
+    def kv_fleet_frac(self) -> Optional[float]:
+        return self._md.get(self.KV_FLEET_FRAC)
+
+    @kv_fleet_frac.setter
+    def kv_fleet_frac(self, frac: Optional[float]) -> None:
+        if frac is None:
+            self._md.pop(self.KV_FLEET_FRAC, None)
+        else:
+            self._md[self.KV_FLEET_FRAC] = frac
+
+
+def decisions_of(ctx: Any) -> DecisionCarrier:
+    """Carrier for any Context-like object (None-safe: detached dict)."""
+    return DecisionCarrier(getattr(ctx, "metadata", None))

@@ -84,7 +84,7 @@ class PushRouter:
                 worker_id, overlap = others[0], 0.0
                 # any prefix-pull plan was computed against the dead
                 # pick's local overlap — stale for this worker
-                ctx.metadata.pop("prefix_pull", None)
+                ctx.decisions().pull_plan = None
         ctx.metadata["kv_overlap_blocks"] = overlap
         on_complete = getattr(self.selector, "on_request_complete", None)
         try:

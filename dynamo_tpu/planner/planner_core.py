@@ -57,6 +57,7 @@ from dynamo_tpu.planner.perf_interpolation import (
     PrefillInterpolator,
 )
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import provenance as dprov
 
 logger = get_logger("dynamo_tpu.planner")
 
@@ -545,6 +546,23 @@ class Planner:
                 await self.connector.set_replicas(role, n)
             self.metrics.replicas_target[role] = n
 
+    def _note_decision(self, decision: ScaleDecision) -> None:
+        """Provenance + observer fan-out for every decide/arbitrate/freeze
+        outcome: the why-ledger gets a fleet-scoped record (frozen holds
+        map to the dedicated ``freeze`` kind) before on_decision fires."""
+        if dprov.enabled():
+            dprov.record(
+                "planner",
+                "freeze" if decision.direction == "frozen" else "scale",
+                decision.direction,
+                reason=decision.reason,
+                epoch="planner",
+                prefill=decision.prefill,
+                decode=decision.decode,
+            )
+        if self.on_decision is not None:
+            self.on_decision(decision)
+
     async def step(self) -> ScaleDecision:
         """One observe->decide->actuate cycle (the testable unit)."""
         # re-read actual replica counts from connectors that can observe
@@ -577,8 +595,7 @@ class Planner:
                 direction="hold",
             )
             self.decisions.append(decision)
-            if self.on_decision is not None:
-                self.on_decision(decision)
+            self._note_decision(decision)
             return decision
 
         # ---- layer 1: fail static
@@ -595,8 +612,7 @@ class Planner:
                 "planner frozen (%s): holding prefill=%d decode=%d",
                 frozen_why, current[PREFILL], current[DECODE],
             )
-            if self.on_decision is not None:
-                self.on_decision(decision)
+            self._note_decision(decision)
             return decision
         self.metrics.clear_frozen()
 
@@ -617,8 +633,7 @@ class Planner:
             )
             self.decisions.append(decision)
             logger.warning("planner healing %s", decision.reason)
-            if self.on_decision is not None:
-                self.on_decision(decision)
+            self._note_decision(decision)
             return decision
 
         # ---- observe + raw decide
@@ -686,8 +701,7 @@ class Planner:
             decision.prefill, decision.decode, decision.direction,
             decision.reason,
         )
-        if self.on_decision is not None:
-            self.on_decision(decision)
+        self._note_decision(decision)
         return decision
 
     # ------------------------------------------------------------- loop

@@ -136,6 +136,9 @@ class LLMEngineOutput:
     # completed telemetry spans riding the FINAL frame back to the caller
     # (worker -> frontend trace assembly; stripped before the HTTP layer)
     trace: Optional[list] = None
+    # worker-side decision records riding the FINAL frame next to `trace`
+    # (worker -> frontend provenance assembly; same lifecycle)
+    decisions: Optional[list] = None
 
     def to_dict(self) -> dict[str, Any]:
         out: dict[str, Any] = {"token_ids": self.token_ids, "index": self.index}
@@ -153,6 +156,8 @@ class LLMEngineOutput:
             out["error"] = self.error
         if self.trace is not None:
             out["trace"] = self.trace
+        if self.decisions is not None:
+            out["decisions"] = self.decisions
         return out
 
     @classmethod
@@ -168,6 +173,7 @@ class LLMEngineOutput:
             top_logprobs=d.get("top_logprobs"),
             error=d.get("error"),
             trace=d.get("trace"),
+            decisions=d.get("decisions"),
         )
 
     @classmethod

@@ -104,14 +104,25 @@ def resolve_priority(
 def priority_of(ctx: Any = None, request: Any = None) -> str:
     """Read the already-resolved class off a Context / PreprocessedRequest
     (engines call this — resolution happened at the edge)."""
+    from dynamo_tpu.pipeline.context import decisions_of
+
     p = None
     if ctx is not None:
-        p = normalize_priority((getattr(ctx, "metadata", None) or {}).get("priority"))
+        p = normalize_priority(decisions_of(ctx).priority)
     if p is None and request is not None:
         p = normalize_priority(
             (getattr(request, "extra", None) or {}).get("priority")
         )
     return p or DEFAULT_CLASS
+
+
+def priority_source(header: Any = None, ext_value: Any = None) -> str:
+    """Which precedence rung resolved the class (provenance reason slug)."""
+    if normalize_priority(header) is not None:
+        return "header"
+    if normalize_priority(ext_value) is not None:
+        return "ext"
+    return "default"
 
 
 def rank_of(priority: Optional[str]) -> int:
@@ -141,16 +152,31 @@ def stamp_priority(pre: Any, ctx: Any) -> str:
     """Mirror the Context's resolved class onto the wire request (and
     resolve from the request ext stamp / env default when the Context
     carries none). Returns the class."""
+    from dynamo_tpu.pipeline.context import decisions_of
+    from dynamo_tpu.telemetry import provenance as dprov
+
+    carrier = decisions_of(ctx) if ctx is not None else None
     p = None
-    if ctx is not None:
-        p = normalize_priority(ctx.metadata.get("priority"))
+    if carrier is not None:
+        p = normalize_priority(carrier.priority)
     if p is None:
+        ext_value = (pre.extra or {}).get("priority")
         p = resolve_priority(
-            ext_value=(pre.extra or {}).get("priority"),
+            ext_value=ext_value,
             model=getattr(pre, "model", None) or None,
         )
-        if ctx is not None:
-            ctx.metadata["priority"] = p
+        if carrier is not None:
+            carrier.priority = p
+        if dprov.enabled():
+            # resolution happened here (no edge handler stamped the ctx):
+            # record it with the precedence rung that won
+            dprov.record(
+                "qos",
+                "priority",
+                p,
+                reason=priority_source(ext_value=ext_value),
+                ctx=ctx,
+            )
     pre.extra["priority"] = p
     return p
 

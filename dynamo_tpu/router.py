@@ -28,6 +28,7 @@ from typing import Any, Optional
 
 from dynamo_tpu import qos
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import provenance as dprov
 from dynamo_tpu.telemetry import trace as dtrace
 
 logger = get_logger("dynamo_tpu.router")
@@ -133,6 +134,20 @@ def build_router_registry(scheduler, decisions_fn, shed_fn, health=None):
             yield fam
 
     registry.register(_PullCollector())
+
+    # decision provenance plane (ISSUE 20): the router's why-ledger counts
+    # (route / prefix_pull records) — same shared families the frontend
+    # and metrics component export from their own ledgers
+    from dynamo_tpu.components.metrics import decision_families
+
+    class _DecisionCollector:
+        def describe(self):
+            return []
+
+        def collect(self):
+            yield from decision_families()
+
+    registry.register(_DecisionCollector())
     CallbackCounter(
         registry,
         "dyn_llm_router_decisions_total",
@@ -331,6 +346,13 @@ class StandaloneRouter:
             out["trace"] = dtrace.export_for_trace(
                 rsp.trace_id, include_remote=False
             )
+        if dprov.enabled() and request_id:
+            # the routing decision's why-records (route + any pull plan)
+            # ship back in the reply, like the span above: the router
+            # process has no response-plane final frame of its own
+            recs = dprov.export_for_request(request_id)
+            if recs:
+                out["decisions"] = recs
         yield out
 
     async def close(self) -> None:

@@ -36,6 +36,7 @@ from typing import Any, Callable, Optional
 
 from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import provenance as dprov
 
 logger = get_logger("dynamo_tpu.brownout")
 
@@ -158,6 +159,14 @@ class BrownoutController:
             f" [{self.scope}]" if self.scope else "",
             old, LADDER[old], level, LADDER[level],
         )
+        if dprov.enabled():
+            dprov.record(
+                "brownout", "level", LADDER[level],
+                reason="step_up" if level > old else "step_down",
+                epoch=self.scope or "frontend",
+                from_level=old, to_level=level,
+                slo_state=self.last_state,
+            )
         if self.on_change is not None:
             try:
                 self.on_change(old, level, LADDER[level])

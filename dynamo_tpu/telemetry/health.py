@@ -55,6 +55,7 @@ from typing import Callable, Optional
 
 from dynamo_tpu.runtime import clock as dclock
 from dynamo_tpu.runtime.logging import get_logger
+from dynamo_tpu.telemetry import provenance as dprov
 from dynamo_tpu.telemetry.histogram import PhaseHistograms
 
 logger = get_logger("dynamo_tpu.telemetry.health")
@@ -328,6 +329,13 @@ class HealthScorer:
                     "worker %x ejected from routing: health score %.2fx "
                     "fleet median (signal=%s)", wid, v.score, cause,
                 )
+                if dprov.enabled():
+                    dprov.record(
+                        "health", "eject", f"{wid:x}",
+                        reason=cause, epoch=f"{wid:x}",
+                        score=round(v.score, 4),
+                        bad_ticks=v.bad_ticks,
+                    )
                 if self.on_eject is not None:
                     try:
                         self.on_eject(wid, cause)
@@ -346,6 +354,13 @@ class HealthScorer:
                     "worker %x re-admitted to routing (score %.2f)",
                     wid, v.score,
                 )
+                if dprov.enabled():
+                    dprov.record(
+                        "health", "restore", f"{wid:x}",
+                        reason="recovered", epoch=f"{wid:x}",
+                        score=round(v.score, 4),
+                        good_ticks=v.good_ticks,
+                    )
                 if self.on_restore is not None:
                     try:
                         self.on_restore(wid)
@@ -383,6 +398,14 @@ class HealthScorer:
             v.probe_countdown -= 1
             if v.probe_countdown <= 0:
                 v.probe_countdown = self.config.probe_every
+                if dprov.enabled():
+                    # probation trickle fired: 1-in-probe_every routing
+                    # decisions may land on the ejected worker again
+                    dprov.record(
+                        "health", "probe", f"{wid:x}",
+                        reason="trickle", epoch=f"{wid:x}",
+                        score=round(v.score, 4),
+                    )
                 continue  # probe: let this decision consider the worker
             out.add(wid)
         return out

@@ -53,6 +53,7 @@ from pathlib import Path
 from typing import Any, Callable, Optional
 
 from dynamo_tpu.runtime import clock as dclock
+from dynamo_tpu.telemetry import provenance as dprov
 from dynamo_tpu.testing import faults
 from dynamo_tpu.testing.invariants import InvariantSuite, default_suite
 
@@ -421,6 +422,11 @@ class SimResult:
     # seconds; ttft -1 = no token ever) — benchmarks slice these by
     # rollout window to prove TTFT held through the upgrade
     request_log: list = field(default_factory=list)
+    # digest over the provenance ledger's stable lines (ISSUE 20):
+    # timestamp-free, so a pinned (seed, config) must reproduce it
+    # bit-identically — control-plane DECISIONS are part of the
+    # determinism contract, not just the emitted tokens
+    decision_digest: str = ""
 
     @property
     def sim_min_per_wall_s(self) -> float:
@@ -1030,7 +1036,10 @@ class SimFleet:
             stop=StopConditions(max_tokens=len(track.expected)),
         )
         req.extra["priority"] = track.priority
-        ctx = Context()
+        # deterministic request identity: decision records key on ctx.id,
+        # so a uuid here would leak run-local randomness into the
+        # otherwise bit-identical decision_digest
+        ctx = Context(id=track.rid)
         track.t_start = dclock.now()
         try:
             async for out in self.remote(req, ctx):
@@ -1278,6 +1287,9 @@ def run_sim(cfg: SimConfig) -> SimResult:
     # pin library-level jitter (migration backoff, random routing): ONE
     # seed pins the whole run
     random.seed(cfg.seed)
+    # empty the process-global provenance ledger so the decision digest
+    # covers exactly this run (and a prior run can't leak records in)
+    dprov.reset(proc="sim", ring=65536)
     suite = default_suite(
         stall_limit_s=cfg.stall_limit_s, fence_grace_s=cfg.fence_grace_s
     )
@@ -1318,6 +1330,8 @@ def run_sim(cfg: SimConfig) -> SimResult:
                 os.environ["DYN_HEDGE"] = prev_hedge
     sim_seconds = sim_clock.now() - t_start
     violations = [v.to_json() for v in suite.found]
+    decision_digest = dprov.digest()
+    dprov.reset()  # back to env defaults for whatever runs next
     return SimResult(
         ok=not violations,
         seed=cfg.seed,
@@ -1342,6 +1356,7 @@ def run_sim(cfg: SimConfig) -> SimResult:
             ]
             for t in fleet._tracks
         ],
+        decision_digest=decision_digest,
     )
 
 
@@ -1568,6 +1583,7 @@ def bank_artifact(
                 "config": result.config,
                 "violations": result.violations,
                 "digest": result.digest,
+                "decision_digest": result.decision_digest,
                 "sim_seconds": result.sim_seconds,
             },
             indent=2,

@@ -100,6 +100,12 @@ INTENTIONALLY_SHARED = {
     "dyn_llm_compile_seconds",
     "dyn_llm_mfu_achieved",
     "dyn_llm_hbm_bytes_per_token_achieved",
+    # decision provenance plane (ISSUE 20): every control-plane process
+    # (frontend, metrics component, standalone router) exports its OWN
+    # ledger's decision counts — decisions are made where they are
+    # recorded, fleet totals come from summing scrapes
+    "dyn_llm_decisions",
+    "dyn_llm_decision_ring_dropped",
 }
 
 UNIT_SUFFIXES = ("_seconds", "_bytes", "_ms", "_ratio")
@@ -476,6 +482,33 @@ def test_meshed_decode_families_present_with_correct_types():
         assert fam is not None and fam.type == "gauge", name
     for role in ("frontend", "router"):
         assert "dyn_llm_tp_collective_bytes_per_step" not in by_role[role], role
+
+
+def test_decision_families_present_with_correct_types():
+    """ISSUE 20: the decision-provenance families must exist with counter
+    semantics on every control-plane role (frontend, metrics component,
+    standalone router), and the decisions family must pre-seed EVERY
+    (actor, kind) pair of the closed taxonomy as stable zero-valued
+    series — dashboards must not see label churn on first decision."""
+    from dynamo_tpu.telemetry.provenance import TAXONOMY
+
+    regs = _all_registries()
+    by_role = {
+        role: {f.name: f for f in _families(reg)}
+        for role, reg in regs.items()
+    }
+    for role in ("frontend", "component", "router"):
+        for name in ("dyn_llm_decisions", "dyn_llm_decision_ring_dropped"):
+            fam = by_role[role].get(name)
+            assert fam is not None and fam.type == "counter", (role, name)
+        fam = by_role[role]["dyn_llm_decisions"]
+        pairs = {
+            (s.labels.get("actor"), s.labels.get("kind"))
+            for s in fam.samples
+        }
+        for actor, kinds in TAXONOMY.items():
+            for kind in kinds:
+                assert (actor, kind) in pairs, (role, actor, kind)
 
 
 def test_every_family_has_help_text():

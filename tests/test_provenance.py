@@ -1,0 +1,526 @@
+"""Decision provenance plane (ISSUE 20): the always-on "why ledger" for
+every control-plane action.
+
+Gold checks:
+
+  * one request through a KV-routed HTTP fleet — shed by brownout, retried,
+    admitted, routed, then preempted and re-admitted on a starved worker —
+    yields ONE causal timeline on ``/debug/decisions/{rid}`` with >= 6
+    typed records spanning >= 2 logical processes, and the token stream is
+    byte-identical to the same scenario with the ledger disabled;
+  * the per-process ring stays bounded under decision churn and counts its
+    evictions;
+  * DYN_DECISIONS=0 keeps ``record()`` / ``enabled()`` under 2 µs/op (the
+    one-flag no-op contract);
+  * records survive the wire (`to_dict`/`from_dict`), ingest dedupes by
+    rec_id, and ledger merge is associative — order of assembly cannot
+    change the evidence;
+  * a pinned-seed chaos sim produces a BIT-IDENTICAL ``decision_digest``
+    on replay, and the digest rides the banked failure artifact;
+  * ``/debug/traces`` and ``/debug/decisions`` assembly is wait-bounded
+    (DYN_TRACE_ASSEMBLE_MS): evidence that has not landed yet yields a
+    ``partial`` response, never a hang and never a premature 404 for a
+    known request.
+"""
+
+import asyncio
+import hashlib
+import json
+import os
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.discovery import register_llm
+from dynamo_tpu.engine.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.entrypoint.inputs import (
+    EngineConfig,
+    make_engine_handler,
+    run_http,
+)
+from dynamo_tpu.pipeline.context import Context
+from dynamo_tpu.pipeline.router import RouterMode
+from dynamo_tpu.runtime.distributed import DistributedRuntime
+from dynamo_tpu.telemetry import provenance as dprov
+from dynamo_tpu.telemetry import trace as dtrace
+
+from tests.util import make_test_mdc
+
+BS = 4
+
+EMPTY_DIGEST = hashlib.sha256().hexdigest()
+
+
+@pytest.fixture
+def prov():
+    """Ledger ON with a fresh ring; always restored to the env default."""
+    dprov.set_enabled(True)
+    dprov.reset(proc="frontend")
+    yield
+    dprov.set_mode(os.environ.get("DYN_DECISIONS", "1"))
+    dprov.reset()
+
+
+# ------------------------------------------------------------------ core
+
+
+def test_record_fields_and_closed_taxonomy(prov):
+    rec = dprov.record(
+        "router", "route", 7, reason="overlap",
+        alternatives=[{"worker": 7, "overlap": 3}, {"worker": 9, "overlap": 0}],
+        request_id="r-1", overlap_blocks=3,
+    )
+    assert rec.actor == "router" and rec.kind == "route"
+    assert rec.chosen == 7 and rec.reason == "overlap"
+    assert rec.request_id == "r-1" and rec.proc == "frontend"
+    assert rec.attrs == {"overlap_blocks": 3}
+    assert len(rec.alternatives) == 2
+    assert rec.unix_ns > 0 and rec.t_ns > 0 and not rec.remote
+    # the vocabulary is closed: an unknown actor/kind is a programming
+    # error at the call site, not a new label quietly minted
+    with pytest.raises(ValueError):
+        dprov.record("router", "shed", 1)
+    with pytest.raises(ValueError):
+        dprov.record("scheduler", "route", 1)
+    assert dprov.counts() == {("router", "route"): 1}
+
+
+def test_ctx_supplies_request_and_trace_identity(prov):
+    ctx = Context()
+    ctx.metadata["trace"] = {"tid": "t" * 32}
+    rec = dprov.record("qos", "priority", "bulk", reason="header", ctx=ctx)
+    assert rec.request_id == ctx.id
+    assert rec.trace_id == "t" * 32
+    assert dprov.records_for_request(ctx.id) == [rec]
+
+
+def test_disabled_mode_records_nothing(prov):
+    dprov.set_enabled(False)
+    assert dprov.record("router", "route", 1) is None
+    # no validation either — the disabled path is one flag check deep
+    assert dprov.record("not-an-actor", "nope") is None
+    assert dprov.counts() == {}
+
+
+def test_ring_bounded_under_churn_counts_evictions(prov):
+    dprov.reset(proc="frontend", ring=64)
+    for i in range(200):
+        dprov.record("admission", "admit", "m", reason="under_watermark",
+                     request_id=f"r{i}")
+    led = dprov.ledger()
+    assert led.ring_len() == 64
+    assert dprov.dropped_total() == 200 - 64
+    # counters survive eviction: the metrics plane sees every decision
+    assert dprov.counts()[("admission", "admit")] == 200
+    # evicted requests are gone, recent ones remain addressable
+    assert dprov.records_for_request("r0") == []
+    assert len(dprov.records_for_request("r199")) == 1
+
+
+def test_disabled_fast_path_under_two_microseconds(prov):
+    from benchmarks.provenance_bench import measure_noop_ns
+
+    ns = measure_noop_ns(iters=50_000)
+    for name, per_op in ns.items():
+        assert per_op < 2000, f"disabled {name}() costs {per_op} ns/op"
+
+
+def test_auto_mode_flight_recorder_retention(prov):
+    dprov.set_mode("auto")
+    assert dprov.enabled() and dprov.auto()
+    for rid in ("keep-1", "drop-1"):
+        dprov.record("admission", "admit", "m", reason="under_watermark",
+                     request_id=rid)
+        dprov.record("router", "route", 3, reason="load", request_id=rid)
+    # completion verdicts: an unremarkable request's records are discarded,
+    # a remarkable one's are kept and tagged
+    dprov.maybe_retain("drop-1", None)
+    dprov.maybe_retain("keep-1", "slo_breach")
+    assert dprov.records_for_request("drop-1") == []
+    assert len(dprov.records_for_request("keep-1")) == 2
+    assert dprov.ledger().retention_of("keep-1") == "slo_breach"
+    assert dprov.ledger().discarded_total == 2
+
+
+# ------------------------------------------------------------ wire + merge
+
+
+def _mk_wire_records(n: int, proc: str, rid: str) -> list[dict]:
+    dprov.reset(proc=proc)
+    for i in range(n):
+        dprov.record("engine", "preempt", "bulk", reason="class_rank",
+                     request_id=rid, generated=i)
+    return dprov.export_for_request(rid)
+
+
+def test_wire_roundtrip_preserves_identity(prov):
+    ctx = Context()
+    rec = dprov.record(
+        "remote", "migrate", "worker-2", reason="stream_error", ctx=ctx,
+        alternatives=[{"worker": 1, "reason": "dead"}], replayed_tokens=5,
+    )
+    d = json.loads(json.dumps(rec.to_dict()))  # through the wire
+    back = dprov.DecisionRecord.from_dict(d)
+    assert back.rec_id == rec.rec_id
+    assert back.remote  # ingested records are marked foreign
+    assert back.stable_key() == rec.stable_key()
+    assert back.to_dict() == rec.to_dict() | {}
+
+
+def test_ingest_dedupes_and_merge_is_associative(prov):
+    a = _mk_wire_records(3, "frontend", "req-x")
+    b = _mk_wire_records(2, "worker-1", "req-x")
+    c = _mk_wire_records(4, "worker-2", "req-x")
+
+    # idempotent: re-ingesting the same shipment files nothing new
+    dprov.reset(proc="frontend")
+    assert dprov.ingest(a) == 3
+    assert dprov.ingest(a) == 0
+
+    # associative: (A+B)+C and A+(B+C) assemble the same record set
+    dprov.reset(proc="frontend")
+    dprov.ingest(a)
+    dprov.ingest(b)
+    dprov.ingest(c)
+    left = {r.rec_id for r in dprov.records_for_request("req-x")}
+    dprov.reset(proc="frontend")
+    dprov.ingest(b + c)
+    dprov.ingest(a)
+    right = {r.rec_id for r in dprov.records_for_request("req-x")}
+    assert left == right and len(left) == 9
+
+
+def test_timeline_orders_across_processes(prov):
+    rid = "req-t"
+    worker = _mk_wire_records(2, "worker-1", rid)
+    dprov.reset(proc="frontend")
+    dprov.record("admission", "admit", "m", reason="under_watermark",
+                 request_id=rid)
+    dprov.ingest(worker)
+    tl = dprov.timeline(rid)
+    assert [r["unix_ns"] for r in tl] == sorted(r["unix_ns"] for r in tl)
+    assert {r["proc"] for r in tl} == {"frontend", "worker-1"}
+
+
+def test_digest_is_deterministic_and_timestamp_blind(prov):
+    def run() -> str:
+        dprov.reset(proc="frontend")
+        for i in range(5):
+            dprov.record("router", "route", i % 2, reason="load",
+                         request_id=f"r{i}",
+                         alternatives=[{"worker": 0}, {"worker": 1}])
+        return dprov.digest()
+
+    d1 = run()
+    time.sleep(0.01)  # different wall/monotonic clocks, same decisions
+    d2 = run()
+    assert d1 == d2 != EMPTY_DIGEST
+    # one divergent choice flips the digest, and stable_lines names it
+    dprov.reset(proc="frontend")
+    dprov.record("router", "route", 1, reason="overlap", request_id="r0")
+    assert dprov.digest() != d1
+    (line,) = dprov.stable_lines()
+    assert line.startswith("router|route|1|overlap|r0")
+
+
+# ------------------------------------------------------------ sim digest
+
+
+def test_sim_decision_digest_bit_identical_and_banked(tmp_path):
+    from dynamo_tpu.testing.sim import bank_artifact, chaos_scenario, run_sim
+
+    dprov.set_mode("1")
+    try:
+        cfg = chaos_scenario(seed=29, sim_minutes=1.0, n_workers=2)
+        r1 = run_sim(cfg)
+        r2 = run_sim(cfg)
+        # chaos produces decisions, and the same seed reproduces them
+        # bit-for-bit (rec ids and clocks are excluded from the digest)
+        assert r1.decision_digest == r2.decision_digest != EMPTY_DIGEST
+        # the replayable failure artifact carries the decision evidence
+        path = bank_artifact(r1, out_dir=str(tmp_path))
+        banked = json.loads(path.read_text())
+        assert banked["decision_digest"] == r1.decision_digest
+    finally:
+        dprov.set_mode(os.environ.get("DYN_DECISIONS", "1"))
+        dprov.reset()
+
+
+# ---------------------------------------------------------------- HTTP e2e
+
+
+TRACKED_PROMPT = "hello world the quick brown fox jumps over"  # 8 tokens
+GROWER_PROMPT = " ".join(["one two three four five six"] * 10)  # 60 tokens
+
+
+async def _drive_fleet_scenario(collect_debug: bool):
+    """One shed->retry->admit->route->preempt->readmit pass through a
+    single-worker KV-routed HTTP fleet. Returns (tracked_text, grower_text,
+    debug payloads or None)."""
+    from dynamo_tpu.kv_router.scheduler import KvRouterConfig
+
+    rid = "prov-e2e-req"
+    front_drt = await DistributedRuntime.detached()
+    wdrt = await DistributedRuntime.detached()
+    service = None
+    try:
+        mdc = make_test_mdc("prov-e2e", kv_block_size=BS)
+        endpoint = (
+            wdrt.namespace("prov").component("mock").endpoint("generate")
+        )
+        # sizing contract: either stream FITS ALONE (tracked peaks at
+        # (8 prompt + 96 generated)/4 + 1 = 27 blocks, grower at
+        # (60 + 24)/4 + 1 = 22) but they cannot both hold KV at once, so
+        # the engine must preempt the bulk victim and re-admit it after
+        # backoff — real decisions, no mocks
+        eng = MockEngine(
+            MockEngineArgs(
+                num_blocks=28,
+                block_size=BS,
+                max_batch=8,
+                speedup_ratio=10.0,
+                decode_per_token_s=0.01,
+                preempt_backoff_ms=1.0,
+                max_preemptions=1000,
+            )
+        )
+        eng.trace_proc = "worker-1"
+        await endpoint.serve_endpoint(make_engine_handler(eng, "worker-1"))
+        await register_llm(wdrt, endpoint, mdc)
+
+        config = EngineConfig.dynamic(
+            RouterMode.KV,
+            kv_router_config=KvRouterConfig(router_temperature=0.0),
+        )
+        service = await run_http(front_drt, config, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{service.port}"
+
+        async def sse_text(resp) -> str:
+            text = []
+            async for line in resp.content:
+                line = line.decode().strip()
+                if not line.startswith("data:") or line == "data: [DONE]":
+                    continue
+                d = json.loads(line[len("data:"):])
+                for ch in d.get("choices") or []:
+                    text.append(ch.get("text") or "")
+            return "".join(text)
+
+        payload = {
+            "model": "prov-e2e",
+            "prompt": TRACKED_PROMPT,
+            "max_tokens": 96,
+            "stream": True,
+        }
+        headers = {"x-request-id": rid, "x-dyn-priority": "bulk"}
+        async with aiohttp.ClientSession() as session:
+            for _ in range(50):
+                async with session.get(f"{base}/v1/models") as resp:
+                    if (await resp.json())["data"]:
+                        break
+                await asyncio.sleep(0.1)
+
+            # 1) brownout sheds the bulk request at the front door
+            service.admission.brownout_shed = frozenset({"bulk"})
+            async with session.post(
+                f"{base}/v1/completions", json=payload, headers=headers
+            ) as resp:
+                assert resp.status == 429, await resp.text()
+            service.admission.brownout_shed = frozenset()
+
+            # 2) the client retries with the SAME request id: admitted,
+            #    routed, and decoded — with an interactive grower arriving
+            #    mid-stream to force the preemption
+            async def grower() -> str:
+                async with session.post(
+                    f"{base}/v1/completions",
+                    json={
+                        "model": "prov-e2e",
+                        "prompt": GROWER_PROMPT,
+                        "max_tokens": 24,
+                        "stream": True,
+                    },
+                    headers={"x-dyn-priority": "interactive"},
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+                    return await sse_text(resp)
+
+            async with session.post(
+                f"{base}/v1/completions", json=payload, headers=headers
+            ) as resp:
+                assert resp.status == 200, await resp.text()
+                # let the tracked stream establish itself on the worker
+                # before the grower lands
+                first = await resp.content.readline()
+                assert first
+                gtask = asyncio.create_task(grower())
+                tracked_text = (
+                    first.decode() + (await resp.content.read()).decode()
+                )
+                tracked_text = "".join(
+                    "".join(
+                        ch.get("text") or ""
+                        for ch in json.loads(line[len("data:"):]).get(
+                            "choices"
+                        ) or []
+                    )
+                    for line in (
+                        ln.strip() for ln in tracked_text.splitlines()
+                    )
+                    if line.startswith("data:") and line != "data: [DONE]"
+                )
+                grower_text = await gtask
+
+            debug = None
+            if collect_debug:
+                async with session.get(
+                    f"{base}/debug/decisions/{rid}"
+                ) as resp:
+                    assert resp.status == 200, await resp.text()
+                    timeline = await resp.json()
+                async with session.get(f"{base}/debug/fleet") as resp:
+                    assert resp.status == 200, await resp.text()
+                    fleet = await resp.json()
+                debug = (timeline, fleet)
+            else:
+                async with session.get(
+                    f"{base}/debug/decisions/{rid}"
+                ) as resp:
+                    assert resp.status == 404  # ledger off -> no endpoint
+        return tracked_text, grower_text, debug
+    finally:
+        if service:
+            await service.close()
+        await front_drt.close()
+        await wdrt.close()
+
+
+@pytest.mark.asyncio
+async def test_e2e_timeline_six_records_two_procs_token_identical(prov):
+    tracked_on, grower_on, (timeline, fleet) = await _drive_fleet_scenario(
+        collect_debug=True
+    )
+
+    assert timeline["request_id"] == "prov-e2e-req"
+    assert timeline["partial"] is False
+    recs = timeline["decisions"]
+    assert timeline["count"] == len(recs) >= 6
+    # >= 2 logical processes: the frontend's records plus the worker's
+    # preempt/readmit records that rode the final frame home
+    assert len(timeline["procs"]) >= 2
+    assert {"frontend", "worker-1"} <= set(timeline["procs"])
+
+    kinds = [(r["actor"], r["kind"]) for r in recs]
+    for k in kinds:
+        assert k[1] in dprov.TAXONOMY[k[0]]
+    for expected in (
+        ("admission", "shed"),     # attempt 1: brownout refusal, explained
+        ("admission", "admit"),    # attempt 2, same request id
+        ("qos", "priority"),
+        ("router", "route"),
+        ("engine", "preempt"),     # worker-side, starved cache
+        ("engine", "readmit"),
+    ):
+        assert expected in kinds, (expected, kinds)
+
+    # causal order: the server sorts by the cross-process unix anchor
+    stamps = [(r["unix_ns"], r["t_ns"]) for r in recs]
+    assert stamps == sorted(stamps)
+    assert kinds.index(("admission", "shed")) < kinds.index(
+        ("admission", "admit")
+    ) < kinds.index(("engine", "preempt")) < kinds.index(
+        ("engine", "readmit")
+    )
+    shed = next(r for r in recs if r["kind"] == "shed")
+    assert shed["reason"] == "brownout" and shed["chosen"] == "bulk"
+    preempt = next(r for r in recs if r["kind"] == "preempt")
+    assert preempt["proc"] == "worker-1"
+    assert preempt["chosen"] == "bulk"  # the bulk victim, never interactive
+    route = next(r for r in recs if r["kind"] == "route")
+    assert route["reason"] == "single_candidate"
+
+    # the fleet snapshot aggregates the same ledger
+    dec = fleet["decisions"]
+    assert dec["enabled"] is True
+    assert dec["counts"].get("engine/preempt", 0) >= 1
+    assert dec["counts"].get("admission/shed", 0) >= 1
+    assert "brownout" in fleet and "admission" in fleet
+
+    # observability must not bend the data plane: the identical scenario
+    # with the ledger disabled streams byte-identical tokens
+    dprov.set_enabled(False)
+    tracked_off, grower_off, _ = await _drive_fleet_scenario(
+        collect_debug=False
+    )
+    assert tracked_on == tracked_off and tracked_on
+    assert grower_on == grower_off and grower_on
+
+
+# ------------------------------------------------- wait-bounded assembly
+
+
+@pytest.mark.asyncio
+async def test_debug_assembly_wait_bounded_not_404(prov, monkeypatch):
+    """Regression (ISSUE 20 satellite): a request whose worker evidence has
+    not landed yet must get a bounded wait and a ``partial`` answer — not a
+    hang, and not a 404 that makes the operator think the id is wrong."""
+    monkeypatch.setenv("DYN_TRACE_ASSEMBLE_MS", "80")
+    dtrace.set_enabled(True)
+    dtrace.reset(proc="frontend", ring=16)
+    front_drt = await DistributedRuntime.detached()
+    service = None
+    try:
+        engine = MockEngine(MockEngineArgs(speedup_ratio=1000.0))
+        config = EngineConfig.static_(engine, make_test_mdc("wb"))
+        service = await run_http(
+            front_drt, config, host="127.0.0.1", port=0
+        )
+        base = f"http://127.0.0.1:{service.port}"
+
+        # a known request: root span opened here, but its spans evicted
+        # from the bounded ring before assembly (the trace-export race)
+        ctx = Context(id="known-rid")
+        with dtrace.root_span("http_request", ctx, request_id=ctx.id):
+            pass
+        filler = Context()
+        with dtrace.root_span("filler", filler, request_id=filler.id) as r:
+            for _ in range(40):
+                with dtrace.span("spin", ctx=filler):
+                    pass
+        assert dtrace.trace_for_request("known-rid") is not None
+
+        async with aiohttp.ClientSession() as session:
+            t0 = time.monotonic()
+            async with session.get(f"{base}/debug/traces/known-rid") as resp:
+                waited = time.monotonic() - t0
+                assert resp.status == 200
+                doc = await resp.json()
+            assert doc["otherData"]["partial"] is True
+            assert doc["traceEvents"] == []
+            # it polled to the DYN_TRACE_ASSEMBLE_MS budget, then answered
+            assert 0.08 <= waited < 3.0
+
+            # same contract on the decisions plane: the request is known
+            # (trace root exists) but no decision records have landed
+            t0 = time.monotonic()
+            async with session.get(
+                f"{base}/debug/decisions/known-rid"
+            ) as resp:
+                waited = time.monotonic() - t0
+                assert resp.status == 200
+                body = await resp.json()
+            assert body["partial"] is True and body["decisions"] == []
+            assert waited < 3.0
+
+            # a request NOBODY has heard of is still a crisp 404
+            async with session.get(
+                f"{base}/debug/decisions/never-seen"
+            ) as resp:
+                assert resp.status == 404
+    finally:
+        if service:
+            await service.close()
+        await front_drt.close()
+        dtrace.set_enabled(False)
+        dtrace.reset()
